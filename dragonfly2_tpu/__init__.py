@@ -1,0 +1,19 @@
+"""dragonfly2_tpu — a TPU-pod-native P2P distribution fabric.
+
+A brand-new implementation of the capabilities of Dragonfly2 (CNCF's P2P
+file-distribution / image-acceleration system), designed idiomatically for
+TPU pods and JAX/XLA rather than ported:
+
+- ``manager``   — global control plane of record (clusters, configs, jobs).
+- ``scheduler`` — per-cluster brain: peer/task/host state machines and
+  ICI/DCN-topology-aware parent selection.
+- ``daemon``    — per-host data plane: piece engine, storage, upload server,
+  proxy, object-storage gateway, HBM sink.
+- ``trainer``   — JAX bandwidth-predictor (MLP + GNN) trained on TPU and
+  served back into scheduling decisions.
+- ``tools``     — dfget / dfcache / dfstore CLIs.
+
+Reference surface: aobt/Dragonfly2 (see SURVEY.md for the file:line map).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+"""Shared primitives: IDs, piece math, errors, units, rate limiting, DAG,
+TTL cache, GC runner, logging, metrics, dynconfig."""

@@ -1,0 +1,93 @@
+"""Digest parsing and verification.
+
+Role parity: reference ``pkg/digest`` — "algo:hex" strings, verifying readers,
+and per-piece hash checks. The hot path (hashing 4-16 MiB pieces) dispatches
+to the C++ native library when built (``native/libdfnative.so``), falling back
+to hashlib.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterator
+
+SUPPORTED = ("sha256", "sha512", "sha1", "md5", "crc32c", "blake2b")
+
+
+_HEX_LEN = {"sha256": 64, "sha512": 128, "sha1": 40, "md5": 32, "crc32c": 8,
+            "blake2b": 64}
+_HEX_CHARS = set("0123456789abcdef")
+
+
+def parse(digest: str) -> tuple[str, str]:
+    """Split "sha256:abcd..." into (algo, hexvalue); validates algo + hex + length."""
+    algo, sep, value = digest.partition(":")
+    if not sep or not value:
+        raise ValueError(f"invalid digest {digest!r}; want 'algo:hex'")
+    algo = algo.lower()
+    if algo not in SUPPORTED:
+        raise ValueError(f"unsupported digest algorithm {algo!r}")
+    value = value.lower()
+    if len(value) != _HEX_LEN[algo] or not set(value) <= _HEX_CHARS:
+        raise ValueError(f"invalid {algo} digest value {value!r}")
+    return algo, value
+
+
+def hash_bytes(algo: str, data: bytes | memoryview) -> str:
+    """Hex digest of ``data`` under ``algo`` (native-accelerated when available)."""
+    from ..storage import native  # local import: avoid cycle at package init
+    out = native.hash_bytes(algo, data)
+    if out is not None:
+        return out
+    if algo == "crc32c":
+        return f"{_crc32c_py(bytes(data)):08x}"
+    if algo == "blake2b":
+        return hashlib.blake2b(data, digest_size=32).hexdigest()
+    return hashlib.new(algo, data).hexdigest()
+
+
+def hash_stream(algo: str, chunks: Iterator[bytes]) -> str:
+    if algo == "crc32c":
+        acc = 0
+        for c in chunks:
+            acc = _crc32c_py(c, acc)
+        return f"{acc:08x}"
+    if algo == "blake2b":
+        h = hashlib.blake2b(digest_size=32)
+    else:
+        h = hashlib.new(algo)
+    for c in chunks:
+        h.update(c)
+    return h.hexdigest()
+
+
+def verify(digest: str, data: bytes | memoryview) -> bool:
+    algo, want = parse(digest)
+    return hash_bytes(algo, data) == want
+
+
+def for_bytes(algo: str, data: bytes | memoryview) -> str:
+    return f"{algo}:{hash_bytes(algo, data)}"
+
+
+# -- pure-python crc32c (Castagnoli), fallback when native lib is absent -----
+
+_CRC32C_POLY = 0x82F63B78
+_crc32c_table: list[int] | None = None
+
+
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    global _crc32c_table
+    if _crc32c_table is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+            tbl.append(c)
+        _crc32c_table = tbl
+    c = crc ^ 0xFFFFFFFF
+    tbl = _crc32c_table
+    for b in data:
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF

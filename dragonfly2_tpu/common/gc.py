@@ -1,0 +1,71 @@
+"""Interval-driven GC runner: named tasks swept on their own periods.
+
+Role parity: reference ``pkg/gc`` (``gc.go:28-130``) and
+``client/daemon/gc`` — storage managers and the scheduler's resource
+managers register sweepers here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+log = logging.getLogger("df.gc")
+
+
+@dataclass
+class GCTask:
+    id: str
+    interval: float
+    run: Callable[[], Awaitable[int] | int]  # returns number reclaimed
+
+
+class GC:
+    def __init__(self) -> None:
+        self._tasks: dict[str, GCTask] = {}
+        self._runners: list[asyncio.Task] = []
+        self._stopped = asyncio.Event()
+
+    def add(self, task: GCTask) -> None:
+        if task.id in self._tasks:
+            raise ValueError(f"gc task exists: {task.id}")
+        self._tasks[task.id] = task
+
+    async def run_one(self, task_id: str) -> int:
+        task = self._tasks[task_id]
+        out = task.run()
+        if asyncio.iscoroutine(out):
+            out = await out
+        return int(out or 0)
+
+    async def _loop(self, task: GCTask) -> None:
+        while not self._stopped.is_set():
+            try:
+                await asyncio.wait_for(self._stopped.wait(), timeout=task.interval)
+                return
+            except asyncio.TimeoutError:
+                pass
+            try:
+                n = await self.run_one(task.id)
+                if n:
+                    log.debug("gc %s reclaimed %d", task.id, n)
+            except Exception:
+                log.exception("gc task %s failed", task.id)
+
+    def start(self) -> None:
+        self._stopped.clear()
+        for task in self._tasks.values():
+            self._runners.append(asyncio.get_running_loop().create_task(self._loop(task)))
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        for r in self._runners:
+            r.cancel()
+        for r in self._runners:
+            try:
+                await r
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._runners.clear()

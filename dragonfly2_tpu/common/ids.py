@@ -1,0 +1,71 @@
+"""Content-addressed identifiers for tasks, peers, and hosts.
+
+Role parity: reference ``pkg/idgen`` (``task_id.go:37-93``, ``peer_id.go``,
+``host_id.go``). A *task* is identified by what it fetches — sha256 over the
+normalized URL plus the download-relevant metadata (filtered query params,
+digest, tag, application, range) — so any peer asking for the same bytes maps
+to the same task id and can join the same P2P swarm.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+import uuid
+from urllib.parse import urlsplit, urlunsplit, parse_qsl, urlencode
+
+
+def _filtered_url(url: str, filtered_query_params: list[str] | None) -> str:
+    """Normalize a URL, dropping query params that don't change the content
+    (e.g. signatures, expiry timestamps on presigned URLs)."""
+    parts = urlsplit(url)
+    query = parse_qsl(parts.query, keep_blank_values=True)
+    if filtered_query_params:
+        drop = {p.lower() for p in filtered_query_params}
+        query = [(k, v) for k, v in query if k.lower() not in drop]
+    query.sort()
+    return urlunsplit((parts.scheme.lower(), parts.netloc, parts.path,
+                       urlencode(query), ""))
+
+
+def task_id(url: str, *, tag: str = "", application: str = "",
+            digest: str = "", piece_range: str = "",
+            filtered_query_params: list[str] | None = None) -> str:
+    """Content-addressed task id (hex sha256)."""
+    h = hashlib.sha256()
+    h.update(_filtered_url(url, filtered_query_params).encode())
+    for part in (tag, application, digest, piece_range):
+        h.update(b"\x00")
+        h.update(part.encode())
+    return h.hexdigest()
+
+
+def parent_task_id(url: str, *, tag: str = "", application: str = "",
+                   digest: str = "",
+                   filtered_query_params: list[str] | None = None) -> str:
+    """Task id of the whole-file parent of a ranged sub-task (range dropped).
+
+    Ranged requests store into a sub-task that shares the parent task's file
+    (reference ``storage/local_storage_subtask.go``): the parent id is the key
+    both sides agree on.
+    """
+    return task_id(url, tag=tag, application=application, digest=digest,
+                   filtered_query_params=filtered_query_params)
+
+
+def peer_id(hostname: str, ip: str, *, seed: bool = False) -> str:
+    """Unique-per-process peer id: host identity + random suffix."""
+    kind = "seed" if seed else "peer"
+    return f"{ip}-{hostname}-{uuid.uuid4().hex[:16]}-{kind}"
+
+
+def host_id(hostname: str, ip: str, port: int = 0) -> str:
+    """Stable host id. One daemon process == one host."""
+    if port:
+        return f"{hostname}-{ip}-{port}"
+    return f"{hostname}-{ip}"
+
+
+def must_new_id() -> str:
+    """Opaque unique id (jobs, streams)."""
+    return f"{int(time.time() * 1000):x}-{uuid.uuid4().hex[:12]}"

@@ -1,0 +1,77 @@
+"""Structured logging setup: per-concern loggers with optional rotating files.
+
+Role parity: reference ``internal/dflog`` (zap cores per concern — core, grpc,
+gc, gin — with rotation and context loggers). We use stdlib logging with a
+key=value formatter; ``with_fields`` returns a LoggerAdapter carrying task/peer
+context the way ``SugaredLoggerOnWith`` does.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+import sys
+from typing import Any
+
+CONCERNS = ("core", "rpc", "gc", "http", "storage", "sched")
+
+
+class KVFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        fields = getattr(record, "df_fields", None)
+        if fields:
+            kv = " ".join(f"{k}={v}" for k, v in fields.items())
+            return f"{base} {kv}"
+        return base
+
+
+class ContextLogger(logging.LoggerAdapter):
+    def process(self, msg: str, kwargs: dict[str, Any]):
+        extra = kwargs.setdefault("extra", {})
+        merged = dict(self.extra or {})
+        merged.update(extra.get("df_fields", {}))
+        extra["df_fields"] = merged
+        return msg, kwargs
+
+    def with_fields(self, **fields: Any) -> "ContextLogger":
+        merged = dict(self.extra or {})
+        merged.update(fields)
+        return ContextLogger(self.logger, merged)
+
+
+def with_fields(name: str, **fields: Any) -> ContextLogger:
+    return ContextLogger(logging.getLogger(name), fields)
+
+
+_configured = False
+
+
+def setup(level: str = "INFO", log_dir: str | None = None, console: bool = True,
+          max_bytes: int = 50 * 1024 * 1024, backups: int = 3) -> None:
+    """Configure the ``df`` logger tree. Idempotent."""
+    global _configured
+    root = logging.getLogger("df")
+    if _configured:
+        root.setLevel(level.upper())
+        return
+    _configured = True
+    root.setLevel(level.upper())
+    root.propagate = False
+    fmt = KVFormatter("%(asctime)s %(levelname).1s %(name)s %(message)s")
+    if console:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(fmt)
+        root.addHandler(h)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        for concern in CONCERNS:
+            fh = logging.handlers.RotatingFileHandler(
+                os.path.join(log_dir, f"{concern}.log"),
+                maxBytes=max_bytes, backupCount=backups)
+            fh.setFormatter(fmt)
+            lg = logging.getLogger(f"df.{concern}")
+            lg.addHandler(fh)
+    if not root.handlers:
+        root.addHandler(logging.NullHandler())

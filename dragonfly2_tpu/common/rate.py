@@ -1,0 +1,75 @@
+"""Token-bucket rate limiting (async), the primitive under per-peer and
+total-rate limits and the traffic shaper.
+
+Role parity: reference ``client/util`` RateLimiter + golang.org/x/time/rate
+usages in ``piece_manager.go`` / ``traffic_shaper.go``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class TokenBucket:
+    """Classic token bucket. ``rate`` tokens/second, ``burst`` capacity.
+
+    ``rate <= 0`` means unlimited. Thread-compatible for reads; writers are
+    expected to be on one event loop (the daemon's).
+    """
+
+    def __init__(self, rate: float, burst: float | None = None):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else max(self.rate, 1.0)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+
+    def set_rate(self, rate: float, burst: float | None = None) -> None:
+        self._refill()
+        self.rate = float(rate)
+        if burst is not None:
+            self.burst = float(burst)
+        elif self.rate > 0:
+            self.burst = max(self.rate, 1.0)
+        self._tokens = min(self._tokens, self.burst)
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        if self.rate > 0:
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def try_acquire(self, n: float) -> bool:
+        if self.rate <= 0:
+            return True
+        self._refill()
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    def reserve(self, n: float) -> float:
+        """Take ``n`` tokens (going negative if needed); return seconds to wait."""
+        if self.rate <= 0:
+            return 0.0
+        self._refill()
+        self._tokens -= n
+        if self._tokens >= 0:
+            return 0.0
+        return -self._tokens / self.rate
+
+    def _unreserve(self, n: float) -> None:
+        self._refill()
+        self._tokens = min(self.burst, self._tokens + n)
+
+    async def acquire(self, n: float) -> None:
+        # Oversized requests (a 16 MiB piece against a small burst) are allowed
+        # through one at a time by paying the full wait instead of deadlocking.
+        delay = self.reserve(n)
+        if delay > 0:
+            try:
+                await asyncio.sleep(delay)
+            except asyncio.CancelledError:
+                # the bytes were never moved: hand the tokens back
+                self._unreserve(n)
+                raise

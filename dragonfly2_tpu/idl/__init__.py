@@ -1,0 +1,10 @@
+"""The wire IDL: typed dataclass messages + msgpack codec.
+
+The reference keeps its protobuf IDL in an external module (d7y.io/api) and
+wraps it in ``pkg/rpc``; here the IDL is first-class in-tree. Messages are
+frozen-ish dataclasses registered with the codec by name; the wire format is
+msgpack maps tagged with ``__t``.
+"""
+
+from .base import message, encode, decode, dumps, loads  # noqa: F401
+from . import messages  # noqa: F401  (registers all message types)

@@ -1,0 +1,120 @@
+"""Message registry + msgpack codec.
+
+``@message`` registers a dataclass under its class name; ``dumps``/``loads``
+move any registered message (with nested messages, enums, lists, optionals)
+through msgpack. Unknown fields arriving on the wire are dropped — that is the
+forward-compat rule (like protobuf's unknown-field tolerance, minus retention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import types
+import typing
+from typing import Any, Type, TypeVar
+
+_UNION_TYPES = (typing.Union, types.UnionType)
+
+import msgpack
+
+T = TypeVar("T")
+
+_REGISTRY: dict[str, type] = {}
+_HINTS: dict[type, dict[str, Any]] = {}
+
+
+def message(cls: Type[T]) -> Type[T]:
+    """Class decorator: make a dataclass a wire message."""
+    cls = dataclasses.dataclass(cls)  # type: ignore[call-overload]
+    name = cls.__name__
+    if name in _REGISTRY and _REGISTRY[name] is not cls:
+        raise ValueError(f"duplicate message name {name}")
+    _REGISTRY[name] = cls
+    return cls
+
+
+def _hints(cls: type) -> dict[str, Any]:
+    h = _HINTS.get(cls)
+    if h is None:
+        h = typing.get_type_hints(cls)
+        _HINTS[cls] = h
+    return h
+
+
+def encode(obj: Any) -> Any:
+    """Message tree -> plain msgpack-able structure."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out: dict[str, Any] = {"__t": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            out[f.name] = encode(v)
+        return out
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, (list, tuple)):
+        return [encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: encode(v) for k, v in obj.items()}
+    return obj
+
+
+def decode(data: Any, expect: Any = None) -> Any:
+    """Plain structure -> message tree. ``expect`` narrows typed coercion."""
+    if isinstance(data, dict) and "__t" in data:
+        cls = _REGISTRY.get(data["__t"])
+        if cls is None:
+            raise ValueError(f"unknown message type {data['__t']!r}")
+        hints = _hints(cls)
+        kwargs: dict[str, Any] = {}
+        names = {f.name for f in dataclasses.fields(cls)}
+        for k, v in data.items():
+            if k == "__t" or k not in names:
+                continue
+            kwargs[k] = _coerce(hints.get(k), v)
+        return cls(**kwargs)
+    if expect is not None:
+        return _coerce(expect, data)
+    if isinstance(data, list):
+        return [decode(v) for v in data]
+    if isinstance(data, dict):
+        return {k: decode(v) for k, v in data.items()}
+    return data
+
+
+def _coerce(ftype: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    if ftype is None or ftype is Any:
+        return decode(value)
+    origin = typing.get_origin(ftype)
+    if origin in _UNION_TYPES:
+        args = [a for a in typing.get_args(ftype) if a is not type(None)]
+        if len(args) == 1:
+            return _coerce(args[0], value)
+        return decode(value)
+    if isinstance(ftype, type) and issubclass(ftype, enum.Enum):
+        return ftype(value)
+    if isinstance(ftype, type) and dataclasses.is_dataclass(ftype):
+        return decode(value)
+    if origin in (list, tuple) or ftype in (list, tuple):
+        container = origin or ftype
+        elem = (typing.get_args(ftype) or (Any,))[0]
+        seq = [_coerce(elem, v) for v in value]
+        return tuple(seq) if container is tuple else seq
+    if origin is dict:
+        kt, vt = (typing.get_args(ftype) or (Any, Any))[:2]
+        return {k: _coerce(vt, v) for k, v in value.items()}
+    if ftype is float and isinstance(value, int):
+        return float(value)
+    return value
+
+
+def dumps(obj: Any) -> bytes:
+    return msgpack.packb(encode(obj), use_bin_type=True)
+
+
+def loads(raw: bytes) -> Any:
+    return decode(msgpack.unpackb(raw, raw=False, strict_map_key=False))

@@ -1,0 +1,541 @@
+"""All wire messages for the four services.
+
+Service surface parity (reference ``pkg/rpc/*`` client wrappers, SURVEY §2.6):
+scheduler (register/report/announce/probes), daemon (download/piece sync/cache
+ops/seeding), manager (entities/keepalive/dynconfig), trainer (dataset upload).
+TPU-native additions: ``TopologyInfo`` carries ICI slice coordinates so the
+scheduler can score parents by link locality, and ``DeviceSink`` describes an
+HBM placement target for a download.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from .base import message
+
+
+# ---------------------------------------------------------------- enums
+
+class SizeScope(enum.IntEnum):
+    NORMAL = 0   # many pieces, full P2P
+    SMALL = 1    # exactly one piece: skip piece sync, single parent
+    TINY = 2     # <=128 KiB: content returned inline in register result
+    EMPTY = 3    # zero bytes
+
+
+class TaskType(enum.IntEnum):
+    STANDARD = 0       # downloaded file, GC-able
+    PERSISTENT = 1     # dfcache import: pinned until deleted
+    PERSISTENT_CACHE = 2
+
+
+class Priority(enum.IntEnum):
+    LEVEL0 = 0  # highest
+    LEVEL1 = 1
+    LEVEL2 = 2
+    LEVEL3 = 3
+    LEVEL4 = 4
+    LEVEL5 = 5
+    LEVEL6 = 6  # lowest
+
+
+class HostType(enum.IntEnum):
+    NORMAL = 0       # ordinary peer
+    SUPER_SEED = 1   # seed peer, first to back-source
+    STRONG_SEED = 2
+    WEAK_SEED = 3
+
+
+class LinkType(enum.IntEnum):
+    """Locality class between two hosts, best to worst."""
+
+    LOCAL = 0  # same host
+    ICI = 1    # same TPU slice: wired inter-chip interconnect
+    DCN = 2    # same zone, data-center network between slices/hosts
+    WAN = 3    # cross-zone / unknown
+
+
+# ---------------------------------------------------------------- core types
+
+@message
+class UrlMeta:
+    """Download-relevant metadata; participates in the task id."""
+
+    digest: str = ""                 # "sha256:..." expected digest of whole file
+    tag: str = ""                    # task isolation tag
+    range: str = ""                  # "bytes=a-b" sub-range request
+    filtered_query_params: list[str] | None = None
+    header: dict | None = None       # extra origin request headers
+    application: str = ""
+    priority: Priority = Priority.LEVEL0
+
+
+@message
+class TopologyInfo:
+    """Where a host sits in the TPU pod fabric.
+
+    This replaces the reference's IDC/location strings
+    (``scheduler/scheduling/evaluator/evaluator_base.go:28-46`` scores) with
+    coordinates the evaluator can compute real link classes from.
+    """
+
+    slice_name: str = ""             # e.g. "v5p-256-slice-0"; "" = not a TPU host
+    worker_index: int = -1           # TPU VM worker number within the slice
+    ici_coords: tuple | None = None  # chip-mesh coords of this host's chips, e.g. (x, y, z)
+    num_chips: int = 0
+    zone: str = ""                   # cloud zone (DCN domain)
+    cluster_id: int = 0
+
+
+@message
+class CPUStat:
+    logical_count: int = 0
+    percent: float = 0.0
+
+
+@message
+class MemoryStat:
+    total: int = 0
+    available: int = 0
+    used_percent: float = 0.0
+
+
+@message
+class NetworkStat:
+    download_rate: int = 0       # bytes/s current
+    download_rate_limit: int = 0
+    upload_rate: int = 0
+    upload_rate_limit: int = 0
+
+
+@message
+class DiskStat:
+    total: int = 0
+    free: int = 0
+    used_percent: float = 0.0
+
+
+@message
+class Host:
+    """A daemon instance's identity + address, carried in every register."""
+
+    id: str = ""
+    ip: str = ""
+    hostname: str = ""
+    port: int = 0                  # peer gRPC port
+    download_port: int = 0         # piece upload (HTTP) port
+    type: HostType = HostType.NORMAL
+    os: str = ""
+    platform: str = ""
+    topology: TopologyInfo | None = None
+    cpu: CPUStat | None = None
+    memory: MemoryStat | None = None
+    network: NetworkStat | None = None
+    disk: DiskStat | None = None
+    concurrent_upload_limit: int = 100
+    build_version: str = ""
+
+
+@message
+class PieceInfo:
+    piece_num: int = 0
+    range_start: int = 0
+    range_size: int = 0
+    digest: str = ""               # per-piece "crc32c:..." / "md5:..."
+    download_cost_ms: int = 0      # filled by downloader when reporting
+
+
+@message
+class PiecePacket:
+    """Answer to "which pieces does peer X have" — also carries dst address."""
+
+    task_id: str = ""
+    dst_peer_id: str = ""
+    dst_addr: str = ""             # "ip:download_port" to fetch pieces from
+    piece_infos: list[PieceInfo] | None = None
+    total_piece_count: int = -1    # -1: unknown yet
+    content_length: int = -1
+    piece_size: int = 0
+    extend_attribute: dict | None = None
+
+
+@message
+class DeviceSink:
+    """TPU-native: optional terminal sink describing how verified bytes land
+    in device HBM (which mesh axis shard this host holds, dtype, etc.)."""
+
+    enabled: bool = False
+    dtype: str = "uint8"
+    shard_index: int = 0
+    shard_count: int = 1
+    donate: bool = True
+
+
+# ---------------------------------------------------------------- scheduler service
+
+@message
+class RegisterPeerTaskRequest:
+    url: str = ""
+    url_meta: UrlMeta | None = None
+    task_id: str = ""
+    peer_id: str = ""
+    peer_host: Host | None = None
+    is_migrating: bool = False
+
+
+@message
+class SinglePiece:
+    dst_peer_id: str = ""
+    dst_addr: str = ""
+    piece_info: PieceInfo | None = None
+
+
+@message
+class RegisterResult:
+    task_id: str = ""
+    size_scope: SizeScope = SizeScope.NORMAL
+    direct_content: bytes = b""           # TINY: whole file inline
+    single_piece: SinglePiece | None = None  # SMALL
+    content_length: int = -1
+    piece_size: int = 0
+
+
+@message
+class HostLoad:
+    cpu_ratio: float = 0.0
+    mem_ratio: float = 0.0
+    disk_ratio: float = 0.0
+
+
+@message
+class PieceResult:
+    """Peer -> scheduler, one per finished/failed piece (the report stream)."""
+
+    task_id: str = ""
+    src_peer_id: str = ""           # downloader
+    dst_peer_id: str = ""           # parent it fetched from ("" = back-source)
+    piece_info: PieceInfo | None = None
+    begin_ms: int = 0
+    end_ms: int = 0
+    success: bool = False
+    code: int = 0                   # errors.Code
+    host_load: HostLoad | None = None
+    finished_count: int = 0         # pieces this peer now holds
+
+
+@message
+class PeerAddr:
+    peer_id: str = ""
+    ip: str = ""
+    rpc_port: int = 0
+    download_port: int = 0
+    link: LinkType = LinkType.DCN   # scheduler-computed locality to the child
+
+
+@message
+class PeerPacket:
+    """Scheduler -> peer: current parent assignment set."""
+
+    task_id: str = ""
+    src_peer_id: str = ""
+    parallel_count: int = 4
+    main_peer: PeerAddr | None = None
+    candidate_peers: list[PeerAddr] | None = None
+    code: int = 0                   # e.g. SCHED_NEED_BACK_SOURCE
+
+
+@message
+class PeerResult:
+    """Final report when a peer's task ends."""
+
+    task_id: str = ""
+    peer_id: str = ""
+    src_ip: str = ""
+    url: str = ""
+    success: bool = False
+    traffic: int = 0                # bytes downloaded P2P
+    cost_ms: int = 0
+    code: int = 0
+    total_piece_count: int = 0
+    content_length: int = -1
+
+
+@message
+class AnnounceHostRequest:
+    host: Host | None = None
+    interval_s: float = 30.0
+
+
+@message
+class LeaveHostRequest:
+    host_id: str = ""
+
+
+@message
+class LeavePeerRequest:
+    task_id: str = ""
+    peer_id: str = ""
+
+
+@message
+class StatTaskRequest:
+    task_id: str = ""
+
+
+@message
+class TaskStat:
+    id: str = ""
+    type: TaskType = TaskType.STANDARD
+    content_length: int = -1
+    total_piece_count: int = -1
+    state: str = ""
+    peer_count: int = 0
+    has_available_peer: bool = False
+
+
+@message
+class ProbeTarget:
+    host_id: str = ""
+    ip: str = ""
+    port: int = 0
+
+
+@message
+class SyncProbesRequest:
+    """Daemon -> scheduler: either asking for targets or reporting results."""
+
+    host: Host | None = None
+    probes: list[Probe] | None = None
+    failed_host_ids: list[str] | None = None
+
+
+@message
+class Probe:
+    target_host_id: str = ""
+    rtt_us: int = 0
+    created_at_ms: int = 0
+
+
+@message
+class SyncProbesResponse:
+    targets: list[ProbeTarget] | None = None
+    probe_interval_s: float = 20.0
+
+
+# ---------------------------------------------------------------- daemon service
+
+@message
+class DownloadRequest:
+    url: str = ""
+    output: str = ""                # abs path; "" = stream/cache only
+    url_meta: UrlMeta | None = None
+    timeout_s: float = 0.0
+    rate_limit_bps: int = 0
+    disable_back_source: bool = False
+    recursive: bool = False
+    recursive_concurrency: int = 8
+    keep_original_offset: bool = False
+    device_sink: DeviceSink | None = None
+    task_type: TaskType = TaskType.STANDARD
+
+
+@message
+class DownloadResponse:
+    task_id: str = ""
+    peer_id: str = ""
+    completed_length: int = 0
+    content_length: int = -1
+    done: bool = False
+    output: str = ""                # echo of where this entry landed (recursive)
+    code: int = 0
+    message: str = ""
+
+
+@message
+class PieceTaskRequest:
+    task_id: str = ""
+    src_peer_id: str = ""           # requester
+    dst_peer_id: str = ""           # owner being asked
+    start_num: int = 0
+    limit: int = 32
+
+
+@message
+class StatTaskDaemonRequest:
+    url: str = ""
+    url_meta: UrlMeta | None = None
+    task_id: str = ""
+    local_only: bool = False
+
+
+@message
+class ImportTaskRequest:
+    path: str = ""
+    url: str = ""                   # cache key url (d7y cache scheme)
+    url_meta: UrlMeta | None = None
+    task_type: TaskType = TaskType.PERSISTENT
+
+
+@message
+class ExportTaskRequest:
+    url: str = ""
+    output: str = ""
+    url_meta: UrlMeta | None = None
+    timeout_s: float = 0.0
+    local_only: bool = False
+
+
+@message
+class DeleteTaskRequest:
+    url: str = ""
+    url_meta: UrlMeta | None = None
+    task_id: str = ""
+
+
+@message
+class ObtainSeedsRequest:
+    url: str = ""
+    url_meta: UrlMeta | None = None
+    task_id: str = ""
+
+
+@message
+class PieceSeed:
+    peer_id: str = ""
+    host_id: str = ""
+    piece_info: PieceInfo | None = None
+    done: bool = False
+    content_length: int = -1
+    total_piece_count: int = -1
+
+
+@message
+class Empty:
+    pass
+
+
+# ---------------------------------------------------------------- manager service
+
+@message
+class SchedulerEntity:
+    id: int = 0
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    state: str = "inactive"         # active | inactive
+    scheduler_cluster_id: int = 0
+    features: list[str] | None = None
+    topology: TopologyInfo | None = None
+
+
+@message
+class SeedPeerEntity:
+    id: int = 0
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    download_port: int = 0
+    object_storage_port: int = 0
+    type: str = "super"
+    state: str = "inactive"
+    seed_peer_cluster_id: int = 0
+    topology: TopologyInfo | None = None
+
+
+@message
+class ClusterConfig:
+    """Scheduler-cluster tunables served via dynconfig."""
+
+    candidate_parent_limit: int = 4
+    filter_parent_limit: int = 15
+    job_rate_limit: int = 10
+    seed_peer_load_limit: int = 300
+    peer_load_limit: int = 50
+    piece_parallel_count: int = 4
+
+
+@message
+class GetSchedulersRequest:
+    hostname: str = ""
+    ip: str = ""
+    topology: TopologyInfo | None = None
+    version: str = ""
+
+
+@message
+class GetSchedulersResponse:
+    schedulers: list[SchedulerEntity] | None = None
+    cluster_config: ClusterConfig | None = None
+
+
+@message
+class GetSeedPeersRequest:
+    cluster_id: int = 0
+
+
+@message
+class GetSeedPeersResponse:
+    seed_peers: list[SeedPeerEntity] | None = None
+
+
+@message
+class KeepAliveRequest:
+    source_type: str = ""           # "scheduler" | "seed_peer"
+    hostname: str = ""
+    ip: str = ""
+    cluster_id: int = 0
+
+
+@message
+class RegisterSchedulerRequest:
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    scheduler_cluster_id: int = 0
+    topology: TopologyInfo | None = None
+
+
+@message
+class RegisterSeedPeerRequest:
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    download_port: int = 0
+    object_storage_port: int = 0
+    type: str = "super"
+    seed_peer_cluster_id: int = 0
+    topology: TopologyInfo | None = None
+
+
+# ---------------------------------------------------------------- trainer service
+
+@message
+class TrainRequest:
+    """Client-stream chunk: schedulers upload CSV datasets for model fitting."""
+
+    hostname: str = ""
+    ip: str = ""
+    cluster_id: int = 0
+    dataset: str = ""               # "download" | "networktopology"
+    chunk: bytes = b""
+    done: bool = False
+
+
+@message
+class TrainResponse:
+    ok: bool = True
+    message: str = ""
+    model_version: str = ""
+
+
+@message
+class ModelInferRequest:
+    model_name: str = "bandwidth_mlp"
+    features: list[list] | None = None   # batch of feature rows
+
+
+@message
+class ModelInferResponse:
+    outputs: list[float] | None = None
+    model_version: str = ""

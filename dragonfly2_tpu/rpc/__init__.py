@@ -1,0 +1,11 @@
+"""RPC transport: real gRPC (HTTP/2) with the msgpack IDL codec.
+
+Role parity: reference ``pkg/rpc`` — client wrappers with retry/backoff,
+server listen helpers, health service — plus ``pkg/balancer``'s
+consistent-hashing scheduler picker. Services are registered as generic
+method tables (no codegen); every method moves ``idl`` messages.
+"""
+
+from .server import RPCServer, ServiceDef, rpc_error_interceptor  # noqa: F401
+from .client import Channel, ServiceClient, RPCError  # noqa: F401
+from .balancer import HashRing, ConsistentHashPool  # noqa: F401

@@ -1,0 +1,133 @@
+"""Consistent-hash balancing across scheduler instances.
+
+Role parity: reference ``pkg/balancer/consistent_hashing.go`` +
+``pkg/resolver`` — every daemon hashes the task id onto the scheduler ring so
+all peers of one task land on the same scheduler (scheduling state is
+in-memory per scheduler). The pool is dynconfig-observable: address-set
+changes rebuild the ring without dropping existing channels.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import logging
+from typing import Sequence
+
+from .client import Channel
+
+log = logging.getLogger("df.rpc.balancer")
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    def __init__(self, nodes: Sequence[str] = (), replicas: int = 64):
+        self.replicas = replicas
+        self._ring: list[tuple[int, str]] = []
+        self._nodes: set[str] = set()
+        for n in nodes:
+            self.add(n)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.replicas):
+            self._ring.append((_hash(f"{node}#{i}"), node))
+        self._ring.sort()
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._ring = [(h, n) for h, n in self._ring if n != node]
+
+    def nodes(self) -> set[str]:
+        return set(self._nodes)
+
+    def pick(self, key: str) -> str | None:
+        if not self._ring:
+            return None
+        h = _hash(key)
+        idx = bisect.bisect(self._ring, (h, ""))
+        if idx == len(self._ring):
+            idx = 0
+        return self._ring[idx][1]
+
+    def pick_n(self, key: str, n: int) -> list[str]:
+        """The n distinct nodes clockwise from the key (failover order)."""
+        if not self._ring:
+            return []
+        h = _hash(key)
+        idx = bisect.bisect(self._ring, (h, ""))
+        out: list[str] = []
+        seen: set[str] = set()
+        for i in range(len(self._ring)):
+            _, node = self._ring[(idx + i) % len(self._ring)]
+            if node not in seen:
+                seen.add(node)
+                out.append(node)
+                if len(out) >= n:
+                    break
+        return out
+
+
+class ConsistentHashPool:
+    """Channels to a dynamic node set, picked by hashed key with failover."""
+
+    def __init__(self, addresses: Sequence[str] = (), *, replicas: int = 64):
+        self._ring = HashRing(addresses, replicas=replicas)
+        self._channels: dict[str, Channel] = {}
+        self._retired: list[Channel] = []  # removed but not yet closed
+        self._close_tasks: set = set()     # strong refs so tasks aren't GC'd
+
+    def update(self, addresses: Sequence[str]) -> None:
+        want = set(addresses)
+        for addr in want - self._ring.nodes():
+            self._ring.add(addr)
+        for addr in self._ring.nodes() - want:
+            self._ring.remove(addr)
+            ch = self._channels.pop(addr, None)
+            if ch is not None:
+                self._retired.append(ch)
+        self._drain_retired()
+
+    def _drain_retired(self) -> None:
+        import asyncio
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # sync context: retired list drains on next update/close
+        while self._retired:
+            ch = self._retired.pop()
+            t = loop.create_task(ch.close())
+            self._close_tasks.add(t)
+            t.add_done_callback(self._close_tasks.discard)
+
+    def addresses(self) -> set[str]:
+        return self._ring.nodes()
+
+    def channel_for(self, key: str) -> Channel | None:
+        addr = self._ring.pick(key)
+        if addr is None:
+            return None
+        return self._channel(addr)
+
+    def channels_for(self, key: str, n: int) -> list[Channel]:
+        return [self._channel(a) for a in self._ring.pick_n(key, n)]
+
+    def _channel(self, addr: str) -> Channel:
+        ch = self._channels.get(addr)
+        if ch is None:
+            ch = Channel(addr)
+            self._channels[addr] = ch
+        return ch
+
+    async def close(self) -> None:
+        for ch in list(self._channels.values()) + self._retired:
+            await ch.close()
+        self._channels.clear()
+        self._retired.clear()

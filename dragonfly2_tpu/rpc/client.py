@@ -1,0 +1,184 @@
+"""gRPC client helpers: typed service clients with retry/backoff, DFError
+reconstruction, and stream call support.
+
+Role parity: reference ``pkg/rpc/*/client`` wrappers (retry/backoff
+interceptors, ``client_v1.go:126``-style method surface).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, AsyncIterator
+
+import grpc
+import grpc.aio
+
+from ..common.errors import Code, DFError
+from ..idl import dumps, loads
+
+log = logging.getLogger("df.rpc.client")
+
+_RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
+
+
+class RPCError(Exception):
+    def __init__(self, code: grpc.StatusCode, message: str):
+        super().__init__(f"{code.name}: {message}")
+        self.code = code
+        self.message = message
+
+
+def _translate(exc: grpc.aio.AioRpcError) -> Exception:
+    """Rebuild DFError from the DF:<code>:<msg> status convention."""
+    details = exc.details() or ""
+    if details.startswith("DF:"):
+        try:
+            _, code_s, msg = details.split(":", 2)
+            return DFError(Code(int(code_s)), msg)
+        except (ValueError, KeyError):
+            pass
+    return RPCError(exc.code(), details)
+
+
+class Channel:
+    """An insecure channel to one address, with lazily-created method stubs."""
+
+    def __init__(self, address: str, *, options: list | None = None):
+        self.address = address
+        self._channel = grpc.aio.insecure_channel(address, options=options or [
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+        ])
+        self._stubs: dict[tuple[str, str, str], Any] = {}
+
+    def _stub(self, kind: str, service: str, method: str):
+        key = (kind, service, method)
+        stub = self._stubs.get(key)
+        if stub is None:
+            factory = getattr(self._channel, kind)
+            stub = factory(f"/{service}/{method}",
+                           request_serializer=dumps, response_deserializer=loads)
+            self._stubs[key] = stub
+        return stub
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+    async def wait_ready(self, timeout: float = 5.0) -> None:
+        await asyncio.wait_for(self._channel.channel_ready(), timeout)
+
+
+class ServiceClient:
+    """Typed calls against one service on one channel."""
+
+    def __init__(self, channel: Channel, service: str, *,
+                 max_attempts: int = 3, base_backoff: float = 0.1,
+                 max_backoff: float = 2.0):
+        self.channel = channel
+        self.service = service
+        self.max_attempts = max_attempts
+        self.base_backoff = base_backoff
+        self.max_backoff = max_backoff
+
+    async def unary(self, method: str, request: Any, *, timeout: float | None = None) -> Any:
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                stub = self.channel._stub("unary_unary", self.service, method)
+                return await stub(request, timeout=timeout)
+            except grpc.aio.AioRpcError as exc:
+                if exc.code() in _RETRYABLE and attempt < self.max_attempts:
+                    delay = min(self.max_backoff,
+                                self.base_backoff * (2 ** (attempt - 1)))
+                    delay *= 0.5 + random.random()
+                    log.debug("retrying %s/%s after %s (%.2fs)",
+                              self.service, method, exc.code().name, delay)
+                    await asyncio.sleep(delay)
+                    continue
+                raise _translate(exc) from None
+
+    def unary_stream(self, method: str, request: Any, *,
+                     timeout: float | None = None) -> "_StreamIter":
+        stub = self.channel._stub("unary_stream", self.service, method)
+        return _StreamIter(stub(request, timeout=timeout))
+
+    async def stream_unary(self, method: str, requests: AsyncIterator[Any], *,
+                           timeout: float | None = None) -> Any:
+        stub = self.channel._stub("stream_unary", self.service, method)
+        try:
+            return await stub(requests, timeout=timeout)
+        except grpc.aio.AioRpcError as exc:
+            raise _translate(exc) from None
+
+    def stream_stream(self, method: str, *, timeout: float | None = None) -> "_BidiCall":
+        stub = self.channel._stub("stream_stream", self.service, method)
+        return _BidiCall(stub(timeout=timeout))
+
+
+class _StreamIter:
+    """Server-stream iterator translating grpc errors to DFError/RPCError."""
+
+    def __init__(self, call):
+        self.call = call
+
+    def cancel(self) -> None:
+        self.call.cancel()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        msg = await self.read()
+        if msg is None:
+            raise StopAsyncIteration
+        return msg
+
+    async def read(self):
+        """Like __anext__ but returns None at end of stream."""
+        try:
+            msg = await self.call.read()
+        except grpc.aio.AioRpcError as exc:
+            raise _translate(exc) from None
+        if msg is grpc.aio.EOF:
+            return None
+        return msg
+
+
+class _BidiCall:
+    """Bidirectional stream with explicit write/read halves."""
+
+    def __init__(self, call):
+        self.call = call
+
+    async def write(self, msg: Any) -> None:
+        try:
+            await self.call.write(msg)
+        except grpc.aio.AioRpcError as exc:
+            raise _translate(exc) from None
+
+    async def done_writing(self) -> None:
+        await self.call.done_writing()
+
+    async def read(self) -> Any | None:
+        try:
+            msg = await self.call.read()
+        except grpc.aio.AioRpcError as exc:
+            raise _translate(exc) from None
+        if msg is grpc.aio.EOF:
+            return None
+        return msg
+
+    def cancel(self) -> None:
+        self.call.cancel()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        msg = await self.read()
+        if msg is None:
+            raise StopAsyncIteration
+        return msg

@@ -1,0 +1,140 @@
+"""gRPC server glue: register async handler tables, map DFError to status.
+
+A service is a ``ServiceDef`` naming async handlers:
+
+    svc = ServiceDef("df.scheduler.Scheduler")
+    svc.unary_unary("RegisterPeerTask", handler)
+    svc.stream_stream("ReportPieceResult", handler)
+
+Handlers receive decoded ``idl`` messages (or async iterators of them) plus
+the grpc context; DFError raised anywhere is carried to the peer in the
+status message as ``DF:<code>:<text>`` and re-raised client-side.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator, Awaitable, Callable
+
+import grpc
+import grpc.aio
+
+from ..common.errors import Code, DFError
+from ..idl import dumps, loads
+
+log = logging.getLogger("df.rpc.server")
+
+_KINDS = ("unary_unary", "unary_stream", "stream_unary", "stream_stream")
+
+
+def _status_message(exc: BaseException) -> str:
+    err = DFError.wrap(exc)
+    return f"DF:{int(err.code)}:{err.message}"
+
+
+class ServiceDef:
+    def __init__(self, name: str):
+        self.name = name
+        self._methods: dict[str, grpc.RpcMethodHandler] = {}
+
+    def _wrap_response_handler(self, fn):
+        async def handler(request, context):
+            try:
+                return await fn(request, context)
+            except DFError as exc:
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION, _status_message(exc))
+            except grpc.aio.AbortError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - boundary
+                log.exception("handler %s failed", fn.__qualname__)
+                await context.abort(grpc.StatusCode.INTERNAL, _status_message(exc))
+        return handler
+
+    def _wrap_stream_handler(self, fn):
+        async def handler(request, context) -> AsyncIterator:
+            try:
+                async for resp in fn(request, context):
+                    yield resp
+            except DFError as exc:
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION, _status_message(exc))
+            except grpc.aio.AbortError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - boundary
+                log.exception("stream handler %s failed", fn.__qualname__)
+                await context.abort(grpc.StatusCode.INTERNAL, _status_message(exc))
+        return handler
+
+    def unary_unary(self, method: str, fn: Callable[..., Awaitable]) -> None:
+        self._methods[method] = grpc.unary_unary_rpc_method_handler(
+            self._wrap_response_handler(fn),
+            request_deserializer=loads, response_serializer=dumps)
+
+    def unary_stream(self, method: str, fn: Callable[..., AsyncIterator]) -> None:
+        self._methods[method] = grpc.unary_stream_rpc_method_handler(
+            self._wrap_stream_handler(fn),
+            request_deserializer=loads, response_serializer=dumps)
+
+    def stream_unary(self, method: str, fn: Callable[..., Awaitable]) -> None:
+        self._methods[method] = grpc.stream_unary_rpc_method_handler(
+            self._wrap_response_handler(fn),
+            request_deserializer=loads, response_serializer=dumps)
+
+    def stream_stream(self, method: str, fn: Callable[..., AsyncIterator]) -> None:
+        self._methods[method] = grpc.stream_stream_rpc_method_handler(
+            self._wrap_stream_handler(fn),
+            request_deserializer=loads, response_serializer=dumps)
+
+    def build(self) -> grpc.GenericRpcHandler:
+        return grpc.method_handlers_generic_handler(self.name, self._methods)
+
+
+def rpc_error_interceptor():  # placeholder hook point for tracing interceptors
+    return None
+
+
+class _Health:
+    """Minimal health service (role parity: ``pkg/rpc/health``)."""
+
+    def __init__(self) -> None:
+        self.serving = True
+
+    async def check(self, request, context):
+        from ..idl.messages import Empty
+        if not self.serving:
+            raise DFError(Code.UNAVAILABLE, "not serving")
+        return Empty()
+
+
+class RPCServer:
+    """One gRPC server hosting many ServiceDefs on one address.
+
+    ``address`` may be "ip:port", "unix:/path", or "ip:0" (ephemeral —
+    resolved port available as ``.port`` after ``start``).
+    """
+
+    def __init__(self, address: str, *, options: list | None = None):
+        self.address = address
+        self.port: int | None = None
+        self.health = _Health()
+        self._server = grpc.aio.server(options=options or [
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+        ])
+        health_def = ServiceDef("df.health.Health")
+        health_def.unary_unary("Check", self.health.check)
+        self._defs: list[ServiceDef] = [health_def]
+
+    def register(self, service: ServiceDef) -> None:
+        self._defs.append(service)
+
+    async def start(self) -> None:
+        self._server.add_generic_rpc_handlers(tuple(d.build() for d in self._defs))
+        port = self._server.add_insecure_port(self.address)
+        if not self.address.startswith("unix:"):
+            self.port = port
+        await self._server.start()
+        log.info("rpc server on %s (port=%s): %s", self.address, self.port,
+                 ",".join(d.name for d in self._defs))
+
+    async def stop(self, grace: float = 1.0) -> None:
+        await self._server.stop(grace)

@@ -1,0 +1,1 @@
+"""Piece-addressed local storage engine + native (C++) hot path + HBM sink."""

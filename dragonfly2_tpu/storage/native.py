@@ -1,0 +1,83 @@
+"""ctypes bindings to the C++ hot-path library (``native/``).
+
+The native library accelerates what the reference's Rust client (`client-rs`)
+and Go hot loops do natively: piece hashing (sha256/md5/crc32c) and aligned
+file piece IO. Loading is best-effort — every caller has a pure-Python
+fallback, so the framework runs (slower) without the .so. Build with
+``make -C native`` (see native/Makefile).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+_LIB_NAMES = ("libdfnative.so",)
+_lib = None
+_lib_lock = threading.Lock()
+_load_attempted = False
+
+
+def _candidate_paths():
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    for name in _LIB_NAMES:
+        yield os.path.join(repo, "native", "build", name)
+        yield os.path.join(repo, "native", name)
+        yield name  # system path
+
+
+def load():
+    """Load the native library once; returns None if unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        for path in _candidate_paths():
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                continue
+            try:
+                _bind(lib)
+            except AttributeError:
+                continue
+            _lib = lib
+            break
+    return _lib
+
+
+def _bind(lib) -> None:
+    # int df_hash(const char* algo, const uint8_t* data, size_t n, char* hex_out, size_t hex_cap)
+    lib.df_hash.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+                            ctypes.c_char_p, ctypes.c_size_t]
+    lib.df_hash.restype = ctypes.c_int
+    # int64 df_pwrite(const char* path, const uint8_t* data, size_t n, int64 offset)
+    lib.df_pwrite.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]
+    lib.df_pwrite.restype = ctypes.c_int64
+    # int64 df_pread(const char* path, uint8_t* buf, size_t n, int64 offset)
+    lib.df_pread.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]
+    lib.df_pread.restype = ctypes.c_int64
+    # int df_verify_pieces(...) — batch hash of piece table; bound lazily where used
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def hash_bytes(algo: str, data: bytes | memoryview) -> str | None:
+    """Hex digest via native lib, or None to signal fallback."""
+    lib = load()
+    if lib is None:
+        return None
+    if isinstance(data, memoryview):
+        data = bytes(data)
+    out = ctypes.create_string_buffer(129)
+    rc = lib.df_hash(algo.encode(), data, len(data), out, len(out))
+    if rc != 0:
+        return None
+    return out.value.decode()

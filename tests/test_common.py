@@ -1,0 +1,300 @@
+"""Stage-1 unit tests: ids, piece math, units, digest, dag, cache, rate,
+config, metrics."""
+
+import asyncio
+import time
+
+import pytest
+
+from dragonfly2_tpu.common import digest, ids
+from dragonfly2_tpu.common.cache import TTLCache
+from dragonfly2_tpu.common.config import ConfigError, from_dict, _mini_yaml
+from dragonfly2_tpu.common.dag import DAG, DAGError
+from dragonfly2_tpu.common.errors import Code, DFError
+from dragonfly2_tpu.common.metrics import Registry
+from dragonfly2_tpu.common.piece import (
+    DEFAULT_PIECE_SIZE, MAX_PIECE_SIZE, Range, compute_piece_size,
+    parse_http_range, piece_count, piece_range,
+)
+from dragonfly2_tpu.common.rate import TokenBucket
+from dragonfly2_tpu.common.unit import GiB, MiB, format_bytes, parse_bytes
+
+
+class TestPieceMath:
+    def test_default_size_small_files(self):
+        assert compute_piece_size(0) == DEFAULT_PIECE_SIZE
+        assert compute_piece_size(200 * MiB) == DEFAULT_PIECE_SIZE
+
+    def test_grows_with_content_and_caps(self):
+        assert compute_piece_size(300 * MiB) > DEFAULT_PIECE_SIZE
+        assert compute_piece_size(100 * GiB) == MAX_PIECE_SIZE
+
+    def test_growth_is_monotonic(self):
+        last = 0
+        for length in (1, 100 * MiB, 500 * MiB, GiB, 10 * GiB, 100 * GiB):
+            size = compute_piece_size(length)
+            assert size >= last
+            last = size
+
+    def test_piece_count_and_ranges_cover_content(self):
+        length = 10 * MiB + 12345
+        size = compute_piece_size(length)
+        n = piece_count(length, size)
+        total = 0
+        for i in range(n):
+            off, ln = piece_range(i, size, length)
+            assert off == total
+            total += ln
+        assert total == length
+
+    def test_piece_range_out_of_bounds(self):
+        with pytest.raises(ValueError):
+            piece_range(5, DEFAULT_PIECE_SIZE, DEFAULT_PIECE_SIZE)
+
+    def test_http_range_forms(self):
+        assert parse_http_range("bytes=0-99", 1000) == Range(0, 100)
+        assert parse_http_range("bytes=500-", 1000) == Range(500, 500)
+        assert parse_http_range("bytes=-100", 1000) == Range(900, 100)
+        assert parse_http_range("bytes=0-9999", 1000) == Range(0, 1000)
+        with pytest.raises(ValueError):
+            parse_http_range("bytes=1000-", 1000)
+        with pytest.raises(ValueError):
+            parse_http_range("items=0-1", 1000)
+        for bad in ("bytes=--5", "bytes=-0", "bytes=a-b", "bytes=5-3"):
+            with pytest.raises(ValueError):
+                parse_http_range(bad, 1000)
+
+
+class TestIds:
+    def test_task_id_stable_and_content_addressed(self):
+        a = ids.task_id("http://x/f?b=2&a=1")
+        b = ids.task_id("http://x/f?a=1&b=2")  # query order normalized
+        assert a == b
+        assert ids.task_id("http://x/f?a=1") != a
+
+    def test_filtered_params_dropped(self):
+        a = ids.task_id("http://x/f?sig=abc&a=1", filtered_query_params=["sig"])
+        b = ids.task_id("http://x/f?sig=zzz&a=1", filtered_query_params=["sig"])
+        assert a == b
+
+    def test_meta_changes_id(self):
+        base = ids.task_id("http://x/f")
+        assert ids.task_id("http://x/f", tag="t") != base
+        assert ids.task_id("http://x/f", digest="sha256:aa") != base
+        assert ids.task_id("http://x/f", piece_range="bytes=0-1") != base
+
+    def test_parent_task_id_ignores_range(self):
+        assert ids.parent_task_id("http://x/f") == ids.task_id("http://x/f")
+
+    def test_peer_ids_unique(self):
+        assert ids.peer_id("h", "1.2.3.4") != ids.peer_id("h", "1.2.3.4")
+        assert ids.peer_id("h", "1.2.3.4", seed=True).endswith("-seed")
+
+
+class TestDigest:
+    def test_parse(self):
+        val = "AB" * 32
+        assert digest.parse(f"sha256:{val}") == ("sha256", val.lower())
+        with pytest.raises(ValueError):
+            digest.parse("nosep")
+        with pytest.raises(ValueError):
+            digest.parse("weird:aa")
+        with pytest.raises(ValueError):  # wrong length
+            digest.parse("sha256:abcd")
+        with pytest.raises(ValueError):  # non-hex
+            digest.parse("crc32c:zzzzzzzz")
+
+    def test_roundtrip_all_algos(self):
+        data = b"hello dragonfly" * 1000
+        for algo in ("sha256", "md5", "sha1", "crc32c", "blake2b"):
+            d = digest.for_bytes(algo, data)
+            assert digest.verify(d, data)
+            assert not digest.verify(d, data + b"x")
+
+    def test_crc32c_known_vector(self):
+        # RFC 3720 test vector: 32 bytes of zeros -> 0x8a9136aa
+        assert digest.hash_bytes("crc32c", b"\x00" * 32) == "8a9136aa"
+
+    def test_stream_matches_bytes(self):
+        data = b"abc" * 5000
+        chunks = [data[i:i + 1000] for i in range(0, len(data), 1000)]
+        for algo in ("sha256", "crc32c"):
+            assert digest.hash_stream(algo, iter(chunks)) == digest.hash_bytes(algo, data)
+
+
+class TestUnit:
+    def test_parse(self):
+        assert parse_bytes("4MiB") == 4 * MiB
+        assert parse_bytes("1.5g") == int(1.5 * GiB)
+        assert parse_bytes(4096) == 4096
+        assert parse_bytes("100") == 100
+        with pytest.raises(ValueError):
+            parse_bytes("4 parsecs")
+
+    def test_format(self):
+        assert format_bytes(4 * MiB) == "4.0MiB"
+        assert format_bytes(10) == "10B"
+
+
+class TestDAG:
+    def test_cycle_refused(self):
+        g = DAG()
+        for v in "abc":
+            g.add_vertex(v, v)
+        g.add_edge("a", "b")
+        g.add_edge("b", "c")
+        with pytest.raises(DAGError):
+            g.add_edge("c", "a")
+        with pytest.raises(DAGError):
+            g.add_edge("a", "a")
+
+    def test_reparent(self):
+        g = DAG()
+        for v in "abcd":
+            g.add_vertex(v, v)
+        g.add_edge("a", "c")
+        g.add_edge("b", "d")
+        g.delete_in_edges("c")
+        g.add_edge("b", "c")
+        assert g.parents("c") == {"b"}
+        assert g.in_degree("c") == 1
+
+    def test_delete_vertex_cleans_edges(self):
+        g = DAG()
+        for v in "abc":
+            g.add_vertex(v, v)
+        g.add_edge("a", "b")
+        g.add_edge("b", "c")
+        g.delete_vertex("b")
+        assert g.children("a") == set()
+        assert g.parents("c") == set()
+        assert len(g) == 2
+
+    def test_descendants(self):
+        g = DAG()
+        for v in "abcd":
+            g.add_vertex(v, v)
+        g.add_edge("a", "b")
+        g.add_edge("b", "c")
+        assert g.descendants("a") == {"b", "c"}
+        assert g.descendants("d") == set()
+
+
+class TestErrors:
+    def test_wrap_preserves_dferror(self):
+        e = DFError(Code.SCHED_NEED_BACK_SOURCE, "go direct")
+        assert DFError.wrap(e) is e
+        wrapped = DFError.wrap(ValueError("boom"))
+        assert wrapped.code == Code.UNKNOWN
+        assert "boom" in wrapped.message
+
+
+class TestCache:
+    def test_ttl_expiry(self):
+        c = TTLCache(default_ttl=0.05)
+        c.set("k", 1)
+        assert c.get("k") == 1
+        time.sleep(0.08)
+        assert c.get("k") is None
+
+    def test_no_expire(self):
+        c = TTLCache()
+        c.set("k", 2, ttl=0)
+        time.sleep(0.01)
+        assert c.get("k") == 2
+
+
+class TestRate:
+    def test_unlimited(self):
+        tb = TokenBucket(0)
+        assert tb.try_acquire(10**12)
+
+    def test_limits(self):
+        tb = TokenBucket(1000, burst=1000)
+        assert tb.try_acquire(1000)
+        assert not tb.try_acquire(500)
+
+    def test_async_acquire_waits(self):
+        async def go():
+            tb = TokenBucket(10000, burst=1000)
+            await tb.acquire(1000)
+            t0 = time.monotonic()
+            await tb.acquire(1000)  # must wait ~0.1s for refill
+            return time.monotonic() - t0
+        waited = asyncio.run(go())
+        assert waited > 0.05
+
+
+class TestConfig:
+    def test_from_dict_nested_and_unknown_key(self):
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Inner:
+            port: int = 0
+
+        @dataclasses.dataclass
+        class Outer:
+            name: str = ""
+            inner: Inner = dataclasses.field(default_factory=Inner)
+
+        cfg = from_dict(Outer, {"name": "x", "inner": {"port": 99}})
+        assert cfg.inner.port == 99
+        with pytest.raises(ConfigError):
+            from_dict(Outer, {"nope": 1})
+
+    def test_validate_hook_runs(self):
+        import dataclasses
+
+        @dataclasses.dataclass
+        class C:
+            n: int = -1
+
+            def validate(self):
+                if self.n < 0:
+                    raise ConfigError("n must be >= 0")
+
+        with pytest.raises(ConfigError):
+            from_dict(C, {})
+        assert from_dict(C, {"n": 3}).n == 3
+
+    def test_mini_yaml(self):
+        text = """
+# comment
+server:
+  port: 8002
+  host: "0.0.0.0"
+  tls: false
+limits:
+  - 1
+  - 2.5
+  - on
+name: demo
+"""
+        data = _mini_yaml(text)
+        assert data == {
+            "server": {"port": 8002, "host": "0.0.0.0", "tls": False},
+            "limits": [1, 2.5, True],
+            "name": "demo",
+        }
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_exposition(self):
+        r = Registry()
+        c = r.counter("df_requests_total", "reqs", ("kind",))
+        c.labels("p2p").inc()
+        c.labels("p2p").inc(2)
+        g = r.gauge("df_peers", "peers")
+        g.set(7)
+        h = r.histogram("df_latency_seconds", "lat", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        assert c.value("p2p") == 3
+        assert g.value() == 7
+        text = r.expose()
+        assert 'df_requests_total{kind="p2p"} 3.0' in text
+        assert "df_peers 7.0" in text
+        assert 'df_latency_seconds_bucket{le="+Inf"} 2.0' in text
+        counts, total, n = h.snapshot()
+        assert n == 2 and total == 5.05

@@ -1,0 +1,162 @@
+"""Stage-1 RPC tests: IDL codec round-trips, gRPC unary/stream calls with
+DFError propagation, consistent-hash balancer."""
+
+import asyncio
+
+import pytest
+
+from dragonfly2_tpu.common.errors import Code, DFError
+from dragonfly2_tpu.idl import dumps, loads
+from dragonfly2_tpu.idl.messages import (
+    DownloadRequest, Host, HostType, PeerAddr, PeerPacket, PieceInfo,
+    PiecePacket, Priority, RegisterPeerTaskRequest, SizeScope, TopologyInfo,
+    UrlMeta,
+)
+from dragonfly2_tpu.rpc import Channel, ConsistentHashPool, HashRing, RPCServer, ServiceClient, ServiceDef
+
+
+class TestCodec:
+    def test_roundtrip_nested(self):
+        req = RegisterPeerTaskRequest(
+            url="http://origin/f.bin",
+            url_meta=UrlMeta(digest="sha256:aa", tag="t", priority=Priority.LEVEL2),
+            peer_id="p1",
+            peer_host=Host(id="h1", ip="10.0.0.1", port=65000,
+                           type=HostType.SUPER_SEED,
+                           topology=TopologyInfo(slice_name="v5p-8", worker_index=2,
+                                                 ici_coords=(0, 1, 2), num_chips=4)),
+        )
+        out = loads(dumps(req))
+        assert isinstance(out, RegisterPeerTaskRequest)
+        assert out.url_meta.priority is Priority.LEVEL2
+        assert out.peer_host.type is HostType.SUPER_SEED
+        # bare-tuple-annotated fields round-trip as tuples, so messages compare equal
+        assert out.peer_host.topology.ici_coords == (0, 1, 2)
+        assert out == req
+
+    def test_bytes_and_lists(self):
+        pkt = PiecePacket(task_id="t", piece_infos=[
+            PieceInfo(piece_num=i, range_start=i * 4, range_size=4, digest=f"crc32c:{i:08x}")
+            for i in range(3)
+        ], total_piece_count=3)
+        out = loads(dumps(pkt))
+        assert [p.piece_num for p in out.piece_infos] == [0, 1, 2]
+
+    def test_unknown_fields_dropped(self):
+        import msgpack
+        raw = msgpack.packb({"__t": "UrlMeta", "tag": "x", "brand_new_field": 9})
+        out = loads(raw)
+        assert isinstance(out, UrlMeta) and out.tag == "x"
+
+    def test_enum_coercion(self):
+        pkt = PeerPacket(task_id="t", main_peer=PeerAddr(peer_id="p"), code=0)
+        out = loads(dumps(pkt))
+        assert out.main_peer.peer_id == "p"
+
+
+class _EchoService:
+    async def echo(self, request, context):
+        return request
+
+    async def fail(self, request, context):
+        raise DFError(Code.SCHED_NEED_BACK_SOURCE, "fetch it yourself")
+
+    async def countdown(self, request, context):
+        for i in range(3):
+            yield DownloadRequest(url=f"step-{i}")
+
+    async def summarize(self, request_iter, context):
+        n = 0
+        async for _ in request_iter:
+            n += 1
+        return DownloadRequest(url=f"got-{n}")
+
+
+async def _with_server(fn):
+    svc = _EchoService()
+    sdef = ServiceDef("df.test.Echo")
+    sdef.unary_unary("Echo", svc.echo)
+    sdef.unary_unary("Fail", svc.fail)
+    sdef.unary_stream("Countdown", svc.countdown)
+    sdef.stream_unary("Summarize", svc.summarize)
+    server = RPCServer("127.0.0.1:0")
+    server.register(sdef)
+    await server.start()
+    ch = Channel(f"127.0.0.1:{server.port}")
+    client = ServiceClient(ch, "df.test.Echo")
+    try:
+        return await fn(client)
+    finally:
+        await ch.close()
+        await server.stop(0)
+
+
+class TestGRPC:
+    def test_unary_roundtrip(self):
+        async def go(client):
+            out = await client.unary("Echo", DownloadRequest(url="http://x", rate_limit_bps=5))
+            assert out.url == "http://x" and out.rate_limit_bps == 5
+        asyncio.run(_with_server(go))
+
+    def test_dferror_crosses_wire(self):
+        async def go(client):
+            with pytest.raises(DFError) as ei:
+                await client.unary("Fail", DownloadRequest())
+            assert ei.value.code == Code.SCHED_NEED_BACK_SOURCE
+            assert "fetch it yourself" in ei.value.message
+        asyncio.run(_with_server(go))
+
+    def test_server_stream(self):
+        async def go(client):
+            urls = [m.url async for m in client.unary_stream("Countdown", DownloadRequest())]
+            assert urls == ["step-0", "step-1", "step-2"]
+        asyncio.run(_with_server(go))
+
+    def test_client_stream(self):
+        async def go(client):
+            async def gen():
+                for _ in range(5):
+                    yield DownloadRequest()
+            out = await client.stream_unary("Summarize", gen())
+            assert out.url == "got-5"
+        asyncio.run(_with_server(go))
+
+    def test_health(self):
+        async def go(client):
+            health = ServiceClient(client.channel, "df.health.Health")
+            from dragonfly2_tpu.idl.messages import Empty
+            out = await health.unary("Check", Empty())
+            assert isinstance(out, Empty)
+        asyncio.run(_with_server(go))
+
+
+class TestHashRing:
+    def test_stable_assignment(self):
+        ring = HashRing(["a:1", "b:1", "c:1"])
+        picks = {k: ring.pick(k) for k in (f"task-{i}" for i in range(100))}
+        # removing one node must not move keys between surviving nodes
+        ring.remove("c:1")
+        for k, before in picks.items():
+            after = ring.pick(k)
+            if before != "c:1":
+                assert after == before
+
+    def test_distribution_roughly_even(self):
+        ring = HashRing([f"n{i}" for i in range(4)], replicas=128)
+        counts = {}
+        for i in range(4000):
+            n = ring.pick(f"k{i}")
+            counts[n] = counts.get(n, 0) + 1
+        assert min(counts.values()) > 4000 / 4 * 0.5
+
+    def test_pick_n_failover_order(self):
+        ring = HashRing(["a", "b", "c"])
+        order = ring.pick_n("task-x", 3)
+        assert len(order) == 3 and order[0] == ring.pick("task-x")
+        assert set(order) == {"a", "b", "c"}
+
+    def test_pool_update(self):
+        pool = ConsistentHashPool(["127.0.0.1:1", "127.0.0.1:2"])
+        assert pool.addresses() == {"127.0.0.1:1", "127.0.0.1:2"}
+        pool.update(["127.0.0.1:2", "127.0.0.1:3"])
+        assert pool.addresses() == {"127.0.0.1:2", "127.0.0.1:3"}

@@ -29,12 +29,12 @@ def _build(cls: Type[T], data: dict[str, Any], path: str) -> T:
     if not dataclasses.is_dataclass(cls):
         raise ConfigError(f"{path}: {cls} is not a dataclass")
     fields = {f.name: f for f in dataclasses.fields(cls)}
+    hints = typing.get_type_hints(cls)
     kwargs: dict[str, Any] = {}
     for key, value in data.items():
         if key not in fields:
             raise ConfigError(f"{path}: unknown key {key!r} for {cls.__name__}")
-        ftype = typing.get_type_hints(cls).get(key, fields[key].type)
-        kwargs[key] = _coerce(ftype, value, f"{path}.{key}")
+        kwargs[key] = _coerce(hints.get(key, fields[key].type), value, f"{path}.{key}")
     return cls(**kwargs)
 
 
@@ -50,7 +50,7 @@ def _coerce(ftype: Any, value: Any, path: str) -> Any:
     if dataclasses.is_dataclass(ftype) and isinstance(value, dict):
         return _build(ftype, value, path)
     if origin in (list, tuple) and isinstance(value, (list, tuple)):
-        (elem,) = typing.get_args(ftype) or (Any,)
+        elem = (typing.get_args(ftype) or (Any,))[0]
         seq = [_coerce(elem, v, f"{path}[{i}]") for i, v in enumerate(value)]
         return tuple(seq) if origin is tuple else seq
     if origin is dict and isinstance(value, dict):

@@ -34,12 +34,17 @@ def parse(digest: str) -> tuple[str, str]:
 
 
 def hash_bytes(algo: str, data: bytes | memoryview) -> str:
-    """Hex digest of ``data`` under ``algo`` (native-accelerated when available)."""
-    from ..storage import native  # local import: avoid cycle at package init
-    out = native.hash_bytes(algo, data)
-    if out is not None:
-        return out
+    """Hex digest of ``data`` under ``algo``.
+
+    crc32c (the per-piece default) dispatches to the native library's
+    hardware-accelerated path (~4.5 GB/s measured vs ~10 MB/s pure Python);
+    sha/md5 stay on hashlib, whose OpenSSL backend outruns portable C++.
+    """
     if algo == "crc32c":
+        from ..storage import native  # local import: avoid cycle at package init
+        out = native.hash_bytes(algo, data)
+        if out is not None:
+            return out
         return f"{_crc32c_py(bytes(data)):08x}"
     if algo == "blake2b":
         return hashlib.blake2b(data, digest_size=32).hexdigest()
@@ -48,9 +53,14 @@ def hash_bytes(algo: str, data: bytes | memoryview) -> str:
 
 def hash_stream(algo: str, chunks: Iterator[bytes]) -> str:
     if algo == "crc32c":
+        from ..storage import native
         acc = 0
+        use_native = native.available()
         for c in chunks:
-            acc = _crc32c_py(c, acc)
+            if use_native:
+                acc = native.crc32c_update(c, acc)
+            else:
+                acc = _crc32c_py(c, acc)
         return f"{acc:08x}"
     if algo == "blake2b":
         h = hashlib.blake2b(digest_size=32)

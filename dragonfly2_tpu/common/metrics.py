@@ -150,30 +150,29 @@ class Registry:
         self._lock = threading.Lock()
 
     def counter(self, name: str, help_: str = "", labels: tuple[str, ...] = ()) -> Counter:
-        return self._get_or_make(Counter, name, help_, labels)
+        return self._get_or_make(
+            Counter, name, labels, lambda: Counter(name, help_, tuple(labels)))
 
     def gauge(self, name: str, help_: str = "", labels: tuple[str, ...] = ()) -> Gauge:
-        return self._get_or_make(Gauge, name, help_, labels)
+        return self._get_or_make(
+            Gauge, name, labels, lambda: Gauge(name, help_, tuple(labels)))
 
     def histogram(self, name: str, help_: str = "", labels: tuple[str, ...] = (),
                   buckets: tuple[float, ...] = _DEFAULT_BUCKETS) -> Histogram:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = Histogram(name, help_, labels, buckets)
-                self._metrics[name] = m
-            elif not isinstance(m, Histogram):
-                raise TypeError(f"metric {name} already registered as {m.kind}")
-            return m
+        return self._get_or_make(
+            Histogram, name, labels, lambda: Histogram(name, help_, tuple(labels), buckets))
 
-    def _get_or_make(self, cls, name, help_, labels):
+    def _get_or_make(self, cls, name, labels, factory=None):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = cls(name, help_, tuple(labels))
+                m = factory() if factory else cls(name, "", tuple(labels))
                 self._metrics[name] = m
             elif not isinstance(m, cls):
                 raise TypeError(f"metric {name} already registered as {m.kind}")
+            elif m.label_names != tuple(labels):
+                raise TypeError(f"metric {name} re-registered with labels "
+                                f"{tuple(labels)} != {m.label_names}")
             return m
 
     def expose(self) -> str:
@@ -182,8 +181,10 @@ class Registry:
         def esc(val: str) -> str:
             return val.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
+        with self._lock:
+            metrics = list(self._metrics.values())
         out: list[str] = []
-        for m in self._metrics.values():
+        for m in metrics:
             out.append(f"# HELP {m.name} {m.help}")
             out.append(f"# TYPE {m.name} {m.kind}")
             extra = ("le",) if isinstance(m, Histogram) else ()

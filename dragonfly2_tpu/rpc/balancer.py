@@ -127,7 +127,10 @@ class ConsistentHashPool:
         return ch
 
     async def close(self) -> None:
+        import asyncio
         for ch in list(self._channels.values()) + self._retired:
             await ch.close()
         self._channels.clear()
         self._retired.clear()
+        if self._close_tasks:
+            await asyncio.gather(*list(self._close_tasks), return_exceptions=True)

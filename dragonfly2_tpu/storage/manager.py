@@ -1,0 +1,193 @@
+"""StorageManager: the daemon's registry of TaskStorages with reload + GC.
+
+Role parity: reference ``client/daemon/storage/storage_manager.go`` —
+``RegisterTask`` (:239), piece IO dispatch (:293-344),
+``ReloadPersistentTask`` (:674), ``TryGC`` (:804) with reclaim marks driven
+by TTL and disk high/low watermarks; persistent (dfcache) tasks are pinned.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass
+
+from ..common.errors import Code, DFError
+from ..idl.messages import TaskType
+from .metadata import METADATA_FILE, TaskMetadata
+from .store import SubTaskStorage, TaskStorage
+
+log = logging.getLogger("df.storage.manager")
+
+
+@dataclass
+class StorageConfig:
+    data_dir: str = ""
+    task_ttl_s: float = 6 * 3600.0
+    # GC starts above high watermark and stops below low watermark
+    disk_gc_high_ratio: float = 0.90
+    disk_gc_low_ratio: float = 0.80
+    capacity_bytes: int = 0          # 0: use the filesystem's capacity
+    gc_interval_s: float = 60.0
+
+    def validate(self) -> None:
+        if not (0 < self.disk_gc_low_ratio <= self.disk_gc_high_ratio <= 1):
+            raise ValueError("bad GC watermarks")
+
+
+class StorageManager:
+    def __init__(self, cfg: StorageConfig):
+        cfg.validate()
+        self.cfg = cfg
+        os.makedirs(cfg.data_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._tasks: dict[str, TaskStorage] = {}
+        self._subtasks: dict[str, SubTaskStorage] = {}
+        self.reload()
+
+    # -- registration --------------------------------------------------
+
+    def _task_dir(self, task_id: str) -> str:
+        return os.path.join(self.cfg.data_dir, task_id[:3], task_id)
+
+    def register_task(self, md: TaskMetadata) -> TaskStorage:
+        with self._lock:
+            ts = self._tasks.get(md.task_id)
+            if ts is not None:
+                return ts
+            ts = TaskStorage(self._task_dir(md.task_id), md)
+            self._tasks[md.task_id] = ts
+            return ts
+
+    def register_subtask(self, md: TaskMetadata) -> SubTaskStorage:
+        """Ranged sub-task sharing the parent's data file; the parent task is
+        created (empty) if unknown so the range lands at its final offset."""
+        if not md.parent_task_id:
+            raise DFError(Code.INVALID_ARGUMENT, "subtask needs parent_task_id")
+        with self._lock:
+            st = self._subtasks.get(md.task_id)
+            if st is not None:
+                return st
+        parent = self._tasks.get(md.parent_task_id)
+        if parent is None:
+            parent = self.register_task(TaskMetadata(
+                task_id=md.parent_task_id, url=md.url, tag=md.tag))
+        st = SubTaskStorage(parent, md)
+        with self._lock:
+            self._subtasks[md.task_id] = st
+        return st
+
+    def get(self, task_id: str) -> TaskStorage | SubTaskStorage | None:
+        with self._lock:
+            return self._tasks.get(task_id) or self._subtasks.get(task_id)
+
+    def find_completed_task(self, task_id: str) -> TaskStorage | None:
+        ts = self._tasks.get(task_id)
+        if ts is not None and ts.md.done and ts.md.success:
+            ts.md.access_time = time.time()
+            return ts
+        return None
+
+    def find_partial_completed_task(self, parent_task_id: str,
+                                    start: int, length: int) -> TaskStorage | None:
+        """A completed whole-file task can serve any sub-range directly
+        (reference ``FindPartialCompletedTask``)."""
+        ts = self.find_completed_task(parent_task_id)
+        if ts is None:
+            return None
+        if ts.md.content_length >= 0 and start + length <= ts.md.content_length:
+            return ts
+        return None
+
+    def tasks(self) -> list[TaskStorage]:
+        with self._lock:
+            return list(self._tasks.values())
+
+    def delete_task(self, task_id: str) -> bool:
+        with self._lock:
+            ts = self._tasks.pop(task_id, None)
+            self._subtasks.pop(task_id, None)
+        if ts is None:
+            return False
+        ts.destroy()
+        return True
+
+    # -- restart recovery ---------------------------------------------
+
+    def reload(self) -> int:
+        """Re-index completed tasks from disk; drop invalid/partial ones.
+
+        Partial downloads are discarded (their piece table can't be trusted
+        against a crashed writer) — same policy as the reference
+        (``storage_manager.go:662 IsInvalid``).
+        """
+        n = 0
+        root = self.cfg.data_dir
+        for prefix in os.listdir(root) if os.path.isdir(root) else []:
+            pdir = os.path.join(root, prefix)
+            if not os.path.isdir(pdir):
+                continue
+            for tid in os.listdir(pdir):
+                tdir = os.path.join(pdir, tid)
+                mpath = os.path.join(tdir, METADATA_FILE)
+                if not os.path.exists(mpath):
+                    shutil.rmtree(tdir, ignore_errors=True)
+                    continue
+                try:
+                    md = TaskMetadata.load(tdir)
+                except (OSError, ValueError, KeyError, TypeError):
+                    shutil.rmtree(tdir, ignore_errors=True)
+                    continue
+                if not (md.done and md.success):
+                    shutil.rmtree(tdir, ignore_errors=True)
+                    continue
+                with self._lock:
+                    self._tasks[md.task_id] = TaskStorage(tdir, md)
+                n += 1
+        if n:
+            log.info("reloaded %d completed tasks", n)
+        return n
+
+    # -- GC ------------------------------------------------------------
+
+    def _usage(self) -> tuple[int, int]:
+        """(used_bytes_by_store, capacity_bytes)."""
+        used = sum(ts.disk_usage() for ts in self.tasks())
+        if self.cfg.capacity_bytes:
+            return used, self.cfg.capacity_bytes
+        try:
+            stat = shutil.disk_usage(self.cfg.data_dir)
+            return used, stat.total
+        except OSError:
+            return used, 0
+
+    def try_gc(self) -> int:
+        """TTL sweep + usage-driven eviction, oldest-access first."""
+        reclaimed = 0
+        now = time.time()
+        candidates: list[TaskStorage] = []
+        for ts in self.tasks():
+            if ts.md.task_type != TaskType.STANDARD:
+                continue  # persistent cache entries are pinned
+            if not ts.md.done:
+                continue  # active download
+            if now - ts.md.access_time > self.cfg.task_ttl_s:
+                if self.delete_task(ts.md.task_id):
+                    reclaimed += 1
+            else:
+                candidates.append(ts)
+        used, cap = self._usage()
+        if cap and used / cap > self.cfg.disk_gc_high_ratio:
+            target = int(cap * self.cfg.disk_gc_low_ratio)
+            candidates.sort(key=lambda t: t.md.access_time)
+            for ts in candidates:
+                if used <= target:
+                    break
+                sz = ts.disk_usage()
+                if self.delete_task(ts.md.task_id):
+                    used -= sz
+                    reclaimed += 1
+        return reclaimed

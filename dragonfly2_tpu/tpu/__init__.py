@@ -1,0 +1,2 @@
+"""TPU-native layer: pod topology detection, mesh helpers, and the HBM sink
+that lands verified pieces in device memory."""

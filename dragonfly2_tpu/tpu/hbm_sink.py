@@ -1,0 +1,166 @@
+"""The HBM sink: verified pieces land in device memory, overlapped with the
+download.
+
+This is the TPU-native replacement for the GPUDirect/pinned-CUDA-memory role
+in GPU-side distribution stacks (see BASELINE.json north star). Design:
+
+- Pieces are written into a preallocated host ``numpy`` buffer (the pinned
+  staging area) at their content offsets, zero extra copies in Python
+  (memoryview slicing).
+- The content is split into ``shard_count`` contiguous byte shards. The
+  moment every byte of a shard is present, that shard is handed to
+  ``jax.device_put`` — transfers overlap the rest of the download instead of
+  waiting for completion (piece-verify ∥ device-DMA, the overlap SURVEY §7
+  flags as the hard part).
+- ``result()`` assembles per-device shards into ONE logically-global jax.Array
+  via ``jax.make_array_from_single_device_arrays`` when a mesh sharding is
+  given, so downstream JAX code sees a normal sharded array on the mesh.
+
+Single-host by design: each daemon feeds its own host's devices; cross-host
+distribution is the P2P fabric's job, not XLA's.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger("df.storage.hbm")
+
+
+class CoverageMap:
+    """Tracks which byte ranges are present; answers 'is [a,b) complete?'.
+
+    Piece arrivals are arbitrary-order; ranges are merged as they land.
+    """
+
+    def __init__(self) -> None:
+        self._ranges: list[tuple[int, int]] = []  # merged, sorted [start,end)
+        self._lock = threading.Lock()
+
+    def add(self, start: int, end: int) -> None:
+        with self._lock:
+            ranges = self._ranges
+            lo, hi = start, end
+            out = []
+            inserted = False
+            for s, e in ranges:
+                if e < lo or s > hi:   # disjoint
+                    if s > hi and not inserted:
+                        out.append((lo, hi))
+                        inserted = True
+                    out.append((s, e))
+                else:                   # overlap/adjacent: merge
+                    lo, hi = min(lo, s), max(hi, e)
+            if not inserted:
+                out.append((lo, hi))
+            out.sort()
+            self._ranges = out
+
+    def covers(self, start: int, end: int) -> bool:
+        if start >= end:
+            return True
+        with self._lock:
+            for s, e in self._ranges:
+                if s <= start and end <= e:
+                    return True
+        return False
+
+    def covered_bytes(self) -> int:
+        with self._lock:
+            return sum(e - s for s, e in self._ranges)
+
+
+class DeviceIngest:
+    """Streams a task's bytes into per-device shards as pieces arrive."""
+
+    def __init__(self, content_length: int, *, devices: Any = None,
+                 sharding: Any = None, dtype: str = "uint8"):
+        """``devices``: explicit device list (round-robin shards), or
+        ``sharding``: a 1-D jax NamedSharding to assemble a global array on.
+        """
+        import jax
+
+        if content_length <= 0:
+            raise ValueError("content_length must be known for device ingest")
+        self.content_length = content_length
+        self.dtype = np.dtype(dtype)
+        self._sharding = sharding
+        if sharding is not None:
+            devices = list(sharding.mesh.devices.flat)
+        elif devices is None:
+            devices = jax.devices()
+        self.devices = list(devices)
+        n = len(self.devices)
+        # equal shards padded to dtype & device-count alignment
+        itemsize = self.dtype.itemsize
+        padded = -(-content_length // (n * itemsize)) * (n * itemsize)
+        self.padded_length = padded
+        self.shard_bytes = padded // n
+        self.host = np.zeros(padded, dtype=np.uint8)
+        self._coverage = CoverageMap()
+        self._shard_arrays: list[Any | None] = [None] * n
+        self._shard_sent = [False] * n
+        self._lock = threading.Lock()
+        if content_length < padded:  # pad tail is trivially "present"
+            self._coverage.add(content_length, padded)
+
+    def write(self, offset: int, data: bytes | memoryview) -> None:
+        """Land one verified piece; fires device transfers for any shard the
+        piece completes."""
+        end = offset + len(data)
+        if end > self.content_length:
+            raise ValueError(f"write beyond content: {end} > {self.content_length}")
+        self.host[offset:end] = np.frombuffer(data, dtype=np.uint8)
+        self._coverage.add(offset, end)
+        first = offset // self.shard_bytes
+        last = (end - 1) // self.shard_bytes
+        for shard in range(first, min(last + 1, len(self.devices))):
+            self._maybe_send(shard)
+
+    def _maybe_send(self, shard: int) -> None:
+        s, e = shard * self.shard_bytes, (shard + 1) * self.shard_bytes
+        with self._lock:
+            if self._shard_sent[shard]:
+                return
+            if not self._coverage.covers(s, min(e, self.content_length)):
+                return
+            self._shard_sent[shard] = True
+        import jax
+
+        view = self.host[s:e].view(self.dtype)
+        # async dispatch: returns immediately, DMA overlaps further pieces
+        self._shard_arrays[shard] = jax.device_put(view, self.devices[shard])
+        log.debug("shard %d/%d -> %s", shard, len(self.devices), self.devices[shard])
+
+    def done_fraction(self) -> float:
+        return self._coverage.covered_bytes() / self.padded_length
+
+    def flush(self) -> None:
+        """Force-send incomplete shards (only valid once all writes landed)."""
+        for shard in range(len(self.devices)):
+            self._maybe_send(shard)
+
+    def result(self):
+        """Block until transfers finish; return the device-resident data.
+
+        With a sharding: one global jax.Array of shape (padded_length //
+        itemsize,) sharded over the mesh axis. Without: list of per-device
+        arrays.
+        """
+        import jax
+
+        if not all(self._shard_sent):
+            missing = [i for i, sent in enumerate(self._shard_sent) if not sent]
+            raise RuntimeError(f"shards incomplete: {missing}")
+        arrays = [a for a in self._shard_arrays]
+        for a in arrays:
+            a.block_until_ready()
+        if self._sharding is None:
+            return arrays
+        global_shape = (self.padded_length // self.dtype.itemsize,)
+        return jax.make_array_from_single_device_arrays(
+            global_shape, self._sharding, arrays)

@@ -7,10 +7,18 @@ reference validates cluster behavior on a kind cluster (SURVEY §4).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the env points JAX at real TPU hardware — tests must
+# not occupy the chip, and the sharding paths need 8 devices. The axon
+# sitecustomize hook sets jax.config programmatically, so env vars alone
+# don't win; override the config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 

@@ -1,0 +1,114 @@
+"""Stage-2 tests: coverage map + device ingest onto the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.tpu.hbm_sink import CoverageMap, DeviceIngest
+from dragonfly2_tpu.tpu.mesh import make_mesh, named_sharding
+from dragonfly2_tpu.tpu import topology
+from dragonfly2_tpu.idl.messages import LinkType, TopologyInfo
+
+
+class TestCoverageMap:
+    def test_merge_and_covers(self):
+        c = CoverageMap()
+        c.add(0, 10)
+        c.add(20, 30)
+        assert c.covers(0, 10) and not c.covers(5, 25)
+        c.add(10, 20)  # bridges the gap
+        assert c.covers(0, 30)
+        assert c.covered_bytes() == 30
+
+    def test_out_of_order_overlaps(self):
+        c = CoverageMap()
+        c.add(50, 60)
+        c.add(0, 5)
+        c.add(3, 55)
+        assert c.covers(0, 60)
+        assert c.covered_bytes() == 60
+
+
+class TestDeviceIngest:
+    def test_shards_land_on_all_devices(self):
+        import jax
+
+        content = np.random.default_rng(0).integers(0, 255, 1_000_000, dtype=np.uint8)
+        raw = content.tobytes()
+        ingest = DeviceIngest(len(raw), devices=jax.devices())
+        # feed pieces out of order
+        piece = 100_000
+        order = list(range(0, len(raw), piece))
+        order = order[1::2] + order[0::2]
+        for off in order:
+            ingest.write(off, raw[off:off + piece])
+        arrays = ingest.result()
+        assert len(arrays) == len(jax.devices())
+        flat = np.concatenate([np.asarray(a) for a in arrays])[:len(raw)]
+        assert np.array_equal(flat, content)
+
+    def test_global_sharded_array(self):
+        import jax
+
+        mesh = make_mesh({"data": len(jax.devices())})
+        sharding = named_sharding(mesh, "data")
+        raw = bytes(range(256)) * 1000
+        ingest = DeviceIngest(len(raw), sharding=sharding)
+        step = 64 * 1024
+        for off in range(0, len(raw), step):
+            ingest.write(off, raw[off:off + step])
+        arr = ingest.result()
+        assert arr.shape[0] == ingest.padded_length
+        assert len(arr.sharding.device_set) == len(jax.devices())
+        np.testing.assert_array_equal(
+            np.asarray(arr)[:len(raw)], np.frombuffer(raw, dtype=np.uint8))
+
+    def test_incomplete_result_raises(self):
+        ingest = DeviceIngest(1000)
+        ingest.write(0, b"x" * 10)
+        with pytest.raises(RuntimeError):
+            ingest.result()
+
+    def test_overlap_send_before_completion(self):
+        """Early shards ship while later bytes are still missing."""
+        import jax
+
+        n_dev = len(jax.devices())
+        ingest = DeviceIngest(n_dev * 1000, devices=jax.devices())
+        ingest.write(0, b"a" * 1000)  # completes shard 0 only
+        assert ingest._shard_sent[0]
+        assert not any(ingest._shard_sent[1:])
+
+
+class TestTopology:
+    def test_link_classification(self):
+        a = TopologyInfo(slice_name="s0", zone="z0", ici_coords=(0, 0, 0))
+        b = TopologyInfo(slice_name="s0", zone="z0", ici_coords=(1, 2, 0))
+        c = TopologyInfo(slice_name="s1", zone="z0")
+        d = TopologyInfo(slice_name="s2", zone="z9")
+        assert topology.link_type(a, b) == LinkType.ICI
+        assert topology.link_type(a, c) == LinkType.DCN
+        assert topology.link_type(a, d) == LinkType.WAN
+        assert topology.link_type(a, b, same_host=True) == LinkType.LOCAL
+        assert topology.link_type(None, b) == LinkType.WAN
+
+    def test_ici_hops(self):
+        a = TopologyInfo(ici_coords=(0, 0, 0))
+        b = TopologyInfo(ici_coords=(1, 2, 0))
+        assert topology.ici_hops(a, b) == 3
+        assert topology.ici_hops(a, TopologyInfo()) == 1 << 16
+
+    def test_detect_runs(self):
+        info = topology.detect()
+        assert info.zone  # falls back to "local"
+
+
+class TestMesh:
+    def test_make_mesh_axes(self):
+        import jax
+
+        n = len(jax.devices())
+        mesh = make_mesh({"data": -1, "model": 2})
+        assert mesh.shape["model"] == 2
+        assert mesh.shape["data"] == n // 2
+        with pytest.raises(ValueError):
+            make_mesh({"data": 3}) if n % 3 else (_ for _ in ()).throw(ValueError())

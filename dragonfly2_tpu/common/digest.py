@@ -51,21 +51,39 @@ def hash_bytes(algo: str, data: bytes | memoryview) -> str:
     return hashlib.new(algo, data).hexdigest()
 
 
-def hash_stream(algo: str, chunks: Iterator[bytes]) -> str:
-    if algo == "crc32c":
-        from ..storage import native
-        acc = 0
-        use_native = native.available()
-        for c in chunks:
-            if use_native:
-                acc = native.crc32c_update(c, acc)
+class Hasher:
+    """Incremental hasher covering all SUPPORTED algos (incl. crc32c)."""
+
+    def __init__(self, algo: str):
+        self.algo = algo
+        self._crc: int | None = None
+        self._h = None
+        if algo == "crc32c":
+            self._crc = 0
+            from ..storage import native
+            self._native = native if native.available() else None
+        elif algo == "blake2b":
+            self._h = hashlib.blake2b(digest_size=32)
+        else:
+            self._h = hashlib.new(algo)
+
+    def update(self, data: bytes) -> None:
+        if self._crc is not None:
+            if self._native is not None:
+                self._crc = self._native.crc32c_update(data, self._crc)
             else:
-                acc = _crc32c_py(c, acc)
-        return f"{acc:08x}"
-    if algo == "blake2b":
-        h = hashlib.blake2b(digest_size=32)
-    else:
-        h = hashlib.new(algo)
+                self._crc = _crc32c_py(data, self._crc)
+        else:
+            self._h.update(data)
+
+    def hexdigest(self) -> str:
+        if self._crc is not None:
+            return f"{self._crc:08x}"
+        return self._h.hexdigest()
+
+
+def hash_stream(algo: str, chunks: Iterator[bytes]) -> str:
+    h = Hasher(algo)
     for c in chunks:
         h.update(c)
     return h.hexdigest()

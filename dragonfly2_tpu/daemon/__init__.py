@@ -1,0 +1,4 @@
+"""The peer daemon (data plane): peertask engine, piece manager, storage,
+upload server, proxy, object gateway, announcer — one per host.
+
+Role parity: reference ``client/daemon`` (SURVEY §2.3)."""

@@ -1,0 +1,352 @@
+"""PeerTaskConductor: the per-(task, peer) download state machine.
+
+Role parity: reference ``client/daemon/peer/peertask_conductor.go`` — one
+conductor per running task in the daemon: registers with the scheduler, pulls
+pieces (P2P or back-source), lands them in storage (and optionally straight
+into TPU HBM via the DeviceIngest sink), broadcasts progress to subscribers
+(file/stream façades), reports results, and finalizes with digest check.
+
+Stage layout: the back-source ladder and storage/sink/subscriber machinery
+live here; P2P pulling attaches through ``set_p2p_engine`` (piece_engine.py)
+and the scheduler stream through ``scheduler_session.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, AsyncIterator
+
+from ..common import digest as digestlib
+from ..common.errors import Code, DFError
+from ..common.logging import with_fields
+from ..common.piece import Range, compute_piece_size, piece_count
+from ..idl.messages import TaskType, UrlMeta
+from ..storage.manager import StorageManager
+from ..storage.metadata import TaskMetadata
+from ..storage.store import TaskStorage
+
+log = logging.getLogger("df.core.conductor")
+
+
+class PeerTaskConductor:
+    # terminal states
+    PENDING, RUNNING, SUCCESS, FAILED = "pending", "running", "success", "failed"
+
+    def __init__(self, *, task_id: str, peer_id: str, url: str,
+                 url_meta: UrlMeta | None, storage_mgr: StorageManager,
+                 piece_mgr: Any, scheduler: Any = None,
+                 content_range: Range | None = None,
+                 disable_back_source: bool = False,
+                 task_type: TaskType = TaskType.STANDARD,
+                 device_sink_factory: Any = None,
+                 trace: Any = None):
+        self.task_id = task_id
+        self.peer_id = peer_id
+        self.url = url
+        self.url_meta = url_meta or UrlMeta()
+        self.storage_mgr = storage_mgr
+        self.piece_mgr = piece_mgr
+        self.scheduler = scheduler
+        self.content_range = content_range
+        self.disable_back_source = disable_back_source
+        self.task_type = task_type
+        self.device_sink_factory = device_sink_factory
+        self.trace = trace
+
+        self.state = self.PENDING
+        self.fail_code = Code.OK
+        self.fail_message = ""
+        self.content_length = -1
+        self.piece_size = 0
+        self.total_pieces = -1
+        self.completed_length = 0
+        self.traffic_p2p = 0          # bytes from peers (for egress-saved stats)
+        self.traffic_source = 0       # bytes from origin
+        self.start_ms = int(time.time() * 1000)
+
+        self.storage: TaskStorage | None = None
+        self.device_ingest: Any = None
+        self.ready: set[int] = set()          # piece numbers landed
+        self.done_event = asyncio.Event()
+        self._piece_cond = asyncio.Condition()
+        self._subscribers: list[asyncio.Queue] = []
+        self._run_task: asyncio.Task | None = None
+        self._p2p_engine: Any = None
+        self.log = with_fields("df.core.conductor",
+                               task=task_id[:12], peer=peer_id[-12:])
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._run_task is None:
+            self.state = self.RUNNING
+            self._run_task = asyncio.get_running_loop().create_task(self._run())
+
+    def set_p2p_engine(self, engine: Any) -> None:
+        self._p2p_engine = engine
+
+    async def _run(self) -> None:
+        try:
+            used_p2p = False
+            if self.scheduler is not None:
+                used_p2p = await self._try_p2p()
+            if not used_p2p:
+                if self.disable_back_source:
+                    raise DFError(Code.CLIENT_BACK_SOURCE_ERROR,
+                                  "no P2P path and back-source disabled")
+                self.log.info("back-source: %s", self.url)
+                await self.piece_mgr.download_source(self)
+            await self._finish_success()
+        except asyncio.CancelledError:
+            await self._finish_fail(Code.CLIENT_CONTEXT_CANCELED, "canceled")
+        except DFError as exc:
+            await self._finish_fail(exc.code, exc.message)
+        except Exception as exc:  # noqa: BLE001
+            self.log.exception("task failed")
+            await self._finish_fail(Code.UNKNOWN, str(exc))
+
+    async def _try_p2p(self) -> bool:
+        """Register + pull via the P2P engine. Returns False to signal the
+        caller to fall back to origin (the reference's fallback ladder:
+        register-fail / NeedBackSource / schedule-timeout)."""
+        try:
+            session = await self.scheduler.register(self)
+        except DFError as exc:
+            if exc.code in (Code.SCHED_NEED_BACK_SOURCE, Code.UNAVAILABLE,
+                            Code.DEADLINE_EXCEEDED):
+                self.log.info("register says back-source: %s", exc.message)
+                return False
+            raise
+        except Exception as exc:  # scheduler unreachable entirely
+            self.log.warning("scheduler unreachable (%s); falling back", exc)
+            return False
+        if session is None:
+            return False
+        if self._p2p_engine is None:
+            await session.close(success=False)
+            return False
+        try:
+            return await self._p2p_engine.pull(self, session)
+        finally:
+            await session.close(success=self.state != self.FAILED)
+
+    # ------------------------------------------------------------------
+    # content metadata + piece arrival (called by piece manager / engine)
+    # ------------------------------------------------------------------
+
+    def set_content_info(self, content_length: int,
+                         piece_size: int = 0) -> int:
+        """Fix piece geometry; register storage + device sink. Returns the
+        piece size. ``content_length`` is the EFFECTIVE length this task
+        stores (the sub-range length for ranged tasks — piece offsets are
+        range-relative). Safe to call more than once with identical values."""
+        if self.piece_size:
+            return self.piece_size
+        effective_len = content_length
+        self.content_length = effective_len
+        self.piece_size = piece_size or compute_piece_size(max(effective_len, 0))
+        if effective_len >= 0:
+            self.total_pieces = piece_count(effective_len, self.piece_size)
+        md = TaskMetadata(
+            task_id=self.task_id, task_type=self.task_type, url=self.url,
+            tag=self.url_meta.tag, application=self.url_meta.application,
+            content_length=effective_len, total_piece_count=self.total_pieces,
+            piece_size=self.piece_size, digest=self.url_meta.digest)
+        self.storage = self.storage_mgr.register_task(md)
+        if (self.device_sink_factory is not None and effective_len > 0
+                and self.device_ingest is None):
+            try:
+                self.device_ingest = self.device_sink_factory(effective_len)
+            except Exception:  # device sink is best-effort
+                self.log.exception("device sink init failed; continuing to disk")
+        return self.piece_size
+
+    async def on_piece_from_source(self, num: int, offset: int, data: bytes,
+                                   cost_ms: int) -> None:
+        await self._land_piece(num, offset, data, cost_ms, source="")
+        self.traffic_source += len(data)
+
+    async def on_piece_from_peer(self, num: int, offset: int, data: bytes,
+                                 cost_ms: int, parent_id: str,
+                                 piece_digest: str = "") -> None:
+        await self._land_piece(num, offset, data, cost_ms, source=parent_id,
+                               piece_digest=piece_digest)
+        self.traffic_p2p += len(data)
+
+    async def _land_piece(self, num: int, offset: int, data: bytes,
+                          cost_ms: int, source: str,
+                          piece_digest: str = "") -> None:
+        if self.storage is None:
+            raise DFError(Code.CLIENT_STORAGE_ERROR, "piece before content info")
+        if num in self.ready:
+            return
+        # hashing+write can take ms at 16MiB — keep the loop responsive
+        await asyncio.to_thread(self.storage.write_piece, num, offset, data,
+                                piece_digest, cost_ms=cost_ms, source=source)
+        if self.device_ingest is not None:
+            try:
+                await asyncio.to_thread(self.device_ingest.write, offset, data)
+            except Exception:
+                self.log.exception("device ingest write failed; disabling sink")
+                self.device_ingest = None
+        async with self._piece_cond:
+            self.ready.add(num)
+            self.completed_length += len(data)
+            self._piece_cond.notify_all()
+        self._publish({"type": "piece", "num": num, "size": len(data),
+                       "completed": self.completed_length,
+                       "total": self.content_length})
+
+    def on_source_complete(self, total: int) -> None:
+        if self.content_length < 0:
+            self.content_length = total
+            self.total_pieces = len(self.ready)
+            if self.storage is not None:
+                self.storage.md.content_length = total
+                self.storage.md.total_piece_count = self.total_pieces
+
+    # ------------------------------------------------------------------
+    # finalize
+    # ------------------------------------------------------------------
+
+    async def _verify_digest(self) -> None:
+        if not self.url_meta.digest or self.storage is None:
+            return
+        if self.content_range is not None:
+            # the digest describes the whole file; a sub-range can't check it
+            return
+        algo, want = digestlib.parse(self.url_meta.digest)
+
+        def compute() -> str:
+            def chunks():
+                with open(self.storage.data_path(), "rb") as f:
+                    remaining = self.content_length
+                    while remaining > 0:
+                        b = f.read(min(4 << 20, remaining))
+                        if not b:
+                            return
+                        remaining -= len(b)
+                        yield b
+            return digestlib.hash_stream(algo, chunks())
+
+        got = await asyncio.to_thread(compute)
+        if got != want:
+            raise DFError(Code.CLIENT_DIGEST_MISMATCH,
+                          f"content digest mismatch: {algo}:{got[:12]}..")
+
+    async def _finish_success(self) -> None:
+        if self.total_pieces >= 0 and len(self.ready) < self.total_pieces:
+            raise DFError(Code.CLIENT_STORAGE_ERROR,
+                          f"incomplete: {len(self.ready)}/{self.total_pieces} pieces")
+        await self._verify_digest()
+        if self.storage is not None:
+            await asyncio.to_thread(
+                self.storage.mark_done, success=True,
+                content_length=self.content_length,
+                total_piece_count=self.total_pieces)
+        if self.device_ingest is not None:
+            try:
+                self.device_ingest.flush()
+            except Exception:
+                self.log.exception("device sink flush failed")
+                self.device_ingest = None
+        self.state = self.SUCCESS
+        self._publish({"type": "done", "success": True,
+                       "completed": self.completed_length,
+                       "total": self.content_length})
+        self.done_event.set()
+        async with self._piece_cond:
+            self._piece_cond.notify_all()
+        self.log.info("task success: %d bytes, %d pieces (p2p=%d src=%d)",
+                      self.completed_length, len(self.ready),
+                      self.traffic_p2p, self.traffic_source)
+
+    async def _finish_fail(self, code: Code, message: str) -> None:
+        if self.state in (self.SUCCESS, self.FAILED):
+            return
+        self.state = self.FAILED
+        self.fail_code = code
+        self.fail_message = message
+        if self.storage is not None:
+            try:
+                await asyncio.to_thread(self.storage.mark_done, success=False)
+            except Exception:  # noqa: BLE001
+                pass
+        self._publish({"type": "done", "success": False, "code": int(code),
+                       "message": message})
+        self.done_event.set()
+        async with self._piece_cond:
+            self._piece_cond.notify_all()
+        self.log.warning("task failed: %s %s", code.name, message)
+
+    async def wait_done(self, timeout: float | None = None) -> bool:
+        if timeout:
+            try:
+                await asyncio.wait_for(self.done_event.wait(), timeout)
+            except asyncio.TimeoutError:
+                return False
+        else:
+            await self.done_event.wait()
+        return self.state == self.SUCCESS
+
+    def cancel(self) -> None:
+        if self._run_task is not None:
+            self._run_task.cancel()
+
+    # ------------------------------------------------------------------
+    # progress fan-out
+    # ------------------------------------------------------------------
+
+    def subscribe(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._subscribers.append(q)
+        if self.done_event.is_set():
+            q.put_nowait({"type": "done", "success": self.state == self.SUCCESS,
+                          "code": int(self.fail_code),
+                          "completed": self.completed_length,
+                          "total": self.content_length,
+                          "message": self.fail_message})
+        return q
+
+    def unsubscribe(self, q: asyncio.Queue) -> None:
+        try:
+            self._subscribers.remove(q)
+        except ValueError:
+            pass
+
+    def _publish(self, event: dict) -> None:
+        for q in list(self._subscribers):
+            q.put_nowait(event)
+
+    # ------------------------------------------------------------------
+    # ordered byte stream (stream tasks, proxy, object gateway)
+    # ------------------------------------------------------------------
+
+    async def read_ordered(self) -> AsyncIterator[bytes]:
+        """Yield content bytes in order as pieces become ready."""
+        num = 0
+        while True:
+            async with self._piece_cond:
+                while (num not in self.ready
+                       and not self.done_event.is_set()):
+                    await self._piece_cond.wait()
+            if num in self.ready:
+                assert self.storage is not None
+                data = await asyncio.to_thread(self.storage.read_piece, num)
+                yield data
+                num += 1
+                if self.total_pieces >= 0 and num >= self.total_pieces:
+                    return
+                continue
+            # done without the piece -> task ended
+            if self.state == self.FAILED:
+                raise DFError(self.fail_code or Code.UNKNOWN,
+                              self.fail_message or "task failed")
+            if self.total_pieces >= 0 and num >= self.total_pieces:
+                return
+            if self.total_pieces < 0:
+                return

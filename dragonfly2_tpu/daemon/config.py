@@ -1,0 +1,81 @@
+"""Daemon configuration.
+
+Role parity: reference ``client/config/peerhost.go`` (DaemonOption tree),
+trimmed to the knobs this implementation actually honors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..common.unit import MiB
+
+
+@dataclass
+class SchedulerConfig:
+    addresses: list[str] = field(default_factory=list)  # empty -> no scheduler (back-source only)
+    register_timeout_s: float = 10.0
+    schedule_timeout_s: float = 30.0       # max wait for a usable peer packet
+    max_reschedule: int = 5                # reference RetryLimit
+
+
+@dataclass
+class DownloadConfig:
+    piece_parallelism: int = 4             # piece download workers per task
+    back_source_parallelism: int = 4       # concurrent origin range streams
+    back_source_group_min_bytes: int = 32 * MiB  # below this, one stream
+    total_rate_limit_bps: int = 0          # 0 = unlimited
+    per_peer_rate_limit_bps: int = 0
+    prefetch_whole_file: bool = False      # ranged requests warm the whole task
+    first_piece_timeout_s: float = 30.0
+    piece_timeout_s: float = 60.0
+
+
+@dataclass
+class UploadConfig:
+    port: int = 0                          # 0 = ephemeral
+    rate_limit_bps: int = 0
+    concurrent_limit: int = 100
+
+
+@dataclass
+class StorageSection:
+    task_ttl_s: float = 6 * 3600.0
+    disk_gc_high_ratio: float = 0.90
+    disk_gc_low_ratio: float = 0.80
+    capacity_bytes: int = 0
+    gc_interval_s: float = 60.0
+
+
+@dataclass
+class ProxyConfig:
+    enabled: bool = False
+    port: int = 0
+    registry_mirror: str = ""              # upstream registry URL
+    rules: list[str] = field(default_factory=list)  # regexes routed via P2P
+    direct_rules: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ObjectStorageConfig:
+    enabled: bool = False
+    port: int = 0
+
+
+@dataclass
+class DaemonConfig:
+    workdir: str = ""
+    host_ip: str = ""
+    hostname: str = ""
+    is_seed: bool = False
+    rpc_port: int = 0                      # peer gRPC (0 = ephemeral)
+    unix_sock: str = ""                    # local API socket path
+    manager_addresses: list[str] = field(default_factory=list)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    download: DownloadConfig = field(default_factory=DownloadConfig)
+    upload: UploadConfig = field(default_factory=UploadConfig)
+    storage: StorageSection = field(default_factory=StorageSection)
+    proxy: ProxyConfig = field(default_factory=ProxyConfig)
+    object_storage: ObjectStorageConfig = field(default_factory=ObjectStorageConfig)
+    announce_interval_s: float = 30.0
+    metrics_port: int = 0                  # 0 = disabled

@@ -1,0 +1,158 @@
+"""Daemon bootstrap: assemble storage, piece engine, servers; serve.
+
+Role parity: reference ``client/daemon/daemon.go`` ``New``/``Serve`` — wires
+the listeners (local API gRPC on unix socket, peer gRPC on TCP, upload HTTP,
+optional proxy/object-gateway HTTP), the GC loop, the announcer, and the
+scheduler client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+from typing import Any
+
+from ..common.dfpath import DFPath
+from ..common.gc import GC, GCTask
+from ..idl.messages import DeviceSink, Host, HostType
+from ..storage.manager import StorageConfig, StorageManager
+from ..tpu import topology
+from .config import DaemonConfig
+from .peertask_manager import PeerTaskManager
+from .piece_manager import PieceManager
+from .rpcserver import DaemonService, build_service
+from .upload_server import UploadServer
+from ..rpc.server import RPCServer
+
+log = logging.getLogger("df.core.daemon")
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+class Daemon:
+    def __init__(self, cfg: DaemonConfig, *, scheduler_factory: Any = None,
+                 p2p_engine_factory: Any = None):
+        self.cfg = cfg
+        self.hostname = cfg.hostname or socket.gethostname()
+        self.host_ip = cfg.host_ip or _local_ip()
+        self.paths = DFPath(cfg.workdir) if cfg.workdir else DFPath()
+        self.paths.ensure()
+        self.topology = topology.detect()
+        self.storage_mgr = StorageManager(StorageConfig(
+            data_dir=os.path.join(self.paths.data_dir, "tasks"),
+            task_ttl_s=cfg.storage.task_ttl_s,
+            disk_gc_high_ratio=cfg.storage.disk_gc_high_ratio,
+            disk_gc_low_ratio=cfg.storage.disk_gc_low_ratio,
+            capacity_bytes=cfg.storage.capacity_bytes,
+            gc_interval_s=cfg.storage.gc_interval_s))
+        self.piece_mgr = PieceManager(cfg.download)
+        self.upload_server = UploadServer(
+            self.storage_mgr, port=cfg.upload.port,
+            rate_limit_bps=cfg.upload.rate_limit_bps, host="127.0.0.1")
+        self._scheduler_factory = scheduler_factory
+        self._p2p_engine_factory = p2p_engine_factory
+        self.scheduler: Any = None
+        self.ptm: PeerTaskManager | None = None
+        self.rpc: RPCServer | None = None
+        self.local_rpc: RPCServer | None = None
+        self.gc = GC()
+        self.proxy_server: Any = None
+        self.object_gateway: Any = None
+        self.announcer: Any = None
+
+    # ------------------------------------------------------------------
+
+    def host_info(self) -> Host:
+        return Host(
+            id=f"{self.hostname}-{self.host_ip}",
+            ip=self.host_ip, hostname=self.hostname,
+            port=self.rpc.port if self.rpc else 0,
+            download_port=self.upload_server.port,
+            type=HostType.SUPER_SEED if self.cfg.is_seed else HostType.NORMAL,
+            os=os.uname().sysname.lower(), platform=os.uname().machine,
+            topology=self.topology,
+            concurrent_upload_limit=self.cfg.upload.concurrent_limit)
+
+    def device_sink_builder(self, spec: DeviceSink):
+        """Returns a factory(content_length) -> DeviceIngest honoring the
+        request's sink spec."""
+        def factory(content_length: int):
+            from ..tpu.hbm_sink import DeviceIngest
+            return DeviceIngest(content_length, dtype=spec.dtype)
+        return factory
+
+    async def start(self) -> None:
+        await self.upload_server.start()
+        if self._scheduler_factory is not None:
+            self.scheduler = self._scheduler_factory(self)
+        self.ptm = PeerTaskManager(
+            storage_mgr=self.storage_mgr, piece_mgr=self.piece_mgr,
+            hostname=self.hostname, host_ip=self.host_ip,
+            scheduler=self.scheduler,
+            p2p_engine_factory=self._p2p_engine_factory,
+            device_sink_builder=self.device_sink_builder,
+            is_seed=self.cfg.is_seed)
+        svc = DaemonService(self.ptm,
+                            upload_addr=f"127.0.0.1:{self.upload_server.port}")
+        # peer-facing TCP server
+        self.rpc = RPCServer(f"127.0.0.1:{self.cfg.rpc_port}")
+        for sdef in build_service(svc):
+            self.rpc.register(sdef)
+        await self.rpc.start()
+        # local API over unix socket (dfget/dfcache/dfstore)
+        sock = self.cfg.unix_sock or self.paths.daemon_sock()
+        if os.path.exists(sock):
+            os.unlink(sock)
+        self.local_rpc = RPCServer(f"unix:{sock}")
+        for sdef in build_service(svc):
+            self.local_rpc.register(sdef)
+        await self.local_rpc.start()
+        self.unix_sock = sock
+        # optional HTTP surfaces
+        if self.cfg.proxy.enabled:
+            from .proxy import ProxyServer
+            self.proxy_server = ProxyServer(self, self.cfg.proxy)
+            await self.proxy_server.start()
+        if self.cfg.object_storage.enabled:
+            from .objectstorage import ObjectGateway
+            self.object_gateway = ObjectGateway(self, self.cfg.object_storage)
+            await self.object_gateway.start()
+        self.gc.add(GCTask("storage", self.cfg.storage.gc_interval_s,
+                           self.storage_mgr.try_gc))
+        self.gc.start()
+        if self.scheduler is not None and hasattr(self.scheduler, "announce_loop"):
+            from .announcer import Announcer
+            self.announcer = Announcer(self)
+            await self.announcer.start()
+        log.info("daemon up: host=%s ip=%s rpc=%s upload=%d sock=%s seed=%s",
+                 self.hostname, self.host_ip, self.rpc.port,
+                 self.upload_server.port, sock, self.cfg.is_seed)
+
+    async def stop(self) -> None:
+        if self.announcer is not None:
+            await self.announcer.stop()
+        await self.gc.stop()
+        if self.ptm is not None:
+            await self.ptm.shutdown()
+        if self.proxy_server is not None:
+            await self.proxy_server.stop()
+        if self.object_gateway is not None:
+            await self.object_gateway.stop()
+        if self.local_rpc is not None:
+            await self.local_rpc.stop(0.2)
+        if self.rpc is not None:
+            await self.rpc.stop(0.2)
+        await self.upload_server.stop()
+        if self.scheduler is not None and hasattr(self.scheduler, "close"):
+            await self.scheduler.close()

@@ -1,0 +1,254 @@
+"""PeerTaskManager: deduplicates conductors per task, serves file/stream
+façades, and the reuse fast path.
+
+Role parity: reference ``client/daemon/peer/peertask_manager.go`` +
+``peertask_file.go`` / ``peertask_stream.go`` / ``peertask_reuse.go``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator
+
+from ..common import ids
+from ..common.errors import Code, DFError
+from ..common.piece import Range, parse_http_range
+from ..idl.messages import (DownloadRequest, DownloadResponse, TaskStat,
+                            TaskType, UrlMeta)
+from ..storage.manager import StorageManager
+from .conductor import PeerTaskConductor
+from .piece_manager import PieceManager
+
+log = logging.getLogger("df.core.peertask")
+
+
+class PeerTaskManager:
+    def __init__(self, *, storage_mgr: StorageManager, piece_mgr: PieceManager,
+                 hostname: str, host_ip: str, scheduler: Any = None,
+                 p2p_engine_factory: Any = None,
+                 device_sink_builder: Any = None, is_seed: bool = False):
+        self.storage_mgr = storage_mgr
+        self.piece_mgr = piece_mgr
+        self.hostname = hostname
+        self.host_ip = host_ip
+        self.scheduler = scheduler
+        self.p2p_engine_factory = p2p_engine_factory
+        self.device_sink_builder = device_sink_builder
+        self.is_seed = is_seed
+        self._conductors: dict[str, PeerTaskConductor] = {}
+        self._lock = asyncio.Lock()
+
+    # ------------------------------------------------------------------
+
+    def _task_id(self, url: str, meta: UrlMeta) -> str:
+        return ids.task_id(
+            url, tag=meta.tag, application=meta.application, digest=meta.digest,
+            piece_range=meta.range,
+            filtered_query_params=list(meta.filtered_query_params or []))
+
+    async def get_or_create_conductor(
+            self, url: str, meta: UrlMeta, *,
+            task_type: TaskType = TaskType.STANDARD,
+            disable_back_source: bool = False,
+            device_sink_factory: Any = None) -> PeerTaskConductor:
+        task_id = self._task_id(url, meta)
+        content_range: Range | None = None
+        async with self._lock:
+            conductor = self._conductors.get(task_id)
+            if conductor is not None and conductor.state != PeerTaskConductor.FAILED:
+                return conductor
+            conductor = PeerTaskConductor(
+                task_id=task_id,
+                peer_id=ids.peer_id(self.hostname, self.host_ip, seed=self.is_seed),
+                url=url, url_meta=meta, storage_mgr=self.storage_mgr,
+                piece_mgr=self.piece_mgr, scheduler=self.scheduler,
+                content_range=content_range,
+                disable_back_source=disable_back_source, task_type=task_type,
+                device_sink_factory=device_sink_factory)
+            if self.p2p_engine_factory is not None:
+                conductor.set_p2p_engine(self.p2p_engine_factory())
+            self._conductors[task_id] = conductor
+            conductor.start()
+            return conductor
+
+    def conductor(self, task_id: str) -> PeerTaskConductor | None:
+        return self._conductors.get(task_id)
+
+    # ------------------------------------------------------------------
+    # file task: download -> progress events -> land at output path
+    # ------------------------------------------------------------------
+
+    async def start_file_task(
+            self, req: DownloadRequest) -> AsyncIterator[DownloadResponse]:
+        meta = req.url_meta or UrlMeta()
+        task_id = self._task_id(req.url, meta)
+
+        # reuse fast path: completed task (or a whole-file parent covering a
+        # ranged request) already on disk
+        reuse = self.storage_mgr.find_completed_task(task_id)
+        rng: Range | None = None
+        if meta.range and reuse is None:
+            # ranged request: serve from the whole-file parent when present
+            parent_id = ids.parent_task_id(
+                req.url, tag=meta.tag, application=meta.application,
+                digest=meta.digest,
+                filtered_query_params=list(meta.filtered_query_params or []))
+            parent = self.storage_mgr.get(parent_id)
+            if (parent is not None and getattr(parent.md, "done", False)
+                    and parent.md.content_length >= 0):
+                total = parent.md.content_length
+                try:
+                    rng = parse_http_range(meta.range, total)
+                except ValueError as exc:
+                    raise DFError(Code.INVALID_ARGUMENT, str(exc)) from None
+                reuse = self.storage_mgr.find_partial_completed_task(
+                    parent_id, rng.start, rng.length)
+                if reuse is None:
+                    rng = None
+        if reuse is not None:
+            if req.output:
+                await asyncio.to_thread(
+                    reuse.store_to, req.output,
+                    **({"range_start": rng.start, "range_length": rng.length}
+                       if rng else {}))
+            length = rng.length if rng else reuse.md.content_length
+            yield DownloadResponse(task_id=task_id, peer_id="reused",
+                                   completed_length=length,
+                                   content_length=length, done=True,
+                                   output=req.output)
+            return
+
+        device_factory = None
+        if req.device_sink is not None and req.device_sink.enabled \
+                and self.device_sink_builder is not None:
+            device_factory = self.device_sink_builder(req.device_sink)
+
+        conductor = await self.get_or_create_conductor(
+            req.url, meta, task_type=req.task_type,
+            disable_back_source=req.disable_back_source,
+            device_sink_factory=device_factory)
+        q = conductor.subscribe()
+        try:
+            while True:
+                timeout = req.timeout_s if req.timeout_s > 0 else None
+                try:
+                    event = await asyncio.wait_for(q.get(), timeout)
+                except asyncio.TimeoutError:
+                    raise DFError(Code.DEADLINE_EXCEEDED,
+                                  f"download timed out after {req.timeout_s}s") from None
+                if event["type"] == "piece":
+                    yield DownloadResponse(
+                        task_id=conductor.task_id, peer_id=conductor.peer_id,
+                        completed_length=event["completed"],
+                        content_length=event["total"])
+                elif event["type"] == "done":
+                    if not event.get("success"):
+                        raise DFError(Code(event.get("code") or Code.UNKNOWN),
+                                      event.get("message", "download failed"))
+                    if req.output:
+                        assert conductor.storage is not None
+                        await asyncio.to_thread(conductor.storage.store_to,
+                                                req.output)
+                    yield DownloadResponse(
+                        task_id=conductor.task_id, peer_id=conductor.peer_id,
+                        completed_length=conductor.completed_length,
+                        content_length=conductor.content_length,
+                        done=True, output=req.output)
+                    return
+        finally:
+            conductor.unsubscribe(q)
+
+    # ------------------------------------------------------------------
+    # stream task: ordered bytes (proxy / gateway / dfget stdout)
+    # ------------------------------------------------------------------
+
+    async def stream_task(self, url: str, meta: UrlMeta | None = None,
+                          ) -> tuple[str, AsyncIterator[bytes]]:
+        meta = meta or UrlMeta()
+        task_id = self._task_id(url, meta)
+        reuse = self.storage_mgr.find_completed_task(task_id)
+        if reuse is not None:
+            async def replay() -> AsyncIterator[bytes]:
+                for p in reuse.piece_infos():
+                    yield await asyncio.to_thread(reuse.read_piece, p.num)
+            return task_id, replay()
+        conductor = await self.get_or_create_conductor(url, meta)
+        return task_id, conductor.read_ordered()
+
+    # ------------------------------------------------------------------
+    # cache ops (dfcache surface)
+    # ------------------------------------------------------------------
+
+    async def stat_task(self, task_id: str, *, local_only: bool = True) -> TaskStat:
+        ts = self.storage_mgr.get(task_id)
+        if ts is None:
+            conductor = self._conductors.get(task_id)
+            if conductor is None:
+                raise DFError(Code.NOT_FOUND, f"task {task_id[:12]} not found")
+            return TaskStat(id=task_id, state=conductor.state,
+                            content_length=conductor.content_length,
+                            total_piece_count=conductor.total_pieces)
+        md = ts.md
+        return TaskStat(id=task_id, type=md.task_type,
+                        content_length=md.content_length,
+                        total_piece_count=md.total_piece_count,
+                        state="success" if md.success else
+                              ("done" if md.done else "running"),
+                        has_available_peer=md.done and md.success)
+
+    async def import_file(self, path: str, url: str, meta: UrlMeta | None = None,
+                          task_type: TaskType = TaskType.PERSISTENT) -> str:
+        meta = meta or UrlMeta()
+        task_id = self._task_id(url, meta)
+        if self.storage_mgr.find_completed_task(task_id) is not None:
+            return task_id
+        conductor = PeerTaskConductor(
+            task_id=task_id,
+            peer_id=ids.peer_id(self.hostname, self.host_ip, seed=self.is_seed),
+            url=url, url_meta=meta, storage_mgr=self.storage_mgr,
+            piece_mgr=self.piece_mgr, scheduler=None, task_type=task_type)
+        self._conductors[task_id] = conductor
+
+        async def run_import():
+            try:
+                await self.piece_mgr.import_file(conductor, path)
+                await conductor._finish_success()
+            except DFError as exc:
+                await conductor._finish_fail(exc.code, exc.message)
+            except Exception as exc:  # noqa: BLE001
+                await conductor._finish_fail(Code.UNKNOWN, str(exc))
+
+        asyncio.get_running_loop().create_task(run_import())
+        ok = await conductor.wait_done()
+        if not ok:
+            raise DFError(conductor.fail_code, conductor.fail_message)
+        return task_id
+
+    async def export_file(self, url: str, output: str,
+                          meta: UrlMeta | None = None, *,
+                          local_only: bool = False, timeout_s: float = 0.0) -> str:
+        meta = meta or UrlMeta()
+        task_id = self._task_id(url, meta)
+        ts = self.storage_mgr.find_completed_task(task_id)
+        if ts is not None:
+            await asyncio.to_thread(ts.store_to, output)
+            return task_id
+        if local_only:
+            raise DFError(Code.NOT_FOUND, "task not cached locally")
+        req = DownloadRequest(url=url, output=output, url_meta=meta,
+                              timeout_s=timeout_s)
+        async for _ in self.start_file_task(req):
+            pass
+        return task_id
+
+    async def delete_task(self, task_id: str) -> bool:
+        conductor = self._conductors.pop(task_id, None)
+        if conductor is not None and not conductor.done_event.is_set():
+            conductor.cancel()
+        return self.storage_mgr.delete_task(task_id)
+
+    async def shutdown(self) -> None:
+        for conductor in list(self._conductors.values()):
+            if not conductor.done_event.is_set():
+                conductor.cancel()

@@ -1,0 +1,167 @@
+"""Daemon gRPC services.
+
+Role parity: reference ``client/daemon/rpcserver/rpcserver.go`` — the local
+API (``Download`` server-stream, cache ops) and the peer API
+(``GetPieceTasks``, ``SyncPieceTasks`` bidi, seeder ``ObtainSeeds``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator
+
+from ..common.errors import Code, DFError
+from ..idl.messages import (DeleteTaskRequest, DownloadRequest, Empty,
+                            ExportTaskRequest, ImportTaskRequest,
+                            ObtainSeedsRequest, PiecePacket, PieceSeed,
+                            PieceTaskRequest, StatTaskDaemonRequest, TaskStat,
+                            UrlMeta)
+from ..rpc.server import RPCServer, ServiceDef
+from .peertask_manager import PeerTaskManager
+
+log = logging.getLogger("df.rpc.daemon")
+
+DAEMON_SERVICE = "df.daemon.Daemon"
+SEEDER_SERVICE = "df.daemon.Seeder"
+
+
+class DaemonService:
+    """Wire handlers; pure delegation to PeerTaskManager + storage."""
+
+    def __init__(self, ptm: PeerTaskManager, *, upload_addr: str = ""):
+        self.ptm = ptm
+        self.upload_addr = upload_addr
+
+    # -- local API -----------------------------------------------------
+
+    async def download(self, request: DownloadRequest, context) -> AsyncIterator:
+        async for resp in self.ptm.start_file_task(request):
+            yield resp
+
+    async def stat_task(self, request: StatTaskDaemonRequest, context) -> TaskStat:
+        task_id = request.task_id or self.ptm._task_id(
+            request.url, request.url_meta or UrlMeta())
+        return await self.ptm.stat_task(task_id, local_only=request.local_only)
+
+    async def import_task(self, request: ImportTaskRequest, context) -> TaskStat:
+        task_id = await self.ptm.import_file(
+            request.path, request.url, request.url_meta,
+            task_type=request.task_type)
+        return await self.ptm.stat_task(task_id)
+
+    async def export_task(self, request: ExportTaskRequest, context) -> Empty:
+        await self.ptm.export_file(request.url, request.output,
+                                   request.url_meta, local_only=request.local_only,
+                                   timeout_s=request.timeout_s)
+        return Empty()
+
+    async def delete_task(self, request: DeleteTaskRequest, context) -> Empty:
+        task_id = request.task_id or self.ptm._task_id(
+            request.url, request.url_meta or UrlMeta())
+        await self.ptm.delete_task(task_id)
+        return Empty()
+
+    # -- peer API ------------------------------------------------------
+
+    async def get_piece_tasks(self, request: PieceTaskRequest, context) -> PiecePacket:
+        ts = self.ptm.storage_mgr.get(request.task_id)
+        conductor = self.ptm.conductor(request.task_id)
+        if ts is None and conductor is not None:
+            ts = conductor.storage
+        if ts is None:
+            raise DFError(Code.NOT_FOUND, f"task {request.task_id[:12]} unknown")
+        infos = [p.to_info() for p in ts.piece_infos(request.start_num, request.limit)]
+        md = ts.md
+        return PiecePacket(task_id=request.task_id, dst_peer_id=request.dst_peer_id,
+                           dst_addr=self.upload_addr, piece_infos=infos,
+                           total_piece_count=md.total_piece_count,
+                           content_length=md.content_length,
+                           piece_size=md.piece_size)
+
+    async def sync_piece_tasks(self, request_iter, context) -> AsyncIterator:
+        """Bidi: each request asks for piece metadata; responses stream as
+        pieces appear (push on piece arrival for running tasks)."""
+        async for request in request_iter:
+            conductor = self.ptm.conductor(request.task_id)
+            sent: set[int] = set()
+            packet = await self.get_piece_tasks(request, context)
+            for p in packet.piece_infos or []:
+                sent.add(p.piece_num)
+            yield packet
+            if conductor is None or conductor.done_event.is_set():
+                continue
+            # live task: push updates until done
+            q = conductor.subscribe()
+            try:
+                while True:
+                    event = await q.get()
+                    if event["type"] == "piece" and event["num"] not in sent:
+                        sent.add(event["num"])
+                        refreshed = await self.get_piece_tasks(PieceTaskRequest(
+                            task_id=request.task_id,
+                            src_peer_id=request.src_peer_id,
+                            dst_peer_id=request.dst_peer_id,
+                            start_num=event["num"], limit=1), context)
+                        yield refreshed
+                    elif event["type"] == "done":
+                        yield await self.get_piece_tasks(PieceTaskRequest(
+                            task_id=request.task_id,
+                            src_peer_id=request.src_peer_id,
+                            dst_peer_id=request.dst_peer_id,
+                            start_num=0, limit=0), context)
+                        break
+            finally:
+                conductor.unsubscribe(q)
+
+    # -- seeder API ----------------------------------------------------
+
+    async def obtain_seeds(self, request: ObtainSeedsRequest,
+                           context) -> AsyncIterator:
+        """Trigger a seed download and stream piece announcements (legacy-CDN
+        style interface the scheduler's seed-peer client consumes)."""
+        conductor = await self.ptm.get_or_create_conductor(
+            request.url, request.url_meta or UrlMeta())
+        q = conductor.subscribe()
+        try:
+            # replay pieces already landed
+            if conductor.storage is not None:
+                for p in conductor.storage.piece_infos():
+                    yield PieceSeed(peer_id=conductor.peer_id,
+                                    piece_info=p.to_info(),
+                                    content_length=conductor.content_length,
+                                    total_piece_count=conductor.total_pieces)
+            while True:
+                event = await q.get()
+                if event["type"] == "piece":
+                    assert conductor.storage is not None
+                    metas = conductor.storage.piece_infos(event["num"], 1)
+                    if metas:
+                        yield PieceSeed(peer_id=conductor.peer_id,
+                                        piece_info=metas[0].to_info(),
+                                        content_length=conductor.content_length,
+                                        total_piece_count=conductor.total_pieces)
+                elif event["type"] == "done":
+                    if not event.get("success"):
+                        raise DFError(Code(event.get("code") or Code.UNKNOWN),
+                                      event.get("message", "seed failed"))
+                    yield PieceSeed(peer_id=conductor.peer_id, done=True,
+                                    content_length=conductor.content_length,
+                                    total_piece_count=conductor.total_pieces)
+                    return
+        finally:
+            conductor.unsubscribe(q)
+
+
+def build_service(svc: DaemonService) -> list[ServiceDef]:
+    d = ServiceDef(DAEMON_SERVICE)
+    d.unary_stream("Download", svc.download)
+    d.unary_unary("StatTask", svc.stat_task)
+    d.unary_unary("ImportTask", svc.import_task)
+    d.unary_unary("ExportTask", svc.export_task)
+    d.unary_unary("DeleteTask", svc.delete_task)
+    d.unary_unary("GetPieceTasks", svc.get_piece_tasks)
+    d.stream_stream("SyncPieceTasks", svc.sync_piece_tasks)
+    s = ServiceDef(SEEDER_SERVICE)
+    s.unary_stream("ObtainSeeds", svc.obtain_seeds)
+    return [d, s]

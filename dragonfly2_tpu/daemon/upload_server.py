@@ -1,0 +1,97 @@
+"""Upload server: the HTTP surface other peers fetch pieces from.
+
+Role parity: reference ``client/daemon/upload/upload_manager.go`` — route
+``GET /download/{taskID[:3]}/{taskID}?peerId=`` with a ``Range:`` header,
+served straight from the piece store, rate-limited, instrumented.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from aiohttp import web
+
+from ..common.errors import DFError
+from ..common.metrics import REGISTRY
+from ..common.piece import parse_http_range
+from ..common.rate import TokenBucket
+from ..storage.manager import StorageManager
+
+log = logging.getLogger("df.http.upload")
+
+_upload_bytes = REGISTRY.counter("df_upload_bytes_total",
+                                 "bytes served to other peers")
+_upload_reqs = REGISTRY.counter("df_upload_requests_total",
+                                "piece requests served", ("status",))
+
+
+class UploadServer:
+    def __init__(self, storage_mgr: StorageManager, *, port: int = 0,
+                 rate_limit_bps: int = 0, host: str = "0.0.0.0"):
+        self.storage_mgr = storage_mgr
+        self.host = host
+        self.port = port
+        self.limiter = TokenBucket(rate_limit_bps or 0)
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        async def healthy(_r: web.Request) -> web.Response:
+            return web.Response(text="ok")
+
+        async def metrics(_r: web.Request) -> web.Response:
+            return web.Response(text=REGISTRY.expose())
+
+        app = web.Application()
+        app.router.add_get("/download/{prefix}/{task_id}", self._handle)
+        app.router.add_get("/healthy", healthy)
+        app.router.add_get("/metrics", metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # resolve ephemeral port
+        for s in self._runner.sites:
+            server = getattr(s, "_server", None)
+            if server and server.sockets:
+                self.port = server.sockets[0].getsockname()[1]
+        log.info("upload server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        task_id = request.match_info["task_id"]
+        ts = self.storage_mgr.get(task_id)
+        if ts is None:
+            _upload_reqs.labels("404").inc()
+            raise web.HTTPNotFound(text=f"task {task_id[:12]} not found")
+        total = ts.md.content_length
+        rng_header = request.headers.get("Range", "")
+        if not rng_header:
+            _upload_reqs.labels("400").inc()
+            raise web.HTTPBadRequest(text="Range header required for piece reads")
+        try:
+            limit = total if total >= 0 else (1 << 62)
+            rng = parse_http_range(rng_header, limit)
+        except ValueError as exc:
+            _upload_reqs.labels("416").inc()
+            raise web.HTTPRequestRangeNotSatisfiable(text=str(exc))
+        has = getattr(ts, "has_range", None)
+        if has is not None and not has(rng.start, rng.length):
+            _upload_reqs.labels("416").inc()
+            raise web.HTTPRequestRangeNotSatisfiable(
+                text=f"bytes {rng.start}+{rng.length} not stored yet")
+        try:
+            data = await asyncio.to_thread(ts.read_range, rng.start, rng.length)
+        except DFError as exc:
+            _upload_reqs.labels("404").inc()
+            raise web.HTTPNotFound(text=exc.message)
+        await self.limiter.acquire(len(data))
+        _upload_bytes.inc(len(data))
+        _upload_reqs.labels("206").inc()
+        return web.Response(
+            status=206, body=data,
+            headers={"Content-Range": f"bytes {rng.start}-{rng.end - 1}/{total}",
+                     "Content-Type": "application/octet-stream"})

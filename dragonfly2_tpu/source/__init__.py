@@ -1,0 +1,15 @@
+"""Origin ("back-to-source") clients, keyed by URL scheme.
+
+Role parity: reference ``pkg/source`` — ``ResourceClient`` interface
+(``source/source_client.go:102-128``), per-scheme registry + loader
+(``source/loader``), request adapters, recursive lister. Clients here:
+file://, http(s):// (aiohttp), memory:// (tests), gs:// (GCS, gated — the
+runtime image has zero egress, so it is exercised only through its request
+shaping).
+"""
+
+from .client import (  # noqa: F401
+    SourceRequest, SourceResponse, ResourceClient, ListEntry,
+    register_client, client_for, content_length, supports_range, download,
+)
+from . import file_client, http_client, memory_client, gcs_client  # noqa: F401

@@ -1,0 +1,91 @@
+"""ResourceClient protocol + scheme registry.
+
+Reference surface (``source/source_client.go:102-128``): GetContentLength,
+IsSupportRange, Download(+expire info), GetLastModified, plus the recursive
+lister. Downloads are async chunk iterators so the piece engine can hash and
+store while bytes stream in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Protocol
+
+from ..common.errors import Code, DFError
+from ..common.piece import Range
+
+
+@dataclass
+class SourceRequest:
+    url: str
+    header: dict[str, str] = field(default_factory=dict)
+    range: Range | None = None
+    timeout_s: float = 0.0
+
+
+@dataclass
+class SourceResponse:
+    """Handle on an in-flight origin download."""
+
+    status: int = 200
+    content_length: int = -1       # of THIS response body (range-aware)
+    total_length: int = -1         # of the whole resource when known
+    supports_range: bool = False
+    last_modified: str = ""
+    header: dict[str, str] = field(default_factory=dict)
+    chunks: AsyncIterator[bytes] | None = None
+
+    async def read_all(self) -> bytes:
+        out = bytearray()
+        assert self.chunks is not None
+        async for c in self.chunks:
+            out.extend(c)
+        return bytes(out)
+
+
+@dataclass
+class ListEntry:
+    url: str
+    name: str
+    is_dir: bool
+    content_length: int = -1
+
+
+class ResourceClient(Protocol):
+    async def content_length(self, req: SourceRequest) -> int: ...
+    async def supports_range(self, req: SourceRequest) -> bool: ...
+    async def download(self, req: SourceRequest) -> SourceResponse: ...
+    async def last_modified(self, req: SourceRequest) -> str: ...
+    async def list(self, req: SourceRequest) -> list[ListEntry]: ...
+
+
+_REGISTRY: dict[str, ResourceClient] = {}
+
+
+def register_client(schemes: list[str] | str, client: ResourceClient) -> None:
+    if isinstance(schemes, str):
+        schemes = [schemes]
+    for s in schemes:
+        _REGISTRY[s.lower()] = client
+
+
+def client_for(url: str) -> ResourceClient:
+    scheme = url.split("://", 1)[0].lower() if "://" in url else "file"
+    client = _REGISTRY.get(scheme)
+    if client is None:
+        raise DFError(Code.SOURCE_ERROR, f"no source client for scheme {scheme!r}")
+    return client
+
+
+# module-level conveniences mirroring the reference's package-level funcs
+
+async def content_length(req: SourceRequest) -> int:
+    return await client_for(req.url).content_length(req)
+
+
+async def supports_range(req: SourceRequest) -> bool:
+    return await client_for(req.url).supports_range(req)
+
+
+async def download(req: SourceRequest) -> SourceResponse:
+    return await client_for(req.url).download(req)

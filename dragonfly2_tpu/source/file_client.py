@@ -1,0 +1,81 @@
+"""file:// origin client (also the default for bare paths)."""
+
+from __future__ import annotations
+
+import os
+from typing import AsyncIterator
+from urllib.parse import unquote, urlsplit
+
+from ..common.errors import Code, DFError
+from .client import ListEntry, SourceRequest, SourceResponse, register_client
+
+_CHUNK = 1 << 20
+
+
+def _path(url: str) -> str:
+    if "://" in url:
+        parts = urlsplit(url)
+        return unquote(parts.path)
+    return url
+
+
+class FileSourceClient:
+    async def content_length(self, req: SourceRequest) -> int:
+        try:
+            size = os.path.getsize(_path(req.url))
+        except OSError:
+            raise DFError(Code.SOURCE_NOT_FOUND, f"no such file: {req.url}") from None
+        if req.range is not None:
+            return min(req.range.length, max(0, size - req.range.start))
+        return size
+
+    async def supports_range(self, req: SourceRequest) -> bool:
+        return True
+
+    async def last_modified(self, req: SourceRequest) -> str:
+        try:
+            return str(os.path.getmtime(_path(req.url)))
+        except OSError:
+            return ""
+
+    async def download(self, req: SourceRequest) -> SourceResponse:
+        path = _path(req.url)
+        try:
+            total = os.path.getsize(path)
+        except OSError:
+            raise DFError(Code.SOURCE_NOT_FOUND, f"no such file: {req.url}") from None
+        start, length = 0, total
+        if req.range is not None:
+            start = req.range.start
+            length = min(req.range.length, max(0, total - start))
+
+        async def chunks() -> AsyncIterator[bytes]:
+            with open(path, "rb") as f:
+                f.seek(start)
+                remaining = length
+                while remaining > 0:
+                    data = f.read(min(_CHUNK, remaining))
+                    if not data:
+                        return
+                    remaining -= len(data)
+                    yield data
+
+        return SourceResponse(status=200, content_length=length, total_length=total,
+                              supports_range=True, chunks=chunks())
+
+    async def list(self, req: SourceRequest) -> list[ListEntry]:
+        path = _path(req.url)
+        if not os.path.isdir(path):
+            return [ListEntry(url=req.url, name=os.path.basename(path), is_dir=False,
+                              content_length=await self.content_length(req))]
+        out = []
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            is_dir = os.path.isdir(full)
+            out.append(ListEntry(
+                url=f"file://{full}", name=name, is_dir=is_dir,
+                content_length=-1 if is_dir else os.path.getsize(full)))
+        return out
+
+
+register_client(["file"], FileSourceClient())

@@ -1,0 +1,149 @@
+"""http(s):// origin client over aiohttp.
+
+Parity notes: HEAD for metadata with GET-range fallback (some origins reject
+HEAD), Range header for piece-group reads, Accept-Ranges/Content-Range
+detection, Last-Modified passthrough (reference ``source/clients/httpprotocol``).
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator
+
+import aiohttp
+
+from ..common.errors import Code, DFError
+from .client import ListEntry, SourceRequest, SourceResponse, register_client
+
+_CHUNK = 1 << 20
+
+
+def _timeout(req: SourceRequest) -> aiohttp.ClientTimeout:
+    if req.timeout_s and req.timeout_s > 0:
+        return aiohttp.ClientTimeout(total=req.timeout_s)
+    return aiohttp.ClientTimeout(total=None, sock_connect=30, sock_read=120)
+
+
+def _status_error(status: int, url: str) -> DFError:
+    if status == 404:
+        return DFError(Code.SOURCE_NOT_FOUND, f"origin 404: {url}")
+    if status in (401, 403):
+        return DFError(Code.SOURCE_AUTH_ERROR, f"origin {status}: {url}")
+    return DFError(Code.SOURCE_ERROR, f"origin status {status}: {url}")
+
+
+class HTTPSourceClient:
+    def __init__(self) -> None:
+        # sessions are loop-bound; the registry client is a process singleton
+        # that may serve several asyncio.run lifetimes (CLIs, tests)
+        self._sessions: dict[int, aiohttp.ClientSession] = {}
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        session = self._sessions.get(id(loop))
+        if session is None or session.closed:
+            session = aiohttp.ClientSession()
+            self._sessions[id(loop)] = session
+            self._sessions = {k: s for k, s in self._sessions.items()
+                              if not s.closed}
+        return session
+
+    async def close(self) -> None:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        session = self._sessions.pop(id(loop), None)
+        if session and not session.closed:
+            await session.close()
+
+    async def _head(self, req: SourceRequest) -> tuple[int, dict]:
+        session = await self._get_session()
+        try:
+            async with session.head(req.url, headers=req.header, allow_redirects=True,
+                                    timeout=_timeout(req)) as resp:
+                if resp.status < 400:
+                    return resp.status, dict(resp.headers)
+        except aiohttp.ClientError:
+            pass
+        # some origins reject HEAD: 1-byte ranged GET as metadata probe
+        probe = {**req.header, "Range": "bytes=0-0"}
+        try:
+            async with session.get(req.url, headers=probe, allow_redirects=True,
+                                   timeout=_timeout(req)) as resp:
+                if resp.status >= 400:
+                    raise _status_error(resp.status, req.url)
+                headers = dict(resp.headers)
+                cr = headers.get("Content-Range", "")
+                if "/" in cr:
+                    headers["Content-Length"] = cr.rsplit("/", 1)[1]
+                    headers["Accept-Ranges"] = "bytes"
+                return resp.status, headers
+        except aiohttp.ClientError as exc:
+            raise DFError(Code.SOURCE_ERROR, f"origin probe failed: {exc}") from None
+
+    async def content_length(self, req: SourceRequest) -> int:
+        _, headers = await self._head(req)
+        try:
+            total = int(headers.get("Content-Length", "-1"))
+        except ValueError:
+            return -1
+        if req.range is not None and total >= 0:
+            return min(req.range.length, max(0, total - req.range.start))
+        return total
+
+    async def supports_range(self, req: SourceRequest) -> bool:
+        _, headers = await self._head(req)
+        return headers.get("Accept-Ranges", "").lower() == "bytes"
+
+    async def last_modified(self, req: SourceRequest) -> str:
+        _, headers = await self._head(req)
+        return headers.get("Last-Modified", "")
+
+    async def download(self, req: SourceRequest) -> SourceResponse:
+        session = await self._get_session()
+        headers = dict(req.header)
+        if req.range is not None:
+            headers["Range"] = req.range.http_header()
+        try:
+            resp = await session.get(req.url, headers=headers, allow_redirects=True,
+                                     timeout=_timeout(req))
+        except aiohttp.ClientError as exc:
+            raise DFError(Code.SOURCE_ERROR, f"origin get failed: {exc}") from None
+        if resp.status >= 400:
+            status = resp.status
+            resp.close()
+            raise _status_error(status, req.url)
+        if req.range is not None and resp.status != 206:
+            resp.close()
+            raise DFError(Code.SOURCE_RANGE_UNSUPPORTED,
+                          f"origin ignored range request: status {resp.status}")
+        length = int(resp.headers.get("Content-Length", "-1"))
+        total = length
+        cr = resp.headers.get("Content-Range", "")
+        if "/" in cr:
+            tail = cr.rsplit("/", 1)[1]
+            if tail.isdigit():
+                total = int(tail)
+
+        async def chunks() -> AsyncIterator[bytes]:
+            try:
+                async for data in resp.content.iter_chunked(_CHUNK):
+                    yield data
+            finally:
+                resp.close()
+
+        return SourceResponse(
+            status=resp.status, content_length=length, total_length=total,
+            supports_range=resp.status == 206
+            or resp.headers.get("Accept-Ranges", "").lower() == "bytes",
+            last_modified=resp.headers.get("Last-Modified", ""),
+            header=dict(resp.headers), chunks=chunks())
+
+    async def list(self, req: SourceRequest) -> list[ListEntry]:
+        # plain HTTP has no directory protocol; single entry
+        return [ListEntry(url=req.url, name=req.url.rsplit("/", 1)[-1],
+                          is_dir=False, content_length=await self.content_length(req))]
+
+
+register_client(["http", "https"], HTTPSourceClient())

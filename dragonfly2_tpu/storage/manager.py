@@ -165,20 +165,33 @@ class StorageManager:
             return used, 0
 
     def try_gc(self) -> int:
-        """TTL sweep + usage-driven eviction, oldest-access first."""
+        """TTL sweep + usage-driven eviction, oldest-access first.
+
+        Not-done tasks are treated as active while their access_time is
+        fresh (pieces still landing); once stale past the TTL they are
+        abandoned downloads and reclaimed too. Sub-task views whose parent
+        is gone (or stale) are dropped with them.
+        """
         reclaimed = 0
         now = time.time()
         candidates: list[TaskStorage] = []
         for ts in self.tasks():
             if ts.md.task_type != TaskType.STANDARD:
                 continue  # persistent cache entries are pinned
-            if not ts.md.done:
+            stale = now - ts.md.access_time > self.cfg.task_ttl_s
+            if not ts.md.done and not stale:
                 continue  # active download
-            if now - ts.md.access_time > self.cfg.task_ttl_s:
+            if stale:
                 if self.delete_task(ts.md.task_id):
                     reclaimed += 1
             else:
                 candidates.append(ts)
+        with self._lock:
+            dead_subs = [tid for tid, st in self._subtasks.items()
+                         if st.parent.md.task_id not in self._tasks
+                         or now - st.md.access_time > self.cfg.task_ttl_s]
+            for tid in dead_subs:
+                del self._subtasks[tid]
         used, cap = self._usage()
         if cap and used / cap > self.cfg.disk_gc_high_ratio:
             target = int(cap * self.cfg.disk_gc_low_ratio)

@@ -56,12 +56,6 @@ def _bind(lib) -> None:
     lib.df_hash.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
                             ctypes.c_char_p, ctypes.c_size_t]
     lib.df_hash.restype = ctypes.c_int
-    # int64 df_pwrite(const char* path, const uint8_t* data, size_t n, int64 offset)
-    lib.df_pwrite.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]
-    lib.df_pwrite.restype = ctypes.c_int64
-    # int64 df_pread(const char* path, uint8_t* buf, size_t n, int64 offset)
-    lib.df_pread.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]
-    lib.df_pread.restype = ctypes.c_int64
     # uint32 df_crc32c(const uint8_t* data, size_t n, uint32 seed) — chainable
     lib.df_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
     lib.df_crc32c.restype = ctypes.c_uint32

@@ -5,8 +5,8 @@ task driver) and ``local_storage_subtask.go`` (ranged sub-tasks share the
 parent's file). Pieces are written at their offsets with per-piece digest
 verification; reads serve other peers (upload server) and the final sink.
 
-Writes go through the native C++ pwrite path when the library is built,
-else buffered Python IO on a preallocated (sparse) file.
+Piece hashing rides the native C++ crc32c path when the library is built
+(see native.py); file IO is buffered Python on a sparse file.
 """
 
 from __future__ import annotations
@@ -101,6 +101,22 @@ class TaskStorage:
             f.seek(start)
             return f.read(length)
 
+    def has_range(self, start: int, length: int) -> bool:
+        """True if stored pieces fully cover [start, start+length)."""
+        end = start + length
+        covered = start
+        with self._lock:
+            spans = sorted((p.start, p.start + p.size)
+                           for p in self.md.pieces.values())
+        for s, e in spans:
+            if s > covered:
+                return False
+            if e > covered:
+                covered = e
+            if covered >= end:
+                return True
+        return covered >= end
+
     def piece_infos(self, start_num: int = 0, limit: int = 0) -> list[PieceMeta]:
         with self._lock:
             nums = sorted(n for n in self.md.pieces if n >= start_num)
@@ -186,6 +202,10 @@ class SubTaskStorage:
     def write_piece(self, num: int, offset: int, data: bytes | memoryview,
                     piece_digest: str = "", *, cost_ms: int = 0,
                     source: str = "") -> PieceMeta:
+        if offset + len(data) > self.md.range_length:
+            raise DFError(Code.CLIENT_STORAGE_ERROR,
+                          f"piece {num} spills past sub-range: "
+                          f"{offset}+{len(data)} > {self.md.range_length}")
         if piece_digest and not digestlib.verify(piece_digest, data):
             raise DFError(Code.CLIENT_DIGEST_MISMATCH, f"piece {num} digest mismatch")
         if not piece_digest:
@@ -202,6 +222,8 @@ class SubTaskStorage:
                          digest=piece_digest, cost_ms=cost_ms, source=source)
         with self._lock:
             self.md.pieces[num] = meta
+            self.md.access_time = time.time()
+        self.parent.md.access_time = time.time()
         return meta
 
     def read_piece(self, num: int) -> bytes:

@@ -1,0 +1,2 @@
+"""CLI tools: dfget (download), dfcache (P2P cache ops), dfstore (object
+gateway client), plus service launchers. Role parity: reference ``cmd/``."""

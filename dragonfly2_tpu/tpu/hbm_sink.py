@@ -122,25 +122,30 @@ class DeviceIngest:
             self._maybe_send(shard)
 
     def _maybe_send(self, shard: int) -> None:
+        import jax
+
         s, e = shard * self.shard_bytes, (shard + 1) * self.shard_bytes
         with self._lock:
             if self._shard_sent[shard]:
                 return
             if not self._coverage.covers(s, min(e, self.content_length)):
                 return
+            view = self.host[s:e].view(self.dtype)
+            # async dispatch: returns immediately, DMA overlaps further pieces.
+            # array assignment stays under the lock so result()'s all-sent
+            # check can never observe a sent-but-None shard.
+            self._shard_arrays[shard] = jax.device_put(view, self.devices[shard])
             self._shard_sent[shard] = True
-        import jax
-
-        view = self.host[s:e].view(self.dtype)
-        # async dispatch: returns immediately, DMA overlaps further pieces
-        self._shard_arrays[shard] = jax.device_put(view, self.devices[shard])
         log.debug("shard %d/%d -> %s", shard, len(self.devices), self.devices[shard])
 
     def done_fraction(self) -> float:
         return self._coverage.covered_bytes() / self.padded_length
 
     def flush(self) -> None:
-        """Force-send incomplete shards (only valid once all writes landed)."""
+        """Send any fully-covered shard whose transfer hasn't fired — in
+        practice the padding-only tail shards that no write ever touches.
+        Shards with missing content bytes are left unsent (result() will
+        name them)."""
         for shard in range(len(self.devices)):
             self._maybe_send(shard)
 
@@ -153,10 +158,12 @@ class DeviceIngest:
         """
         import jax
 
-        if not all(self._shard_sent):
-            missing = [i for i, sent in enumerate(self._shard_sent) if not sent]
+        with self._lock:
+            sent = list(self._shard_sent)
+            arrays = list(self._shard_arrays)
+        if not all(sent):
+            missing = [i for i, s in enumerate(sent) if not s]
             raise RuntimeError(f"shards incomplete: {missing}")
-        arrays = [a for a in self._shard_arrays]
         for a in arrays:
             a.block_until_ready()
         if self._sharding is None:

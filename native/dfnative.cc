@@ -12,11 +12,6 @@
 #include <cstdio>
 #include <cstring>
 
-#include <fcntl.h>
-#include <sys/stat.h>
-#include <sys/types.h>
-#include <unistd.h>
-
 #if defined(__SSE4_2__)
 #include <nmmintrin.h>
 #endif
@@ -293,42 +288,6 @@ int df_hash(const char* algo, const uint8_t* data, size_t n, char* hex_out,
 // Matches the pure-Python _crc32c_py(data, crc) contract.
 uint32_t df_crc32c(const uint8_t* data, size_t n, uint32_t seed) {
   return crc32c(data, n, seed);
-}
-
-// Positioned write, creating the file if needed. Returns bytes written or -errno.
-int64_t df_pwrite(const char* path, const uint8_t* data, size_t n,
-                  int64_t offset) {
-  int fd = open(path, O_WRONLY | O_CREAT, 0644);
-  if (fd < 0) return -1;
-  int64_t total = 0;
-  while (size_t(total) < n) {
-    ssize_t w = pwrite(fd, data + total, n - total, offset + total);
-    if (w < 0) {
-      close(fd);
-      return -1;
-    }
-    total += w;
-  }
-  close(fd);
-  return total;
-}
-
-// Positioned read. Returns bytes read or -1.
-int64_t df_pread(const char* path, uint8_t* buf, size_t n, int64_t offset) {
-  int fd = open(path, O_RDONLY);
-  if (fd < 0) return -1;
-  int64_t total = 0;
-  while (size_t(total) < n) {
-    ssize_t r = pread(fd, buf + total, n - total, offset + total);
-    if (r < 0) {
-      close(fd);
-      return -1;
-    }
-    if (r == 0) break;
-    total += r;
-  }
-  close(fd);
-  return total;
 }
 
 }  // extern "C"

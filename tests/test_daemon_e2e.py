@@ -1,0 +1,347 @@
+"""Stage-4 E2E: daemon back-sources from a local HTTP origin through the real
+gRPC surface; reuse fast path; digest verification; device-sink ingest.
+
+This mirrors the reference's in-process harness pattern
+(``peer/peertask_manager_test.go:91-289``): real storage on a tempdir, real
+HTTP origin, real gRPC between client and daemon.
+"""
+
+import asyncio
+import hashlib
+import os
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.common.errors import Code, DFError
+from dragonfly2_tpu.daemon.config import DaemonConfig, DownloadConfig, StorageSection
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.idl.messages import (DeviceSink, DownloadRequest, Empty,
+                                         StatTaskDaemonRequest, UrlMeta)
+from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+
+
+async def start_origin(data_map: dict[str, bytes]):
+    async def handle(request: web.Request):
+        data = data_map.get(request.path.lstrip("/"))
+        if data is None:
+            return web.Response(status=404)
+        headers = {"Accept-Ranges": "bytes"}
+        rng = request.headers.get("Range")
+        if rng:
+            from dragonfly2_tpu.common.piece import parse_http_range
+            r = parse_http_range(rng, len(data))
+            headers["Content-Range"] = f"bytes {r.start}-{r.end-1}/{len(data)}"
+            return web.Response(status=206, body=data[r.start:r.end], headers=headers)
+        return web.Response(body=data, headers=headers)
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handle)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = None
+    for s in runner.sites:
+        server = getattr(s, "_server", None)
+        if server and server.sockets:
+            port = server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def daemon_config(tmp_path, name="d1") -> DaemonConfig:
+    return DaemonConfig(
+        workdir=str(tmp_path / name), host_ip="127.0.0.1", hostname=name,
+        download=DownloadConfig(back_source_group_min_bytes=1 << 20),
+        storage=StorageSection(gc_interval_s=3600))
+
+
+async def run_daemon_ctx(tmp_path, fn, name="d1"):
+    daemon = Daemon(daemon_config(tmp_path, name))
+    await daemon.start()
+    ch = Channel(f"unix:{daemon.unix_sock}")
+    client = ServiceClient(ch, "df.daemon.Daemon")
+    try:
+        return await fn(daemon, client)
+    finally:
+        await ch.close()
+        await daemon.stop()
+
+
+class TestBackSourceE2E:
+    def test_download_small_file(self, tmp_path):
+        data = os.urandom(300_000)
+
+        async def go():
+            origin, base = await start_origin({"f.bin": data})
+            try:
+                async def body(daemon, client):
+                    out = tmp_path / "out.bin"
+                    done = []
+                    async for resp in client.unary_stream("Download", DownloadRequest(
+                            url=f"{base}/f.bin", output=str(out))):
+                        if resp.done:
+                            done.append(resp)
+                    assert done and done[0].content_length == len(data)
+                    assert out.read_bytes() == data
+                await run_daemon_ctx(tmp_path, body)
+            finally:
+                await origin.cleanup()
+        asyncio.run(go())
+
+    def test_concurrent_piece_groups_large_file(self, tmp_path):
+        # > group_min (1 MiB in test config): exercises parallel range streams
+        data = os.urandom(6 * 1024 * 1024 + 12345)
+
+        async def go():
+            origin, base = await start_origin({"big.bin": data})
+            try:
+                async def body(daemon, client):
+                    out = tmp_path / "big.out"
+                    async for resp in client.unary_stream("Download", DownloadRequest(
+                            url=f"{base}/big.bin", output=str(out),
+                            url_meta=UrlMeta(
+                                digest=f"sha256:{hashlib.sha256(data).hexdigest()}"))):
+                        pass
+                    assert out.read_bytes() == data
+                await run_daemon_ctx(tmp_path, body)
+            finally:
+                await origin.cleanup()
+        asyncio.run(go())
+
+    def test_digest_mismatch_fails(self, tmp_path):
+        data = os.urandom(100_000)
+
+        async def go():
+            origin, base = await start_origin({"f": data})
+            try:
+                async def body(daemon, client):
+                    with pytest.raises(DFError) as ei:
+                        async for _ in client.unary_stream("Download", DownloadRequest(
+                                url=f"{base}/f", output=str(tmp_path / "x"),
+                                url_meta=UrlMeta(digest="sha256:" + "0" * 64))):
+                            pass
+                    assert ei.value.code == Code.CLIENT_DIGEST_MISMATCH
+                await run_daemon_ctx(tmp_path, body)
+            finally:
+                await origin.cleanup()
+        asyncio.run(go())
+
+    def test_origin_404(self, tmp_path):
+        async def go():
+            origin, base = await start_origin({})
+            try:
+                async def body(daemon, client):
+                    with pytest.raises(DFError) as ei:
+                        async for _ in client.unary_stream("Download", DownloadRequest(
+                                url=f"{base}/missing", output=str(tmp_path / "x"))):
+                            pass
+                    assert ei.value.code == Code.SOURCE_NOT_FOUND
+                await run_daemon_ctx(tmp_path, body)
+            finally:
+                await origin.cleanup()
+        asyncio.run(go())
+
+    def test_reuse_fast_path_no_second_origin_hit(self, tmp_path):
+        data = os.urandom(200_000)
+        hits = {"n": 0}
+
+        async def go():
+            async def handle(request: web.Request):
+                hits["n"] += 1
+                return web.Response(body=data, headers={"Accept-Ranges": "bytes"})
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handle)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = [s._server.sockets[0].getsockname()[1] for s in runner.sites][0]
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async def body(daemon, client):
+                    for out_name in ("a.bin", "b.bin"):
+                        async for _ in client.unary_stream("Download", DownloadRequest(
+                                url=f"{base}/f", output=str(tmp_path / out_name))):
+                            pass
+                    assert (tmp_path / "a.bin").read_bytes() == data
+                    assert (tmp_path / "b.bin").read_bytes() == data
+                    # HEAD/probe + one GET on first download; zero on second
+                    first_hits = hits["n"]
+                    assert first_hits >= 1
+                    return first_hits
+                n = await run_daemon_ctx(tmp_path, body)
+                assert n == hits["n"]  # no extra origin traffic for reuse
+            finally:
+                await runner.cleanup()
+        asyncio.run(go())
+
+    def test_stat_and_delete(self, tmp_path):
+        data = os.urandom(50_000)
+
+        async def go():
+            origin, base = await start_origin({"f": data})
+            try:
+                async def body(daemon, client):
+                    url = f"{base}/f"
+                    async for _ in client.unary_stream("Download", DownloadRequest(
+                            url=url, output=str(tmp_path / "s.bin"))):
+                        pass
+                    stat = await client.unary("StatTask",
+                                              StatTaskDaemonRequest(url=url))
+                    assert stat.content_length == len(data)
+                    assert stat.state == "success"
+                    from dragonfly2_tpu.idl.messages import DeleteTaskRequest
+                    await client.unary("DeleteTask", DeleteTaskRequest(url=url))
+                    with pytest.raises(DFError):
+                        await client.unary("StatTask",
+                                           StatTaskDaemonRequest(url=url))
+                await run_daemon_ctx(tmp_path, body)
+            finally:
+                await origin.cleanup()
+        asyncio.run(go())
+
+
+class TestDeviceSinkE2E:
+    def test_download_lands_on_devices(self, tmp_path):
+        """DeviceSink in the request -> content ends up in device arrays."""
+        data = os.urandom(400_000)
+
+        async def go():
+            origin, base = await start_origin({"w.safetensors": data})
+            try:
+                daemon = Daemon(daemon_config(tmp_path))
+                await daemon.start()
+                try:
+                    # exercise through PTM directly to reach the ingest object
+                    req = DownloadRequest(
+                        url=f"{base}/w.safetensors", output=str(tmp_path / "w"),
+                        device_sink=DeviceSink(enabled=True))
+                    async for _ in daemon.ptm.start_file_task(req):
+                        pass
+                    conductor = daemon.ptm.conductor(
+                        daemon.ptm._task_id(f"{base}/w.safetensors", UrlMeta()))
+                    assert conductor.device_ingest is not None
+                    arrays = conductor.device_ingest.result()
+                    import numpy as np
+                    flat = np.concatenate([np.asarray(a) for a in arrays])
+                    assert flat[:len(data)].tobytes() == data
+                finally:
+                    await daemon.stop()
+            finally:
+                await origin.cleanup()
+        asyncio.run(go())
+
+
+class TestImportExport:
+    def test_import_then_export(self, tmp_path):
+        data = os.urandom(150_000)
+        src = tmp_path / "src.bin"
+        src.write_bytes(data)
+
+        async def go():
+            async def body(daemon, client):
+                from dragonfly2_tpu.idl.messages import (ExportTaskRequest,
+                                                         ImportTaskRequest)
+                stat = await client.unary("ImportTask", ImportTaskRequest(
+                    path=str(src), url="d7y://cache/model-v1"))
+                assert stat.content_length == len(data)
+                out = tmp_path / "exported.bin"
+                await client.unary("ExportTask", ExportTaskRequest(
+                    url="d7y://cache/model-v1", output=str(out), local_only=True))
+                assert out.read_bytes() == data
+            await run_daemon_ctx(tmp_path, body)
+        asyncio.run(go())
+
+
+class TestRangedDownload:
+    def test_ranged_request_downloads_only_range(self, tmp_path):
+        data = os.urandom(500_000)
+        got_ranges = []
+
+        async def go():
+            async def handle(request: web.Request):
+                rng = request.headers.get("Range")
+                headers = {"Accept-Ranges": "bytes"}
+                if rng:
+                    got_ranges.append(rng)
+                    from dragonfly2_tpu.common.piece import parse_http_range
+                    r = parse_http_range(rng, len(data))
+                    headers["Content-Range"] = f"bytes {r.start}-{r.end-1}/{len(data)}"
+                    return web.Response(status=206, body=data[r.start:r.end],
+                                        headers=headers)
+                return web.Response(body=data, headers=headers)
+
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handle)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = [s._server.sockets[0].getsockname()[1] for s in runner.sites][0]
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async def body(daemon, client):
+                    out = tmp_path / "rng.bin"
+                    async for resp in client.unary_stream("Download", DownloadRequest(
+                            url=f"{base}/f", output=str(out),
+                            url_meta=UrlMeta(range="bytes=1000-5999"))):
+                        if resp.done:
+                            assert resp.content_length == 5000
+                    assert out.read_bytes() == data[1000:6000]
+                await run_daemon_ctx(tmp_path, body)
+            finally:
+                await runner.cleanup()
+        asyncio.run(go())
+
+    def test_range_served_from_completed_parent(self, tmp_path):
+        data = os.urandom(300_000)
+
+        async def go():
+            origin, base = await start_origin({"f": data})
+            try:
+                async def body(daemon, client):
+                    # whole file first
+                    async for _ in client.unary_stream("Download", DownloadRequest(
+                            url=f"{base}/f", output=str(tmp_path / "whole.bin"))):
+                        pass
+                    await origin.cleanup()  # origin gone: range must come from cache
+                    out = tmp_path / "part.bin"
+                    async for _ in client.unary_stream("Download", DownloadRequest(
+                            url=f"{base}/f", output=str(out),
+                            url_meta=UrlMeta(range="bytes=100-299"))):
+                        pass
+                    assert out.read_bytes() == data[100:300]
+                await run_daemon_ctx(tmp_path, body)
+            finally:
+                pass
+        asyncio.run(go())
+
+
+class TestGCAbandoned:
+    def test_abandoned_inflight_task_reclaimed(self, tmp_path):
+        import time as _time
+        from dragonfly2_tpu.storage.manager import StorageConfig, StorageManager
+        from dragonfly2_tpu.storage.metadata import TaskMetadata
+
+        mgr = StorageManager(StorageConfig(data_dir=str(tmp_path / "d"),
+                                           task_ttl_s=0.01))
+        ts = mgr.register_task(TaskMetadata(task_id="ab" * 32))
+        ts.write_piece(0, 0, b"partial")
+        _time.sleep(0.05)
+        assert mgr.try_gc() == 1
+        assert mgr.get("ab" * 32) is None
+
+    def test_subtask_bounds_enforced(self, tmp_path):
+        import pytest as _pytest
+        from dragonfly2_tpu.common.errors import Code as _Code, DFError as _DFError
+        from dragonfly2_tpu.storage.manager import StorageConfig, StorageManager
+        from dragonfly2_tpu.storage.metadata import TaskMetadata
+
+        mgr = StorageManager(StorageConfig(data_dir=str(tmp_path / "d")))
+        sub = mgr.register_subtask(TaskMetadata(
+            task_id="cd" * 32, parent_task_id="ef" * 32,
+            range_start=0, range_length=1000))
+        with _pytest.raises(_DFError) as ei:
+            sub.write_piece(0, 900, b"x" * 4096)
+        assert ei.value.code == _Code.CLIENT_STORAGE_ERROR

@@ -1,0 +1,208 @@
+"""Stage-3 tests: source registry, file/http/memory clients, GCS request
+shaping against a local fake."""
+
+import asyncio
+import os
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.common.errors import Code, DFError
+from dragonfly2_tpu.common.piece import Range
+from dragonfly2_tpu.source import (SourceRequest, client_for, download,
+                                   content_length)
+from dragonfly2_tpu.source.memory_client import put_blob, delete_blob
+
+
+def test_registry_dispatch():
+    assert client_for("http://x/y").__class__.__name__ == "HTTPSourceClient"
+    assert client_for("file:///tmp/x").__class__.__name__ == "FileSourceClient"
+    assert client_for("gs://b/o").__class__.__name__ == "GCSSourceClient"
+    with pytest.raises(DFError):
+        client_for("weird://x")
+
+
+class TestFileClient:
+    def test_roundtrip_and_range(self, tmp_path):
+        p = tmp_path / "f.bin"
+        data = os.urandom(100_000)
+        p.write_bytes(data)
+
+        async def go():
+            url = f"file://{p}"
+            assert await content_length(SourceRequest(url=url)) == len(data)
+            resp = await download(SourceRequest(url=url))
+            assert await resp.read_all() == data
+            resp = await download(SourceRequest(url=url, range=Range(500, 1000)))
+            body = await resp.read_all()
+            assert body == data[500:1500]
+            assert resp.total_length == len(data)
+        asyncio.run(go())
+
+    def test_missing_file(self):
+        async def go():
+            with pytest.raises(DFError) as ei:
+                await download(SourceRequest(url="file:///no/such/file"))
+            assert ei.value.code == Code.SOURCE_NOT_FOUND
+        asyncio.run(go())
+
+    def test_list_dir(self, tmp_path):
+        (tmp_path / "a.txt").write_text("aa")
+        (tmp_path / "sub").mkdir()
+        (tmp_path / "sub" / "b.txt").write_text("bb")
+
+        async def go():
+            entries = await client_for("file://x").list(
+                SourceRequest(url=f"file://{tmp_path}"))
+            names = {e.name: e.is_dir for e in entries}
+            assert names == {"a.txt": False, "sub": True}
+        asyncio.run(go())
+
+
+class TestMemoryClient:
+    def test_roundtrip(self):
+        url = put_blob("t1", b"hello world" * 100)
+
+        async def go():
+            assert await content_length(SourceRequest(url=url)) == 1100
+            resp = await download(SourceRequest(url=url, range=Range(0, 5)))
+            assert await resp.read_all() == b"hello"
+        try:
+            asyncio.run(go())
+        finally:
+            delete_blob("t1")
+
+
+def _origin_app(data: bytes, *, support_range=True, no_head=False,
+                no_length=False):
+    async def handle(request: web.Request):
+        if request.method == "HEAD" and no_head:
+            return web.Response(status=405)
+        headers = {}
+        if support_range:
+            headers["Accept-Ranges"] = "bytes"
+        rng = request.headers.get("Range")
+        if rng and support_range:
+            from dragonfly2_tpu.common.piece import parse_http_range
+            r = parse_http_range(rng, len(data))
+            body = data[r.start:r.end]
+            headers["Content-Range"] = f"bytes {r.start}-{r.end-1}/{len(data)}"
+            return web.Response(status=206, body=body, headers=headers)
+        if no_length:
+            resp = web.StreamResponse(headers=headers)
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+            await resp.write(data)
+            await resp.write_eof()
+            return resp
+        return web.Response(body=data, headers=headers)
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handle)
+    return app
+
+
+async def _with_origin(app, fn):
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = None
+    for s in runner.sites:
+        server = getattr(s, "_server", None)
+        if server and server.sockets:
+            port = server.sockets[0].getsockname()[1]
+    try:
+        return await fn(f"http://127.0.0.1:{port}")
+    finally:
+        await runner.cleanup()
+
+
+class TestHTTPClient:
+    def test_metadata_and_download(self):
+        data = os.urandom(50_000)
+
+        async def go(base):
+            url = f"{base}/f.bin"
+            assert await content_length(SourceRequest(url=url)) == len(data)
+            client = client_for(url)
+            assert await client.supports_range(SourceRequest(url=url))
+            resp = await download(SourceRequest(url=url))
+            assert await resp.read_all() == data
+        asyncio.run(_with_origin(_origin_app(data), go))
+
+    def test_ranged_download(self):
+        data = os.urandom(50_000)
+
+        async def go(base):
+            resp = await download(SourceRequest(url=f"{base}/f",
+                                                range=Range(1000, 2000)))
+            assert resp.status == 206
+            assert await resp.read_all() == data[1000:3000]
+            assert resp.total_length == len(data)
+        asyncio.run(_with_origin(_origin_app(data), go))
+
+    def test_head_fallback_to_ranged_get(self):
+        data = os.urandom(10_000)
+
+        async def go(base):
+            n = await content_length(SourceRequest(url=f"{base}/f"))
+            assert n == len(data)
+        asyncio.run(_with_origin(_origin_app(data, no_head=True), go))
+
+    def test_unknown_length(self):
+        data = os.urandom(10_000)
+
+        async def go(base):
+            resp = await download(SourceRequest(url=f"{base}/f"))
+            body = await resp.read_all()
+            assert body == data
+        asyncio.run(_with_origin(_origin_app(data, no_length=True), go))
+
+    def test_404(self):
+        async def go(base):
+            app_url = f"{base}/x"
+            with pytest.raises(DFError) as ei:
+                await download(SourceRequest(url=app_url))
+            assert ei.value.code == Code.SOURCE_NOT_FOUND
+
+        app = web.Application()
+        app.router.add_get("/y", lambda r: web.Response())
+        asyncio.run(_with_origin(app, go))
+
+
+class TestGCSClient:
+    def test_request_shaping_against_fake(self, monkeypatch):
+        """gs:// URLs hit the JSON media endpoint with Range + auth headers."""
+        data = os.urandom(20_000)
+        seen = {}
+
+        async def handle(request: web.Request):
+            seen["path"] = request.path_qs
+            seen["auth"] = request.headers.get("Authorization", "")
+            seen["range"] = request.headers.get("Range", "")
+            rng = request.headers.get("Range")
+            if rng:
+                from dragonfly2_tpu.common.piece import parse_http_range
+                r = parse_http_range(rng, len(data))
+                return web.Response(status=206, body=data[r.start:r.end],
+                                    headers={"Content-Range":
+                                             f"bytes {r.start}-{r.end-1}/{len(data)}"})
+            return web.Response(body=data, headers={"Accept-Ranges": "bytes"})
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handle)
+
+        async def go(base):
+            monkeypatch.setenv("DF_GCS_ENDPOINT", base)
+            monkeypatch.setenv("GOOGLE_APPLICATION_TOKEN", "tok123")
+            resp = await download(SourceRequest(url="gs://mybucket/models/w.safetensors",
+                                                range=Range(100, 200)))
+            body = await resp.read_all()
+            assert body == data[100:300]
+            assert seen["path"].startswith(
+                "/storage/v1/b/mybucket/o/models%2Fw.safetensors")
+            assert "alt=media" in seen["path"]
+            assert seen["auth"] == "Bearer tok123"
+            assert seen["range"] == "bytes=100-299"
+        asyncio.run(_with_origin(app, go))

@@ -65,7 +65,8 @@ class ObjectStorageConfig:
 @dataclass
 class DaemonConfig:
     workdir: str = ""
-    host_ip: str = ""
+    host_ip: str = ""                      # advertised to peers/scheduler
+    listen_ip: str = "0.0.0.0"             # servers bind here (may differ under NAT)
     hostname: str = ""
     is_seed: bool = False
     rpc_port: int = 0                      # peer gRPC (0 = ephemeral)

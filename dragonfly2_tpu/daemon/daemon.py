@@ -22,7 +22,11 @@ from ..tpu import topology
 from .config import DaemonConfig
 from .peertask_manager import PeerTaskManager
 from .piece_manager import PieceManager
+from ..rpc.client import ChannelPool
+from .piece_downloader import PieceDownloader
+from .piece_engine import PieceEngine
 from .rpcserver import DaemonService, build_service
+from .scheduler_session import SchedulerConnector
 from .upload_server import UploadServer
 from ..rpc.server import RPCServer
 
@@ -59,7 +63,8 @@ class Daemon:
         self.piece_mgr = PieceManager(cfg.download)
         self.upload_server = UploadServer(
             self.storage_mgr, port=cfg.upload.port,
-            rate_limit_bps=cfg.upload.rate_limit_bps, host="127.0.0.1")
+            rate_limit_bps=cfg.upload.rate_limit_bps,
+            host=cfg.listen_ip)
         self._scheduler_factory = scheduler_factory
         self._p2p_engine_factory = p2p_engine_factory
         self.scheduler: Any = None
@@ -94,22 +99,40 @@ class Daemon:
 
     async def start(self) -> None:
         await self.upload_server.start()
-        if self._scheduler_factory is not None:
-            self.scheduler = self._scheduler_factory(self)
+        self._peer_channels = ChannelPool()
+        self._piece_downloader = PieceDownloader(
+            timeout_s=self.cfg.download.piece_timeout_s)
+        engine_factory = self._p2p_engine_factory
+        if engine_factory is None:
+            def engine_factory() -> PieceEngine:
+                return PieceEngine(
+                    parallelism=self.cfg.download.piece_parallelism,
+                    schedule_timeout_s=self.cfg.scheduler.schedule_timeout_s,
+                    piece_timeout_s=self.cfg.download.piece_timeout_s,
+                    downloader=self._piece_downloader,
+                    channel_pool=self._peer_channels)
         self.ptm = PeerTaskManager(
             storage_mgr=self.storage_mgr, piece_mgr=self.piece_mgr,
             hostname=self.hostname, host_ip=self.host_ip,
-            scheduler=self.scheduler,
-            p2p_engine_factory=self._p2p_engine_factory,
+            scheduler=None,
+            p2p_engine_factory=engine_factory,
             device_sink_builder=self.device_sink_builder,
             is_seed=self.cfg.is_seed)
         svc = DaemonService(self.ptm,
-                            upload_addr=f"127.0.0.1:{self.upload_server.port}")
-        # peer-facing TCP server
-        self.rpc = RPCServer(f"127.0.0.1:{self.cfg.rpc_port}")
+                            upload_addr=f"{self.host_ip}:{self.upload_server.port}")
+        # peer-facing TCP server: bind the listen address, advertise host_ip
+        self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.rpc_port}")
         for sdef in build_service(svc):
             self.rpc.register(sdef)
         await self.rpc.start()
+        # scheduler connector needs the resolved rpc/upload ports for register
+        if self._scheduler_factory is not None:
+            self.scheduler = self._scheduler_factory(self)
+        elif self.cfg.scheduler.addresses:
+            self.scheduler = SchedulerConnector(
+                self.cfg.scheduler.addresses, self.host_info(),
+                register_timeout_s=self.cfg.scheduler.register_timeout_s)
+        self.ptm.scheduler = self.scheduler
         # local API over unix socket (dfget/dfcache/dfstore)
         sock = self.cfg.unix_sock or self.paths.daemon_sock()
         if os.path.exists(sock):
@@ -154,5 +177,9 @@ class Daemon:
         if self.rpc is not None:
             await self.rpc.stop(0.2)
         await self.upload_server.stop()
+        if getattr(self, "_piece_downloader", None) is not None:
+            await self._piece_downloader.close()
+        if getattr(self, "_peer_channels", None) is not None:
+            await self._peer_channels.close()
         if self.scheduler is not None and hasattr(self.scheduler, "close"):
             await self.scheduler.close()

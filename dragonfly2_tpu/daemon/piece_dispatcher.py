@@ -1,0 +1,212 @@
+"""Piece dispatcher: picks the next (piece, parent) pair for a worker.
+
+Role parity: reference ``client/daemon/peer/piece_dispatcher.go`` — scores
+parents by observed per-byte piece latency with epsilon-random exploration
+(``DefaultPieceDispatcherRandomRatio``), so fast ICI-local parents win the
+steady state while new parents still get probed.
+
+The dispatcher owns:
+  * the queue of pieces still to fetch, each with the set of parents known
+    to hold it;
+  * per-parent latency EWMAs and failure counts (a parent past the failure
+    limit is ejected and its queued pieces re-homed).
+
+Workers call ``get()`` (blocks until a piece is dispatchable or the task is
+finished) and then ``report(...)`` with the outcome.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+
+from ..idl.messages import PieceInfo
+
+log = logging.getLogger("df.flow.dispatch")
+
+EXPLORE_RATIO = 0.1          # epsilon for random parent choice
+PARENT_FAIL_LIMIT = 3        # consecutive failures before ejection
+_EWMA_ALPHA = 0.3
+
+
+class ParentState:
+    def __init__(self, peer_id: str, addr: str):
+        self.peer_id = peer_id
+        self.addr = addr                # "ip:download_port"
+        self.ns_per_byte = 0.0          # latency EWMA, 0 = no data yet
+        self.consecutive_fails = 0
+        self.inflight = 0
+        self.ejected = False
+
+    def observe(self, cost_ms: int, size: int, ok: bool) -> None:
+        if ok:
+            self.consecutive_fails = 0
+            if size > 0:
+                sample = cost_ms * 1e6 / size
+                if self.ns_per_byte == 0.0:
+                    self.ns_per_byte = sample
+                else:
+                    self.ns_per_byte += _EWMA_ALPHA * (sample - self.ns_per_byte)
+        else:
+            self.consecutive_fails += 1
+            if self.consecutive_fails >= PARENT_FAIL_LIMIT:
+                self.ejected = True
+
+    def score(self) -> float:
+        """Lower is better. Unprobed parents score best so they get traffic;
+        in-flight load breaks ties toward idle parents."""
+        base = self.ns_per_byte if self.ns_per_byte > 0 else -1.0
+        return base + self.inflight * 0.01
+
+
+class _PieceState:
+    __slots__ = ("info", "holders", "inflight")
+
+    def __init__(self, info: PieceInfo):
+        self.info = info
+        self.holders: set[str] = set()   # parent peer ids that announced it
+        self.inflight = False
+
+
+class Dispatch:
+    """One unit of work handed to a worker."""
+
+    __slots__ = ("piece", "parent")
+
+    def __init__(self, piece: PieceInfo, parent: ParentState):
+        self.piece = piece
+        self.parent = parent
+
+
+class PieceDispatcher:
+    def __init__(self, *, explore_ratio: float = EXPLORE_RATIO):
+        self.explore_ratio = explore_ratio
+        self.parents: dict[str, ParentState] = {}
+        self._pieces: dict[int, _PieceState] = {}
+        self._done: set[int] = set()
+        self._closed = False
+        self._cond = asyncio.Condition()
+
+    # ------------------------------------------------------------------
+    # feeding: parents + announced pieces
+    # ------------------------------------------------------------------
+
+    async def add_parent(self, peer_id: str, addr: str) -> ParentState:
+        async with self._cond:
+            st = self.parents.get(peer_id)
+            if st is None or st.ejected:
+                st = ParentState(peer_id, addr)
+                self.parents[peer_id] = st
+            else:
+                st.addr = addr
+            self._cond.notify_all()
+            return st
+
+    async def remove_parent(self, peer_id: str) -> None:
+        async with self._cond:
+            st = self.parents.get(peer_id)
+            if st is not None:
+                st.ejected = True
+            self._cond.notify_all()
+
+    async def announce(self, parent_id: str, infos: list[PieceInfo]) -> None:
+        """Parent reports it holds these pieces."""
+        async with self._cond:
+            notify = False
+            for info in infos:
+                if info.piece_num in self._done:
+                    continue
+                ps = self._pieces.get(info.piece_num)
+                if ps is None:
+                    ps = _PieceState(info)
+                    self._pieces[info.piece_num] = ps
+                elif not ps.info.digest and info.digest:
+                    ps.info = info
+                ps.holders.add(parent_id)
+                notify = True
+            if notify:
+                self._cond.notify_all()
+
+    async def mark_done(self, piece_num: int) -> None:
+        async with self._cond:
+            self._done.add(piece_num)
+            self._pieces.pop(piece_num, None)
+            self._cond.notify_all()
+
+    async def close(self) -> None:
+        async with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    def _live_parents(self) -> list[ParentState]:
+        return [p for p in self.parents.values() if not p.ejected]
+
+    def _pick(self) -> Dispatch | None:
+        candidates = []
+        for ps in self._pieces.values():
+            if ps.inflight:
+                continue
+            holders = [self.parents[h] for h in ps.holders
+                       if h in self.parents and not self.parents[h].ejected]
+            if holders:
+                candidates.append((ps, holders))
+        if not candidates:
+            return None
+        # fetch lowest-numbered available piece first: keeps read_ordered()
+        # consumers (stream/proxy) flowing with minimal buffering
+        ps, holders = min(candidates, key=lambda c: c[0].info.piece_num)
+        if len(holders) > 1 and random.random() < self.explore_ratio:
+            parent = random.choice(holders)
+        else:
+            parent = min(holders, key=ParentState.score)
+        ps.inflight = True
+        parent.inflight += 1
+        return Dispatch(ps.info, parent)
+
+    async def get(self, timeout: float | None = None) -> Dispatch | None:
+        """Next (piece, parent) to fetch; None when closed or timed out."""
+        deadline = time.monotonic() + timeout if timeout else None
+        async with self._cond:
+            while True:
+                if self._closed:
+                    return None
+                d = self._pick()
+                if d is not None:
+                    return d
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                try:
+                    await asyncio.wait_for(self._cond.wait(), remaining)
+                except asyncio.TimeoutError:
+                    return None
+
+    async def report(self, d: Dispatch, *, ok: bool, cost_ms: int = 0) -> None:
+        async with self._cond:
+            d.parent.inflight = max(0, d.parent.inflight - 1)
+            d.parent.observe(cost_ms, d.piece.range_size, ok)
+            num = d.piece.piece_num
+            if ok:
+                self._done.add(num)
+                self._pieces.pop(num, None)
+            else:
+                ps = self._pieces.get(num)
+                if ps is not None:
+                    ps.inflight = False
+                    if d.parent.ejected:
+                        ps.holders.discard(d.parent.peer_id)
+            self._cond.notify_all()
+
+    def pending_count(self) -> int:
+        return len(self._pieces)
+
+    def has_live_parent(self) -> bool:
+        return any(not p.ejected for p in self.parents.values())

@@ -1,0 +1,323 @@
+"""P2P piece engine: pulls a task's pieces from parent peers.
+
+Role parity: reference ``client/daemon/peer/peertask_conductor.go`` P2P half —
+``pullPiecesWithP2P`` (:544), ``receivePeerPacket`` (:659), the 4 piece
+workers (:976-1010) — plus ``peertask_piecetask_synchronizer.go`` (one
+``SyncPieceTasks`` bidi stream per parent feeding the dispatcher).
+
+``pull`` returns:
+  * True  — task completed via P2P (conductor verifies + finalizes)
+  * False — fall back to origin (the back-source ladder: NeedBackSource from
+    the scheduler, no parents within the schedule timeout, or all parents
+    dying without replacement)
+and raises DFError for hard failures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import TYPE_CHECKING
+
+from ..common.errors import Code, DFError
+from ..common.metrics import REGISTRY
+from ..idl.messages import (PeerAddr, PeerPacket, PieceInfo, PieceResult,
+                            PieceTaskRequest, SizeScope)
+from ..rpc.client import ChannelPool, ServiceClient
+from .piece_dispatcher import Dispatch, PieceDispatcher
+from .piece_downloader import PieceDownloader
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .conductor import PeerTaskConductor
+    from .scheduler_session import PeerSession
+
+log = logging.getLogger("df.flow.engine")
+
+DAEMON_SERVICE = "df.daemon.Daemon"
+
+_p2p_pieces = REGISTRY.counter("df_p2p_piece_total",
+                               "pieces fetched from peers", ("result",))
+
+
+class _Synchronizer:
+    """One SyncPieceTasks stream against one parent daemon."""
+
+    def __init__(self, engine: "PieceEngine", conductor: "PeerTaskConductor",
+                 parent: PeerAddr):
+        self.engine = engine
+        self.conductor = conductor
+        self.parent = parent
+        self.task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self.task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        addr = f"{self.parent.ip}:{self.parent.rpc_port}"
+        try:
+            client = self.engine.peer_client(addr)
+            stream = client.stream_stream("SyncPieceTasks")
+            await stream.write(PieceTaskRequest(
+                task_id=self.conductor.task_id,
+                src_peer_id=self.conductor.peer_id,
+                dst_peer_id=self.parent.peer_id,
+                start_num=0, limit=1 << 20))
+            try:
+                while True:
+                    packet = await stream.read()
+                    if packet is None:
+                        break
+                    await self._on_packet(packet)
+            finally:
+                stream.cancel()
+        except asyncio.CancelledError:
+            raise
+        except DFError as exc:
+            log.debug("sync with %s ended: %s", self.parent.peer_id, exc)
+            await self.engine.dispatcher.remove_parent(self.parent.peer_id)
+        except Exception as exc:  # noqa: BLE001 - parent went away
+            log.debug("sync with %s failed: %s", self.parent.peer_id, exc)
+            await self.engine.dispatcher.remove_parent(self.parent.peer_id)
+
+    async def _on_packet(self, packet) -> None:
+        if packet.content_length >= 0 and self.conductor.piece_size == 0:
+            self.conductor.set_content_info(packet.content_length,
+                                            packet.piece_size)
+            self.engine.geometry_known.set()
+        dst_addr = packet.dst_addr or f"{self.parent.ip}:{self.parent.download_port}"
+        await self.engine.dispatcher.add_parent(self.parent.peer_id, dst_addr)
+        infos = [p for p in (packet.piece_infos or [])
+                 if p.piece_num not in self.conductor.ready]
+        if infos:
+            await self.engine.dispatcher.announce(self.parent.peer_id, infos)
+
+    def stop(self) -> None:
+        if self.task is not None:
+            self.task.cancel()
+
+
+class PieceEngine:
+    def __init__(self, *, parallelism: int = 4,
+                 schedule_timeout_s: float = 30.0,
+                 piece_timeout_s: float = 60.0,
+                 downloader: PieceDownloader | None = None,
+                 channel_pool: ChannelPool | None = None):
+        self.parallelism = parallelism
+        self.schedule_timeout_s = schedule_timeout_s
+        self.piece_timeout_s = piece_timeout_s
+        self.downloader = downloader or PieceDownloader(timeout_s=piece_timeout_s)
+        self._own_downloader = downloader is None
+        # channel pool may be shared daemon-wide so parent connections persist
+        self._channels = channel_pool if channel_pool is not None else ChannelPool()
+        self._own_channels = channel_pool is None
+        self.dispatcher = PieceDispatcher()
+        self.geometry_known = asyncio.Event()
+        self._synchronizers: dict[str, _Synchronizer] = {}
+        self._need_back_source = False
+        self._first_parent = asyncio.Event()
+
+    def peer_client(self, addr: str) -> ServiceClient:
+        return ServiceClient(self._channels.get(addr), DAEMON_SERVICE)
+
+    # ------------------------------------------------------------------
+
+    async def pull(self, conductor: "PeerTaskConductor",
+                   session: "PeerSession") -> bool:
+        result = session.result
+        try:
+            if result.size_scope == SizeScope.EMPTY:
+                conductor.set_content_info(0)
+                return True
+            if result.size_scope == SizeScope.TINY and result.direct_content:
+                data = result.direct_content
+                conductor.set_content_info(len(data))
+                await conductor.on_piece_from_peer(0, 0, data, 0, "scheduler")
+                return True
+            if (result.size_scope == SizeScope.SMALL
+                    and result.single_piece is not None
+                    and result.single_piece.piece_info is not None):
+                ok = await self._pull_single(conductor, session,
+                                             result.single_piece)
+                if ok:
+                    return True
+                # fall through to the normal path: scheduler may still help
+            return await self._pull_normal(conductor, session)
+        finally:
+            await self._teardown()
+
+    async def _pull_single(self, conductor, session, single) -> bool:
+        info: PieceInfo = single.piece_info
+        if session.result.content_length >= 0:
+            conductor.set_content_info(session.result.content_length,
+                                       session.result.piece_size)
+        else:
+            conductor.set_content_info(info.range_size)
+        t0 = int(time.time() * 1000)
+        try:
+            data, cost = await self.downloader.download_piece(
+                dst_addr=single.dst_addr, task_id=conductor.task_id,
+                src_peer_id=conductor.peer_id, piece=info)
+        except DFError as exc:
+            _p2p_pieces.labels("fail").inc()
+            await session.report_piece(self._piece_result(
+                conductor, info, single.dst_peer_id, t0, ok=False,
+                code=exc.code))
+            return False
+        await conductor.on_piece_from_peer(info.piece_num, info.range_start,
+                                           data, cost, single.dst_peer_id,
+                                           piece_digest=info.digest)
+        _p2p_pieces.labels("ok").inc()
+        await session.report_piece(self._piece_result(
+            conductor, info, single.dst_peer_id, t0, ok=True, cost_ms=cost))
+        return True
+
+    async def _pull_normal(self, conductor, session) -> bool:
+        if session.result.content_length >= 0:
+            conductor.set_content_info(session.result.content_length,
+                                       session.result.piece_size)
+            self.geometry_known.set()
+
+        packet_task = asyncio.get_running_loop().create_task(
+            self._consume_packets(conductor, session))
+        workers = [asyncio.get_running_loop().create_task(
+            self._worker(conductor, session)) for _ in range(self.parallelism)]
+        try:
+            # first gate: a parent must show up within the schedule timeout
+            try:
+                await asyncio.wait_for(self._first_parent.wait(),
+                                       self.schedule_timeout_s)
+            except asyncio.TimeoutError:
+                log.info("no parents within %.1fs; back-source",
+                         self.schedule_timeout_s)
+                return False
+            if self._need_back_source:
+                return False
+
+            while True:
+                if self._need_back_source:
+                    return False
+                if (conductor.total_pieces >= 0
+                        and len(conductor.ready) >= conductor.total_pieces):
+                    return True
+                if (not self.dispatcher.has_live_parent()
+                        and self._all_sync_done()):
+                    # parents gone and nothing new scheduled: give the
+                    # scheduler a grace period, then fall back
+                    try:
+                        await asyncio.wait_for(
+                            self._wait_parent_change(),
+                            self.schedule_timeout_s)
+                    except asyncio.TimeoutError:
+                        log.info("parents exhausted; back-source for the rest")
+                        return False
+                    continue
+                await asyncio.sleep(0.02)
+        finally:
+            packet_task.cancel()
+            for w in workers:
+                w.cancel()
+            await asyncio.gather(packet_task, *workers, return_exceptions=True)
+
+    def _all_sync_done(self) -> bool:
+        return all(s.task is None or s.task.done()
+                   for s in self._synchronizers.values())
+
+    async def _wait_parent_change(self) -> None:
+        seen = {pid for pid, p in self.dispatcher.parents.items()
+                if not p.ejected}
+        while True:
+            live = {pid for pid, p in self.dispatcher.parents.items()
+                    if not p.ejected}
+            if live - seen or self._need_back_source:
+                return
+            await asyncio.sleep(0.05)
+
+    # ------------------------------------------------------------------
+
+    async def _consume_packets(self, conductor, session) -> None:
+        """Apply scheduler parent assignments as they arrive."""
+        while True:
+            packet: PeerPacket = await session.packets.get()
+            code = Code(packet.code or 0)
+            if code == Code.SCHED_NEED_BACK_SOURCE:
+                self._need_back_source = True
+                self._first_parent.set()
+                return
+            if code in (Code.SCHED_PEER_GONE, Code.SCHED_REREGISTER,
+                        Code.SCHED_TASK_STATUS_ERROR, Code.UNAVAILABLE):
+                # stream ended or scheduler lost us; workers drain what they
+                # have, the main loop decides on fallback
+                self._first_parent.set()
+                continue
+            parents = list(packet.candidate_peers or [])
+            if packet.main_peer is not None:
+                parents.insert(0, packet.main_peer)
+            for parent in parents:
+                if parent.peer_id == conductor.peer_id:
+                    continue
+                dl_addr = f"{parent.ip}:{parent.download_port}"
+                await self.dispatcher.add_parent(parent.peer_id, dl_addr)
+                if parent.peer_id not in self._synchronizers:
+                    sync = _Synchronizer(self, conductor, parent)
+                    self._synchronizers[parent.peer_id] = sync
+                    sync.start()
+            if parents:
+                self._first_parent.set()
+
+    async def _worker(self, conductor, session) -> None:
+        while True:
+            d = await self.dispatcher.get()
+            if d is None:
+                return
+            await self._download_one(conductor, session, d)
+
+    async def _download_one(self, conductor, session, d: Dispatch) -> None:
+        t0 = int(time.time() * 1000)
+        try:
+            data, cost = await self.downloader.download_piece(
+                dst_addr=d.parent.addr, task_id=conductor.task_id,
+                src_peer_id=conductor.peer_id, piece=d.piece)
+        except DFError as exc:
+            _p2p_pieces.labels("fail").inc()
+            await self.dispatcher.report(d, ok=False)
+            await session.report_piece(self._piece_result(
+                conductor, d.piece, d.parent.peer_id, t0, ok=False,
+                code=exc.code))
+            return
+        await conductor.on_piece_from_peer(
+            d.piece.piece_num, d.piece.range_start, data, cost,
+            d.parent.peer_id, piece_digest=d.piece.digest)
+        _p2p_pieces.labels("ok").inc()
+        await self.dispatcher.report(d, ok=True, cost_ms=cost)
+        await session.report_piece(self._piece_result(
+            conductor, d.piece, d.parent.peer_id, t0, ok=True, cost_ms=cost,
+            finished=len(conductor.ready)))
+
+    @staticmethod
+    def _piece_result(conductor, info: PieceInfo, parent_id: str, t0: int, *,
+                      ok: bool, cost_ms: int = 0, code: Code = Code.OK,
+                      finished: int = 0) -> PieceResult:
+        reported = PieceInfo(piece_num=info.piece_num,
+                             range_start=info.range_start,
+                             range_size=info.range_size, digest=info.digest,
+                             download_cost_ms=cost_ms)
+        return PieceResult(
+            task_id=conductor.task_id, src_peer_id=conductor.peer_id,
+            dst_peer_id=parent_id, piece_info=reported, begin_ms=t0,
+            end_ms=t0 + cost_ms, success=ok, code=int(code),
+            finished_count=finished)
+
+    # ------------------------------------------------------------------
+
+    async def _teardown(self) -> None:
+        for sync in self._synchronizers.values():
+            sync.stop()
+        await asyncio.gather(
+            *(s.task for s in self._synchronizers.values() if s.task),
+            return_exceptions=True)
+        await self.dispatcher.close()
+        if self._own_channels:
+            await self._channels.close()
+        if self._own_downloader:
+            await self.downloader.close()

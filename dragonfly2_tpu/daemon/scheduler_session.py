@@ -1,0 +1,187 @@
+"""Scheduler connector: the daemon's client side of the scheduler service.
+
+Role parity: reference ``client/daemon/peer/peertask_conductor.go`` register
+(:249) + ``ReportPieceResult`` stream handling (:340, :659) and
+``pkg/rpc/scheduler/client`` — one connector per daemon, one ``PeerSession``
+per running task. The session owns the bidi report stream: piece results go
+up, ``PeerPacket`` parent assignments come down into a queue the P2P engine
+consumes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import TYPE_CHECKING
+
+from ..common.errors import Code, DFError
+from ..idl.messages import (Host, PeerPacket, PeerResult, PieceResult,
+                            RegisterPeerTaskRequest, RegisterResult)
+from ..rpc.client import Channel, ServiceClient
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .conductor import PeerTaskConductor
+
+log = logging.getLogger("df.flow.schedsess")
+
+SCHEDULER_SERVICE = "df.scheduler.Scheduler"
+
+
+class PeerSession:
+    """A registered (task, peer) against one scheduler."""
+
+    def __init__(self, client: ServiceClient, result: RegisterResult,
+                 conductor: "PeerTaskConductor"):
+        self.client = client
+        self.result = result
+        self.conductor = conductor
+        self.task_id = conductor.task_id
+        self.peer_id = conductor.peer_id
+        self.packets: asyncio.Queue[PeerPacket] = asyncio.Queue()
+        self._stream = None
+        self._reader: asyncio.Task | None = None
+        self._closed = False
+        self._peer_result_sent = False
+
+    async def open_report_stream(self) -> None:
+        """Open the bidi piece-result stream; an empty first report asks the
+        scheduler for the initial parent assignment (reference sends a zeroed
+        PieceResult the same way)."""
+        self._stream = self.client.stream_stream("ReportPieceResult")
+        await self._stream.write(PieceResult(
+            task_id=self.task_id, src_peer_id=self.peer_id, success=True,
+            code=int(Code.OK)))
+        self._reader = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                packet = await self._stream.read()
+                if packet is None:
+                    break
+                self.packets.put_nowait(packet)
+        except DFError as exc:
+            # surface scheduler-side verdicts (NeedBackSource et al.) as a
+            # synthetic packet so the engine's single consume loop sees them
+            self.packets.put_nowait(PeerPacket(
+                task_id=self.task_id, src_peer_id=self.peer_id,
+                code=int(exc.code)))
+        except Exception as exc:  # noqa: BLE001 - stream teardown races
+            if not self._closed:
+                log.debug("report stream reader ended: %s", exc)
+        finally:
+            self.packets.put_nowait(PeerPacket(
+                task_id=self.task_id, src_peer_id=self.peer_id,
+                code=int(Code.UNAVAILABLE)))
+
+    async def report_piece(self, result: PieceResult) -> None:
+        if self._stream is None or self._closed:
+            return
+        try:
+            await self._stream.write(result)
+        except Exception as exc:  # noqa: BLE001
+            log.debug("report_piece failed: %s", exc)
+
+    async def close(self, *, success: bool) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        conductor = self.conductor
+        if self._stream is not None:
+            try:
+                await self._stream.done_writing()
+            except Exception:  # noqa: BLE001
+                pass
+            if self._reader is not None:
+                self._reader.cancel()
+                try:
+                    await self._reader
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            self._stream.cancel()
+        if conductor is not None and not self._peer_result_sent:
+            self._peer_result_sent = True
+            try:
+                await self.client.unary("ReportPeerResult", PeerResult(
+                    task_id=self.task_id, peer_id=self.peer_id,
+                    url=conductor.url, success=success,
+                    traffic=conductor.traffic_p2p,
+                    cost_ms=int(time.time() * 1000) - conductor.start_ms,
+                    code=int(conductor.fail_code),
+                    total_piece_count=conductor.total_pieces,
+                    content_length=conductor.content_length), timeout=5.0)
+            except Exception as exc:  # noqa: BLE001
+                log.debug("ReportPeerResult failed: %s", exc)
+
+
+class SchedulerConnector:
+    """Daemon-wide scheduler client; conductor-facing ``register`` entry.
+
+    The conductor treats ``register`` raising SCHED_NEED_BACK_SOURCE /
+    UNAVAILABLE / DEADLINE_EXCEEDED as "go to origin" (the reference's
+    fallback ladder at ``peertask_conductor.go:284``).
+    """
+
+    def __init__(self, addresses: list[str], host: Host, *,
+                 register_timeout_s: float = 10.0):
+        from ..rpc.balancer import HashRing
+        self.addresses = list(addresses)
+        self.host = host
+        self.register_timeout_s = register_timeout_s
+        self._ring = HashRing(self.addresses)
+        self._channels: dict[str, Channel] = {}
+
+    def _client(self, task_id: str) -> ServiceClient:
+        # consistent-hash the task onto one scheduler address so all peers of
+        # a task converge on the same brain (reference pkg/balancer)
+        addr = self._ring.pick(task_id)
+        if addr is None:
+            raise DFError(Code.UNAVAILABLE, "no scheduler addresses")
+        ch = self._channels.get(addr)
+        if ch is None:
+            ch = Channel(addr)
+            self._channels[addr] = ch
+        return ServiceClient(ch, SCHEDULER_SERVICE)
+
+    def refresh_host(self, host: Host) -> None:
+        self.host = host
+
+    async def register(self, conductor: "PeerTaskConductor") -> PeerSession:
+        client = self._client(conductor.task_id)
+        result: RegisterResult = await client.unary(
+            "RegisterPeerTask",
+            RegisterPeerTaskRequest(
+                url=conductor.url, url_meta=conductor.url_meta,
+                task_id=conductor.task_id, peer_id=conductor.peer_id,
+                peer_host=self.host),
+            timeout=self.register_timeout_s)
+        session = PeerSession(client, result, conductor)
+        await session.open_report_stream()
+        return session
+
+    async def announce_host(self, request) -> None:
+        if not self.addresses:
+            return
+        client = self._client(self.host.id)
+        await client.unary("AnnounceHost", request, timeout=5.0)
+
+    async def sync_probes(self):
+        """Open the probe bidi stream (network-topology module drives it)."""
+        client = self._client(self.host.id)
+        return client.stream_stream("SyncProbes")
+
+    async def leave_host(self) -> None:
+        from ..idl.messages import LeaveHostRequest
+        try:
+            client = self._client(self.host.id)
+            await client.unary("LeaveHost",
+                               LeaveHostRequest(host_id=self.host.id),
+                               timeout=3.0)
+        except Exception as exc:  # noqa: BLE001 - best effort on shutdown
+            log.debug("LeaveHost failed: %s", exc)
+
+    async def close(self) -> None:
+        for ch in self._channels.values():
+            await ch.close()
+        self._channels.clear()

@@ -70,6 +70,35 @@ class Channel:
         await asyncio.wait_for(self._channel.channel_ready(), timeout)
 
 
+class ChannelPool:
+    """LRU cache of channels keyed by address.
+
+    Peers come and go; without eviction a long-lived daemon accumulates one
+    open channel per parent ever dialed. ``limit`` bounds that: least
+    recently used channels are closed as new addresses arrive.
+    """
+
+    def __init__(self, limit: int = 128):
+        self.limit = limit
+        self._channels: dict[str, Channel] = {}
+
+    def get(self, address: str) -> Channel:
+        ch = self._channels.pop(address, None)
+        if ch is None:
+            ch = Channel(address)
+            while len(self._channels) >= self.limit:
+                oldest = next(iter(self._channels))
+                evicted = self._channels.pop(oldest)
+                asyncio.get_running_loop().create_task(evicted.close())
+        self._channels[address] = ch   # re-insert = most recently used
+        return ch
+
+    async def close(self) -> None:
+        for ch in self._channels.values():
+            await ch.close()
+        self._channels.clear()
+
+
 class ServiceClient:
     """Typed calls against one service on one channel."""
 

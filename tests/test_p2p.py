@@ -1,0 +1,290 @@
+"""Stage-5 P2P data path: two daemons on one host exchange pieces.
+
+Mirrors the reference's in-process multi-peer harness
+(``peer/peertask_manager_test.go:91-289``): a scripted scheduler session
+hands daemon B a PeerPacket pointing at daemon A; B must fetch every piece
+over the real upload-HTTP + SyncPieceTasks gRPC path with back-source
+disabled, proving the bytes moved peer-to-peer.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from dragonfly2_tpu.common.errors import Code, DFError
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.daemon.piece_dispatcher import PieceDispatcher
+from dragonfly2_tpu.idl.messages import (DownloadRequest, PeerAddr, PeerPacket,
+                                         PieceInfo, RegisterResult, SizeScope)
+from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+
+from test_daemon_e2e import daemon_config, start_origin
+
+
+class ScriptedSession:
+    """Stands in for scheduler_session.PeerSession with a pre-loaded packet
+    queue (the reference scripts PeerPacket streams the same way)."""
+
+    def __init__(self, result: RegisterResult, packets: list[PeerPacket]):
+        self.result = result
+        self.packets = asyncio.Queue()
+        for p in packets:
+            self.packets.put_nowait(p)
+        self.reported = []
+        self.closed_with = None
+
+    async def report_piece(self, result) -> None:
+        self.reported.append(result)
+
+    async def close(self, *, success: bool) -> None:
+        self.closed_with = success
+
+
+class ScriptedScheduler:
+    def __init__(self, make_session):
+        self.make_session = make_session
+
+    async def register(self, conductor):
+        return self.make_session(conductor)
+
+    async def close(self):
+        pass
+
+
+def parent_addr(daemon: Daemon, peer_id: str) -> PeerAddr:
+    return PeerAddr(peer_id=peer_id, ip="127.0.0.1",
+                    rpc_port=daemon.rpc.port,
+                    download_port=daemon.upload_server.port)
+
+
+async def seed_daemon_with(tmp_path, data: bytes, name="seed"):
+    """Start a daemon and let it back-source one file; returns
+    (daemon, origin_runner, url, seed_peer_id)."""
+    origin, base = await start_origin({"w.bin": data})
+    daemon = Daemon(daemon_config(tmp_path, name))
+    await daemon.start()
+    url = f"{base}/w.bin"
+    ch = Channel(f"unix:{daemon.unix_sock}")
+    client = ServiceClient(ch, "df.daemon.Daemon")
+    async for resp in client.unary_stream("Download", DownloadRequest(url=url)):
+        if resp.done:
+            task_id = resp.task_id
+    await ch.close()
+    peer_id = daemon.ptm.conductor(task_id).peer_id
+    return daemon, origin, url, task_id, peer_id
+
+
+class TestP2PTwoDaemons:
+    def test_full_p2p_transfer(self, tmp_path):
+        data = os.urandom(9 * 1024 * 1024 + 333)  # 3 pieces at 4 MiB
+
+        async def go():
+            seed, origin, url, task_id, seed_peer = await seed_daemon_with(
+                tmp_path, data)
+            await origin.cleanup()  # origin gone: bytes MUST come from seed
+            leecher = Daemon(daemon_config(tmp_path, "leech"))
+
+            def make_session(conductor):
+                packet = PeerPacket(
+                    task_id=conductor.task_id,
+                    src_peer_id=conductor.peer_id,
+                    main_peer=parent_addr(seed, seed_peer))
+                return ScriptedSession(RegisterResult(
+                    task_id=conductor.task_id,
+                    size_scope=SizeScope.NORMAL), [packet])
+
+            leecher._scheduler_factory = lambda d: ScriptedScheduler(make_session)
+            await leecher.start()
+            try:
+                ch = Channel(f"unix:{leecher.unix_sock}")
+                client = ServiceClient(ch, "df.daemon.Daemon")
+                out = tmp_path / "p2p.out"
+                done = []
+                async for resp in client.unary_stream("Download", DownloadRequest(
+                        url=url, output=str(out), disable_back_source=True,
+                        timeout_s=30.0)):
+                    if resp.done:
+                        done.append(resp)
+                await ch.close()
+                assert done and done[0].content_length == len(data)
+                assert out.read_bytes() == data
+                conductor = leecher.ptm.conductor(task_id)
+                assert conductor.traffic_p2p == len(data)
+                assert conductor.traffic_source == 0
+            finally:
+                await leecher.stop()
+                await seed.stop()
+
+        asyncio.run(go())
+
+    def test_p2p_while_seed_still_downloading(self, tmp_path):
+        """B joins while A is mid-download: piece announcements must stream
+        through SyncPieceTasks as they land (the push half of the bidi)."""
+        data = os.urandom(12 * 1024 * 1024)
+
+        async def go():
+            # slow origin: trickle the file so A's download overlaps B's
+            from aiohttp import web
+
+            async def handle(request):
+                rng = request.headers.get("Range")
+                body = data
+                status = 200
+                headers = {"Accept-Ranges": "bytes"}
+                if rng:
+                    from dragonfly2_tpu.common.piece import parse_http_range
+                    r = parse_http_range(rng, len(data))
+                    body = data[r.start:r.end]
+                    status = 206
+                    headers["Content-Range"] = \
+                        f"bytes {r.start}-{r.end-1}/{len(data)}"
+                resp = web.StreamResponse(status=status, headers=headers)
+                resp.content_length = len(body)
+                await resp.prepare(request)
+                for i in range(0, len(body), 1 << 20):
+                    await resp.write(body[i:i + (1 << 20)])
+                    await asyncio.sleep(0.02)
+                return resp
+
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handle)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = None
+            for s in runner.sites:
+                server = getattr(s, "_server", None)
+                if server and server.sockets:
+                    port = server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/w.bin"
+
+            seed = Daemon(daemon_config(tmp_path, "seed2"))
+            await seed.start()
+            leecher = Daemon(daemon_config(tmp_path, "leech2"))
+            try:
+                # kick off A's download without waiting for completion
+                ch_a = Channel(f"unix:{seed.unix_sock}")
+                client_a = ServiceClient(ch_a, "df.daemon.Daemon")
+                stream_a = client_a.unary_stream("Download",
+                                                 DownloadRequest(url=url))
+                first = await stream_a.read()
+                assert first is not None
+                task_id = first.task_id
+                seed_peer = seed.ptm.conductor(task_id).peer_id
+
+                def make_session(conductor):
+                    packet = PeerPacket(
+                        task_id=conductor.task_id,
+                        src_peer_id=conductor.peer_id,
+                        main_peer=parent_addr(seed, seed_peer))
+                    return ScriptedSession(RegisterResult(
+                        task_id=conductor.task_id,
+                        size_scope=SizeScope.NORMAL), [packet])
+
+                leecher._scheduler_factory = \
+                    lambda d: ScriptedScheduler(make_session)
+                await leecher.start()
+                ch_b = Channel(f"unix:{leecher.unix_sock}")
+                client_b = ServiceClient(ch_b, "df.daemon.Daemon")
+                out = tmp_path / "live.out"
+                done = []
+                async for resp in client_b.unary_stream(
+                        "Download", DownloadRequest(
+                            url=url, output=str(out),
+                            disable_back_source=True, timeout_s=60.0)):
+                    if resp.done:
+                        done.append(resp)
+                assert done and out.read_bytes() == data
+                # drain A's stream too
+                while await stream_a.read() is not None:
+                    pass
+                await ch_a.close()
+                await ch_b.close()
+            finally:
+                await leecher.stop()
+                await seed.stop()
+                await runner.cleanup()
+
+        asyncio.run(go())
+
+    def test_back_source_when_no_parents(self, tmp_path):
+        """NeedBackSource from the scheduler drops B to the origin."""
+        data = os.urandom(500_000)
+
+        async def go():
+            origin, base = await start_origin({"f.bin": data})
+            daemon = Daemon(daemon_config(tmp_path, "solo"))
+
+            def make_session(conductor):
+                return ScriptedSession(
+                    RegisterResult(task_id=conductor.task_id,
+                                   size_scope=SizeScope.NORMAL),
+                    [PeerPacket(task_id=conductor.task_id,
+                                src_peer_id=conductor.peer_id,
+                                code=int(Code.SCHED_NEED_BACK_SOURCE))])
+
+            daemon._scheduler_factory = lambda d: ScriptedScheduler(make_session)
+            await daemon.start()
+            try:
+                ch = Channel(f"unix:{daemon.unix_sock}")
+                client = ServiceClient(ch, "df.daemon.Daemon")
+                out = tmp_path / "bs.out"
+                done = []
+                async for resp in client.unary_stream("Download", DownloadRequest(
+                        url=f"{base}/f.bin", output=str(out), timeout_s=30.0)):
+                    if resp.done:
+                        done.append(resp)
+                await ch.close()
+                assert done and out.read_bytes() == data
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+
+class TestPieceDispatcher:
+    def test_prefers_fast_parent(self):
+        async def go():
+            d = PieceDispatcher(explore_ratio=0.0)
+            fast = await d.add_parent("fast", "127.0.0.1:1")
+            slow = await d.add_parent("slow", "127.0.0.1:2")
+            fast.observe(10, 4 << 20, True)     # ~2.4 ns/B
+            slow.observe(400, 4 << 20, True)    # ~95 ns/B
+            await d.announce("fast", [PieceInfo(piece_num=0, range_size=100)])
+            await d.announce("slow", [PieceInfo(piece_num=0, range_size=100)])
+            got = await d.get(timeout=1.0)
+            assert got is not None and got.parent.peer_id == "fast"
+        asyncio.run(go())
+
+    def test_failure_ejects_parent_and_rehomes(self):
+        async def go():
+            d = PieceDispatcher(explore_ratio=0.0)
+            await d.add_parent("bad", "127.0.0.1:1")
+            await d.announce("bad", [PieceInfo(piece_num=0, range_size=10)])
+            for _ in range(3):
+                disp = await d.get(timeout=1.0)
+                assert disp is not None
+                await d.report(disp, ok=False)
+            assert not d.has_live_parent()
+            # new healthy parent announcing the same piece takes over
+            await d.add_parent("good", "127.0.0.1:2")
+            await d.announce("good", [PieceInfo(piece_num=0, range_size=10)])
+            disp = await d.get(timeout=1.0)
+            assert disp is not None and disp.parent.peer_id == "good"
+            await d.report(disp, ok=True, cost_ms=5)
+            assert d.pending_count() == 0
+        asyncio.run(go())
+
+    def test_lowest_piece_first(self):
+        async def go():
+            d = PieceDispatcher(explore_ratio=0.0)
+            await d.add_parent("p", "127.0.0.1:1")
+            await d.announce("p", [PieceInfo(piece_num=5, range_size=10),
+                                   PieceInfo(piece_num=1, range_size=10),
+                                   PieceInfo(piece_num=3, range_size=10)])
+            disp = await d.get(timeout=1.0)
+            assert disp is not None and disp.piece.piece_num == 1
+        asyncio.run(go())

@@ -74,6 +74,7 @@ class PeerTaskConductor:
         self._subscribers: list[asyncio.Queue] = []
         self._run_task: asyncio.Task | None = None
         self._p2p_engine: Any = None
+        self._session: Any = None      # scheduler PeerSession once registered
         self.log = with_fields("df.core.conductor",
                                task=task_id[:12], peer=peer_id[-12:])
 
@@ -93,7 +94,9 @@ class PeerTaskConductor:
         try:
             used_p2p = False
             if self.scheduler is not None:
-                used_p2p = await self._try_p2p()
+                self._session = await self._register()
+                if self._session is not None and self._p2p_engine is not None:
+                    used_p2p = await self._p2p_engine.pull(self, self._session)
             if not used_p2p:
                 if self.disable_back_source:
                     raise DFError(Code.CLIENT_BACK_SOURCE_ERROR,
@@ -108,31 +111,26 @@ class PeerTaskConductor:
         except Exception as exc:  # noqa: BLE001
             self.log.exception("task failed")
             await self._finish_fail(Code.UNKNOWN, str(exc))
+        finally:
+            # closed only after finalize so the PeerResult carries the real
+            # outcome — a half-pulled peer must never be advertised complete
+            if self._session is not None:
+                await self._session.close(success=self.state == self.SUCCESS)
 
-    async def _try_p2p(self) -> bool:
-        """Register + pull via the P2P engine. Returns False to signal the
-        caller to fall back to origin (the reference's fallback ladder:
-        register-fail / NeedBackSource / schedule-timeout)."""
+    async def _register(self):
+        """Register with the scheduler; None means "go to origin" (the
+        reference's fallback ladder: register-fail / NeedBackSource)."""
         try:
-            session = await self.scheduler.register(self)
+            return await self.scheduler.register(self)
         except DFError as exc:
             if exc.code in (Code.SCHED_NEED_BACK_SOURCE, Code.UNAVAILABLE,
                             Code.DEADLINE_EXCEEDED):
                 self.log.info("register says back-source: %s", exc.message)
-                return False
+                return None
             raise
         except Exception as exc:  # scheduler unreachable entirely
             self.log.warning("scheduler unreachable (%s); falling back", exc)
-            return False
-        if session is None:
-            return False
-        if self._p2p_engine is None:
-            await session.close(success=False)
-            return False
-        try:
-            return await self._p2p_engine.pull(self, session)
-        finally:
-            await session.close(success=self.state != self.FAILED)
+            return None
 
     # ------------------------------------------------------------------
     # content metadata + piece arrival (called by piece manager / engine)
@@ -169,6 +167,19 @@ class PeerTaskConductor:
                                    cost_ms: int) -> None:
         await self._land_piece(num, offset, data, cost_ms, source="")
         self.traffic_source += len(data)
+        if self._session is not None:
+            # a back-source peer announces its pieces so the scheduler can
+            # make it a parent — this is where origin egress gets saved
+            from ..idl.messages import PieceInfo, PieceResult
+            now = int(time.time() * 1000)
+            await self._session.report_piece(PieceResult(
+                task_id=self.task_id, src_peer_id=self.peer_id,
+                dst_peer_id="", success=True,
+                piece_info=PieceInfo(piece_num=num, range_start=offset,
+                                     range_size=len(data),
+                                     download_cost_ms=cost_ms),
+                begin_ms=now - cost_ms, end_ms=now,
+                finished_count=len(self.ready)))
 
     async def on_piece_from_peer(self, num: int, offset: int, data: bytes,
                                  cost_ms: int, parent_id: str,

@@ -181,5 +181,8 @@ class Daemon:
             await self._piece_downloader.close()
         if getattr(self, "_peer_channels", None) is not None:
             await self._peer_channels.close()
-        if self.scheduler is not None and hasattr(self.scheduler, "close"):
-            await self.scheduler.close()
+        if self.scheduler is not None:
+            if hasattr(self.scheduler, "leave_host"):
+                await self.scheduler.leave_host()
+            if hasattr(self.scheduler, "close"):
+                await self.scheduler.close()

@@ -93,10 +93,14 @@ class PieceDispatcher:
     # feeding: parents + announced pieces
     # ------------------------------------------------------------------
 
-    async def add_parent(self, peer_id: str, addr: str) -> ParentState:
+    async def add_parent(self, peer_id: str, addr: str, *,
+                         resurrect: bool = False) -> ParentState:
+        """Known parents keep their state. An ejected parent stays ejected
+        unless ``resurrect`` (an explicit scheduler re-assignment) — piece
+        announcements must NOT revive a parent the failure limit removed."""
         async with self._cond:
             st = self.parents.get(peer_id)
-            if st is None or st.ejected:
+            if st is None or (st.ejected and resurrect):
                 st = ParentState(peer_id, addr)
                 self.parents[peer_id] = st
             else:
@@ -128,12 +132,6 @@ class PieceDispatcher:
                 notify = True
             if notify:
                 self._cond.notify_all()
-
-    async def mark_done(self, piece_num: int) -> None:
-        async with self._cond:
-            self._done.add(piece_num)
-            self._pieces.pop(piece_num, None)
-            self._cond.notify_all()
 
     async def close(self) -> None:
         async with self._cond:

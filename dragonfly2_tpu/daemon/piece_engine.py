@@ -84,7 +84,10 @@ class _Synchronizer:
         if packet.content_length >= 0 and self.conductor.piece_size == 0:
             self.conductor.set_content_info(packet.content_length,
                                             packet.piece_size)
-            self.engine.geometry_known.set()
+        if self.conductor.piece_size == 0:
+            # parent itself doesn't know the geometry yet (unknown-length
+            # origin mid-flight): skip — the done-refresh re-announces all
+            return
         dst_addr = packet.dst_addr or f"{self.parent.ip}:{self.parent.download_port}"
         await self.engine.dispatcher.add_parent(self.parent.peer_id, dst_addr)
         infos = [p for p in (packet.piece_infos or [])
@@ -112,7 +115,6 @@ class PieceEngine:
         self._channels = channel_pool if channel_pool is not None else ChannelPool()
         self._own_channels = channel_pool is None
         self.dispatcher = PieceDispatcher()
-        self.geometry_known = asyncio.Event()
         self._synchronizers: dict[str, _Synchronizer] = {}
         self._need_back_source = False
         self._first_parent = asyncio.Event()
@@ -176,7 +178,6 @@ class PieceEngine:
         if session.result.content_length >= 0:
             conductor.set_content_info(session.result.content_length,
                                        session.result.piece_size)
-            self.geometry_known.set()
 
         packet_task = asyncio.get_running_loop().create_task(
             self._consume_packets(conductor, session))
@@ -200,10 +201,9 @@ class PieceEngine:
                 if (conductor.total_pieces >= 0
                         and len(conductor.ready) >= conductor.total_pieces):
                     return True
-                if (not self.dispatcher.has_live_parent()
-                        and self._all_sync_done()):
-                    # parents gone and nothing new scheduled: give the
-                    # scheduler a grace period, then fall back
+                if not self.dispatcher.has_live_parent():
+                    # parents gone: give the scheduler a grace period to
+                    # re-assign, then fall back to origin
                     try:
                         await asyncio.wait_for(
                             self._wait_parent_change(),
@@ -212,26 +212,25 @@ class PieceEngine:
                         log.info("parents exhausted; back-source for the rest")
                         return False
                     continue
-                await asyncio.sleep(0.02)
+                # progress tick: piece arrivals notify the conductor's cond
+                async with conductor._piece_cond:
+                    try:
+                        await asyncio.wait_for(conductor._piece_cond.wait(),
+                                               0.25)
+                    except asyncio.TimeoutError:
+                        pass
         finally:
             packet_task.cancel()
             for w in workers:
                 w.cancel()
             await asyncio.gather(packet_task, *workers, return_exceptions=True)
 
-    def _all_sync_done(self) -> bool:
-        return all(s.task is None or s.task.done()
-                   for s in self._synchronizers.values())
-
     async def _wait_parent_change(self) -> None:
-        seen = {pid for pid, p in self.dispatcher.parents.items()
-                if not p.ejected}
-        while True:
-            live = {pid for pid, p in self.dispatcher.parents.items()
-                    if not p.ejected}
-            if live - seen or self._need_back_source:
-                return
-            await asyncio.sleep(0.05)
+        cond = self.dispatcher._cond
+        async with cond:
+            while (not self.dispatcher.has_live_parent()
+                   and not self._need_back_source):
+                await cond.wait()
 
     # ------------------------------------------------------------------
 
@@ -243,6 +242,8 @@ class PieceEngine:
             if code == Code.SCHED_NEED_BACK_SOURCE:
                 self._need_back_source = True
                 self._first_parent.set()
+                async with self.dispatcher._cond:
+                    self.dispatcher._cond.notify_all()
                 return
             if code in (Code.SCHED_PEER_GONE, Code.SCHED_REREGISTER,
                         Code.SCHED_TASK_STATUS_ERROR, Code.UNAVAILABLE):
@@ -257,8 +258,10 @@ class PieceEngine:
                 if parent.peer_id == conductor.peer_id:
                     continue
                 dl_addr = f"{parent.ip}:{parent.download_port}"
-                await self.dispatcher.add_parent(parent.peer_id, dl_addr)
-                if parent.peer_id not in self._synchronizers:
+                await self.dispatcher.add_parent(parent.peer_id, dl_addr,
+                                                 resurrect=True)
+                sync = self._synchronizers.get(parent.peer_id)
+                if sync is None or (sync.task is not None and sync.task.done()):
                     sync = _Synchronizer(self, conductor, parent)
                     self._synchronizers[parent.peer_id] = sync
                     sync.start()
@@ -281,6 +284,12 @@ class PieceEngine:
         except DFError as exc:
             _p2p_pieces.labels("fail").inc()
             await self.dispatcher.report(d, ok=False)
+            if d.parent.ejected:
+                # ejected parent: its sync stream must die too, or a dead
+                # parent keeps the engine looking alive forever
+                sync = self._synchronizers.get(d.parent.peer_id)
+                if sync is not None:
+                    sync.stop()
             await session.report_piece(self._piece_result(
                 conductor, d.piece, d.parent.peer_id, t0, ok=False,
                 code=exc.code))

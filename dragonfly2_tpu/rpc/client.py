@@ -78,9 +78,12 @@ class ChannelPool:
     recently used channels are closed as new addresses arrive.
     """
 
-    def __init__(self, limit: int = 128):
+    def __init__(self, limit: int = 128, evict_grace_s: float = 120.0):
         self.limit = limit
+        self.evict_grace_s = evict_grace_s
         self._channels: dict[str, Channel] = {}
+        self._evicted: list[Channel] = []
+        self._closers: set[asyncio.Task] = set()
 
     def get(self, address: str) -> Channel:
         ch = self._channels.pop(address, None)
@@ -88,15 +91,36 @@ class ChannelPool:
             ch = Channel(address)
             while len(self._channels) >= self.limit:
                 oldest = next(iter(self._channels))
-                evicted = self._channels.pop(oldest)
-                asyncio.get_running_loop().create_task(evicted.close())
+                self._evict(self._channels.pop(oldest))
         self._channels[address] = ch   # re-insert = most recently used
         return ch
 
+    def _evict(self, ch: Channel) -> None:
+        # grace-period close: streams opened on this channel (piece sync
+        # bidis) get time to finish before the channel dies under them
+        self._evicted.append(ch)
+
+        async def delayed() -> None:
+            await asyncio.sleep(self.evict_grace_s)
+            try:
+                self._evicted.remove(ch)
+            except ValueError:
+                return            # pool.close() beat us to it
+            await ch.close()
+
+        t = asyncio.get_running_loop().create_task(delayed())
+        self._closers.add(t)
+        t.add_done_callback(self._closers.discard)
+
     async def close(self) -> None:
+        for t in list(self._closers):
+            t.cancel()
         for ch in self._channels.values():
             await ch.close()
         self._channels.clear()
+        for ch in self._evicted:
+            await ch.close()
+        self._evicted.clear()
 
 
 class ServiceClient:

@@ -1,0 +1,9 @@
+"""Scheduler: the per-cluster brain that builds piece-flow trees.
+
+Role parity: reference ``scheduler/`` (SURVEY §2.4) — resource FSMs over an
+in-memory cluster state, candidate filtering + evaluator scoring, seed-peer
+triggering, and the register/report gRPC surface. TPU-native: parent scoring
+uses real fabric link classes (LOCAL/ICI/DCN/WAN) instead of IDC strings.
+"""
+
+from .server import Scheduler, SchedulerConfig  # noqa: F401

@@ -1,0 +1,58 @@
+"""Scheduler configuration + cluster constants.
+
+Role parity: reference ``scheduler/config/config.go`` + ``constants.go``
+(candidate/filter limits :33-37, retry limits :63-71).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+# reference scheduler/config/constants.go:33-37
+CANDIDATE_PARENT_LIMIT = 4
+FILTER_PARENT_LIMIT = 15
+
+# reference scheduler/config/constants.go:63-71
+DEFAULT_BACK_SOURCE_CONCURRENT = 200
+RETRY_LIMIT = 5                  # schedule retries before back-source verdict
+RETRY_BACK_SOURCE_LIMIT = 4      # failed reports before NeedBackSource
+
+PEER_TTL_S = 24 * 3600.0
+TASK_TTL_S = 24 * 3600.0
+HOST_TTL_S = 6 * 3600.0
+PEER_GC_INTERVAL_S = 60.0
+
+
+@dataclass
+class SeedPeerAddr:
+    """A seed daemon the scheduler may trigger (config- or manager-sourced)."""
+
+    host_id: str = ""
+    ip: str = "127.0.0.1"
+    rpc_port: int = 0
+    download_port: int = 0
+
+
+@dataclass
+class SchedulerConfig:
+    listen_ip: str = "0.0.0.0"
+    advertise_ip: str = "127.0.0.1"
+    port: int = 0                          # 0 = ephemeral
+    cluster_id: int = 1
+    algorithm: str = "default"             # default | nt | ml
+    seed_peers: list[SeedPeerAddr] = field(default_factory=list)
+    candidate_parent_limit: int = CANDIDATE_PARENT_LIMIT
+    filter_parent_limit: int = FILTER_PARENT_LIMIT
+    retry_limit: int = RETRY_LIMIT
+    retry_back_source_limit: int = RETRY_BACK_SOURCE_LIMIT
+    back_source_concurrent: int = DEFAULT_BACK_SOURCE_CONCURRENT
+    peer_ttl_s: float = PEER_TTL_S
+    task_ttl_s: float = TASK_TTL_S
+    host_ttl_s: float = HOST_TTL_S
+    gc_interval_s: float = PEER_GC_INTERVAL_S
+    manager_addresses: list[str] = field(default_factory=list)
+    trainer_address: str = ""
+    keepalive_interval_s: float = 30.0
+    records_dir: str = ""                  # download-record CSVs ("" = workdir)
+    workdir: str = ""

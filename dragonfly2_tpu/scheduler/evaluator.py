@@ -1,0 +1,122 @@
+"""Parent evaluator: scores candidate parents for a downloading peer.
+
+Role parity: reference ``scheduler/scheduling/evaluator/`` — the base
+weighted-sum scorer (``evaluator_base.go:28-46``: piece 0.2, upload-success
+0.2, free-upload 0.15, host-type 0.15, IDC 0.15, location 0.15), the
+``nt`` network-topology variant (RTT weight 0.3), the ``ml`` slot, and the
+``IsBadNode`` Z-score outlier ejection (``evaluator.go:93``).
+
+TPU-native change: the IDC + location string-affinity weights (0.30 combined)
+become a single fabric-locality score computed from real pod coordinates
+(LOCAL > ICI > DCN > WAN, ``tpu/topology.py``) — same weight mass, but
+driven by where the bytes would actually flow (ICI stays on the slice's
+wired mesh; DCN rides the NIC).
+"""
+
+from __future__ import annotations
+
+import logging
+import statistics
+
+from ..idl.messages import HostType, LinkType
+from ..tpu.topology import LINK_BANDWIDTH_SCORE, ici_hops, link_type
+from .resource import Peer
+
+log = logging.getLogger("df.sched.eval")
+
+# weight structure per evaluator_base.go:28-46, with IDC+location mass
+# reassigned to fabric locality
+W_PIECE = 0.20
+W_UPLOAD_SUCCESS = 0.20
+W_FREE_UPLOAD = 0.15
+W_HOST_TYPE = 0.15
+W_LOCALITY = 0.30
+
+BAD_NODE_Z = 3.0                 # reference uses 3-sigma piece-cost outliers
+
+
+class Evaluator:
+    """``default`` algorithm: rule-based weighted sum."""
+
+    def evaluate(self, child: Peer, parent: Peer, *,
+                 total_piece_count: int) -> float:
+        return (W_PIECE * self._piece_score(parent, total_piece_count)
+                + W_UPLOAD_SUCCESS * parent.host.upload_success_ratio()
+                + W_FREE_UPLOAD * self._free_upload_score(parent)
+                + W_HOST_TYPE * self._host_type_score(parent)
+                + W_LOCALITY * self._locality_score(child, parent))
+
+    # -- individual scores --------------------------------------------
+
+    @staticmethod
+    def _piece_score(parent: Peer, total_piece_count: int) -> float:
+        if total_piece_count > 0:
+            return len(parent.finished_pieces) / total_piece_count
+        return 1.0 if parent.finished_pieces else 0.0
+
+    @staticmethod
+    def _free_upload_score(parent: Peer) -> float:
+        limit = parent.host.upload_limit
+        return parent.host.free_upload_slots() / limit if limit else 0.0
+
+    @staticmethod
+    def _host_type_score(parent: Peer) -> float:
+        # seed classes beat normal peers (they hold full content and serve
+        # nothing else); reference orders super > strong > weak > normal
+        return {HostType.SUPER_SEED: 1.0, HostType.STRONG_SEED: 0.9,
+                HostType.WEAK_SEED: 0.8, HostType.NORMAL: 0.5}.get(
+                    parent.host.msg.type, 0.5)
+
+    @staticmethod
+    def _locality_score(child: Peer, parent: Peer) -> float:
+        same_host = child.host.id == parent.host.id
+        lt = link_type(child.host.msg.topology, parent.host.msg.topology,
+                       same_host=same_host)
+        score = LINK_BANDWIDTH_SCORE[lt]
+        if lt == LinkType.ICI:
+            # tie-break same-slice parents by torus distance: every hop is
+            # wired bandwidth, but fewer hops = less contention
+            a, b = child.host.msg.topology, parent.host.msg.topology
+            hops = ici_hops(a, b)
+            if hops < (1 << 16):
+                score -= min(0.05, 0.005 * hops)
+        return score
+
+    # -- bad node ------------------------------------------------------
+
+    @staticmethod
+    def is_bad_node(peer: Peer) -> bool:
+        """Z-score ejection on recent piece costs (evaluator.go:93+)."""
+        costs = peer.piece_costs_ms
+        if len(costs) < 4:
+            return False
+        mean = statistics.fmean(costs)
+        stdev = statistics.pstdev(costs)
+        if stdev == 0:
+            return False
+        return (costs[-1] - mean) / stdev > BAD_NODE_Z
+
+
+class RTTEvaluator(Evaluator):
+    """``nt`` algorithm: replaces the static locality score with measured
+    RTT when the probe store has data for the pair
+    (reference ``evaluator_network_topology.go:30-57``)."""
+
+    def __init__(self, topo_store):
+        self.topo = topo_store
+
+    def _locality_score(self, child: Peer, parent: Peer) -> float:  # type: ignore[override]
+        rtt_us = self.topo.avg_rtt_us(child.host.id, parent.host.id)
+        if rtt_us is None:
+            return Evaluator._locality_score(child, parent)
+        # map RTT to (0,1]: <=50us (ICI neighborhood) ~1.0, 10ms ~0.1
+        return max(0.05, min(1.0, 50.0 / max(rtt_us, 50.0) + 0.05))
+
+
+def make_evaluator(algorithm: str, *, topo_store=None, infer=None) -> Evaluator:
+    if algorithm == "nt" and topo_store is not None:
+        return RTTEvaluator(topo_store)
+    if algorithm == "ml" and infer is not None:
+        from .evaluator_ml import MLEvaluator
+        return MLEvaluator(infer)
+    return Evaluator()

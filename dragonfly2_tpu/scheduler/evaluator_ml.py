@@ -1,0 +1,52 @@
+"""``ml`` evaluator: scores parents with the trained bandwidth predictor.
+
+Role parity: the slot the reference left as a TODO
+(``scheduler/scheduling/evaluator/evaluator.go:84-86`` falls back to base).
+Completing this loop is BASELINE config #5: the trainer fits the model on
+TPU (``trainer/training.py``) and the scheduler queries it here.
+
+Falls back to the rule-based score whenever inference is unavailable or the
+feature row cannot be built.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .evaluator import Evaluator
+from .resource import Peer
+
+log = logging.getLogger("df.sched.eval_ml")
+
+
+class MLEvaluator(Evaluator):
+    def __init__(self, infer):
+        """``infer(features: list[list[float]]) -> list[float]`` returns a
+        predicted goodness per row (higher = better parent)."""
+        self.infer = infer
+
+    def evaluate(self, child: Peer, parent: Peer, *,
+                 total_piece_count: int) -> float:
+        try:
+            row = self.feature_row(child, parent,
+                                   total_piece_count=total_piece_count)
+            out = self.infer([row])
+            if out:
+                return float(out[0])
+        except Exception as exc:  # noqa: BLE001 - model serving is optional
+            log.debug("ml inference failed (%s); using base score", exc)
+        return super().evaluate(child, parent,
+                                total_piece_count=total_piece_count)
+
+    def feature_row(self, child: Peer, parent: Peer, *,
+                    total_piece_count: int) -> list[float]:
+        """Feature layout shared with ``trainer/features.py`` — keep in sync."""
+        return [
+            self._piece_score(parent, total_piece_count),
+            parent.host.upload_success_ratio(),
+            self._free_upload_score(parent),
+            self._host_type_score(parent),
+            self._locality_score(child, parent),
+            float(len(parent.finished_pieces)),
+            float(parent.host.concurrent_upload_count),
+        ]

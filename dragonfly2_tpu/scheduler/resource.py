@@ -1,0 +1,334 @@
+"""In-memory cluster state: Task / Peer / Host with explicit state machines.
+
+Role parity: reference ``scheduler/resource/`` — Task piece-holder DAG over
+peers (``task.go:58-220``), Peer FSM (``peer.go:53-80``), Host
+upload-slot accounting (``host.go``), managers with TTL GC
+(``peer_manager.go:250`` etc.). The FSMs here are explicit enum + allowed-
+transition tables — the state × stream × retry matrix is the bug farm
+(SURVEY §7 hard parts), so transitions are validated, never implied.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import time
+
+from ..common.dag import DAG, DAGError
+from ..common.errors import Code, DFError
+from ..idl.messages import Host as HostMsg
+from ..idl.messages import PieceInfo, SizeScope, TaskType
+
+log = logging.getLogger("df.sched.resource")
+
+
+# ---------------------------------------------------------------- FSMs
+
+class PeerState(str, enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"            # registered, downloading via P2P
+    BACK_SOURCE = "back_source"    # told to fetch from origin
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    LEAVING = "leaving"
+
+
+_PEER_TRANSITIONS: dict[PeerState, set[PeerState]] = {
+    PeerState.PENDING: {PeerState.RUNNING, PeerState.BACK_SOURCE,
+                        PeerState.FAILED, PeerState.LEAVING},
+    PeerState.RUNNING: {PeerState.BACK_SOURCE, PeerState.SUCCEEDED,
+                        PeerState.FAILED, PeerState.LEAVING},
+    PeerState.BACK_SOURCE: {PeerState.SUCCEEDED, PeerState.FAILED,
+                            PeerState.LEAVING},
+    PeerState.SUCCEEDED: {PeerState.LEAVING},
+    PeerState.FAILED: {PeerState.RUNNING, PeerState.LEAVING},
+    PeerState.LEAVING: set(),
+}
+
+
+class TaskState(str, enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"        # at least one peer finished the content
+    FAILED = "failed"
+
+
+_TASK_TRANSITIONS: dict[TaskState, set[TaskState]] = {
+    TaskState.PENDING: {TaskState.RUNNING, TaskState.FAILED},
+    TaskState.RUNNING: {TaskState.SUCCEEDED, TaskState.FAILED},
+    TaskState.SUCCEEDED: {TaskState.RUNNING},   # re-validated after GC/expiry
+    TaskState.FAILED: {TaskState.RUNNING},
+}
+
+
+# ---------------------------------------------------------------- entities
+
+class Host:
+    def __init__(self, msg: HostMsg):
+        self.id = msg.id
+        self.msg = msg
+        self.concurrent_upload_count = 0
+        self.upload_success = 0
+        self.upload_fail = 0
+        self.created_at = time.time()
+        self.updated_at = self.created_at
+
+    @property
+    def upload_limit(self) -> int:
+        return self.msg.concurrent_upload_limit or 100
+
+    def free_upload_slots(self) -> int:
+        return max(0, self.upload_limit - self.concurrent_upload_count)
+
+    def touch(self, msg: HostMsg | None = None) -> None:
+        if msg is not None:
+            self.msg = msg
+        self.updated_at = time.time()
+
+    def observe_upload(self, ok: bool) -> None:
+        if ok:
+            self.upload_success += 1
+        else:
+            self.upload_fail += 1
+
+    def upload_success_ratio(self) -> float:
+        total = self.upload_success + self.upload_fail
+        return self.upload_success / total if total else 1.0
+
+
+class Peer:
+    def __init__(self, peer_id: str, task: "Task", host: Host):
+        self.id = peer_id
+        self.task = task
+        self.host = host
+        self.state = PeerState.PENDING
+        self.finished_pieces: set[int] = set()
+        self.piece_costs_ms: list[int] = []       # recent piece costs (bad-node)
+        self.schedule_count = 0                   # packets sent to this peer
+        self.report_fail_count = 0                # failed piece reports
+        self.blocked_parents: set[str] = set()
+        self.packet_sink = None                   # set by the report stream
+        self.created_at = time.time()
+        self.updated_at = self.created_at
+
+    def transit(self, to: PeerState) -> None:
+        if to == self.state:
+            return
+        if to not in _PEER_TRANSITIONS[self.state]:
+            raise DFError(Code.SCHED_TASK_STATUS_ERROR,
+                          f"peer {self.id[-12:]}: illegal {self.state.value}"
+                          f" -> {to.value}")
+        log.debug("peer %s: %s -> %s", self.id[-12:], self.state.value, to.value)
+        self.state = to
+        self.updated_at = time.time()
+
+    def touch(self) -> None:
+        self.updated_at = time.time()
+
+    def observe_piece_cost(self, cost_ms: int) -> None:
+        self.piece_costs_ms.append(cost_ms)
+        if len(self.piece_costs_ms) > 20:
+            self.piece_costs_ms = self.piece_costs_ms[-20:]
+
+    def is_done(self) -> bool:
+        return self.state in (PeerState.SUCCEEDED, PeerState.FAILED,
+                              PeerState.LEAVING)
+
+    def has_content(self) -> bool:
+        """Usable as a parent: finished, or running with pieces to share."""
+        if self.state == PeerState.SUCCEEDED:
+            return True
+        return (self.state in (PeerState.RUNNING, PeerState.BACK_SOURCE)
+                and bool(self.finished_pieces))
+
+
+class Task:
+    def __init__(self, task_id: str, url: str, *,
+                 task_type: TaskType = TaskType.STANDARD):
+        self.id = task_id
+        self.url = url
+        self.task_type = task_type
+        self.state = TaskState.PENDING
+        self.content_length = -1
+        self.piece_size = 0
+        self.total_piece_count = -1
+        self.direct_content = b""                # TINY tasks: inline bytes
+        self.pieces: dict[int, PieceInfo] = {}   # canonical piece metadata
+        self.peers: dict[str, Peer] = {}
+        self.dag: DAG[str] = DAG()               # edges parent -> child
+        self.back_source_count = 0
+        self.seed_triggered = False
+        self.seed_job = None                     # asyncio.Task of the trigger
+        self.created_at = time.time()
+        self.updated_at = self.created_at
+
+    def transit(self, to: TaskState) -> None:
+        if to == self.state:
+            return
+        if to not in _TASK_TRANSITIONS[self.state]:
+            raise DFError(Code.SCHED_TASK_STATUS_ERROR,
+                          f"task {self.id[:12]}: illegal {self.state.value}"
+                          f" -> {to.value}")
+        self.state = to
+        self.updated_at = time.time()
+
+    # -- geometry ------------------------------------------------------
+
+    def set_content_info(self, content_length: int, piece_size: int,
+                         total_piece_count: int) -> None:
+        if content_length >= 0:
+            self.content_length = content_length
+        if piece_size > 0:
+            self.piece_size = piece_size
+        if total_piece_count >= 0:
+            self.total_piece_count = total_piece_count
+        self.updated_at = time.time()
+
+    def size_scope(self) -> SizeScope:
+        if self.content_length < 0:
+            return SizeScope.NORMAL
+        if self.content_length == 0:
+            return SizeScope.EMPTY
+        if self.content_length <= 128 * 1024 and self.direct_content:
+            return SizeScope.TINY
+        if self.total_piece_count == 1:
+            return SizeScope.SMALL
+        return SizeScope.NORMAL
+
+    def record_piece(self, info: PieceInfo) -> None:
+        known = self.pieces.get(info.piece_num)
+        if known is None or (not known.digest and info.digest):
+            self.pieces[info.piece_num] = info
+
+    # -- peer/DAG management ------------------------------------------
+
+    def add_peer(self, peer: Peer) -> None:
+        self.peers[peer.id] = peer
+        self.dag.add_vertex(peer.id, peer.id)
+        self.touch()
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.peers.pop(peer_id, None)
+        try:
+            self.dag.delete_vertex(peer_id)
+        except DAGError:
+            pass
+        self.touch()
+
+    def set_parents(self, child_id: str, parent_ids: list[str]) -> None:
+        """Re-point the child's in-edges at the new parent set (re-parenting
+        on reschedule must drop stale edges or the DAG fills with cycles)."""
+        self.dag.delete_in_edges(child_id)
+        for pid in parent_ids:
+            if pid == child_id or pid not in self.dag:
+                continue
+            try:
+                self.dag.add_edge(pid, child_id)
+            except DAGError:
+                log.debug("edge %s->%s would cycle; skipped", pid[-12:],
+                          child_id[-12:])
+
+    def would_cycle(self, parent_id: str, child_id: str) -> bool:
+        return self.dag.can_reach(child_id, parent_id)
+
+    def has_available_peer(self) -> bool:
+        return any(p.has_content() for p in self.peers.values())
+
+    def touch(self) -> None:
+        self.updated_at = time.time()
+
+
+# ---------------------------------------------------------------- managers
+
+class Resource:
+    """The cluster state of record for one scheduler."""
+
+    def __init__(self, *, peer_ttl_s: float = 24 * 3600.0,
+                 task_ttl_s: float = 24 * 3600.0,
+                 host_ttl_s: float = 6 * 3600.0):
+        self.tasks: dict[str, Task] = {}
+        self.hosts: dict[str, Host] = {}
+        self.peer_ttl_s = peer_ttl_s
+        self.task_ttl_s = task_ttl_s
+        self.host_ttl_s = host_ttl_s
+
+    # -- lookups -------------------------------------------------------
+
+    def get_or_create_task(self, task_id: str, url: str, *,
+                           task_type: TaskType = TaskType.STANDARD) -> Task:
+        task = self.tasks.get(task_id)
+        if task is None:
+            task = Task(task_id, url, task_type=task_type)
+            self.tasks[task_id] = task
+        return task
+
+    def store_host(self, msg: HostMsg) -> Host:
+        host = self.hosts.get(msg.id)
+        if host is None:
+            host = Host(msg)
+            self.hosts[msg.id] = host
+        else:
+            host.touch(msg)
+        return host
+
+    def get_or_create_peer(self, peer_id: str, task: Task, host: Host) -> Peer:
+        peer = task.peers.get(peer_id)
+        if peer is None:
+            peer = Peer(peer_id, task, host)
+            task.add_peer(peer)
+        return peer
+
+    def find_peer(self, task_id: str, peer_id: str) -> Peer | None:
+        task = self.tasks.get(task_id)
+        return task.peers.get(peer_id) if task else None
+
+    # -- departures ----------------------------------------------------
+
+    def leave_peer(self, task_id: str, peer_id: str) -> None:
+        task = self.tasks.get(task_id)
+        if task is None:
+            return
+        peer = task.peers.get(peer_id)
+        if peer is not None and peer.state != PeerState.LEAVING:
+            try:
+                peer.transit(PeerState.LEAVING)
+            except DFError:
+                pass
+        task.remove_peer(peer_id)
+
+    def leave_host(self, host_id: str) -> list[Peer]:
+        """Remove the host and every peer on it; returns orphaned children's
+        peers so the service can reschedule them."""
+        self.hosts.pop(host_id, None)
+        orphaned: list[Peer] = []
+        for task in self.tasks.values():
+            gone = [p for p in task.peers.values() if p.host.id == host_id]
+            for peer in gone:
+                children = task.dag.children(peer.id)
+                task.remove_peer(peer.id)
+                for cid in children:
+                    child = task.peers.get(cid)
+                    if child is not None and not child.is_done():
+                        orphaned.append(child)
+        return orphaned
+
+    # -- GC ------------------------------------------------------------
+
+    def gc(self) -> int:
+        """Evict idle peers, empty/expired tasks, and silent hosts."""
+        now = time.time()
+        evicted = 0
+        for task in list(self.tasks.values()):
+            for peer in list(task.peers.values()):
+                idle = now - peer.updated_at
+                if (peer.is_done() and idle > 300.0) or idle > self.peer_ttl_s:
+                    task.remove_peer(peer.id)
+                    evicted += 1
+            if not task.peers and now - task.updated_at > self.task_ttl_s:
+                del self.tasks[task.id]
+                evicted += 1
+        for host in list(self.hosts.values()):
+            if now - host.updated_at > self.host_ttl_s:
+                del self.hosts[host.id]
+                evicted += 1
+        return evicted

@@ -1,0 +1,80 @@
+"""Scheduling core: pick parents for a peer, or rule back-source.
+
+Role parity: reference ``scheduler/scheduling/scheduling.go`` —
+``ScheduleParentAndCandidateParents`` retry loop, ``FindCandidateParents``
+(:385) and ``filterCandidateParents`` (:500-570: blocklist, same-peer,
+DAG-cycle, bad-node, free-upload-slot checks), with the
+``RetryBackToSourceLimit`` arbitration.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..idl.messages import PeerAddr, PeerPacket
+from ..tpu.topology import link_type
+from .config import SchedulerConfig
+from .evaluator import Evaluator
+from .resource import Peer
+
+log = logging.getLogger("df.sched.core")
+
+
+class Scheduling:
+    def __init__(self, cfg: SchedulerConfig, evaluator: Evaluator):
+        self.cfg = cfg
+        self.evaluator = evaluator
+
+    # ------------------------------------------------------------------
+
+    def filter_candidates(self, child: Peer) -> list[Peer]:
+        """All legal parents for ``child``, pre-scoring (the filter half)."""
+        task = child.task
+        out: list[Peer] = []
+        for parent in task.peers.values():
+            if len(out) >= self.cfg.filter_parent_limit:
+                break
+            if parent.id == child.id:
+                continue
+            if parent.id in child.blocked_parents:
+                continue
+            if not parent.has_content():
+                continue
+            if parent.host.free_upload_slots() <= 0:
+                continue
+            if self.evaluator.is_bad_node(parent):
+                continue
+            if task.would_cycle(parent.id, child.id):
+                continue
+            out.append(parent)
+        return out
+
+    def find_parents(self, child: Peer) -> list[Peer]:
+        candidates = self.filter_candidates(child)
+        if not candidates:
+            return []
+        total = child.task.total_piece_count
+        scored = sorted(
+            candidates,
+            key=lambda p: self.evaluator.evaluate(child, p,
+                                                  total_piece_count=total),
+            reverse=True)
+        return scored[:self.cfg.candidate_parent_limit]
+
+    # ------------------------------------------------------------------
+
+    def build_packet(self, child: Peer, parents: list[Peer]) -> PeerPacket:
+        def addr(p: Peer) -> PeerAddr:
+            same_host = p.host.id == child.host.id
+            return PeerAddr(
+                peer_id=p.id, ip=p.host.msg.ip,
+                rpc_port=p.host.msg.port,
+                download_port=p.host.msg.download_port,
+                link=link_type(child.host.msg.topology, p.host.msg.topology,
+                               same_host=same_host))
+        main = addr(parents[0]) if parents else None
+        return PeerPacket(
+            task_id=child.task.id, src_peer_id=child.id,
+            parallel_count=4, main_peer=main,
+            candidate_peers=[addr(p) for p in parents[1:]])
+

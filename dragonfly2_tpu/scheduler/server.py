@@ -1,0 +1,65 @@
+"""Scheduler bootstrap: wire resource, scheduling, seed client, GC, gRPC.
+
+Role parity: reference ``scheduler/scheduler.go`` ``New``/``Serve``
+(:110-299, :302) minus manager/Redis (dynconfig + keepalive attach in the
+manager stage; job queues ride the manager's queue, not Redis).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..common.gc import GC, GCTask
+from ..rpc.server import RPCServer
+from .config import SchedulerConfig
+from .evaluator import make_evaluator
+from .resource import Resource
+from .scheduling import Scheduling
+from .seed_client import SeedPeerClient
+from .service import SchedulerService, build_service
+from .topology_store import TopologyStore
+
+log = logging.getLogger("df.sched.server")
+
+
+class Scheduler:
+    def __init__(self, cfg: SchedulerConfig, *, records=None, infer=None):
+        self.cfg = cfg
+        self.resource = Resource(peer_ttl_s=cfg.peer_ttl_s,
+                                 task_ttl_s=cfg.task_ttl_s,
+                                 host_ttl_s=cfg.host_ttl_s)
+        self.topo = TopologyStore()
+        evaluator = make_evaluator(cfg.algorithm, topo_store=self.topo,
+                                   infer=infer)
+        self.scheduling = Scheduling(cfg, evaluator)
+        self.seed_client = SeedPeerClient(self.resource, cfg.seed_peers)
+        self.service = SchedulerService(cfg, self.resource, self.scheduling,
+                                        self.seed_client, self.topo,
+                                        records=records)
+        self.rpc: RPCServer | None = None
+        self.gc = GC()
+        self.port: int | None = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.cfg.advertise_ip}:{self.port}"
+
+    async def start(self) -> None:
+        self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.port}")
+        self.rpc.register(build_service(self.service))
+        await self.rpc.start()
+        self.port = self.rpc.port
+        self.gc.add(GCTask("resource", self.cfg.gc_interval_s,
+                           self.resource.gc))
+        self.gc.start()
+        log.info("scheduler up on %s (cluster=%d, algorithm=%s, seeds=%d)",
+                 self.address, self.cfg.cluster_id, self.cfg.algorithm,
+                 len(self.cfg.seed_peers))
+
+    async def stop(self) -> None:
+        await self.gc.stop()
+        for t in list(self.service._seed_tasks):
+            t.cancel()
+        await self.seed_client.close()
+        if self.rpc is not None:
+            await self.rpc.stop(0.5)

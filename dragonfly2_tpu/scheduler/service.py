@@ -1,0 +1,335 @@
+"""Scheduler gRPC service: register / report / announce / probes / leave.
+
+Role parity: reference ``scheduler/service/service_v1.go`` — RegisterPeerTask
+with size-scope dispatch (:1005-1110), the ReportPieceResult bidi stream
+driving reschedules (:187), piece success/failure handlers (:1159, :1210),
+AnnounceHost (:478), SyncProbes (:688), StatTask, LeaveHost/LeavePeer.
+
+Back-source arbitration (SURVEY §7 hard part): a child with no viable
+parents is NOT immediately sent to origin — if a seed trigger is in flight
+the scheduler retries on a short interval and only rules NeedBackSource when
+patience runs out or no seed exists. Encoded in ``_schedule_with_patience``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator
+
+from ..common.errors import Code, DFError
+from ..common.metrics import REGISTRY
+from ..idl.messages import (AnnounceHostRequest, Empty, LeaveHostRequest,
+                            LeavePeerRequest, PeerPacket, PeerResult,
+                            PieceResult, RegisterPeerTaskRequest,
+                            RegisterResult, SinglePiece, SizeScope,
+                            StatTaskRequest, SyncProbesResponse, TaskStat,
+                            ProbeTarget)
+from ..rpc.server import ServiceDef
+from .config import SchedulerConfig
+from .resource import Peer, PeerState, Resource, TaskState
+from .scheduling import Scheduling
+from .seed_client import SeedPeerClient
+from .topology_store import TopologyStore
+
+log = logging.getLogger("df.sched.service")
+
+SCHEDULER_SERVICE = "df.scheduler.Scheduler"
+
+_registers = REGISTRY.counter("df_sched_register_total",
+                              "peer task registrations", ("scope",))
+_schedules = REGISTRY.counter("df_sched_schedule_total",
+                              "scheduling decisions", ("kind",))
+_piece_reports = REGISTRY.counter("df_sched_piece_report_total",
+                                  "piece results received", ("result",))
+
+SCHEDULE_RETRY_INTERVAL_S = 0.25
+SCHEDULE_PATIENCE_S = 10.0
+
+
+class SchedulerService:
+    def __init__(self, cfg: SchedulerConfig, resource: Resource,
+                 scheduling: Scheduling, seed_client: SeedPeerClient,
+                 topo: TopologyStore, *, records=None):
+        self.cfg = cfg
+        self.resource = resource
+        self.scheduling = scheduling
+        self.seed_client = seed_client
+        self.topo = topo
+        self.records = records          # download-record sink (trainer dataset)
+        self._seed_tasks: set[asyncio.Task] = set()
+
+    # ------------------------------------------------------------------
+    # RegisterPeerTask
+    # ------------------------------------------------------------------
+
+    async def register_peer_task(self, req: RegisterPeerTaskRequest,
+                                 context) -> RegisterResult:
+        if not req.task_id or not req.peer_id or req.peer_host is None:
+            raise DFError(Code.INVALID_ARGUMENT,
+                          "task_id, peer_id, peer_host required")
+        task = self.resource.get_or_create_task(req.task_id, req.url)
+        if task.state in (TaskState.SUCCEEDED, TaskState.FAILED):
+            task.transit(TaskState.RUNNING)
+        elif task.state == TaskState.PENDING:
+            task.transit(TaskState.RUNNING)
+        host = self.resource.store_host(req.peer_host)
+        peer = self.resource.get_or_create_peer(req.peer_id, task, host)
+        if peer.state == PeerState.PENDING:
+            peer.transit(PeerState.RUNNING)
+
+        # first peer of an unseeded task: fire the seed trigger
+        if (not task.seed_triggered and self.seed_client.available()
+                and not task.has_available_peer()):
+            task.seed_triggered = True
+            t = asyncio.get_running_loop().create_task(
+                self.seed_client.trigger(task, req.url_meta))
+            task.seed_job = t
+            self._seed_tasks.add(t)
+            t.add_done_callback(self._seed_tasks.discard)
+
+        scope = task.size_scope()
+        result = RegisterResult(task_id=task.id, size_scope=SizeScope.NORMAL,
+                                content_length=task.content_length,
+                                piece_size=task.piece_size)
+        if scope == SizeScope.EMPTY:
+            result.size_scope = SizeScope.EMPTY
+        elif scope == SizeScope.TINY:
+            result.size_scope = SizeScope.TINY
+            result.direct_content = task.direct_content
+        elif scope == SizeScope.SMALL:
+            single = self._single_piece_parent(peer)
+            if single is not None:
+                result.size_scope = SizeScope.SMALL
+                result.single_piece = single
+        _registers.labels(result.size_scope.name).inc()
+        return result
+
+    def _single_piece_parent(self, child: Peer) -> SinglePiece | None:
+        info = child.task.pieces.get(0)
+        if info is None:
+            return None
+        parents = self.scheduling.find_parents(child)
+        if not parents:
+            return None
+        p = parents[0]
+        return SinglePiece(
+            dst_peer_id=p.id,
+            dst_addr=f"{p.host.msg.ip}:{p.host.msg.download_port}",
+            piece_info=info)
+
+    # ------------------------------------------------------------------
+    # ReportPieceResult (bidi stream)
+    # ------------------------------------------------------------------
+
+    async def report_piece_result(self, request_iter,
+                                  context) -> AsyncIterator[PeerPacket]:
+        first: PieceResult | None = None
+        async for msg in request_iter:
+            first = msg
+            break
+        if first is None:
+            return
+        peer = self.resource.find_peer(first.task_id, first.src_peer_id)
+        if peer is None:
+            raise DFError(Code.SCHED_REREGISTER,
+                          f"unknown peer {first.src_peer_id[-12:]}")
+        sink: asyncio.Queue[PeerPacket | None] = asyncio.Queue()
+        peer.packet_sink = sink
+
+        async def consume() -> None:
+            try:
+                async for result in request_iter:
+                    await self._handle_piece_result(peer, result)
+            except Exception as exc:  # noqa: BLE001 - client went away
+                log.debug("report stream from %s ended: %s",
+                          peer.id[-12:], exc)
+            finally:
+                sink.put_nowait(None)
+
+        consumer = asyncio.get_running_loop().create_task(consume())
+        scheduler_task = asyncio.get_running_loop().create_task(
+            self._schedule_with_patience(peer, sink))
+        try:
+            while True:
+                packet = await sink.get()
+                if packet is None:
+                    break
+                yield packet
+                if packet.code == int(Code.SCHED_NEED_BACK_SOURCE):
+                    # verdict delivered; the stream stays open for reports
+                    continue
+        finally:
+            scheduler_task.cancel()
+            consumer.cancel()
+            await asyncio.gather(consumer, scheduler_task,
+                                 return_exceptions=True)
+            if peer.packet_sink is sink:
+                peer.packet_sink = None
+
+    async def _schedule_with_patience(self, peer: Peer,
+                                      sink: asyncio.Queue) -> None:
+        """Initial scheduling loop: try now, retry while a seed is coming,
+        rule back-source when patience ends."""
+        deadline = (asyncio.get_running_loop().time() + SCHEDULE_PATIENCE_S)
+        while True:
+            if peer.is_done() or peer.state == PeerState.BACK_SOURCE:
+                return
+            parents = self.scheduling.find_parents(peer)
+            if parents:
+                peer.schedule_count += 1
+                peer.task.set_parents(peer.id, [p.id for p in parents])
+                _schedules.labels("parents").inc()
+                sink.put_nowait(self.scheduling.build_packet(peer, parents))
+                return
+            now = asyncio.get_running_loop().time()
+            seed_pending = (peer.task.seed_job is not None
+                            and not peer.task.seed_job.done())
+            if now >= deadline or not seed_pending:
+                packet = self._rule_back_source(peer)
+                if packet is not None:
+                    sink.put_nowait(packet)
+                return
+            await asyncio.sleep(SCHEDULE_RETRY_INTERVAL_S)
+
+    def _rule_back_source(self, peer: Peer) -> PeerPacket | None:
+        task = peer.task
+        if task.back_source_count >= self.cfg.back_source_concurrent:
+            _schedules.labels("busy").inc()
+            return PeerPacket(task_id=task.id, src_peer_id=peer.id,
+                              code=int(Code.SCHED_TASK_STATUS_ERROR))
+        task.back_source_count += 1
+        try:
+            peer.transit(PeerState.BACK_SOURCE)
+        except DFError:
+            return None
+        _schedules.labels("back_source").inc()
+        return PeerPacket(task_id=task.id, src_peer_id=peer.id,
+                          code=int(Code.SCHED_NEED_BACK_SOURCE))
+
+    async def _handle_piece_result(self, peer: Peer,
+                                   result: PieceResult) -> None:
+        peer.touch()
+        task = peer.task
+        if result.success:
+            _piece_reports.labels("ok").inc()
+            if result.piece_info is not None:
+                task.record_piece(result.piece_info)
+                peer.finished_pieces.add(result.piece_info.piece_num)
+                peer.observe_piece_cost(result.piece_info.download_cost_ms)
+            if result.dst_peer_id:
+                parent = task.peers.get(result.dst_peer_id)
+                if parent is not None:
+                    parent.host.observe_upload(True)
+            if self.records is not None and result.piece_info is not None:
+                self.records.on_piece(peer, result)
+            return
+        _piece_reports.labels("fail").inc()
+        peer.report_fail_count += 1
+        if result.dst_peer_id:
+            parent = task.peers.get(result.dst_peer_id)
+            if parent is not None:
+                parent.host.observe_upload(False)
+            peer.blocked_parents.add(result.dst_peer_id)
+        # losing a parent: offer a fresh assignment (or the origin)
+        await self._reschedule(peer)
+
+    async def _reschedule(self, peer: Peer) -> None:
+        if peer.packet_sink is None or peer.is_done():
+            return
+        if peer.state == PeerState.BACK_SOURCE:
+            return
+        parents = self.scheduling.find_parents(peer)
+        if parents:
+            peer.schedule_count += 1
+            peer.task.set_parents(peer.id, [p.id for p in parents])
+            _schedules.labels("parents").inc()
+            peer.packet_sink.put_nowait(
+                self.scheduling.build_packet(peer, parents))
+            return
+        if peer.report_fail_count >= self.cfg.retry_back_source_limit:
+            packet = self._rule_back_source(peer)
+            if packet is not None:
+                peer.packet_sink.put_nowait(packet)
+
+    # ------------------------------------------------------------------
+    # ReportPeerResult — final verdict for one peer's run
+    # ------------------------------------------------------------------
+
+    async def report_peer_result(self, result: PeerResult, context) -> Empty:
+        peer = self.resource.find_peer(result.task_id, result.peer_id)
+        if peer is None:
+            return Empty()
+        task = peer.task
+        if result.success:
+            task.set_content_info(result.content_length, 0,
+                                  result.total_piece_count)
+            if not peer.is_done():
+                peer.transit(PeerState.SUCCEEDED)
+            if task.state == TaskState.RUNNING:
+                task.transit(TaskState.SUCCEEDED)
+        else:
+            if not peer.is_done():
+                peer.transit(PeerState.FAILED)
+        if self.records is not None:
+            self.records.on_peer(peer, result)
+        return Empty()
+
+    # ------------------------------------------------------------------
+    # host lifecycle + stat + probes
+    # ------------------------------------------------------------------
+
+    async def announce_host(self, req: AnnounceHostRequest, context) -> Empty:
+        if req.host is not None:
+            self.resource.store_host(req.host)
+        return Empty()
+
+    async def leave_host(self, req: LeaveHostRequest, context) -> Empty:
+        orphans = self.resource.leave_host(req.host_id)
+        for child in orphans:
+            await self._reschedule(child)
+        return Empty()
+
+    async def leave_peer(self, req: LeavePeerRequest, context) -> Empty:
+        self.resource.leave_peer(req.task_id, req.peer_id)
+        return Empty()
+
+    async def stat_task(self, req: StatTaskRequest, context) -> TaskStat:
+        task = self.resource.tasks.get(req.task_id)
+        if task is None:
+            raise DFError(Code.NOT_FOUND, f"task {req.task_id[:12]} unknown")
+        return TaskStat(id=task.id, type=task.task_type,
+                        content_length=task.content_length,
+                        total_piece_count=task.total_piece_count,
+                        state=task.state.value, peer_count=len(task.peers),
+                        has_available_peer=task.has_available_peer())
+
+    async def sync_probes(self, request_iter,
+                          context) -> AsyncIterator[SyncProbesResponse]:
+        async for req in request_iter:
+            src = req.host.id if req.host is not None else ""
+            for probe in req.probes or []:
+                self.topo.record(src, probe.target_host_id, probe.rtt_us)
+            for failed in req.failed_host_ids or []:
+                self.topo.fail(src, failed)
+            targets = []
+            for hid in self.topo.pick_targets(
+                    src, list(self.resource.hosts)):
+                host = self.resource.hosts.get(hid)
+                if host is not None:
+                    targets.append(ProbeTarget(host_id=hid, ip=host.msg.ip,
+                                               port=host.msg.port))
+            yield SyncProbesResponse(targets=targets)
+
+
+def build_service(svc: SchedulerService) -> ServiceDef:
+    d = ServiceDef(SCHEDULER_SERVICE)
+    d.unary_unary("RegisterPeerTask", svc.register_peer_task)
+    d.stream_stream("ReportPieceResult", svc.report_piece_result)
+    d.unary_unary("ReportPeerResult", svc.report_peer_result)
+    d.unary_unary("AnnounceHost", svc.announce_host)
+    d.unary_unary("LeaveHost", svc.leave_host)
+    d.unary_unary("LeavePeer", svc.leave_peer)
+    d.unary_unary("StatTask", svc.stat_task)
+    d.stream_stream("SyncProbes", svc.sync_probes)
+    return d

@@ -1,0 +1,63 @@
+"""RTT graph between hosts, fed by daemon probe reports.
+
+Role parity: reference ``scheduler/networktopology/`` — per-(src,dst) probe
+queues with sliding EWMA avgRTT (α=0.1), neighbour queries for the ``nt``
+evaluator, and snapshot rows for the trainer dataset. The reference keeps
+this in Redis for cross-scheduler sharing; here it is the scheduler's own
+memory (single control-plane store per SURVEY §2.8 note).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+_EWMA_ALPHA = 0.1
+
+
+@dataclass
+class ProbeStat:
+    avg_rtt_us: float
+    count: int
+    updated_at: float
+
+
+class TopologyStore:
+    def __init__(self, *, probe_targets: int = 5):
+        self.probe_targets = probe_targets
+        self._stats: dict[tuple[str, str], ProbeStat] = {}
+
+    def record(self, src: str, dst: str, rtt_us: int) -> None:
+        key = (src, dst)
+        st = self._stats.get(key)
+        now = time.time()
+        if st is None:
+            self._stats[key] = ProbeStat(float(rtt_us), 1, now)
+        else:
+            st.avg_rtt_us += _EWMA_ALPHA * (rtt_us - st.avg_rtt_us)
+            st.count += 1
+            st.updated_at = now
+
+    def fail(self, src: str, dst: str) -> None:
+        self._stats.pop((src, dst), None)
+
+    def avg_rtt_us(self, src: str, dst: str) -> float | None:
+        st = self._stats.get((src, dst)) or self._stats.get((dst, src))
+        return st.avg_rtt_us if st else None
+
+    def probed_count(self, src: str) -> int:
+        return sum(1 for (s, _d) in self._stats if s == src)
+
+    def pick_targets(self, src: str, all_hosts: list[str]) -> list[str]:
+        """Least-probed-first target selection for a prober."""
+        others = [h for h in all_hosts if h != src]
+        others.sort(key=lambda h: (self._stats.get((src, h)) is not None,
+                                   (self._stats.get((src, h)) or
+                                    ProbeStat(0, 0, 0)).updated_at))
+        return others[:self.probe_targets]
+
+    def snapshot_rows(self) -> list[dict]:
+        """Feature rows for the trainer dataset."""
+        return [{"src": s, "dst": d, "avg_rtt_us": st.avg_rtt_us,
+                 "count": st.count, "updated_at": st.updated_at}
+                for (s, d), st in self._stats.items()]

@@ -1,0 +1,251 @@
+"""Stage-6: scheduler unit tests + the full-stack E2E slice.
+
+E2E topology (BASELINE config #2 shape, shrunk): origin -> seed daemon
+(triggered via ObtainSeeds by the scheduler) -> leecher daemons that
+register with the REAL scheduler over gRPC and pull pieces P2P. Verifies
+the whole register/report/schedule loop with zero scripted components.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from dragonfly2_tpu.common.errors import Code
+from dragonfly2_tpu.daemon.config import (DaemonConfig, DownloadConfig,
+                                          SchedulerConfig as DaemonSchedCfg,
+                                          StorageSection)
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.idl.messages import (DownloadRequest, Host, HostType,
+                                         PieceInfo, TopologyInfo, UrlMeta)
+from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+from dragonfly2_tpu.scheduler.config import SeedPeerAddr
+from dragonfly2_tpu.scheduler.evaluator import Evaluator
+from dragonfly2_tpu.scheduler.resource import (Peer, PeerState, Resource,
+                                               Task, TaskState)
+
+from test_daemon_e2e import daemon_config, start_origin
+
+
+# ---------------------------------------------------------------- unit: FSM
+
+def _mk_peer(peer_id="p1", host_id="h1", *, host_type=HostType.NORMAL,
+             topology=None, task=None):
+    res = Resource()
+    task = task or Task("t" * 64, "http://o/f")
+    host = res.store_host(Host(id=host_id, ip="127.0.0.1", port=1,
+                               download_port=2, type=host_type,
+                               topology=topology))
+    return res.get_or_create_peer(peer_id, task, host)
+
+
+class TestFSM:
+    def test_legal_path(self):
+        peer = _mk_peer()
+        peer.transit(PeerState.RUNNING)
+        peer.transit(PeerState.SUCCEEDED)
+        peer.transit(PeerState.LEAVING)
+
+    def test_illegal_transition_raises(self):
+        peer = _mk_peer()
+        peer.transit(PeerState.RUNNING)
+        peer.transit(PeerState.SUCCEEDED)
+        with pytest.raises(Exception):
+            peer.transit(PeerState.RUNNING)
+
+    def test_task_dag_no_cycles(self):
+        task = Task("t" * 64, "u")
+        a = _mk_peer("a", "ha", task=task)
+        b = _mk_peer("b", "hb", task=task)
+        task.set_parents("b", ["a"])
+        assert task.would_cycle("b", "a")   # a->b exists; b->a would cycle
+        task.set_parents("b", [])           # re-parenting clears old edges
+        assert not task.would_cycle("b", "a")
+
+
+# ---------------------------------------------------------------- unit: eval
+
+class TestEvaluator:
+    def _pair(self, child_topo, parent_topo, parent_type=HostType.NORMAL):
+        task = Task("t" * 64, "u")
+        child = _mk_peer("c", "hc", topology=child_topo, task=task)
+        parent = _mk_peer("p", "hp", host_type=parent_type,
+                          topology=parent_topo, task=task)
+        parent.transit(PeerState.RUNNING)
+        parent.finished_pieces.add(0)
+        return child, parent
+
+    def test_ici_beats_dcn_beats_wan(self):
+        ev = Evaluator()
+        t_child = TopologyInfo(slice_name="s0", zone="z0")
+        same_slice = self._pair(t_child, TopologyInfo(slice_name="s0", zone="z0"))
+        same_zone = self._pair(t_child, TopologyInfo(slice_name="s1", zone="z0"))
+        far = self._pair(t_child, TopologyInfo(slice_name="s2", zone="z9"))
+        scores = [ev.evaluate(c, p, total_piece_count=10)
+                  for c, p in (same_slice, same_zone, far)]
+        assert scores[0] > scores[1] > scores[2]
+
+    def test_seed_host_preferred(self):
+        ev = Evaluator()
+        t = TopologyInfo(zone="z0")
+        _, normal = self._pair(t, t)
+        _, seed = self._pair(t, t, parent_type=HostType.SUPER_SEED)
+        child, _ = self._pair(t, t)
+        assert ev.evaluate(child, seed, total_piece_count=10) > \
+               ev.evaluate(child, normal, total_piece_count=10)
+
+    def test_bad_node_needs_outlier(self):
+        peer = _mk_peer()
+        for _ in range(10):
+            peer.observe_piece_cost(100)
+        assert not Evaluator.is_bad_node(peer)
+        peer.observe_piece_cost(100_000)
+        assert Evaluator.is_bad_node(peer)
+
+
+# ---------------------------------------------------------------- E2E
+
+def leecher_config(tmp_path, name, sched_addr) -> DaemonConfig:
+    cfg = daemon_config(tmp_path, name)
+    cfg.scheduler = DaemonSchedCfg(addresses=[sched_addr],
+                                   schedule_timeout_s=20.0)
+    return cfg
+
+
+async def download_via(daemon: Daemon, url: str, out: str,
+                       disable_back_source=True):
+    ch = Channel(f"unix:{daemon.unix_sock}")
+    client = ServiceClient(ch, "df.daemon.Daemon")
+    done = []
+    async for resp in client.unary_stream("Download", DownloadRequest(
+            url=url, output=out, disable_back_source=disable_back_source,
+            timeout_s=60.0)):
+        if resp.done:
+            done.append(resp)
+    await ch.close()
+    return done[-1] if done else None
+
+
+class TestSchedulerE2E:
+    def test_seed_fanout_two_leechers(self, tmp_path):
+        data = os.urandom(10 * 1024 * 1024 + 777)
+
+        async def go():
+            origin, base = await start_origin({"m.bin": data})
+            url = f"{base}/m.bin"
+            # seed daemon (no scheduler; serves ObtainSeeds)
+            seed_cfg = daemon_config(tmp_path, "seed")
+            seed_cfg.is_seed = True
+            seed = Daemon(seed_cfg)
+            await seed.start()
+
+            sched = Scheduler(SchedulerConfig(seed_peers=[SeedPeerAddr(
+                ip="127.0.0.1", rpc_port=seed.rpc.port,
+                download_port=seed.upload_server.port)]))
+            await sched.start()
+
+            l1 = Daemon(leecher_config(tmp_path, "l1", sched.address))
+            l2 = Daemon(leecher_config(tmp_path, "l2", sched.address))
+            await l1.start()
+            await l2.start()
+            try:
+                r1, r2 = await asyncio.gather(
+                    download_via(l1, url, str(tmp_path / "l1.out")),
+                    download_via(l2, url, str(tmp_path / "l2.out")))
+                assert r1 is not None and r2 is not None
+                assert (tmp_path / "l1.out").read_bytes() == data
+                assert (tmp_path / "l2.out").read_bytes() == data
+                c1 = l1.ptm.conductor(r1.task_id)
+                c2 = l2.ptm.conductor(r2.task_id)
+                # back-source disabled: every byte moved through the mesh
+                assert c1.traffic_source == 0 and c2.traffic_source == 0
+                assert c1.traffic_p2p == len(data)
+                # scheduler state settled: task succeeded, seed has pieces
+                task = sched.resource.tasks[r1.task_id]
+                assert task.state == TaskState.SUCCEEDED
+                assert task.has_available_peer()
+                assert task.total_piece_count == 3
+            finally:
+                await l1.stop()
+                await l2.stop()
+                await sched.stop()
+                await seed.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+    def test_no_seed_rules_back_source(self, tmp_path):
+        """Scheduler without seed peers must rule NeedBackSource and the
+        daemon must then fetch from origin."""
+        data = os.urandom(600_000)
+
+        async def go():
+            origin, base = await start_origin({"x.bin": data})
+            sched = Scheduler(SchedulerConfig())
+            await sched.start()
+            daemon = Daemon(leecher_config(tmp_path, "solo", sched.address))
+            await daemon.start()
+            try:
+                r = await download_via(daemon, f"{base}/x.bin",
+                                       str(tmp_path / "solo.out"),
+                                       disable_back_source=False)
+                assert r is not None
+                assert (tmp_path / "solo.out").read_bytes() == data
+                conductor = daemon.ptm.conductor(r.task_id)
+                assert conductor.traffic_source == len(data)
+                # peer transitioned through the back-source FSM path; the
+                # final PeerResult races the client's done event — poll
+                peer = sched.resource.find_peer(r.task_id, conductor.peer_id)
+                assert peer is not None
+                for _ in range(40):
+                    if peer.state == PeerState.SUCCEEDED:
+                        break
+                    await asyncio.sleep(0.05)
+                assert peer.state == PeerState.SUCCEEDED
+                # its source pieces were announced: peer is now a parent
+                assert len(peer.finished_pieces) > 0
+            finally:
+                await daemon.stop()
+                await sched.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+    def test_second_download_reuses_mesh_not_origin(self, tmp_path):
+        """Once the mesh holds the file, a newcomer downloads with the
+        origin entirely gone."""
+        data = os.urandom(5 * 1024 * 1024)
+
+        async def go():
+            origin, base = await start_origin({"g.bin": data})
+            url = f"{base}/g.bin"
+            seed_cfg = daemon_config(tmp_path, "seedB")
+            seed_cfg.is_seed = True
+            seed = Daemon(seed_cfg)
+            await seed.start()
+            sched = Scheduler(SchedulerConfig(seed_peers=[SeedPeerAddr(
+                ip="127.0.0.1", rpc_port=seed.rpc.port,
+                download_port=seed.upload_server.port)]))
+            await sched.start()
+            first = Daemon(leecher_config(tmp_path, "first", sched.address))
+            await first.start()
+            try:
+                r = await download_via(first, url, str(tmp_path / "f.out"))
+                assert r is not None
+                await origin.cleanup()   # origin dies
+                late = Daemon(leecher_config(tmp_path, "late", sched.address))
+                await late.start()
+                try:
+                    r2 = await download_via(late, url,
+                                            str(tmp_path / "late.out"))
+                    assert r2 is not None
+                    assert (tmp_path / "late.out").read_bytes() == data
+                finally:
+                    await late.stop()
+            finally:
+                await first.stop()
+                await sched.stop()
+                await seed.stop()
+
+        asyncio.run(go())

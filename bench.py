@@ -1,0 +1,360 @@
+"""Benchmark: P2P fan-out aggregate throughput vs naive direct downloads.
+
+Shape of BASELINE config #2 shrunk to one machine, with every component in
+its OWN OS process (origin, scheduler, seed daemon, N leecher daemons —
+sharing one event loop would measure the GIL, not the framework): an origin
+serving a synthetic weights file, one seed daemon, a real scheduler, and N
+leechers that must replicate the file with back-source disabled (every byte
+rides the mesh). The baseline is N processes each pulling the whole file
+straight from the origin — what a fleet without the framework does.
+
+Piece stores live in tmpfs: the TPU-native terminal sink is HBM/host RAM
+(tpu/hbm_sink.py), so a ~100 MB/s VM boot disk would measure itself.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": GB/s aggregate delivered, "unit": "GB/s",
+   "vs_baseline": ours / naive}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+logging.basicConfig(
+    level=logging.DEBUG if os.environ.get("BENCH_DEBUG_DIR") else logging.WARNING,
+    stream=sys.stderr)
+
+SIZE_MB = int(os.environ.get("BENCH_SIZE_MB", "128"))
+N_LEECHERS = int(os.environ.get("BENCH_LEECHERS", "4"))
+ORIGIN_MBPS = float(os.environ.get("BENCH_ORIGIN_MBPS", "64"))
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def ensure_native() -> None:
+    so = os.path.join(REPO, "native", "build", "libdfnative.so")
+    if not os.path.exists(so):
+        subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                       capture_output=True, check=False)
+
+
+def base_tmp() -> str:
+    return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+# ======================================================================
+# worker roles (each runs in its own process: python bench.py --role X)
+# ======================================================================
+
+async def role_origin(path: str, mbps: float) -> None:
+    """Serve ``path`` with Range support, paced at ``mbps`` MB/s total.
+
+    The cap models the real scarce resource — origin/WAN/GCS egress per
+    cluster (BASELINE's "% origin egress saved"). An uncapped loopback
+    origin would make any P2P layer look like pure overhead, which is not
+    the deployment the reference or this framework exists for. Tracks bytes
+    served at /__stats__.
+    """
+    from aiohttp import web
+
+    from dragonfly2_tpu.common.piece import parse_http_range
+    from dragonfly2_tpu.common.rate import TokenBucket
+
+    size = os.path.getsize(path)
+    bucket = TokenBucket(mbps * 1e6, burst=4e6) if mbps > 0 else None
+    served = {"bytes": 0}
+
+    async def handle(request: web.Request):
+        if request.path == "/__stats__":
+            return web.json_response(served)
+        start, length = 0, size
+        status, headers = 200, {"Accept-Ranges": "bytes",
+                                "Content-Length": "0"}
+        rng = request.headers.get("Range")
+        if rng:
+            r = parse_http_range(rng, size)
+            start, length = r.start, r.length
+            status = 206
+            headers["Content-Range"] = f"bytes {r.start}-{r.end-1}/{size}"
+        headers["Content-Length"] = str(length)
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(request)
+        with open(path, "rb") as f:
+            f.seek(start)
+            remaining = length
+            while remaining > 0:
+                chunk = f.read(min(1 << 20, remaining))
+                if not chunk:
+                    break
+                if bucket is not None:
+                    await bucket.acquire(len(chunk))
+                await resp.write(chunk)
+                served["bytes"] += len(chunk)
+                remaining -= len(chunk)
+        await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handle)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = None
+    for s in runner.sites:
+        server = getattr(s, "_server", None)
+        if server and server.sockets:
+            port = server.sockets[0].getsockname()[1]
+    print(json.dumps({"port": port}), flush=True)
+    await asyncio.Event().wait()
+
+
+async def role_seed(workdir: str) -> None:
+    from dragonfly2_tpu.daemon.config import DaemonConfig, StorageSection
+    from dragonfly2_tpu.daemon.daemon import Daemon
+
+    cfg = DaemonConfig(workdir=workdir, host_ip="127.0.0.1", hostname="seed",
+                       is_seed=True,
+                       storage=StorageSection(gc_interval_s=3600))
+    daemon = Daemon(cfg)
+    await daemon.start()
+    print(json.dumps({"rpc_port": daemon.rpc.port,
+                      "download_port": daemon.upload_server.port}), flush=True)
+    await asyncio.Event().wait()
+
+
+async def role_scheduler(seed_rpc: int, seed_dl: int) -> None:
+    from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+    from dragonfly2_tpu.scheduler.config import SeedPeerAddr
+
+    sched = Scheduler(SchedulerConfig(seed_peers=[SeedPeerAddr(
+        ip="127.0.0.1", rpc_port=seed_rpc, download_port=seed_dl)]))
+    await sched.start()
+    print(json.dumps({"addr": sched.address}), flush=True)
+    await asyncio.Event().wait()
+
+
+async def role_leecher(workdir: str, name: str, sched_addr: str,
+                       url: str) -> None:
+    from dragonfly2_tpu.daemon.config import (DaemonConfig,
+                                              SchedulerConfig as DSched,
+                                              StorageSection)
+    from dragonfly2_tpu.daemon.daemon import Daemon
+    from dragonfly2_tpu.idl.messages import DownloadRequest
+    from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+
+    cfg = DaemonConfig(workdir=workdir, host_ip="127.0.0.1", hostname=name,
+                       scheduler=DSched(addresses=[sched_addr],
+                                        schedule_timeout_s=60.0),
+                       storage=StorageSection(gc_interval_s=3600))
+    daemon = Daemon(cfg)
+    await daemon.start()
+    print("READY", flush=True)
+    await asyncio.get_running_loop().run_in_executor(None, sys.stdin.readline)
+
+    ch = Channel(f"unix:{daemon.unix_sock}")
+    client = ServiceClient(ch, "df.daemon.Daemon")
+    out = os.path.join(workdir, "replica.bin")
+    t0 = time.monotonic()
+    task_id = None
+    async for resp in client.unary_stream("Download", DownloadRequest(
+            url=url, output=out, disable_back_source=True, timeout_s=600.0)):
+        task_id = resp.task_id or task_id
+    elapsed = time.monotonic() - t0
+    size = os.path.getsize(out)
+    sources: dict[str, int] = {}
+    engine_state = {}
+    conductor = daemon.ptm.conductor(task_id) if task_id else None
+    if conductor is not None and conductor.storage is not None:
+        for p in conductor.storage.md.pieces.values():
+            key = (p.source or "origin")[-10:]
+            sources[key] = sources.get(key, 0) + 1
+        engine = conductor._p2p_engine
+        if engine is not None and os.environ.get("BENCH_DEBUG_DIR"):
+            engine_state = {
+                pid[-10:]: {"ejected": st.ejected,
+                            "nspb": round(st.ns_per_byte, 1),
+                            "try": st.attempts, "ann": st.announced}
+                for pid, st in engine.dispatcher.parents.items()}
+    await ch.close()
+    await daemon.stop()
+    out_msg = {"elapsed": elapsed, "bytes": size, "sources": sources}
+    if engine_state:
+        out_msg["parents"] = engine_state
+    print(json.dumps(out_msg), flush=True)
+
+
+async def role_direct(workdir: str, url: str) -> None:
+    import aiohttp
+
+    print("READY", flush=True)
+    await asyncio.get_running_loop().run_in_executor(None, sys.stdin.readline)
+    t0 = time.monotonic()
+    got = 0
+    out = os.path.join(workdir, "direct.bin")
+    async with aiohttp.ClientSession() as session:
+        async with session.get(url) as resp:
+            with open(out, "wb") as f:
+                async for chunk in resp.content.iter_chunked(1 << 20):
+                    f.write(chunk)
+                    got += len(chunk)
+    elapsed = time.monotonic() - t0
+    print(json.dumps({"elapsed": elapsed, "bytes": got}), flush=True)
+
+
+# ======================================================================
+# orchestration
+# ======================================================================
+
+class Proc:
+    def __init__(self, args: list[str], stderr_path: str | None = None):
+        stderr = (open(stderr_path, "w") if stderr_path
+                  else subprocess.DEVNULL)
+        self.p = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "bench.py"), *args],
+            stdout=subprocess.PIPE, stderr=stderr,
+            stdin=subprocess.PIPE, text=True, cwd=REPO)
+
+    def read_json(self, timeout: float = 120.0):
+        line = self._read_line(timeout)
+        return json.loads(line)
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        line = self._read_line(timeout)
+        assert line.strip() == "READY", f"unexpected: {line!r}"
+
+    def _read_line(self, timeout: float) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            line = self.p.stdout.readline()
+            if line:
+                return line
+            if self.p.poll() is not None:
+                raise RuntimeError(f"worker died: rc={self.p.returncode}")
+        raise TimeoutError("worker did not report in time")
+
+    def go(self) -> None:
+        self.p.stdin.write("\n")
+        self.p.stdin.flush()
+
+    def kill(self) -> None:
+        if self.p.poll() is None:
+            self.p.kill()
+            self.p.wait()
+
+
+def run_wave(procs: list[Proc]) -> float:
+    """READY-barrier, then GO all; returns max elapsed reported."""
+    for p in procs:
+        p.wait_ready()
+    for p in procs:
+        p.go()
+    results = [p.read_json(timeout=600.0) for p in procs]
+    for r in results:
+        assert r["bytes"] == SIZE_MB << 20, f"short transfer: {r}"
+        if r.get("sources"):
+            log(f"  piece sources: {r['sources']} ({r['elapsed']:.2f}s)"
+                + (f" parents={r['parents']}" if r.get("parents") else ""))
+    return max(r["elapsed"] for r in results)
+
+
+def main() -> None:
+    ensure_native()
+    workdir = tempfile.mkdtemp(prefix="dfbench-", dir=base_tmp())
+    data_path = os.path.join(workdir, "weights.bin")
+    with open(data_path, "wb") as f:
+        remaining = SIZE_MB << 20
+        while remaining > 0:
+            n = min(remaining, 64 << 20)
+            f.write(os.urandom(n))
+            remaining -= n
+
+    daemons: list[Proc] = []
+    try:
+        origin = Proc(["--role", "origin", data_path, str(ORIGIN_MBPS)])
+        daemons.append(origin)
+        origin_base = f"http://127.0.0.1:{origin.read_json()['port']}"
+        url = f"{origin_base}/weights.bin"
+
+        import urllib.request
+
+        def origin_bytes() -> int:
+            with urllib.request.urlopen(f"{origin_base}/__stats__") as r:
+                return json.loads(r.read())["bytes"]
+
+        log(f"bench: {SIZE_MB} MiB x {N_LEECHERS} leechers, origin capped "
+            f"at {ORIGIN_MBPS:.0f} MB/s (multi-process)")
+        direct = [Proc(["--role", "direct", os.path.join(workdir, f"d{i}"),
+                        url]) for i in range(N_LEECHERS)]
+        for i in range(N_LEECHERS):
+            os.makedirs(os.path.join(workdir, f"d{i}"), exist_ok=True)
+        direct_s = run_wave(direct)
+        direct_egress = origin_bytes()
+        log(f"baseline direct: {direct_s:.2f}s "
+            f"(origin egress {direct_egress / 1e6:.0f} MB)")
+
+        seed = Proc(["--role", "seed", os.path.join(workdir, "seed")])
+        daemons.append(seed)
+        seed_info = seed.read_json()
+        sched = Proc(["--role", "scheduler", str(seed_info["rpc_port"]),
+                      str(seed_info["download_port"])])
+        daemons.append(sched)
+        sched_addr = sched.read_json()["addr"]
+
+        pre = origin_bytes()
+        leechers = [Proc(["--role", "leecher",
+                          os.path.join(workdir, f"l{i}"), f"leech{i}",
+                          sched_addr, url],
+                         stderr_path=os.environ.get("BENCH_DEBUG_DIR") and
+                         os.path.join(os.environ["BENCH_DEBUG_DIR"], f"l{i}.err"))
+                    for i in range(N_LEECHERS)]
+        fanout_s = run_wave(leechers)
+        p2p_egress = origin_bytes() - pre
+        egress_saved = 1.0 - p2p_egress / max(direct_egress, 1)
+        log(f"framework fan-out: {fanout_s:.2f}s (origin egress "
+            f"{p2p_egress / 1e6:.0f} MB, saved {egress_saved:.0%})")
+    finally:
+        for p in daemons:
+            p.kill()
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    delivered_gb = (SIZE_MB << 20) * N_LEECHERS / 1e9
+    value = delivered_gb / fanout_s
+    baseline = delivered_gb / direct_s
+    print(json.dumps({
+        "metric": "p2p_fanout_aggregate_throughput",
+        "value": round(value, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    if "--role" in sys.argv:
+        role = sys.argv[sys.argv.index("--role") + 1]
+        args = sys.argv[sys.argv.index("--role") + 2:]
+        if role == "origin":
+            asyncio.run(role_origin(args[0], float(args[1])))
+        elif role == "seed":
+            asyncio.run(role_seed(args[0]))
+        elif role == "scheduler":
+            asyncio.run(role_scheduler(int(args[0]), int(args[1])))
+        elif role == "leecher":
+            asyncio.run(role_leecher(args[0], args[1], args[2], args[3]))
+        elif role == "direct":
+            asyncio.run(role_direct(args[0], args[1]))
+        else:
+            raise SystemExit(f"unknown role {role}")
+    else:
+        main()

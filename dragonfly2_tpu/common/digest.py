@@ -9,13 +9,14 @@ to hashlib.
 from __future__ import annotations
 
 import hashlib
+import zlib
 from typing import Iterator
 
-SUPPORTED = ("sha256", "sha512", "sha1", "md5", "crc32c", "blake2b")
+SUPPORTED = ("sha256", "sha512", "sha1", "md5", "crc32c", "crc32", "blake2b")
 
 
 _HEX_LEN = {"sha256": 64, "sha512": 128, "sha1": 40, "md5": 32, "crc32c": 8,
-            "blake2b": 64}
+            "crc32": 8, "blake2b": 64}
 _HEX_CHARS = set("0123456789abcdef")
 
 
@@ -46,9 +47,19 @@ def hash_bytes(algo: str, data: bytes | memoryview) -> str:
         if out is not None:
             return out
         return f"{_crc32c_py(bytes(data)):08x}"
+    if algo == "crc32":
+        return f"{zlib.crc32(bytes(data)) & 0xFFFFFFFF:08x}"
     if algo == "blake2b":
         return hashlib.blake2b(data, digest_size=32).hexdigest()
     return hashlib.new(algo, data).hexdigest()
+
+
+def preferred_piece_algo() -> str:
+    """Per-piece digest default: hardware crc32c when the native library is
+    built, zlib's C crc32 otherwise — never the pure-Python crc32c loop
+    (~10 MB/s, visible in end-to-end throughput)."""
+    from ..storage import native
+    return "crc32c" if native.load() is not None else "crc32"
 
 
 class Hasher:
@@ -62,6 +73,10 @@ class Hasher:
             self._crc = 0
             from ..storage import native
             self._native = native if native.available() else None
+        elif algo == "crc32":
+            self._crc = 0
+            self._native = None
+            self._zlib = True
         elif algo == "blake2b":
             self._h = hashlib.blake2b(digest_size=32)
         else:
@@ -69,7 +84,9 @@ class Hasher:
 
     def update(self, data: bytes) -> None:
         if self._crc is not None:
-            if self._native is not None:
+            if getattr(self, "_zlib", False):
+                self._crc = zlib.crc32(data, self._crc) & 0xFFFFFFFF
+            elif self._native is not None:
                 self._crc = self._native.crc32c_update(data, self._crc)
             else:
                 self._crc = _crc32c_py(data, self._crc)

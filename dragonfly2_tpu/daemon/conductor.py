@@ -41,6 +41,7 @@ class PeerTaskConductor:
                  disable_back_source: bool = False,
                  task_type: TaskType = TaskType.STANDARD,
                  device_sink_factory: Any = None,
+                 ordered: bool = False,
                  trace: Any = None):
         self.task_id = task_id
         self.peer_id = peer_id
@@ -53,6 +54,7 @@ class PeerTaskConductor:
         self.disable_back_source = disable_back_source
         self.task_type = task_type
         self.device_sink_factory = device_sink_factory
+        self.ordered = ordered       # stream consumers want low pieces first
         self.trace = trace
 
         self.state = self.PENDING
@@ -184,20 +186,24 @@ class PeerTaskConductor:
     async def on_piece_from_peer(self, num: int, offset: int, data: bytes,
                                  cost_ms: int, parent_id: str,
                                  piece_digest: str = "") -> None:
+        # the P2P downloader verified data against piece_digest already
         await self._land_piece(num, offset, data, cost_ms, source=parent_id,
-                               piece_digest=piece_digest)
+                               piece_digest=piece_digest,
+                               pre_verified=bool(piece_digest))
         self.traffic_p2p += len(data)
 
     async def _land_piece(self, num: int, offset: int, data: bytes,
                           cost_ms: int, source: str,
-                          piece_digest: str = "") -> None:
+                          piece_digest: str = "",
+                          pre_verified: bool = False) -> None:
         if self.storage is None:
             raise DFError(Code.CLIENT_STORAGE_ERROR, "piece before content info")
         if num in self.ready:
             return
         # hashing+write can take ms at 16MiB — keep the loop responsive
         await asyncio.to_thread(self.storage.write_piece, num, offset, data,
-                                piece_digest, cost_ms=cost_ms, source=source)
+                                piece_digest, cost_ms=cost_ms, source=source,
+                                pre_verified=pre_verified)
         if self.device_ingest is not None:
             try:
                 await asyncio.to_thread(self.device_ingest.write, offset, data)

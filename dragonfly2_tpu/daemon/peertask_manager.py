@@ -51,12 +51,20 @@ class PeerTaskManager:
             self, url: str, meta: UrlMeta, *,
             task_type: TaskType = TaskType.STANDARD,
             disable_back_source: bool = False,
-            device_sink_factory: Any = None) -> PeerTaskConductor:
+            device_sink_factory: Any = None,
+            ordered: bool = False) -> PeerTaskConductor:
         task_id = self._task_id(url, meta)
         content_range: Range | None = None
         async with self._lock:
             conductor = self._conductors.get(task_id)
             if conductor is not None and conductor.state != PeerTaskConductor.FAILED:
+                if ordered and not conductor.ordered:
+                    # a stream consumer joined a running file task: switch to
+                    # in-order fetching so read_ordered() doesn't stall
+                    conductor.ordered = True
+                    engine = conductor._p2p_engine
+                    if engine is not None:
+                        engine.dispatcher.ordered = True
                 return conductor
             conductor = PeerTaskConductor(
                 task_id=task_id,
@@ -65,7 +73,7 @@ class PeerTaskManager:
                 piece_mgr=self.piece_mgr, scheduler=self.scheduler,
                 content_range=content_range,
                 disable_back_source=disable_back_source, task_type=task_type,
-                device_sink_factory=device_sink_factory)
+                device_sink_factory=device_sink_factory, ordered=ordered)
             if self.p2p_engine_factory is not None:
                 conductor.set_p2p_engine(self.p2p_engine_factory())
             self._conductors[task_id] = conductor
@@ -173,7 +181,7 @@ class PeerTaskManager:
                 for p in reuse.piece_infos():
                     yield await asyncio.to_thread(reuse.read_piece, p.num)
             return task_id, replay()
-        conductor = await self.get_or_create_conductor(url, meta)
+        conductor = await self.get_or_create_conductor(url, meta, ordered=True)
         return task_id, conductor.read_ordered()
 
     # ------------------------------------------------------------------

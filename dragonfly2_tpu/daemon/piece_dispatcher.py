@@ -39,6 +39,9 @@ class ParentState:
         self.consecutive_fails = 0
         self.inflight = 0
         self.ejected = False
+        # read by bench.py's engine-state dump (BENCH_DEBUG_DIR)
+        self.attempts = 0               # pieces ever dispatched here
+        self.announced = 0              # piece announcements received
 
     def observe(self, cost_ms: int, size: int, ok: bool) -> None:
         if ok:
@@ -56,9 +59,14 @@ class ParentState:
 
     def score(self) -> float:
         """Lower is better. Unprobed parents score best so they get traffic;
-        in-flight load breaks ties toward idle parents."""
-        base = self.ns_per_byte if self.ns_per_byte > 0 else -1.0
-        return base + self.inflight * 0.01
+        in-flight load scales the expected latency (a parent already serving
+        k pieces will deliver the k+1st ~k times slower), which spreads a
+        fan-out across parents instead of herding onto the single fastest."""
+        if self.ns_per_byte <= 0:
+            # still best-in-class, but spread concurrent dispatches across
+            # multiple unprobed parents instead of herding onto the first
+            return -1.0 + self.inflight * 0.01
+        return self.ns_per_byte * (1.0 + self.inflight)
 
 
 class _PieceState:
@@ -81,7 +89,13 @@ class Dispatch:
 
 
 class PieceDispatcher:
-    def __init__(self, *, explore_ratio: float = EXPLORE_RATIO):
+    def __init__(self, *, explore_ratio: float = EXPLORE_RATIO,
+                 ordered: bool = False):
+        # ordered: fetch lowest-numbered first (stream consumers need early
+        # bytes). File tasks use rarest-first instead: a fan-out where every
+        # child grabs piece 0,1,2... holds identical sets and has nothing to
+        # trade — rarest-first makes siblings complementary sources.
+        self.ordered = ordered
         self.explore_ratio = explore_ratio
         self.parents: dict[str, ParentState] = {}
         self._pieces: dict[int, _PieceState] = {}
@@ -129,6 +143,9 @@ class PieceDispatcher:
                 elif not ps.info.digest and info.digest:
                     ps.info = info
                 ps.holders.add(parent_id)
+                st = self.parents.get(parent_id)
+                if st is not None:
+                    st.announced += 1
                 notify = True
             if notify:
                 self._cond.notify_all()
@@ -156,15 +173,20 @@ class PieceDispatcher:
                 candidates.append((ps, holders))
         if not candidates:
             return None
-        # fetch lowest-numbered available piece first: keeps read_ordered()
-        # consumers (stream/proxy) flowing with minimal buffering
-        ps, holders = min(candidates, key=lambda c: c[0].info.piece_num)
+        if self.ordered:
+            ps, holders = min(candidates, key=lambda c: c[0].info.piece_num)
+        else:
+            # rarest-first with random tie-break
+            rarity = min(len(c[1]) for c in candidates)
+            ps, holders = random.choice(
+                [c for c in candidates if len(c[1]) == rarity])
         if len(holders) > 1 and random.random() < self.explore_ratio:
             parent = random.choice(holders)
         else:
             parent = min(holders, key=ParentState.score)
         ps.inflight = True
         parent.inflight += 1
+        parent.attempts += 1
         return Dispatch(ps.info, parent)
 
     async def get(self, timeout: float | None = None) -> Dispatch | None:

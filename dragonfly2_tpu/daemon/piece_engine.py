@@ -126,6 +126,7 @@ class PieceEngine:
 
     async def pull(self, conductor: "PeerTaskConductor",
                    session: "PeerSession") -> bool:
+        self.dispatcher.ordered = conductor.ordered
         result = session.result
         try:
             if result.size_scope == SizeScope.EMPTY:

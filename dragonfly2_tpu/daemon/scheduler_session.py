@@ -40,9 +40,13 @@ class PeerSession:
         self.peer_id = conductor.peer_id
         self.packets: asyncio.Queue[PeerPacket] = asyncio.Queue()
         self._stream = None
+        self._out: asyncio.Queue = asyncio.Queue()
+        self._writer: asyncio.Task | None = None
         self._reader: asyncio.Task | None = None
         self._closed = False
         self._peer_result_sent = False
+
+    _EOF = object()
 
     async def open_report_stream(self) -> None:
         """Open the bidi piece-result stream; an empty first report asks the
@@ -52,7 +56,26 @@ class PeerSession:
         await self._stream.write(PieceResult(
             task_id=self.task_id, src_peer_id=self.peer_id, success=True,
             code=int(Code.OK)))
-        self._reader = asyncio.get_running_loop().create_task(self._read_loop())
+        loop = asyncio.get_running_loop()
+        self._reader = loop.create_task(self._read_loop())
+        self._writer = loop.create_task(self._write_loop())
+
+    async def _write_loop(self) -> None:
+        """Sole owner of the stream's write half. grpc.aio allows one
+        outstanding write, and a write cancelled mid-flight (worker teardown)
+        poisons the stream so done_writing never completes — so piece
+        workers enqueue and only this task ever touches the stream."""
+        try:
+            while True:
+                item = await self._out.get()
+                if item is self._EOF:
+                    await self._stream.done_writing()
+                    return
+                await self._stream.write(item)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - stream went away
+            log.debug("report write loop ended: %s", exc)
 
     async def _read_loop(self) -> None:
         try:
@@ -78,10 +101,20 @@ class PeerSession:
     async def report_piece(self, result: PieceResult) -> None:
         if self._stream is None or self._closed:
             return
+        self._out.put_nowait(result)
+
+    async def _drain_task(self, task: asyncio.Task | None,
+                          timeout: float) -> None:
+        if task is None or task.done():
+            return
         try:
-            await self._stream.write(result)
-        except Exception as exc:  # noqa: BLE001
-            log.debug("report_piece failed: %s", exc)
+            await asyncio.wait_for(asyncio.shield(task), timeout)
+        except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
 
     async def close(self, *, success: bool) -> None:
         if self._closed:
@@ -89,16 +122,13 @@ class PeerSession:
         self._closed = True
         conductor = self.conductor
         if self._stream is not None:
-            try:
-                await self._stream.done_writing()
-            except Exception:  # noqa: BLE001
-                pass
-            if self._reader is not None:
-                self._reader.cancel()
-                try:
-                    await self._reader
-                except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                    pass
+            # graceful half-close: queued piece results drain first, then the
+            # writer sends EOF; the reader ends when the scheduler finishes
+            # its side. Cancelling instead of draining would lose the last
+            # reports and the scheduler would never see this peer complete.
+            self._out.put_nowait(self._EOF)
+            await self._drain_task(self._writer, 5.0)
+            await self._drain_task(self._reader, 5.0)
             self._stream.cancel()
         if conductor is not None and not self._peer_result_sent:
             self._peer_result_sent = True

@@ -83,6 +83,15 @@ class UploadServer:
             _upload_reqs.labels("416").inc()
             raise web.HTTPRequestRangeNotSatisfiable(
                 text=f"bytes {rng.start}+{rng.length} not stored yet")
+        # whole-file tasks: serve via sendfile (FileResponse honors Range) so
+        # piece bytes never enter Python — the upload path is the hottest
+        # loop on a seed peer
+        data_path = getattr(ts, "data_path", None)
+        if data_path is not None and total >= 0:
+            await self.limiter.acquire(rng.length)
+            _upload_bytes.inc(rng.length)
+            _upload_reqs.labels("206").inc()
+            return web.FileResponse(data_path())
         try:
             data = await asyncio.to_thread(ts.read_range, rng.start, rng.length)
         except DFError as exc:

@@ -141,6 +141,7 @@ class SchedulerService:
             try:
                 async for result in request_iter:
                     await self._handle_piece_result(peer, result)
+                log.debug("report stream from %s: clean EOF", peer.id[-12:])
             except Exception as exc:  # noqa: BLE001 - client went away
                 log.debug("report stream from %s ended: %s",
                           peer.id[-12:], exc)
@@ -223,6 +224,12 @@ class SchedulerService:
                     parent.host.observe_upload(True)
             if self.records is not None and result.piece_info is not None:
                 self.records.on_piece(peer, result)
+            # periodic refresh: peers gain content as a fan-out progresses —
+            # re-offer parents every few reports so children spread onto the
+            # mesh instead of herding on the first assignment (usually the
+            # seed). Only pushed when the best-parent set actually changed.
+            if len(peer.finished_pieces) % 8 == 0:
+                await self._refresh_parents(peer)
             return
         _piece_reports.labels("fail").inc()
         peer.report_fail_count += 1
@@ -233,6 +240,21 @@ class SchedulerService:
             peer.blocked_parents.add(result.dst_peer_id)
         # losing a parent: offer a fresh assignment (or the origin)
         await self._reschedule(peer)
+
+    async def _refresh_parents(self, peer: Peer) -> None:
+        if (peer.packet_sink is None or peer.is_done()
+                or peer.state == PeerState.BACK_SOURCE):
+            return
+        parents = self.scheduling.find_parents(peer)
+        if not parents:
+            return
+        new_ids = {p.id for p in parents}
+        if new_ids == peer.task.dag.parents(peer.id):
+            return
+        peer.schedule_count += 1
+        peer.task.set_parents(peer.id, [p.id for p in parents])
+        _schedules.labels("refresh").inc()
+        peer.packet_sink.put_nowait(self.scheduling.build_packet(peer, parents))
 
     async def _reschedule(self, peer: Peer) -> None:
         if peer.packet_sink is None or peer.is_done():

@@ -41,14 +41,20 @@ class TaskStorage:
 
     def write_piece(self, num: int, offset: int, data: bytes | memoryview,
                     piece_digest: str = "", *, cost_ms: int = 0,
-                    source: str = "") -> PieceMeta:
-        """Verify + persist one piece. Idempotent per piece number."""
+                    source: str = "", pre_verified: bool = False) -> PieceMeta:
+        """Verify + persist one piece. Idempotent per piece number.
+
+        ``pre_verified`` skips the redundant re-hash when the transport
+        already checked the bytes against ``piece_digest`` (the P2P
+        downloader does) — hashing each piece twice shows up directly in
+        end-to-end GB/s."""
         if piece_digest:
-            if not digestlib.verify(piece_digest, data):
+            if not pre_verified and not digestlib.verify(piece_digest, data):
                 raise DFError(Code.CLIENT_DIGEST_MISMATCH,
                               f"piece {num} digest mismatch")
         else:
-            piece_digest = digestlib.for_bytes("crc32c", data)
+            piece_digest = digestlib.for_bytes(
+                digestlib.preferred_piece_algo(), data)
         with self._lock:
             existing = self.md.pieces.get(num)
             if existing is not None:
@@ -201,15 +207,17 @@ class SubTaskStorage:
 
     def write_piece(self, num: int, offset: int, data: bytes | memoryview,
                     piece_digest: str = "", *, cost_ms: int = 0,
-                    source: str = "") -> PieceMeta:
+                    source: str = "", pre_verified: bool = False) -> PieceMeta:
         if offset + len(data) > self.md.range_length:
             raise DFError(Code.CLIENT_STORAGE_ERROR,
                           f"piece {num} spills past sub-range: "
                           f"{offset}+{len(data)} > {self.md.range_length}")
-        if piece_digest and not digestlib.verify(piece_digest, data):
+        if piece_digest and not pre_verified \
+                and not digestlib.verify(piece_digest, data):
             raise DFError(Code.CLIENT_DIGEST_MISMATCH, f"piece {num} digest mismatch")
         if not piece_digest:
-            piece_digest = digestlib.for_bytes("crc32c", data)
+            piece_digest = digestlib.for_bytes(
+                digestlib.preferred_piece_algo(), data)
         with self._lock:
             existing = self.md.pieces.get(num)
             if existing is not None:

@@ -1,0 +1,9 @@
+"""Trainer: fits the bandwidth-prediction models on TPU and serves them back
+into scheduler decisions.
+
+Role parity: reference ``trainer/`` — the gRPC dataset sink exists there but
+model fitting is a TODO stub (``trainer/training/training.go:80-97``); this
+package completes the loop in JAX (BASELINE config #5): an MLP piece-cost
+predictor and a host-graph GNN, trained with a pjit-able step over a
+``jax.sharding.Mesh``.
+"""

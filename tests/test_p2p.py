@@ -280,7 +280,8 @@ class TestPieceDispatcher:
 
     def test_lowest_piece_first(self):
         async def go():
-            d = PieceDispatcher(explore_ratio=0.0)
+            # ordered mode (stream consumers); file tasks use rarest-first
+            d = PieceDispatcher(explore_ratio=0.0, ordered=True)
             await d.add_parent("p", "127.0.0.1:1")
             await d.announce("p", [PieceInfo(piece_num=5, range_size=10),
                                    PieceInfo(piece_num=1, range_size=10),

@@ -162,7 +162,12 @@ class TestSchedulerE2E:
                 assert c1.traffic_source == 0 and c2.traffic_source == 0
                 assert c1.traffic_p2p == len(data)
                 # scheduler state settled: task succeeded, seed has pieces
+                # (the final PeerResult trails the client's done event)
                 task = sched.resource.tasks[r1.task_id]
+                for _ in range(200):
+                    if task.state == TaskState.SUCCEEDED:
+                        break
+                    await asyncio.sleep(0.05)
                 assert task.state == TaskState.SUCCEEDED
                 assert task.has_available_peer()
                 assert task.total_piece_count == 3

@@ -110,12 +110,8 @@ async def role_origin(path: str, mbps: float) -> None:
     await runner.setup()
     site = web.TCPSite(runner, "127.0.0.1", 0)
     await site.start()
-    port = None
-    for s in runner.sites:
-        server = getattr(s, "_server", None)
-        if server and server.sockets:
-            port = server.sockets[0].getsockname()[1]
-    print(json.dumps({"port": port}), flush=True)
+    from dragonfly2_tpu.common.aiohttp_util import resolve_port
+    print(json.dumps({"port": resolve_port(runner)}), flush=True)
     await asyncio.Event().wait()
 
 
@@ -234,14 +230,22 @@ class Proc:
         assert line.strip() == "READY", f"unexpected: {line!r}"
 
     def _read_line(self, timeout: float) -> str:
+        import select
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            line = self.p.stdout.readline()
-            if line:
-                return line
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("worker did not report in time")
+            # select before readline: readline() itself blocks and would
+            # defeat the deadline when a worker hangs without printing
+            ready, _, _ = select.select([self.p.stdout], [], [],
+                                        min(remaining, 1.0))
+            if ready:
+                line = self.p.stdout.readline()
+                if line:
+                    return line
             if self.p.poll() is not None:
                 raise RuntimeError(f"worker died: rc={self.p.returncode}")
-        raise TimeoutError("worker did not report in time")
 
     def go(self) -> None:
         self.p.stdin.write("\n")
@@ -296,6 +300,7 @@ def main() -> None:
             f"at {ORIGIN_MBPS:.0f} MB/s (multi-process)")
         direct = [Proc(["--role", "direct", os.path.join(workdir, f"d{i}"),
                         url]) for i in range(N_LEECHERS)]
+        daemons.extend(direct)   # killed on any failure path
         for i in range(N_LEECHERS):
             os.makedirs(os.path.join(workdir, f"d{i}"), exist_ok=True)
         direct_s = run_wave(direct)
@@ -318,6 +323,7 @@ def main() -> None:
                          stderr_path=os.environ.get("BENCH_DEBUG_DIR") and
                          os.path.join(os.environ["BENCH_DEBUG_DIR"], f"l{i}.err"))
                     for i in range(N_LEECHERS)]
+        daemons.extend(leechers)   # killed on any failure path
         fanout_s = run_wave(leechers)
         p2p_egress = origin_bytes() - pre
         egress_saved = 1.0 - p2p_egress / max(direct_egress, 1)

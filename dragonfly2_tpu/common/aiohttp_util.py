@@ -1,0 +1,19 @@
+"""aiohttp server helpers shared across HTTP surfaces."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+
+def resolve_port(runner: web.AppRunner) -> int:
+    """The actual bound port of an ephemeral (`:0`) TCPSite.
+
+    aiohttp doesn't expose this publicly; keep the one reach into
+    ``site._server`` here so every HTTP surface resolves ports the same way
+    and a future aiohttp change breaks exactly one function.
+    """
+    for site in runner.sites:
+        server = getattr(site, "_server", None)
+        if server and server.sockets:
+            return server.sockets[0].getsockname()[1]
+    raise RuntimeError("no bound socket on runner (site not started?)")

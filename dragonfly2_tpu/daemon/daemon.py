@@ -75,6 +75,7 @@ class Daemon:
         self.proxy_server: Any = None
         self.object_gateway: Any = None
         self.announcer: Any = None
+        self.manager: Any = None
 
     # ------------------------------------------------------------------
 
@@ -132,6 +133,8 @@ class Daemon:
             self.scheduler = SchedulerConnector(
                 self.cfg.scheduler.addresses, self.host_info(),
                 register_timeout_s=self.cfg.scheduler.register_timeout_s)
+        elif self.cfg.manager_addresses:
+            await self._attach_manager()
         self.ptm.scheduler = self.scheduler
         # local API over unix socket (dfget/dfcache/dfstore)
         sock = self.cfg.unix_sock or self.paths.daemon_sock()
@@ -162,7 +165,40 @@ class Daemon:
                  self.hostname, self.host_ip, self.rpc.port,
                  self.upload_server.port, sock, self.cfg.is_seed)
 
+    async def _attach_manager(self) -> None:
+        """Discover schedulers via the manager (dynconfig role); seed
+        daemons also register themselves as seed peers + keepalive."""
+        from ..idl.messages import (GetSchedulersRequest,
+                                    RegisterSeedPeerRequest)
+        from ..rpc.manager_link import ManagerLink
+
+        self.manager = ManagerLink(self.cfg.manager_addresses)
+        try:
+            if self.cfg.is_seed:
+                await self.manager.register_seed_peer(RegisterSeedPeerRequest(
+                    hostname=self.hostname, ip=self.host_ip,
+                    port=self.rpc.port,
+                    download_port=self.upload_server.port,
+                    seed_peer_cluster_id=1, topology=self.topology))
+                self.manager.start_keepalive(source_type="seed_peer",
+                                             hostname=self.hostname,
+                                             ip=self.host_ip)
+            resp = await self.manager.get_schedulers(GetSchedulersRequest(
+                hostname=self.hostname, ip=self.host_ip,
+                topology=self.topology))
+            addrs = [f"{s.ip}:{s.port}" for s in (resp.schedulers or [])]
+            if addrs:
+                self.scheduler = SchedulerConnector(
+                    addrs, self.host_info(),
+                    register_timeout_s=self.cfg.scheduler.register_timeout_s)
+            else:
+                log.info("manager knows no active schedulers; back-source only")
+        except Exception as exc:  # noqa: BLE001 - manager optional
+            log.warning("manager attach failed (%s); back-source only", exc)
+
     async def stop(self) -> None:
+        if getattr(self, "manager", None) is not None:
+            await self.manager.close()
         if self.announcer is not None:
             await self.announcer.stop()
         await self.gc.stop()

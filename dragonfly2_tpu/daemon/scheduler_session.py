@@ -101,6 +101,10 @@ class PeerSession:
     async def report_piece(self, result: PieceResult) -> None:
         if self._stream is None or self._closed:
             return
+        if self._writer is not None and self._writer.done():
+            # writer died (scheduler went away): don't queue into the void
+            log.debug("report_piece dropped: writer gone")
+            return
         self._out.put_nowait(result)
 
     async def _drain_task(self, task: asyncio.Task | None,

@@ -12,6 +12,7 @@ import logging
 
 from aiohttp import web
 
+from ..common.aiohttp_util import resolve_port
 from ..common.errors import DFError
 from ..common.metrics import REGISTRY
 from ..common.piece import parse_http_range
@@ -50,11 +51,7 @@ class UploadServer:
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
-        # resolve ephemeral port
-        for s in self._runner.sites:
-            server = getattr(s, "_server", None)
-            if server and server.sockets:
-                self.port = server.sockets[0].getsockname()[1]
+        self.port = resolve_port(self._runner)
         log.info("upload server on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:

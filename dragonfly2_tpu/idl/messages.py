@@ -508,6 +508,23 @@ class RegisterSeedPeerRequest:
     topology: TopologyInfo | None = None
 
 
+@message
+class PreheatRequest:
+    """Manager/operator -> scheduler: warm a URL into the seed layer."""
+
+    url: str = ""
+    url_meta: UrlMeta | None = None
+    wait: bool = True               # block until the seed finishes
+
+
+@message
+class PreheatResponse:
+    task_id: str = ""
+    state: str = ""                 # pending | running | succeeded | failed
+    content_length: int = -1
+    total_piece_count: int = -1
+
+
 # ---------------------------------------------------------------- trainer service
 
 @message

@@ -1,0 +1,10 @@
+"""Manager: the global control plane of record.
+
+Role parity: reference ``manager/`` (SURVEY §2.5) — clusters, scheduler and
+seed-peer instances, applications, keepalive liveness, cluster-config
+(dynconfig) serving, the searcher that assigns peers to scheduler clusters,
+and preheat jobs. GORM/MySQL/Redis/machinery collapse to sqlite + in-proc
+queues + direct gRPC fan-out: one store, no side infrastructure.
+"""
+
+from .server import Manager, ManagerConfig  # noqa: F401

@@ -1,0 +1,77 @@
+"""Manager job runner: preheat fan-out to scheduler instances.
+
+Role parity: reference ``manager/job/preheat.go`` + ``internal/job``
+(machinery group jobs over Redis queues). Here the queue is in-process and
+delivery is a direct gRPC ``Preheat`` call to each target scheduler — same
+verb, no broker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..idl.messages import PreheatRequest, UrlMeta
+from ..rpc.client import ChannelPool, ServiceClient
+from .store import Store
+
+log = logging.getLogger("df.mgr.jobs")
+
+SCHEDULER_SERVICE = "df.scheduler.Scheduler"
+
+
+class JobRunner:
+    def __init__(self, store: Store):
+        self.store = store
+        self._channels = ChannelPool(limit=64)
+        self._running: set[asyncio.Task] = set()
+
+    async def submit_preheat(self, *, url: str, url_meta: UrlMeta | None = None,
+                             cluster_id: int | None = None) -> int:
+        job_id = await asyncio.to_thread(
+            self.store.create_job, "preheat",
+            {"url": url, "cluster_id": cluster_id})
+        t = asyncio.get_running_loop().create_task(
+            self._run_preheat(job_id, url, url_meta, cluster_id))
+        self._running.add(t)
+        t.add_done_callback(self._running.discard)
+        return job_id
+
+    async def _run_preheat(self, job_id: int, url: str,
+                           url_meta: UrlMeta | None,
+                           cluster_id: int | None) -> None:
+        await asyncio.to_thread(self.store.update_job, job_id, state="running")
+        schedulers = await asyncio.to_thread(
+            lambda: self.store.schedulers(cluster_id=cluster_id,
+                                          only_active=True))
+        if not schedulers:
+            await asyncio.to_thread(self.store.update_job, job_id,
+                                    state="failed",
+                                    result={"error": "no active schedulers"})
+            return
+        results = {}
+        ok = 0
+        for sched in schedulers:
+            addr = f"{sched.ip}:{sched.port}"
+            try:
+                client = ServiceClient(self._channels.get(addr),
+                                       SCHEDULER_SERVICE)
+                resp = await client.unary(
+                    "Preheat", PreheatRequest(url=url, url_meta=url_meta,
+                                              wait=True), timeout=600.0)
+                results[addr] = {"state": resp.state, "task_id": resp.task_id}
+                if resp.state == "succeeded":
+                    ok += 1
+            except Exception as exc:  # noqa: BLE001 - per-target isolation
+                results[addr] = {"state": "failed", "error": str(exc)}
+        state = "succeeded" if ok else "failed"
+        await asyncio.to_thread(self.store.update_job, job_id, state=state,
+                                result=results)
+        log.info("preheat job %d %s across %d scheduler(s)", job_id, state,
+                 len(schedulers))
+
+    async def close(self) -> None:
+        for t in list(self._running):
+            t.cancel()
+        await asyncio.gather(*self._running, return_exceptions=True)
+        await self._channels.close()

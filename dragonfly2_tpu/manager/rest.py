@@ -1,0 +1,126 @@
+"""Manager REST API.
+
+Role parity: reference ``manager/handlers`` + ``manager/router`` (gin REST
+CRUD + swagger). The surface is the operational subset: cluster and
+instance listing/creation, applications, preheat job POST + status, and
+health — JSON over aiohttp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+
+from aiohttp import web
+
+from ..common.aiohttp_util import resolve_port
+from ..common.metrics import REGISTRY
+from ..idl.messages import ClusterConfig, UrlMeta
+from .jobs import JobRunner
+from .store import Store
+
+log = logging.getLogger("df.mgr.rest")
+
+
+class RestAPI:
+    def __init__(self, store: Store, jobs: JobRunner, *, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.store = store
+        self.jobs = jobs
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        app = web.Application()
+        r = app.router
+        r.add_get("/healthy", self._healthy)
+        r.add_get("/metrics", self._metrics)
+        r.add_get("/api/v1/scheduler-clusters", self._list_sched_clusters)
+        r.add_post("/api/v1/scheduler-clusters", self._create_sched_cluster)
+        r.add_get("/api/v1/schedulers", self._list_schedulers)
+        r.add_get("/api/v1/seed-peers", self._list_seed_peers)
+        r.add_get("/api/v1/applications", self._list_applications)
+        r.add_post("/api/v1/applications", self._create_application)
+        r.add_post("/api/v1/jobs", self._create_job)
+        r.add_get("/api/v1/jobs", self._list_jobs)
+        r.add_get("/api/v1/jobs/{id}", self._get_job)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = resolve_port(self._runner)
+        log.info("manager REST on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------------
+
+    async def _healthy(self, _r: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def _metrics(self, _r: web.Request) -> web.Response:
+        return web.Response(text=REGISTRY.expose())
+
+    async def _list_sched_clusters(self, _r: web.Request) -> web.Response:
+        return web.json_response(
+            await asyncio.to_thread(self.store.scheduler_clusters))
+
+    async def _create_sched_cluster(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        cfg = ClusterConfig(**body.get("config", {}))
+        cid = await asyncio.to_thread(
+            lambda: self.store.create_scheduler_cluster(
+                body["name"], config=cfg, scopes=body.get("scopes"),
+                is_default=bool(body.get("is_default"))))
+        return web.json_response({"id": cid}, status=201)
+
+    async def _list_schedulers(self, _r: web.Request) -> web.Response:
+        return web.json_response([
+            dataclasses.asdict(s) for s in
+            await asyncio.to_thread(self.store.schedulers)])
+
+    async def _list_seed_peers(self, _r: web.Request) -> web.Response:
+        return web.json_response([
+            dataclasses.asdict(s) for s in
+            await asyncio.to_thread(self.store.seed_peers)])
+
+    async def _list_applications(self, _r: web.Request) -> web.Response:
+        return web.json_response(
+            await asyncio.to_thread(self.store.applications))
+
+    async def _create_application(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        app_id = await asyncio.to_thread(
+            lambda: self.store.upsert_application(
+                body["name"], url=body.get("url", ""),
+                priority=body.get("priority")))
+        return web.json_response({"id": app_id}, status=201)
+
+    async def _create_job(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        if body.get("type") != "preheat":
+            return web.json_response({"error": "unknown job type"}, status=400)
+        args = body.get("args", {})
+        meta = UrlMeta(**args.get("url_meta", {})) if args.get("url_meta") \
+            else None
+        job_id = await self.jobs.submit_preheat(
+            url=args["url"], url_meta=meta,
+            cluster_id=args.get("cluster_id"))
+        return web.json_response({"id": job_id}, status=201)
+
+    async def _list_jobs(self, _r: web.Request) -> web.Response:
+        return web.json_response(await asyncio.to_thread(self.store.jobs))
+
+    async def _get_job(self, request: web.Request) -> web.Response:
+        job = await asyncio.to_thread(
+            self.store.job, int(request.match_info["id"]))
+        if job is None:
+            return web.json_response({"error": "not found"}, status=404)
+        job["args"] = json.loads(job["args"])
+        job["result"] = json.loads(job["result"])
+        return web.json_response(job)

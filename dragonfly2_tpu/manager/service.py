@@ -1,0 +1,98 @@
+"""Manager gRPC service.
+
+Role parity: reference ``manager/rpcserver/`` — GetSchedulers (with
+searcher-driven cluster pick + cluster config), GetSeedPeers, the KeepAlive
+client-stream liveness protocol (``manager_server_v2.go:737``), and the
+self-registration RPCs schedulers/seed peers call on boot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator
+
+from ..common.errors import Code, DFError
+from ..idl.messages import (Empty, GetSchedulersRequest, GetSchedulersResponse,
+                            GetSeedPeersRequest, GetSeedPeersResponse,
+                            KeepAliveRequest, RegisterSchedulerRequest,
+                            RegisterSeedPeerRequest)
+from ..rpc.server import ServiceDef
+from .searcher import find_scheduler_cluster
+from .store import Store
+
+log = logging.getLogger("df.mgr.service")
+
+MANAGER_SERVICE = "df.manager.Manager"
+
+
+class ManagerService:
+    def __init__(self, store: Store):
+        self.store = store
+
+    async def get_schedulers(self, req: GetSchedulersRequest,
+                             context) -> GetSchedulersResponse:
+        clusters = await asyncio.to_thread(self.store.scheduler_clusters)
+        cluster_id = find_scheduler_cluster(clusters, req)
+        if cluster_id is None:
+            raise DFError(Code.NOT_FOUND, "no scheduler clusters")
+        schedulers = await asyncio.to_thread(
+            lambda: self.store.schedulers(cluster_id=cluster_id,
+                                          only_active=True))
+        return GetSchedulersResponse(
+            schedulers=schedulers,
+            cluster_config=self.store.cluster_config(cluster_id))
+
+    async def get_seed_peers(self, req: GetSeedPeersRequest,
+                             context) -> GetSeedPeersResponse:
+        peers = await asyncio.to_thread(
+            lambda: self.store.seed_peers(
+                cluster_id=req.cluster_id or None, only_active=True))
+        return GetSeedPeersResponse(seed_peers=peers)
+
+    async def register_scheduler(self, req: RegisterSchedulerRequest,
+                                 context) -> Empty:
+        cluster_id = req.scheduler_cluster_id or \
+            await asyncio.to_thread(self.store.default_scheduler_cluster)
+        await asyncio.to_thread(
+            lambda: self.store.upsert_scheduler(
+                hostname=req.hostname, ip=req.ip, port=req.port,
+                cluster_id=cluster_id, topology=req.topology))
+        return Empty()
+
+    async def register_seed_peer(self, req: RegisterSeedPeerRequest,
+                                 context) -> Empty:
+        cluster_id = req.seed_peer_cluster_id or 1
+        await asyncio.to_thread(
+            lambda: self.store.upsert_seed_peer(
+                hostname=req.hostname, ip=req.ip, port=req.port,
+                download_port=req.download_port,
+                object_storage_port=req.object_storage_port,
+                type_=req.type or "super", cluster_id=cluster_id,
+                topology=req.topology))
+        return Empty()
+
+    async def keep_alive(self, request_iter, context) -> Empty:
+        """Client-stream: one message per interval; instance goes inactive
+        when the stream dies and the TTL sweep catches it."""
+        ident = None
+        async for req in request_iter:
+            ident = (req.source_type, req.hostname, req.ip)
+            ok = await asyncio.to_thread(
+                self.store.keepalive, req.source_type, req.hostname, req.ip)
+            if not ok:
+                log.warning("keepalive from unregistered %s %s@%s",
+                            req.source_type, req.hostname, req.ip)
+        if ident:
+            log.info("keepalive stream ended: %s %s@%s", *ident)
+        return Empty()
+
+
+def build_service(svc: ManagerService) -> ServiceDef:
+    d = ServiceDef(MANAGER_SERVICE)
+    d.unary_unary("GetSchedulers", svc.get_schedulers)
+    d.unary_unary("GetSeedPeers", svc.get_seed_peers)
+    d.unary_unary("RegisterScheduler", svc.register_scheduler)
+    d.unary_unary("RegisterSeedPeer", svc.register_seed_peer)
+    d.stream_unary("KeepAlive", svc.keep_alive)
+    return d

@@ -1,0 +1,304 @@
+"""Entity store: sqlite-backed tables for the manager's records.
+
+Role parity: reference ``manager/models/*.go`` + ``manager/database`` (GORM
+over MySQL/Postgres). The entity set is the subset the running system
+consumes: scheduler clusters (with config), scheduler instances, seed-peer
+clusters, seed-peer instances, applications, and jobs. sqlite keeps the
+"database of record" property (restart-safe) without external services.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sqlite3
+import threading
+import time
+from typing import Any, Iterable
+
+from ..idl.messages import (ClusterConfig, SchedulerEntity, SeedPeerEntity,
+                            TopologyInfo)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS scheduler_clusters (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT UNIQUE NOT NULL,
+  config TEXT NOT NULL DEFAULT '{}',
+  scopes TEXT NOT NULL DEFAULT '{}',
+  is_default INTEGER NOT NULL DEFAULT 0,
+  created_at REAL, updated_at REAL
+);
+CREATE TABLE IF NOT EXISTS schedulers (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  hostname TEXT NOT NULL, ip TEXT NOT NULL, port INTEGER NOT NULL,
+  state TEXT NOT NULL DEFAULT 'inactive',
+  scheduler_cluster_id INTEGER NOT NULL,
+  features TEXT NOT NULL DEFAULT '[]',
+  topology TEXT NOT NULL DEFAULT '{}',
+  last_keepalive REAL NOT NULL DEFAULT 0,
+  created_at REAL, updated_at REAL,
+  UNIQUE(hostname, ip, port)
+);
+CREATE TABLE IF NOT EXISTS seed_peer_clusters (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT UNIQUE NOT NULL,
+  config TEXT NOT NULL DEFAULT '{}',
+  created_at REAL, updated_at REAL
+);
+CREATE TABLE IF NOT EXISTS seed_peers (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  hostname TEXT NOT NULL, ip TEXT NOT NULL,
+  port INTEGER NOT NULL, download_port INTEGER NOT NULL,
+  object_storage_port INTEGER NOT NULL DEFAULT 0,
+  type TEXT NOT NULL DEFAULT 'super',
+  state TEXT NOT NULL DEFAULT 'inactive',
+  seed_peer_cluster_id INTEGER NOT NULL,
+  topology TEXT NOT NULL DEFAULT '{}',
+  last_keepalive REAL NOT NULL DEFAULT 0,
+  created_at REAL, updated_at REAL,
+  UNIQUE(hostname, ip, port)
+);
+CREATE TABLE IF NOT EXISTS applications (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT UNIQUE NOT NULL,
+  url TEXT NOT NULL DEFAULT '',
+  priority TEXT NOT NULL DEFAULT '{}',
+  created_at REAL, updated_at REAL
+);
+CREATE TABLE IF NOT EXISTS jobs (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  type TEXT NOT NULL,
+  state TEXT NOT NULL DEFAULT 'pending',
+  args TEXT NOT NULL DEFAULT '{}',
+  result TEXT NOT NULL DEFAULT '{}',
+  created_at REAL, updated_at REAL
+);
+"""
+
+
+def _now() -> float:
+    return time.time()
+
+
+class Store:
+    """Thread-safe sqlite store (the manager's aio handlers call via
+    ``asyncio.to_thread`` for writes; reads are fast enough inline)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.row_factory = sqlite3.Row
+        self._lock = threading.Lock()
+        with self._lock:
+            self._db.executescript(_SCHEMA)
+            self._db.commit()
+
+    def close(self) -> None:
+        self._db.close()
+
+    # -- generic helpers ----------------------------------------------
+
+    def _exec(self, sql: str, args: Iterable[Any] = ()) -> sqlite3.Cursor:
+        with self._lock:
+            cur = self._db.execute(sql, tuple(args))
+            self._db.commit()
+            return cur
+
+    def _rows(self, sql: str, args: Iterable[Any] = ()) -> list[sqlite3.Row]:
+        with self._lock:
+            return self._db.execute(sql, tuple(args)).fetchall()
+
+    # -- clusters ------------------------------------------------------
+
+    def create_scheduler_cluster(self, name: str, *,
+                                 config: ClusterConfig | None = None,
+                                 scopes: dict | None = None,
+                                 is_default: bool = False) -> int:
+        cfg = json.dumps(dataclasses.asdict(config or ClusterConfig()))
+        cur = self._exec(
+            "INSERT INTO scheduler_clusters(name, config, scopes, is_default,"
+            " created_at, updated_at) VALUES (?,?,?,?,?,?)",
+            (name, cfg, json.dumps(scopes or {}), int(is_default),
+             _now(), _now()))
+        return int(cur.lastrowid)
+
+    def scheduler_clusters(self) -> list[dict]:
+        return [dict(r) for r in self._rows(
+            "SELECT * FROM scheduler_clusters ORDER BY id")]
+
+    def cluster_config(self, cluster_id: int) -> ClusterConfig:
+        rows = self._rows("SELECT config FROM scheduler_clusters WHERE id=?",
+                          (cluster_id,))
+        if not rows:
+            return ClusterConfig()
+        return ClusterConfig(**json.loads(rows[0]["config"]))
+
+    def default_scheduler_cluster(self) -> int:
+        rows = self._rows("SELECT id FROM scheduler_clusters WHERE is_default=1"
+                          " ORDER BY id LIMIT 1")
+        if rows:
+            return int(rows[0]["id"])
+        return self.create_scheduler_cluster(f"cluster-{_now():.0f}",
+                                             is_default=True)
+
+    def create_seed_peer_cluster(self, name: str) -> int:
+        cur = self._exec(
+            "INSERT INTO seed_peer_clusters(name, created_at, updated_at)"
+            " VALUES (?,?,?)", (name, _now(), _now()))
+        return int(cur.lastrowid)
+
+    # -- scheduler instances ------------------------------------------
+
+    def upsert_scheduler(self, *, hostname: str, ip: str, port: int,
+                         cluster_id: int,
+                         topology: TopologyInfo | None = None,
+                         features: list[str] | None = None) -> int:
+        topo = json.dumps(dataclasses.asdict(topology) if topology else {},
+                          default=list)
+        cur = self._exec(
+            "INSERT INTO schedulers(hostname, ip, port, state,"
+            " scheduler_cluster_id, features, topology, last_keepalive,"
+            " created_at, updated_at)"
+            " VALUES (?,?,?,'active',?,?,?,?,?,?)"
+            " ON CONFLICT(hostname, ip, port) DO UPDATE SET"
+            " state='active', scheduler_cluster_id=excluded.scheduler_cluster_id,"
+            " topology=excluded.topology, last_keepalive=excluded.last_keepalive,"
+            " updated_at=excluded.updated_at",
+            (hostname, ip, port, cluster_id,
+             json.dumps(features or []), topo, _now(), _now(), _now()))
+        rows = self._rows(
+            "SELECT id FROM schedulers WHERE hostname=? AND ip=? AND port=?",
+            (hostname, ip, port))
+        return int(rows[0]["id"])
+
+    def schedulers(self, *, cluster_id: int | None = None,
+                   only_active: bool = False) -> list[SchedulerEntity]:
+        sql = "SELECT * FROM schedulers"
+        args: list = []
+        conds = []
+        if cluster_id is not None:
+            conds.append("scheduler_cluster_id=?")
+            args.append(cluster_id)
+        if only_active:
+            conds.append("state='active'")
+        if conds:
+            sql += " WHERE " + " AND ".join(conds)
+        out = []
+        for r in self._rows(sql + " ORDER BY id", args):
+            topo = json.loads(r["topology"])
+            out.append(SchedulerEntity(
+                id=r["id"], hostname=r["hostname"], ip=r["ip"],
+                port=r["port"], state=r["state"],
+                scheduler_cluster_id=r["scheduler_cluster_id"],
+                features=json.loads(r["features"]),
+                topology=TopologyInfo(**topo) if topo else None))
+        return out
+
+    # -- seed peer instances ------------------------------------------
+
+    def upsert_seed_peer(self, *, hostname: str, ip: str, port: int,
+                         download_port: int, cluster_id: int,
+                         object_storage_port: int = 0, type_: str = "super",
+                         topology: TopologyInfo | None = None) -> int:
+        topo = json.dumps(dataclasses.asdict(topology) if topology else {},
+                          default=list)
+        self._exec(
+            "INSERT INTO seed_peers(hostname, ip, port, download_port,"
+            " object_storage_port, type, state, seed_peer_cluster_id,"
+            " topology, last_keepalive, created_at, updated_at)"
+            " VALUES (?,?,?,?,?,?,'active',?,?,?,?,?)"
+            " ON CONFLICT(hostname, ip, port) DO UPDATE SET"
+            " state='active', download_port=excluded.download_port,"
+            " topology=excluded.topology, last_keepalive=excluded.last_keepalive,"
+            " updated_at=excluded.updated_at",
+            (hostname, ip, port, download_port, object_storage_port, type_,
+             cluster_id, topo, _now(), _now(), _now()))
+        rows = self._rows(
+            "SELECT id FROM seed_peers WHERE hostname=? AND ip=? AND port=?",
+            (hostname, ip, port))
+        return int(rows[0]["id"])
+
+    def seed_peers(self, *, cluster_id: int | None = None,
+                   only_active: bool = False) -> list[SeedPeerEntity]:
+        sql = "SELECT * FROM seed_peers"
+        args: list = []
+        conds = []
+        if cluster_id is not None:
+            conds.append("seed_peer_cluster_id=?")
+            args.append(cluster_id)
+        if only_active:
+            conds.append("state='active'")
+        if conds:
+            sql += " WHERE " + " AND ".join(conds)
+        out = []
+        for r in self._rows(sql + " ORDER BY id", args):
+            topo = json.loads(r["topology"])
+            out.append(SeedPeerEntity(
+                id=r["id"], hostname=r["hostname"], ip=r["ip"],
+                port=r["port"], download_port=r["download_port"],
+                object_storage_port=r["object_storage_port"],
+                type=r["type"], state=r["state"],
+                seed_peer_cluster_id=r["seed_peer_cluster_id"],
+                topology=TopologyInfo(**topo) if topo else None))
+        return out
+
+    # -- keepalive -----------------------------------------------------
+
+    def keepalive(self, source_type: str, hostname: str, ip: str) -> bool:
+        table = "schedulers" if source_type == "scheduler" else "seed_peers"
+        cur = self._exec(
+            f"UPDATE {table} SET last_keepalive=?, state='active',"
+            " updated_at=? WHERE hostname=? AND ip=?",
+            (_now(), _now(), hostname, ip))
+        return cur.rowcount > 0
+
+    def expire_stale(self, *, ttl_s: float) -> int:
+        """Instances silent past the TTL flip to inactive (reference
+        manager marks keepalive-lost instances the same way)."""
+        cutoff = _now() - ttl_s
+        n = 0
+        for table in ("schedulers", "seed_peers"):
+            cur = self._exec(
+                f"UPDATE {table} SET state='inactive', updated_at=?"
+                " WHERE state='active' AND last_keepalive < ?",
+                (_now(), cutoff))
+            n += cur.rowcount
+        return n
+
+    # -- applications & jobs ------------------------------------------
+
+    def upsert_application(self, name: str, *, url: str = "",
+                           priority: dict | None = None) -> int:
+        self._exec(
+            "INSERT INTO applications(name, url, priority, created_at,"
+            " updated_at) VALUES (?,?,?,?,?)"
+            " ON CONFLICT(name) DO UPDATE SET url=excluded.url,"
+            " priority=excluded.priority, updated_at=excluded.updated_at",
+            (name, url, json.dumps(priority or {}), _now(), _now()))
+        return int(self._rows("SELECT id FROM applications WHERE name=?",
+                              (name,))[0]["id"])
+
+    def applications(self) -> list[dict]:
+        return [dict(r) for r in self._rows(
+            "SELECT * FROM applications ORDER BY id")]
+
+    def create_job(self, type_: str, args: dict) -> int:
+        cur = self._exec(
+            "INSERT INTO jobs(type, state, args, created_at, updated_at)"
+            " VALUES (?,?,?,?,?)",
+            (type_, "pending", json.dumps(args), _now(), _now()))
+        return int(cur.lastrowid)
+
+    def update_job(self, job_id: int, *, state: str,
+                   result: dict | None = None) -> None:
+        self._exec("UPDATE jobs SET state=?, result=?, updated_at=? WHERE id=?",
+                   (state, json.dumps(result or {}), _now(), job_id))
+
+    def job(self, job_id: int) -> dict | None:
+        rows = self._rows("SELECT * FROM jobs WHERE id=?", (job_id,))
+        return dict(rows[0]) if rows else None
+
+    def jobs(self, *, state: str | None = None) -> list[dict]:
+        if state:
+            return [dict(r) for r in self._rows(
+                "SELECT * FROM jobs WHERE state=? ORDER BY id", (state,))]
+        return [dict(r) for r in self._rows("SELECT * FROM jobs ORDER BY id")]

@@ -107,6 +107,7 @@ class Peer:
         self.schedule_count = 0                   # packets sent to this peer
         self.report_fail_count = 0                # failed piece reports
         self.blocked_parents: set[str] = set()
+        self.last_offer_ids: set[str] = set()     # parents last pushed to peer
         self.packet_sink = None                   # set by the report stream
         self.created_at = time.time()
         self.updated_at = self.created_at

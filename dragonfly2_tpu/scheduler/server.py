@@ -39,6 +39,7 @@ class Scheduler:
         self.rpc: RPCServer | None = None
         self.gc = GC()
         self.port: int | None = None
+        self.manager = None
 
     @property
     def address(self) -> str:
@@ -49,14 +50,55 @@ class Scheduler:
         self.rpc.register(build_service(self.service))
         await self.rpc.start()
         self.port = self.rpc.port
+        if self.cfg.manager_addresses:
+            await self._attach_manager()
         self.gc.add(GCTask("resource", self.cfg.gc_interval_s,
                            self.resource.gc))
         self.gc.start()
         log.info("scheduler up on %s (cluster=%d, algorithm=%s, seeds=%d)",
                  self.address, self.cfg.cluster_id, self.cfg.algorithm,
-                 len(self.cfg.seed_peers))
+                 len(self.seed_client.seed_peers))
+
+    async def _attach_manager(self) -> None:
+        """Register with the manager, keep alive, and adopt its seed-peer
+        set when none is configured statically (reference scheduler boots
+        the same way off dynconfig)."""
+        import socket
+
+        from ..idl.messages import RegisterSchedulerRequest
+        from ..rpc.manager_link import ManagerLink
+        from ..tpu import topology
+        from .config import SeedPeerAddr
+        from .seed_client import SeedPeerClient
+
+        hostname = socket.gethostname()
+        self.manager = ManagerLink(
+            self.cfg.manager_addresses,
+            keepalive_interval_s=self.cfg.keepalive_interval_s)
+        try:
+            await self.manager.register_scheduler(RegisterSchedulerRequest(
+                hostname=hostname, ip=self.cfg.advertise_ip, port=self.port,
+                scheduler_cluster_id=self.cfg.cluster_id,
+                topology=topology.detect()))
+            self.manager.start_keepalive(source_type="scheduler",
+                                         hostname=hostname,
+                                         ip=self.cfg.advertise_ip,
+                                         cluster_id=self.cfg.cluster_id)
+            if not self.cfg.seed_peers:
+                resp = await self.manager.get_seed_peers()
+                seeds = [SeedPeerAddr(host_id=f"{e.hostname}-{e.ip}",
+                                      ip=e.ip, rpc_port=e.port,
+                                      download_port=e.download_port)
+                         for e in (resp.seed_peers or [])]
+                if seeds:
+                    self.seed_client = SeedPeerClient(self.resource, seeds)
+                    self.service.seed_client = self.seed_client
+        except Exception as exc:  # noqa: BLE001 - manager optional at boot
+            log.warning("manager attach failed (%s); running standalone", exc)
 
     async def stop(self) -> None:
+        if getattr(self, "manager", None) is not None:
+            await self.manager.close()
         await self.gc.stop()
         for t in list(self.service._seed_tasks):
             t.cancel()

@@ -179,6 +179,7 @@ class SchedulerService:
             parents = self.scheduling.find_parents(peer)
             if parents:
                 peer.schedule_count += 1
+                peer.last_offer_ids = {p.id for p in parents}
                 peer.task.set_parents(peer.id, [p.id for p in parents])
                 _schedules.labels("parents").inc()
                 sink.put_nowait(self.scheduling.build_packet(peer, parents))
@@ -249,9 +250,12 @@ class SchedulerService:
         if not parents:
             return
         new_ids = {p.id for p in parents}
-        if new_ids == peer.task.dag.parents(peer.id):
+        # compare against what was last OFFERED, not the DAG (set_parents may
+        # have skipped a cycle-forming edge, which would re-push forever)
+        if new_ids == peer.last_offer_ids:
             return
         peer.schedule_count += 1
+        peer.last_offer_ids = new_ids
         peer.task.set_parents(peer.id, [p.id for p in parents])
         _schedules.labels("refresh").inc()
         peer.packet_sink.put_nowait(self.scheduling.build_packet(peer, parents))
@@ -264,6 +268,7 @@ class SchedulerService:
         parents = self.scheduling.find_parents(peer)
         if parents:
             peer.schedule_count += 1
+            peer.last_offer_ids = {p.id for p in parents}
             peer.task.set_parents(peer.id, [p.id for p in parents])
             _schedules.labels("parents").inc()
             peer.packet_sink.put_nowait(
@@ -326,6 +331,45 @@ class SchedulerService:
                         state=task.state.value, peer_count=len(task.peers),
                         has_available_peer=task.has_available_peer())
 
+    async def preheat(self, req, context):
+        """Warm a URL into the seed layer (reference ``scheduler/job/job.go:152``
+        consumes the same verb from the manager's queue)."""
+        from ..common import ids
+        from ..idl.messages import PreheatResponse, UrlMeta
+
+        meta = req.url_meta or UrlMeta()
+        task_id = ids.task_id(
+            req.url, tag=meta.tag, application=meta.application,
+            digest=meta.digest, piece_range=meta.range,
+            filtered_query_params=list(meta.filtered_query_params or []))
+        if not self.seed_client.available():
+            raise DFError(Code.SCHED_FORBIDDEN, "no seed peers to preheat into")
+        task = self.resource.get_or_create_task(task_id, req.url)
+        if task.state == TaskState.PENDING:
+            task.transit(TaskState.RUNNING)
+        seed_done = task.seed_job is not None and task.seed_job.done()
+        # re-trigger on retry after a failed seed (transient origin outage
+        # must not poison the task until GC)
+        if not task.seed_triggered or (seed_done
+                                       and not task.has_available_peer()):
+            task.seed_triggered = True
+            t = asyncio.get_running_loop().create_task(
+                self.seed_client.trigger(task, meta))
+            task.seed_job = t
+            self._seed_tasks.add(t)
+            t.add_done_callback(self._seed_tasks.discard)
+        if req.wait and task.seed_job is not None:
+            await asyncio.shield(task.seed_job)
+        if task.has_available_peer():
+            state = "succeeded"
+        elif task.seed_job is not None and not task.seed_job.done():
+            state = "running"
+        else:
+            state = "failed"
+        return PreheatResponse(task_id=task_id, state=state,
+                               content_length=task.content_length,
+                               total_piece_count=task.total_piece_count)
+
     async def sync_probes(self, request_iter,
                           context) -> AsyncIterator[SyncProbesResponse]:
         async for req in request_iter:
@@ -353,5 +397,6 @@ def build_service(svc: SchedulerService) -> ServiceDef:
     d.unary_unary("LeaveHost", svc.leave_host)
     d.unary_unary("LeavePeer", svc.leave_peer)
     d.unary_unary("StatTask", svc.stat_task)
+    d.unary_unary("Preheat", svc.preheat)
     d.stream_stream("SyncProbes", svc.sync_probes)
     return d

@@ -1,0 +1,140 @@
+"""Stage-7: manager store/searcher units + the discovery-wired E2E slice.
+
+E2E: every component finds every other component through the manager —
+seed daemon registers itself, scheduler registers itself and adopts the
+manager's seed set, leecher discovers the scheduler — then a REST preheat
+job warms the seed layer and a download rides the mesh.
+"""
+
+import asyncio
+import json
+import os
+
+import aiohttp
+import pytest
+
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.idl.messages import (GetSchedulersRequest, TopologyInfo)
+from dragonfly2_tpu.manager import Manager, ManagerConfig
+from dragonfly2_tpu.manager.searcher import find_scheduler_cluster
+from dragonfly2_tpu.manager.store import Store
+from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+from dragonfly2_tpu.scheduler.resource import TaskState
+
+from test_daemon_e2e import daemon_config, start_origin
+from test_scheduler import download_via
+
+
+class TestStore:
+    def test_scheduler_lifecycle(self):
+        s = Store()
+        cid = s.create_scheduler_cluster("c1", is_default=True)
+        sid = s.upsert_scheduler(hostname="h", ip="1.2.3.4", port=80,
+                                 cluster_id=cid)
+        assert s.schedulers(only_active=True)[0].id == sid
+        # silence flips to inactive after TTL
+        assert s.expire_stale(ttl_s=-1.0) == 1
+        assert not s.schedulers(only_active=True)
+        # keepalive revives
+        assert s.keepalive("scheduler", "h", "1.2.3.4")
+        assert s.schedulers(only_active=True)
+
+    def test_seed_peer_upsert_idempotent(self):
+        s = Store()
+        a = s.upsert_seed_peer(hostname="h", ip="1.1.1.1", port=1,
+                               download_port=2, cluster_id=1)
+        b = s.upsert_seed_peer(hostname="h", ip="1.1.1.1", port=1,
+                               download_port=3, cluster_id=1)
+        assert a == b
+        assert s.seed_peers()[0].download_port == 3
+
+    def test_jobs(self):
+        s = Store()
+        jid = s.create_job("preheat", {"url": "http://x"})
+        s.update_job(jid, state="succeeded", result={"ok": True})
+        assert s.job(jid)["state"] == "succeeded"
+
+
+class TestSearcher:
+    def test_slice_affinity_wins(self):
+        clusters = [
+            {"id": 1, "scopes": json.dumps({"zones": ["z0"]}),
+             "is_default": 1},
+            {"id": 2, "scopes": json.dumps({"slices": ["v5p-256-s0"]}),
+             "is_default": 0},
+        ]
+        req = GetSchedulersRequest(
+            ip="10.0.0.1", topology=TopologyInfo(slice_name="v5p-256-s0",
+                                                 zone="z0"))
+        assert find_scheduler_cluster(clusters, req) == 2
+
+    def test_default_when_no_match(self):
+        clusters = [{"id": 1, "scopes": "{}", "is_default": 1},
+                    {"id": 2, "scopes": "{}", "is_default": 0}]
+        req = GetSchedulersRequest(ip="10.0.0.1")
+        assert find_scheduler_cluster(clusters, req) == 1
+
+
+class TestManagerE2E:
+    def test_discovery_preheat_download(self, tmp_path):
+        data = os.urandom(3 * 1024 * 1024)
+
+        async def go():
+            origin, base = await start_origin({"w.bin": data})
+            url = f"{base}/w.bin"
+
+            manager = Manager(ManagerConfig())
+            await manager.start()
+            mgr_addr = manager.address
+
+            # seed daemon self-registers with the manager
+            seed_cfg = daemon_config(tmp_path, "seedM")
+            seed_cfg.is_seed = True
+            seed_cfg.manager_addresses = [mgr_addr]
+            seed = Daemon(seed_cfg)
+            await seed.start()
+            assert manager.store.seed_peers(only_active=True)
+
+            # scheduler registers itself and adopts the manager's seed set
+            sched = Scheduler(SchedulerConfig(manager_addresses=[mgr_addr]))
+            await sched.start()
+            assert manager.store.schedulers(only_active=True)
+            assert sched.seed_client.available()
+
+            # REST preheat job warms the seed layer
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                        f"http://127.0.0.1:{manager.rest.port}/api/v1/jobs",
+                        json={"type": "preheat",
+                              "args": {"url": url}}) as resp:
+                    assert resp.status == 201
+                    job_id = (await resp.json())["id"]
+                for _ in range(100):
+                    async with http.get(
+                            f"http://127.0.0.1:{manager.rest.port}"
+                            f"/api/v1/jobs/{job_id}") as resp:
+                        job = await resp.json()
+                    if job["state"] in ("succeeded", "failed"):
+                        break
+                    await asyncio.sleep(0.1)
+                assert job["state"] == "succeeded", job
+
+            # leecher finds the scheduler via the manager, rides the mesh
+            leech_cfg = daemon_config(tmp_path, "leechM")
+            leech_cfg.manager_addresses = [mgr_addr]
+            leech = Daemon(leech_cfg)
+            await leech.start()
+            await origin.cleanup()      # preheated: origin no longer needed
+            try:
+                r = await download_via(leech, url, str(tmp_path / "m.out"))
+                assert r is not None
+                assert (tmp_path / "m.out").read_bytes() == data
+                conductor = leech.ptm.conductor(r.task_id)
+                assert conductor.traffic_source == 0
+            finally:
+                await leech.stop()
+                await sched.stop()
+                await seed.stop()
+                await manager.stop()
+
+        asyncio.run(go())

@@ -1,0 +1,89 @@
+"""Daemon announcer: periodic host heartbeat to the scheduler.
+
+Role parity: reference ``client/daemon/announcer/announcer.go`` — announce
+host spec (CPU/mem/disk/net via gopsutil there; /proc + shutil here) to the
+scheduler's ``AnnounceHost`` on an interval so the evaluator's free-slot and
+load scores track reality.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import shutil
+
+from ..idl.messages import (AnnounceHostRequest, CPUStat, DiskStat, Host,
+                            MemoryStat)
+
+log = logging.getLogger("df.flow.announcer")
+
+
+def _memory() -> MemoryStat:
+    total = available = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    available = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    used_pct = 100.0 * (1 - available / total) if total else 0.0
+    return MemoryStat(total=total, available=available, used_percent=used_pct)
+
+
+def _cpu() -> CPUStat:
+    n = os.cpu_count() or 1
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = 0.0
+    return CPUStat(logical_count=n, percent=min(100.0, 100.0 * load1 / n))
+
+
+def _disk(path: str) -> DiskStat:
+    try:
+        du = shutil.disk_usage(path)
+        return DiskStat(total=du.total, free=du.free,
+                        used_percent=100.0 * du.used / du.total)
+    except OSError:
+        return DiskStat()
+
+
+class Announcer:
+    def __init__(self, daemon):
+        self.daemon = daemon
+        self.interval_s = daemon.cfg.announce_interval_s
+        self._task: asyncio.Task | None = None
+
+    def host_with_stats(self) -> Host:
+        host = self.daemon.host_info()
+        host.cpu = _cpu()
+        host.memory = _memory()
+        host.disk = _disk(self.daemon.paths.data_dir)
+        return host
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.daemon.scheduler.announce_host(AnnounceHostRequest(
+                    host=self.host_with_stats(), interval_s=self.interval_s))
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - scheduler may be away
+                log.debug("announce failed: %s", exc)
+            await asyncio.sleep(self.interval_s)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass

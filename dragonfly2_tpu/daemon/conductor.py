@@ -77,6 +77,8 @@ class PeerTaskConductor:
         self._run_task: asyncio.Task | None = None
         self._p2p_engine: Any = None
         self._session: Any = None      # scheduler PeerSession once registered
+        self.shaper: Any = None
+        self.rate_limiter: Any = None  # per-task bucket from the shaper
         self.log = with_fields("df.core.conductor",
                                task=task_id[:12], peer=peer_id[-12:])
 
@@ -91,6 +93,10 @@ class PeerTaskConductor:
 
     def set_p2p_engine(self, engine: Any) -> None:
         self._p2p_engine = engine
+
+    def attach_shaper(self, shaper: Any) -> None:
+        self.shaper = shaper
+        self.rate_limiter = shaper.register(self.task_id)
 
     async def _run(self) -> None:
         try:
@@ -118,6 +124,8 @@ class PeerTaskConductor:
             # outcome — a half-pulled peer must never be advertised complete
             if self._session is not None:
                 await self._session.close(success=self.state == self.SUCCESS)
+            if self.shaper is not None:
+                self.shaper.unregister(self.task_id)
 
     async def _register(self):
         """Register with the scheduler; None means "go to origin" (the
@@ -210,6 +218,8 @@ class PeerTaskConductor:
             except Exception:
                 self.log.exception("device ingest write failed; disabling sink")
                 self.device_ingest = None
+        if self.shaper is not None:
+            self.shaper.record(self.task_id, len(data))
         async with self._piece_cond:
             self.ready.add(num)
             self.completed_length += len(data)

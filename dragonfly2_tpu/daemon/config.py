@@ -26,6 +26,7 @@ class DownloadConfig:
     back_source_group_min_bytes: int = 32 * MiB  # below this, one stream
     total_rate_limit_bps: int = 0          # 0 = unlimited
     per_peer_rate_limit_bps: int = 0
+    traffic_shaper_kind: str = "sampling"  # sampling | plain
     prefetch_whole_file: bool = False      # ranged requests warm the whole task
     first_piece_timeout_s: float = 30.0
     piece_timeout_s: float = 60.0
@@ -60,6 +61,8 @@ class ProxyConfig:
 class ObjectStorageConfig:
     enabled: bool = False
     port: int = 0
+    # bucket name -> source-client base URL (file:///path, http(s)://, gs://)
+    buckets: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -79,4 +82,5 @@ class DaemonConfig:
     proxy: ProxyConfig = field(default_factory=ProxyConfig)
     object_storage: ObjectStorageConfig = field(default_factory=ObjectStorageConfig)
     announce_interval_s: float = 30.0
+    probe_enabled: bool = True             # RTT probing via SyncProbes
     metrics_port: int = 0                  # 0 = disabled

@@ -27,6 +27,7 @@ from .piece_downloader import PieceDownloader
 from .piece_engine import PieceEngine
 from .rpcserver import DaemonService, build_service
 from .scheduler_session import SchedulerConnector
+from .traffic_shaper import TrafficShaper
 from .upload_server import UploadServer
 from ..rpc.server import RPCServer
 
@@ -61,6 +62,9 @@ class Daemon:
             capacity_bytes=cfg.storage.capacity_bytes,
             gc_interval_s=cfg.storage.gc_interval_s))
         self.piece_mgr = PieceManager(cfg.download)
+        self.shaper = TrafficShaper(
+            total_rate_bps=cfg.download.total_rate_limit_bps,
+            kind=cfg.download.traffic_shaper_kind)
         self.upload_server = UploadServer(
             self.storage_mgr, port=cfg.upload.port,
             rate_limit_bps=cfg.upload.rate_limit_bps,
@@ -112,13 +116,14 @@ class Daemon:
                     piece_timeout_s=self.cfg.download.piece_timeout_s,
                     downloader=self._piece_downloader,
                     channel_pool=self._peer_channels)
+        self.shaper.start()
         self.ptm = PeerTaskManager(
             storage_mgr=self.storage_mgr, piece_mgr=self.piece_mgr,
             hostname=self.hostname, host_ip=self.host_ip,
             scheduler=None,
             p2p_engine_factory=engine_factory,
             device_sink_builder=self.device_sink_builder,
-            is_seed=self.cfg.is_seed)
+            is_seed=self.cfg.is_seed, shaper=self.shaper)
         svc = DaemonService(self.ptm,
                             upload_addr=f"{self.host_ip}:{self.upload_server.port}")
         # peer-facing TCP server: bind the listen address, advertise host_ip
@@ -157,10 +162,15 @@ class Daemon:
         self.gc.add(GCTask("storage", self.cfg.storage.gc_interval_s,
                            self.storage_mgr.try_gc))
         self.gc.start()
-        if self.scheduler is not None and hasattr(self.scheduler, "announce_loop"):
+        if self.scheduler is not None and hasattr(self.scheduler, "announce_host"):
             from .announcer import Announcer
             self.announcer = Announcer(self)
             await self.announcer.start()
+        if (self.cfg.probe_enabled and self.scheduler is not None
+                and hasattr(self.scheduler, "sync_probes")):
+            from .networktopology import NetworkTopologyProber
+            self.prober = NetworkTopologyProber(self)
+            await self.prober.start()
         log.info("daemon up: host=%s ip=%s rpc=%s upload=%d sock=%s seed=%s",
                  self.hostname, self.host_ip, self.rpc.port,
                  self.upload_server.port, sock, self.cfg.is_seed)
@@ -199,6 +209,9 @@ class Daemon:
     async def stop(self) -> None:
         if getattr(self, "manager", None) is not None:
             await self.manager.close()
+        if getattr(self, "prober", None) is not None:
+            await self.prober.stop()
+        await self.shaper.stop()
         if self.announcer is not None:
             await self.announcer.stop()
         await self.gc.stop()

@@ -1,0 +1,190 @@
+"""Object-storage gateway: S3-ish REST on the daemon, P2P-accelerated GETs.
+
+Role parity: reference ``client/daemon/objectstorage/`` — bucket/object
+routes (``objectstorage.go:148-204``), ``getObject`` via the P2P task engine
+(:253), ``putObject`` with write-back to the backend (:369). Backends here
+are source-client URL bases per bucket (``file://`` — writable, ``http(s)``,
+``gs://``, ``memory://`` — read-through), configured in
+``ObjectStorageConfig.buckets``; the reference's S3/OSS/OBS SDK clients
+collapse into the same scheme registry the download path already uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import shutil
+import tempfile
+from urllib.parse import quote
+
+from aiohttp import web
+
+from ..common.aiohttp_util import resolve_port
+from ..common.errors import Code, DFError
+from ..common.metrics import REGISTRY
+from ..idl.messages import TaskType, UrlMeta
+from ..source import SourceRequest, client_for
+from .config import ObjectStorageConfig
+
+log = logging.getLogger("df.http.objstore")
+
+_obj_reqs = REGISTRY.counter("df_objstore_requests_total",
+                             "object gateway requests", ("op", "status"))
+
+
+class ObjectGateway:
+    def __init__(self, daemon, cfg: ObjectStorageConfig):
+        self.daemon = daemon
+        self.cfg = cfg
+        self.port = cfg.port
+        self._runner: web.AppRunner | None = None
+
+    def _object_url(self, bucket: str, key: str) -> str:
+        base = self.cfg.buckets.get(bucket)
+        if base is None:
+            raise DFError(Code.NOT_FOUND, f"bucket {bucket!r} not configured")
+        return base.rstrip("/") + "/" + quote(key)
+
+    async def start(self) -> None:
+        app = web.Application(client_max_size=0)
+        r = app.router
+        r.add_get("/healthy", self._healthy)
+        r.add_get("/buckets", self._list_buckets)
+        r.add_get("/buckets/{bucket}/objects", self._list_objects)
+        r.add_head("/buckets/{bucket}/objects/{key:.+}", self._head_object)
+        r.add_get("/buckets/{bucket}/objects/{key:.+}", self._get_object,
+                  allow_head=False)
+        r.add_put("/buckets/{bucket}/objects/{key:.+}", self._put_object)
+        r.add_delete("/buckets/{bucket}/objects/{key:.+}", self._delete_object)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.daemon.cfg.listen_ip, self.port)
+        await site.start()
+        self.port = resolve_port(self._runner)
+        log.info("object gateway on :%d (%d buckets)", self.port,
+                 len(self.cfg.buckets))
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------------
+
+    async def _healthy(self, _r: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def _list_buckets(self, _r: web.Request) -> web.Response:
+        return web.json_response(sorted(self.cfg.buckets))
+
+    async def _list_objects(self, request: web.Request) -> web.Response:
+        bucket = request.match_info["bucket"]
+        try:
+            url = self._object_url(bucket, "")
+            entries = await client_for(url).list(SourceRequest(url=url))
+        except DFError as exc:
+            _obj_reqs.labels("list", "err").inc()
+            return web.json_response({"error": exc.message}, status=404)
+        _obj_reqs.labels("list", "ok").inc()
+        return web.json_response([
+            {"key": e.name, "size": e.content_length, "is_dir": e.is_dir}
+            for e in entries])
+
+    async def _head_object(self, request: web.Request) -> web.Response:
+        url = self._object_url(request.match_info["bucket"],
+                               request.match_info["key"])
+        try:
+            length = await client_for(url).content_length(
+                SourceRequest(url=url))
+        except DFError:
+            length = -1
+        if length < 0:
+            _obj_reqs.labels("head", "404").inc()
+            return web.Response(status=404)
+        _obj_reqs.labels("head", "ok").inc()
+        return web.Response(headers={"Content-Length": str(length)})
+
+    async def _get_object(self, request: web.Request) -> web.StreamResponse:
+        try:
+            url = self._object_url(request.match_info["bucket"],
+                                   request.match_info["key"])
+        except DFError as exc:
+            _obj_reqs.labels("get", "404").inc()
+            return web.json_response({"error": exc.message}, status=404)
+        meta = UrlMeta(tag="objstore")
+        try:
+            task_id, chunks = await self.daemon.ptm.stream_task(url, meta)
+        except DFError as exc:
+            _obj_reqs.labels("get", "err").inc()
+            return web.json_response({"error": exc.message}, status=502)
+        conductor = self.daemon.ptm.conductor(task_id)
+        resp = web.StreamResponse()
+        length = conductor.content_length if conductor is not None else -1
+        if length >= 0:
+            resp.content_length = length
+        await resp.prepare(request)
+        try:
+            async for chunk in chunks:
+                await resp.write(chunk)
+        except DFError as exc:
+            # mid-stream failure: the connection drop is the error signal
+            log.warning("object stream %s failed: %s", url, exc.message)
+            _obj_reqs.labels("get", "err").inc()
+            return resp
+        await resp.write_eof()
+        _obj_reqs.labels("get", "ok").inc()
+        return resp
+
+    async def _put_object(self, request: web.Request) -> web.Response:
+        bucket = request.match_info["bucket"]
+        key = request.match_info["key"]
+        try:
+            url = self._object_url(bucket, key)
+        except DFError as exc:
+            _obj_reqs.labels("put", "404").inc()
+            return web.json_response({"error": exc.message}, status=404)
+        if not url.startswith("file://"):
+            _obj_reqs.labels("put", "501").inc()
+            return web.json_response(
+                {"error": "PUT supported only for file:// backends"},
+                status=501)
+        dest = url[len("file://"):]
+        os.makedirs(os.path.dirname(dest) or "/", exist_ok=True)
+        tmp_fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(dest))
+        try:
+            with os.fdopen(tmp_fd, "wb") as f:
+                async for chunk in request.content.iter_chunked(1 << 20):
+                    f.write(chunk)
+            os.replace(tmp_path, dest)
+        except Exception:
+            with open(tmp_path, "ab"):
+                pass
+            os.unlink(tmp_path)
+            raise
+        # import into the local cache so peers can fetch it immediately
+        # without a second backend read (reference's WriteBack mode)
+        try:
+            await self.daemon.ptm.import_file(dest, url,
+                                              UrlMeta(tag="objstore"),
+                                              task_type=TaskType.STANDARD)
+        except DFError as exc:
+            log.warning("post-PUT import of %s failed: %s", key, exc.message)
+        _obj_reqs.labels("put", "ok").inc()
+        return web.Response(status=201)
+
+    async def _delete_object(self, request: web.Request) -> web.Response:
+        try:
+            url = self._object_url(request.match_info["bucket"],
+                                   request.match_info["key"])
+        except DFError as exc:
+            return web.json_response({"error": exc.message}, status=404)
+        if url.startswith("file://"):
+            try:
+                await asyncio.to_thread(os.unlink, url[len("file://"):])
+            except FileNotFoundError:
+                pass
+        # drop the cached task too
+        task_id = self.daemon.ptm._task_id(url, UrlMeta(tag="objstore"))
+        await self.daemon.ptm.delete_task(task_id)
+        _obj_reqs.labels("delete", "ok").inc()
+        return web.Response(status=204)

@@ -27,7 +27,8 @@ class PeerTaskManager:
     def __init__(self, *, storage_mgr: StorageManager, piece_mgr: PieceManager,
                  hostname: str, host_ip: str, scheduler: Any = None,
                  p2p_engine_factory: Any = None,
-                 device_sink_builder: Any = None, is_seed: bool = False):
+                 device_sink_builder: Any = None, is_seed: bool = False,
+                 shaper: Any = None):
         self.storage_mgr = storage_mgr
         self.piece_mgr = piece_mgr
         self.hostname = hostname
@@ -36,6 +37,7 @@ class PeerTaskManager:
         self.p2p_engine_factory = p2p_engine_factory
         self.device_sink_builder = device_sink_builder
         self.is_seed = is_seed
+        self.shaper = shaper
         self._conductors: dict[str, PeerTaskConductor] = {}
         self._lock = asyncio.Lock()
 
@@ -76,6 +78,8 @@ class PeerTaskManager:
                 device_sink_factory=device_sink_factory, ordered=ordered)
             if self.p2p_engine_factory is not None:
                 conductor.set_p2p_engine(self.p2p_engine_factory())
+            if self.shaper is not None:
+                conductor.attach_shaper(self.shaper)
             self._conductors[task_id] = conductor
             conductor.start()
             return conductor

@@ -277,6 +277,8 @@ class PieceEngine:
             await self._download_one(conductor, session, d)
 
     async def _download_one(self, conductor, session, d: Dispatch) -> None:
+        if conductor.rate_limiter is not None:
+            await conductor.rate_limiter.acquire(d.piece.range_size)
         t0 = int(time.time() * 1000)
         try:
             data, cost = await self.downloader.download_piece(

@@ -32,6 +32,10 @@ class PieceManager:
         self.cfg = cfg
         self.total_limiter = TokenBucket(cfg.total_rate_limit_bps or 0)
 
+    def _limiter(self, conductor) -> TokenBucket:
+        # the shaper's per-task bucket when attached; daemon-wide otherwise
+        return getattr(conductor, "rate_limiter", None) or self.total_limiter
+
     # ------------------------------------------------------------------
     # back-source: origin -> storage
     # ------------------------------------------------------------------
@@ -87,8 +91,9 @@ class PieceManager:
         rel = 0  # offsets are range-relative: the task stores just its range
         t0 = time.monotonic()
         assert resp.chunks is not None
+        limiter = self._limiter(conductor)
         async for chunk in resp.chunks:
-            await self.total_limiter.acquire(len(chunk))
+            await limiter.acquire(len(chunk))
             buf.extend(chunk)
             while len(buf) >= piece_size:
                 data = bytes(buf[:piece_size])
@@ -129,8 +134,9 @@ class PieceManager:
             buf = bytearray()
             t0 = time.monotonic()
             assert resp.chunks is not None
+            limiter = self._limiter(conductor)
             async for chunk in resp.chunks:
-                await self.total_limiter.acquire(len(chunk))
+                await limiter.acquire(len(chunk))
                 buf.extend(chunk)
                 while num < last:
                     _, want = piece_range(num, piece_size, content_len)
@@ -165,8 +171,9 @@ class PieceManager:
         buf = bytearray()
         t0 = time.monotonic()
         assert resp.chunks is not None
+        limiter = self._limiter(conductor)
         async for chunk in resp.chunks:
-            await self.total_limiter.acquire(len(chunk))
+            await limiter.acquire(len(chunk))
             buf.extend(chunk)
             while len(buf) >= piece_size:
                 data = bytes(buf[:piece_size])

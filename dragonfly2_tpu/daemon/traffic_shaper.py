@@ -1,0 +1,110 @@
+"""Traffic shaper: split the daemon's total download budget across tasks.
+
+Role parity: reference ``client/daemon/peer/traffic_shaper.go`` — types
+``plain`` (equal split) and ``sampling`` (shares proportional to each
+task's observed consumption, re-sampled on an interval). Tasks get their
+own TokenBucket whose rate the shaper retunes; the engine and back-source
+path acquire from it per piece.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..common.rate import TokenBucket
+
+log = logging.getLogger("df.flow.shaper")
+
+SAMPLE_INTERVAL_S = 1.0
+MIN_SHARE_RATIO = 0.05     # no running task starves below 5% of total
+
+
+class _TaskEntry:
+    __slots__ = ("bucket", "consumed", "last_consumed", "rate")
+
+    def __init__(self) -> None:
+        self.bucket = TokenBucket(0)     # unlimited until first retune
+        self.consumed = 0
+        self.last_consumed = 0
+        self.rate = 0.0
+
+
+class TrafficShaper:
+    def __init__(self, *, total_rate_bps: float = 0.0,
+                 kind: str = "sampling"):
+        self.total_rate_bps = float(total_rate_bps)
+        self.kind = kind
+        self._tasks: dict[str, _TaskEntry] = {}
+        self._loop_task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        if self.total_rate_bps > 0 and self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._retune_loop())
+
+    async def stop(self) -> None:
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+
+    # ------------------------------------------------------------------
+
+    def register(self, task_id: str) -> TokenBucket:
+        entry = self._tasks.get(task_id)
+        if entry is None:
+            entry = _TaskEntry()
+            self._tasks[task_id] = entry
+            self._retune()
+        return entry.bucket
+
+    def unregister(self, task_id: str) -> None:
+        if self._tasks.pop(task_id, None) is not None:
+            self._retune()
+
+    def record(self, task_id: str, nbytes: int) -> None:
+        entry = self._tasks.get(task_id)
+        if entry is not None:
+            entry.consumed += nbytes
+
+    # ------------------------------------------------------------------
+
+    async def _retune_loop(self) -> None:
+        while True:
+            await asyncio.sleep(SAMPLE_INTERVAL_S)
+            self._retune()
+
+    def _retune(self) -> None:
+        if self.total_rate_bps <= 0 or not self._tasks:
+            return
+        n = len(self._tasks)
+        if self.kind == "plain":
+            share = self.total_rate_bps / n
+            for entry in self._tasks.values():
+                entry.rate = share
+                entry.bucket.set_rate(share)
+            return
+        # sampling: weight by bytes consumed since the last retune, with a
+        # floor so idle-but-running tasks can ramp back up
+        deltas = {}
+        total_delta = 0
+        for tid, entry in self._tasks.items():
+            d = max(0, entry.consumed - entry.last_consumed)
+            entry.last_consumed = entry.consumed
+            deltas[tid] = d
+            total_delta += d
+        floor = self.total_rate_bps * MIN_SHARE_RATIO
+        distributable = self.total_rate_bps - floor * n
+        if distributable <= 0 or total_delta == 0:
+            share = self.total_rate_bps / n
+            for entry in self._tasks.values():
+                entry.rate = share
+                entry.bucket.set_rate(share)
+            return
+        for tid, entry in self._tasks.items():
+            entry.rate = floor + distributable * deltas[tid] / total_delta
+            entry.bucket.set_rate(entry.rate)

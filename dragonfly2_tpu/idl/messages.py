@@ -484,6 +484,7 @@ class KeepAliveRequest:
     source_type: str = ""           # "scheduler" | "seed_peer"
     hostname: str = ""
     ip: str = ""
+    port: int = 0                   # instance identity is (hostname, ip, port)
     cluster_id: int = 0
 
 

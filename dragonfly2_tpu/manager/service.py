@@ -41,7 +41,8 @@ class ManagerService:
                                           only_active=True))
         return GetSchedulersResponse(
             schedulers=schedulers,
-            cluster_config=self.store.cluster_config(cluster_id))
+            cluster_config=await asyncio.to_thread(
+                self.store.cluster_config, cluster_id))
 
     async def get_seed_peers(self, req: GetSeedPeersRequest,
                              context) -> GetSeedPeersResponse:
@@ -79,7 +80,8 @@ class ManagerService:
         async for req in request_iter:
             ident = (req.source_type, req.hostname, req.ip)
             ok = await asyncio.to_thread(
-                self.store.keepalive, req.source_type, req.hostname, req.ip)
+                self.store.keepalive, req.source_type, req.hostname, req.ip,
+                req.port)
             if not ok:
                 log.warning("keepalive from unregistered %s %s@%s",
                             req.source_type, req.hostname, req.ip)

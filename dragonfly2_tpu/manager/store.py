@@ -243,12 +243,19 @@ class Store:
 
     # -- keepalive -----------------------------------------------------
 
-    def keepalive(self, source_type: str, hostname: str, ip: str) -> bool:
+    def keepalive(self, source_type: str, hostname: str, ip: str,
+                  port: int = 0) -> bool:
+        """port=0 is a legacy wildcard; identity is (hostname, ip, port) —
+        without the port one live instance would keep a dead same-host
+        sibling marked active forever."""
         table = "schedulers" if source_type == "scheduler" else "seed_peers"
-        cur = self._exec(
-            f"UPDATE {table} SET last_keepalive=?, state='active',"
-            " updated_at=? WHERE hostname=? AND ip=?",
-            (_now(), _now(), hostname, ip))
+        sql = (f"UPDATE {table} SET last_keepalive=?, state='active',"
+               " updated_at=? WHERE hostname=? AND ip=?")
+        args: list = [_now(), _now(), hostname, ip]
+        if port:
+            sql += " AND port=?"
+            args.append(port)
+        cur = self._exec(sql, args)
         return cur.rowcount > 0
 
     def expire_stale(self, *, ttl_s: float) -> int:

@@ -42,22 +42,34 @@ class ManagerLink:
             self._channel = None
         self._addr_idx += 1
 
+    async def _unary(self, method: str, req, *, timeout: float = 10.0):
+        """Try every configured manager address before giving up — an HA
+        pair with a dead first address must not look globally down."""
+        last: Exception | None = None
+        for _ in range(max(1, len(self.addresses))):
+            try:
+                return await self._client().unary(method, req,
+                                                  timeout=timeout)
+            except Exception as exc:  # noqa: BLE001 - rotate and retry
+                last = exc
+                await self._failover()
+        raise last  # type: ignore[misc]
+
     # -- calls ---------------------------------------------------------
 
     async def register_scheduler(self, req) -> None:
-        await self._client().unary("RegisterScheduler", req, timeout=10.0)
+        await self._unary("RegisterScheduler", req)
 
     async def register_seed_peer(self, req) -> None:
-        await self._client().unary("RegisterSeedPeer", req, timeout=10.0)
+        await self._unary("RegisterSeedPeer", req)
 
     async def get_schedulers(self, req: GetSchedulersRequest
                              ) -> GetSchedulersResponse:
-        return await self._client().unary("GetSchedulers", req, timeout=10.0)
+        return await self._unary("GetSchedulers", req)
 
     async def get_seed_peers(self, cluster_id: int = 0) -> GetSeedPeersResponse:
-        return await self._client().unary(
-            "GetSeedPeers", GetSeedPeersRequest(cluster_id=cluster_id),
-            timeout=10.0)
+        return await self._unary(
+            "GetSeedPeers", GetSeedPeersRequest(cluster_id=cluster_id))
 
     # -- keepalive -----------------------------------------------------
 

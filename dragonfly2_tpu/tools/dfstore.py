@@ -1,0 +1,131 @@
+"""dfstore: object-storage gateway client + CLI.
+
+Role parity: reference ``cmd/dfstore`` + ``client/dfstore/dfstore.go``
+(GetObject/PutObject/CopyObject/DeleteObject/IsObjectExist against the
+daemon's object gateway).
+
+Usage:
+    python -m dragonfly2_tpu.tools.dfstore get  BUCKET KEY -O /path/out
+    python -m dragonfly2_tpu.tools.dfstore put  BUCKET KEY -I /path/in
+    python -m dragonfly2_tpu.tools.dfstore stat BUCKET KEY
+    python -m dragonfly2_tpu.tools.dfstore rm   BUCKET KEY
+    python -m dragonfly2_tpu.tools.dfstore ls   BUCKET
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from urllib.parse import quote
+
+import aiohttp
+
+
+class Dfstore:
+    """HTTP client for the daemon's object gateway."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint.rstrip("/")
+
+    def _url(self, bucket: str, key: str = "") -> str:
+        base = f"{self.endpoint}/buckets/{quote(bucket)}/objects"
+        return f"{base}/{quote(key)}" if key else base
+
+    async def get_object(self, bucket: str, key: str, output: str) -> int:
+        async with aiohttp.ClientSession() as http:
+            async with http.get(self._url(bucket, key)) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"GET {key}: HTTP {resp.status}")
+                n = 0
+                with open(output, "wb") as f:
+                    async for chunk in resp.content.iter_chunked(1 << 20):
+                        f.write(chunk)
+                        n += len(chunk)
+                return n
+
+    async def put_object(self, bucket: str, key: str, path: str) -> None:
+        async with aiohttp.ClientSession() as http:
+            with open(path, "rb") as f:
+                async with http.put(self._url(bucket, key), data=f) as resp:
+                    if resp.status not in (200, 201):
+                        raise RuntimeError(f"PUT {key}: HTTP {resp.status}")
+
+    async def is_object_exist(self, bucket: str, key: str) -> int | None:
+        async with aiohttp.ClientSession() as http:
+            async with http.head(self._url(bucket, key)) as resp:
+                if resp.status != 200:
+                    return None
+                return int(resp.headers.get("Content-Length", -1))
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        async with aiohttp.ClientSession() as http:
+            async with http.delete(self._url(bucket, key)) as resp:
+                if resp.status not in (200, 204):
+                    raise RuntimeError(f"DELETE {key}: HTTP {resp.status}")
+
+    async def list_objects(self, bucket: str) -> list[dict]:
+        async with aiohttp.ClientSession() as http:
+            async with http.get(self._url(bucket)) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"LIST {bucket}: HTTP {resp.status}")
+                return await resp.json()
+
+    async def copy_object(self, bucket: str, src: str, dst: str) -> None:
+        import tempfile
+        with tempfile.NamedTemporaryFile() as tmp:
+            await self.get_object(bucket, src, tmp.name)
+            await self.put_object(bucket, dst, tmp.name)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dfstore",
+                                description="object gateway operations")
+    p.add_argument("op", choices=["get", "put", "stat", "rm", "ls", "cp"])
+    p.add_argument("bucket")
+    p.add_argument("key", nargs="?", default="")
+    p.add_argument("dst_key", nargs="?", default="", help="cp destination key")
+    p.add_argument("-I", "--input", default="")
+    p.add_argument("-O", "--output", default="")
+    p.add_argument("--endpoint", default="http://127.0.0.1:65004",
+                   help="object gateway endpoint")
+    return p
+
+
+async def run(args: argparse.Namespace) -> int:
+    store = Dfstore(args.endpoint)
+    try:
+        if args.op == "get":
+            n = await store.get_object(args.bucket, args.key, args.output)
+            print(json.dumps({"bytes": n, "output": args.output}))
+        elif args.op == "put":
+            await store.put_object(args.bucket, args.key, args.input)
+            print(json.dumps({"stored": args.key}))
+        elif args.op == "stat":
+            size = await store.is_object_exist(args.bucket, args.key)
+            if size is None:
+                print(json.dumps({"exists": False}))
+                return 1
+            print(json.dumps({"exists": True, "size": size}))
+        elif args.op == "rm":
+            await store.delete_object(args.bucket, args.key)
+            print(json.dumps({"deleted": args.key}))
+        elif args.op == "ls":
+            print(json.dumps(await store.list_objects(args.bucket)))
+        elif args.op == "cp":
+            await store.copy_object(args.bucket, args.key, args.dst_key)
+            print(json.dumps({"copied": [args.key, args.dst_key]}))
+        return 0
+    except Exception as exc:  # noqa: BLE001 - CLI boundary
+        print(f"dfstore: {exc}", file=sys.stderr)
+        return 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

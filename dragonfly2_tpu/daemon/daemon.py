@@ -192,7 +192,8 @@ class Daemon:
                     seed_peer_cluster_id=1, topology=self.topology))
                 self.manager.start_keepalive(source_type="seed_peer",
                                              hostname=self.hostname,
-                                             ip=self.host_ip)
+                                             ip=self.host_ip,
+                                             port=self.rpc.port)
             resp = await self.manager.get_schedulers(GetSchedulersRequest(
                 hostname=self.hostname, ip=self.host_ip,
                 topology=self.topology))

@@ -53,7 +53,8 @@ class NetworkTopologyProber:
                 raise
             except Exception as exc:  # noqa: BLE001 - scheduler may be away
                 log.debug("probe round failed: %s", exc)
-                await asyncio.sleep(20.0)
+            # pace re-dials even when the scheduler closes the stream cleanly
+            await asyncio.sleep(20.0)
 
     async def _probe_round(self) -> None:
         stream = await self.daemon.scheduler.sync_probes()

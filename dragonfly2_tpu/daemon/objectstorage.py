@@ -44,7 +44,22 @@ class ObjectGateway:
         base = self.cfg.buckets.get(bucket)
         if base is None:
             raise DFError(Code.NOT_FOUND, f"bucket {bucket!r} not configured")
-        return base.rstrip("/") + "/" + quote(key)
+        # aiohttp percent-decodes match_info, so a key may arrive as a
+        # literal '../..' regardless of how it was escaped on the wire;
+        # reject dot segments outright, and for file:// backends verify the
+        # resolved path stays under the bucket base.
+        if any(seg in ("..", ".") for seg in key.split("/")):
+            raise DFError(Code.INVALID_ARGUMENT,
+                          f"object key {key!r} contains dot segments")
+        url = base.rstrip("/") + "/" + quote(key)
+        if url.startswith("file://"):
+            root = os.path.realpath(base[len("file://"):])
+            dest = os.path.realpath(base[len("file://"):].rstrip("/")
+                                    + "/" + key)
+            if dest != root and not dest.startswith(root + os.sep):
+                raise DFError(Code.INVALID_ARGUMENT,
+                              f"object key {key!r} escapes bucket")
+        return url
 
     async def start(self) -> None:
         app = web.Application(client_max_size=0)
@@ -91,8 +106,12 @@ class ObjectGateway:
             for e in entries])
 
     async def _head_object(self, request: web.Request) -> web.Response:
-        url = self._object_url(request.match_info["bucket"],
-                               request.match_info["key"])
+        try:
+            url = self._object_url(request.match_info["bucket"],
+                                   request.match_info["key"])
+        except DFError:
+            _obj_reqs.labels("head", "404").inc()
+            return web.Response(status=404)
         try:
             length = await client_for(url).content_length(
                 SourceRequest(url=url))

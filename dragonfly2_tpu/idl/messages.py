@@ -557,3 +557,40 @@ class ModelInferRequest:
 class ModelInferResponse:
     outputs: list[float] | None = None
     model_version: str = ""
+
+
+# ---------------------------------------------------------------- model registry
+
+@message
+class ModelEntity:
+    """A versioned trained model (reference ``manager/models/model.go:36``)."""
+
+    id: int = 0
+    name: str = ""                  # bandwidth_mlp | topology_gnn
+    version: str = ""               # content hash of the blob
+    state: str = "active"
+    scheduler_cluster_id: int = 0
+    metrics: dict | None = None     # loss curve, rows, train time...
+    data: bytes = b""               # npz param archive ("" in listings)
+    created_at: float = 0.0
+
+
+@message
+class CreateModelRequest:
+    name: str = ""
+    version: str = ""
+    scheduler_cluster_id: int = 0
+    metrics: dict | None = None
+    data: bytes = b""
+
+
+@message
+class GetModelRequest:
+    name: str = ""
+    version: str = ""               # "" = latest active version
+    scheduler_cluster_id: int = 0
+
+
+@message
+class GetModelResponse:
+    model: ModelEntity | None = None

@@ -47,6 +47,7 @@ class RestAPI:
         r.add_post("/api/v1/jobs", self._create_job)
         r.add_get("/api/v1/jobs", self._list_jobs)
         r.add_get("/api/v1/jobs/{id}", self._get_job)
+        r.add_get("/api/v1/models", self._list_models)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -115,6 +116,11 @@ class RestAPI:
 
     async def _list_jobs(self, _r: web.Request) -> web.Response:
         return web.json_response(await asyncio.to_thread(self.store.jobs))
+
+    async def _list_models(self, request: web.Request) -> web.Response:
+        name = request.query.get("name")
+        return web.json_response(
+            await asyncio.to_thread(lambda: self.store.models(name=name)))
 
     async def _get_job(self, request: web.Request) -> web.Response:
         job = await asyncio.to_thread(

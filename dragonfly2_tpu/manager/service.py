@@ -13,9 +13,11 @@ import logging
 from typing import AsyncIterator
 
 from ..common.errors import Code, DFError
-from ..idl.messages import (Empty, GetSchedulersRequest, GetSchedulersResponse,
-                            GetSeedPeersRequest, GetSeedPeersResponse,
-                            KeepAliveRequest, RegisterSchedulerRequest,
+from ..idl.messages import (CreateModelRequest, Empty, GetModelRequest,
+                            GetModelResponse, GetSchedulersRequest,
+                            GetSchedulersResponse, GetSeedPeersRequest,
+                            GetSeedPeersResponse, KeepAliveRequest,
+                            ModelEntity, RegisterSchedulerRequest,
                             RegisterSeedPeerRequest)
 from ..rpc.server import ServiceDef
 from .searcher import find_scheduler_cluster
@@ -73,6 +75,36 @@ class ManagerService:
                 topology=req.topology))
         return Empty()
 
+    # -- model registry (reference manager/models/model.go:36) ---------
+
+    async def create_model(self, req: CreateModelRequest, context) -> Empty:
+        if not req.name or not req.version or not req.data:
+            raise DFError(Code.INVALID_ARGUMENT,
+                          "name, version, data required")
+        await asyncio.to_thread(
+            lambda: self.store.create_model(
+                name=req.name, version=req.version, data=req.data,
+                metrics=req.metrics,
+                scheduler_cluster_id=req.scheduler_cluster_id))
+        log.info("model registered: %s@%s (%d bytes)", req.name, req.version,
+                 len(req.data))
+        return Empty()
+
+    async def get_model(self, req: GetModelRequest,
+                        context) -> GetModelResponse:
+        row = await asyncio.to_thread(
+            lambda: self.store.get_model(
+                req.name, version=req.version,
+                scheduler_cluster_id=req.scheduler_cluster_id))
+        if row is None:
+            return GetModelResponse(model=None)
+        return GetModelResponse(model=ModelEntity(
+            id=row["id"], name=row["name"], version=row["version"],
+            state=row["state"],
+            scheduler_cluster_id=row["scheduler_cluster_id"],
+            metrics=row["metrics"], data=row["data"],
+            created_at=row["created_at"]))
+
     async def keep_alive(self, request_iter, context) -> Empty:
         """Client-stream: one message per interval; instance goes inactive
         when the stream dies and the TTL sweep catches it."""
@@ -97,4 +129,6 @@ def build_service(svc: ManagerService) -> ServiceDef:
     d.unary_unary("RegisterScheduler", svc.register_scheduler)
     d.unary_unary("RegisterSeedPeer", svc.register_seed_peer)
     d.stream_unary("KeepAlive", svc.keep_alive)
+    d.unary_unary("CreateModel", svc.create_model)
+    d.unary_unary("GetModel", svc.get_model)
     return d

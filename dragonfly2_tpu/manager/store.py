@@ -73,6 +73,17 @@ CREATE TABLE IF NOT EXISTS jobs (
   result TEXT NOT NULL DEFAULT '{}',
   created_at REAL, updated_at REAL
 );
+CREATE TABLE IF NOT EXISTS models (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL,
+  version TEXT NOT NULL,
+  state TEXT NOT NULL DEFAULT 'active',
+  scheduler_cluster_id INTEGER NOT NULL DEFAULT 0,
+  metrics TEXT NOT NULL DEFAULT '{}',
+  data BLOB NOT NULL,
+  created_at REAL,
+  UNIQUE(name, version, scheduler_cluster_id)
+);
 """
 
 
@@ -303,6 +314,57 @@ class Store:
     def job(self, job_id: int) -> dict | None:
         rows = self._rows("SELECT * FROM jobs WHERE id=?", (job_id,))
         return dict(rows[0]) if rows else None
+
+    # -- model registry (reference manager/models/model.go:36) ---------
+
+    def create_model(self, *, name: str, version: str, data: bytes,
+                     metrics: dict | None = None,
+                     scheduler_cluster_id: int = 0) -> int:
+        """Insert one model version; the newest active version per name is
+        the one ``get_model`` serves by default. Idempotent per version."""
+        self._exec(
+            "INSERT INTO models(name, version, state, scheduler_cluster_id,"
+            " metrics, data, created_at) VALUES (?,?,'active',?,?,?,?)"
+            " ON CONFLICT(name, version, scheduler_cluster_id) DO UPDATE SET"
+            " metrics=excluded.metrics, state='active'",
+            (name, version, scheduler_cluster_id,
+             json.dumps(metrics or {}), data, _now()))
+        rows = self._rows(
+            "SELECT id FROM models WHERE name=? AND version=?"
+            " AND scheduler_cluster_id=?",
+            (name, version, scheduler_cluster_id))
+        return int(rows[0]["id"])
+
+    def get_model(self, name: str, *, version: str = "",
+                  scheduler_cluster_id: int = 0) -> dict | None:
+        sql = ("SELECT * FROM models WHERE name=? AND state='active'"
+               " AND scheduler_cluster_id IN (0, ?)")
+        args: list = [name, scheduler_cluster_id]
+        if version:
+            sql += " AND version=?"
+            args.append(version)
+        sql += " ORDER BY created_at DESC, id DESC LIMIT 1"
+        rows = self._rows(sql, args)
+        if not rows:
+            return None
+        r = dict(rows[0])
+        r["metrics"] = json.loads(r["metrics"])
+        return r
+
+    def models(self, *, name: str | None = None) -> list[dict]:
+        """Listing without blobs (REST index view)."""
+        sql = ("SELECT id, name, version, state, scheduler_cluster_id,"
+               " metrics, length(data) AS size, created_at FROM models")
+        args: list = []
+        if name:
+            sql += " WHERE name=?"
+            args.append(name)
+        out = []
+        for r in self._rows(sql + " ORDER BY id", args):
+            d = dict(r)
+            d["metrics"] = json.loads(d["metrics"])
+            out.append(d)
+        return out
 
     def jobs(self, *, state: str | None = None) -> list[dict]:
         if state:

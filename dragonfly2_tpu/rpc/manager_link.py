@@ -74,13 +74,14 @@ class ManagerLink:
     # -- keepalive -----------------------------------------------------
 
     def start_keepalive(self, *, source_type: str, hostname: str, ip: str,
-                        cluster_id: int = 0) -> None:
+                        cluster_id: int = 0, port: int = 0) -> None:
         if self._keepalive_task is None:
             self._keepalive_task = asyncio.get_running_loop().create_task(
-                self._keepalive_loop(source_type, hostname, ip, cluster_id))
+                self._keepalive_loop(source_type, hostname, ip, cluster_id,
+                                     port))
 
     async def _keepalive_loop(self, source_type: str, hostname: str, ip: str,
-                              cluster_id: int) -> None:
+                              cluster_id: int, port: int) -> None:
         while True:
             try:
                 stream_started = asyncio.get_running_loop().time()
@@ -89,7 +90,8 @@ class ManagerLink:
                     while True:
                         yield KeepAliveRequest(source_type=source_type,
                                                hostname=hostname, ip=ip,
-                                               cluster_id=cluster_id)
+                                               cluster_id=cluster_id,
+                                               port=port)
                         await asyncio.sleep(self.keepalive_interval_s)
 
                 await self._client().stream_unary("KeepAlive", beats())

@@ -1,0 +1,157 @@
+"""Scheduler announcer: ship records to the trainer, pull models back.
+
+Role parity: reference ``scheduler/announcer/announcer.go:142-235`` — the
+interval loop that gzips download + networktopology datasets and streams
+them to the trainer's ``Train`` RPC. TPU-native addition (the half the
+reference never built): a model-refresh loop that pulls the latest fitted
+``bandwidth_mlp`` from the manager registry and hot-binds it into the
+``ml`` evaluator, so scheduling decisions improve while the scheduler runs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import json
+import logging
+import socket
+
+from ..idl.messages import GetModelRequest, TrainRequest
+from ..rpc.client import Channel, ServiceClient
+from ..trainer.features import MLP_MODEL_NAME
+
+log = logging.getLogger("df.sched.announcer")
+
+TRAINER_SERVICE = "df.trainer.Trainer"
+UPLOAD_CHUNK_BYTES = 1 << 20
+
+
+class SchedulerAnnouncer:
+    """Owned by ``Scheduler``; both loops are optional and independent:
+    records upload needs ``trainer_address``, model refresh needs the
+    manager link + an MLEvaluator to feed."""
+
+    def __init__(self, scheduler, *, upload_interval_s: float = 60.0,
+                 refresh_interval_s: float = 60.0):
+        self.scheduler = scheduler
+        self.upload_interval_s = upload_interval_s
+        self.refresh_interval_s = refresh_interval_s
+        self._tasks: list[asyncio.Task] = []
+        self._trainer_channel: Channel | None = None
+        self.model_version = ""        # currently served version
+
+    def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        if self.scheduler.cfg.trainer_address and \
+                self.scheduler.service.records is not None:
+            self._tasks.append(loop.create_task(self._upload_loop()))
+        if self._evaluator() is not None and self.scheduler.manager is not None:
+            self._tasks.append(loop.create_task(self._refresh_loop()))
+
+    def _evaluator(self):
+        from .evaluator_ml import MLEvaluator
+        ev = self.scheduler.scheduling.evaluator
+        return ev if isinstance(ev, MLEvaluator) else None
+
+    # -- records upload ------------------------------------------------
+
+    def _trainer_client(self) -> ServiceClient:
+        if self._trainer_channel is None:
+            self._trainer_channel = Channel(self.scheduler.cfg.trainer_address)
+        return ServiceClient(self._trainer_channel, TRAINER_SERVICE)
+
+    async def _upload_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.upload_interval_s)
+            try:
+                await self.upload_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - trainer may be away
+                log.debug("records upload failed: %s", exc)
+
+    async def upload_once(self) -> bool:
+        """One gzip'd upload of everything buffered; False if nothing to send.
+        Public so tests/benches can force a cycle without waiting."""
+        records = self.scheduler.service.records
+        rows = records.drain() if records is not None else []
+        topo_rows = self.scheduler.topo.snapshot_rows()
+        if not rows and not topo_rows:
+            return False
+        hostname = socket.gethostname()
+        ip = self.scheduler.cfg.advertise_ip
+        cluster_id = self.scheduler.cfg.cluster_id
+
+        async def chunks():
+            for dataset, payload in (("download", rows),
+                                     ("networktopology", topo_rows)):
+                if not payload:
+                    continue
+                blob = gzip.compress(
+                    "\n".join(json.dumps(r) for r in payload).encode())
+                for off in range(0, len(blob), UPLOAD_CHUNK_BYTES):
+                    yield TrainRequest(
+                        hostname=hostname, ip=ip, cluster_id=cluster_id,
+                        dataset=dataset,
+                        chunk=blob[off:off + UPLOAD_CHUNK_BYTES])
+            yield TrainRequest(hostname=hostname, ip=ip,
+                               cluster_id=cluster_id, dataset="download",
+                               done=True)
+
+        try:
+            resp = await self._trainer_client().stream_unary(
+                "Train", chunks(), timeout=300.0)
+        except Exception:
+            # trainer away: put the interval's rows back so the next cycle
+            # retries instead of silently losing training data
+            if records is not None:
+                records.requeue(rows)
+            raise
+        log.info("records uploaded: %d download + %d topology rows -> %s",
+                 len(rows), len(topo_rows),
+                 resp.model_version or "(no new model)")
+        return True
+
+    # -- model refresh -------------------------------------------------
+
+    async def _refresh_loop(self) -> None:
+        while True:
+            try:
+                await self.refresh_model_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - registry may be away
+                log.debug("model refresh failed: %s", exc)
+            await asyncio.sleep(self.refresh_interval_s)
+
+    async def refresh_model_once(self) -> bool:
+        """Pull the latest model; True if a new version was bound."""
+        evaluator = self._evaluator()
+        if evaluator is None or self.scheduler.manager is None:
+            return False
+        resp = await self.scheduler.manager._unary(
+            "GetModel", GetModelRequest(
+                name=MLP_MODEL_NAME,
+                scheduler_cluster_id=self.scheduler.cfg.cluster_id))
+        model = resp.model
+        if model is None or model.version == self.model_version:
+            return False
+        from ..trainer.serving import make_mlp_infer
+        infer = make_mlp_infer(model.data)
+        evaluator.infer = infer
+        self.model_version = model.version
+        log.info("ml evaluator now serving %s@%s (final_loss=%s)",
+                 model.name, model.version,
+                 (model.metrics or {}).get("final_loss"))
+        return True
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        if self._trainer_channel is not None:
+            await self._trainer_channel.close()

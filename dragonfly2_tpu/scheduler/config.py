@@ -54,5 +54,7 @@ class SchedulerConfig:
     manager_addresses: list[str] = field(default_factory=list)
     trainer_address: str = ""
     keepalive_interval_s: float = 30.0
-    records_dir: str = ""                  # download-record CSVs ("" = workdir)
+    records_dir: str = ""                  # download-record JSONL ("" = memory-only)
+    train_upload_interval_s: float = 60.0  # records -> trainer cadence
+    model_refresh_interval_s: float = 60.0  # manager -> ml evaluator cadence
     workdir: str = ""

@@ -116,7 +116,9 @@ class RTTEvaluator(Evaluator):
 def make_evaluator(algorithm: str, *, topo_store=None, infer=None) -> Evaluator:
     if algorithm == "nt" and topo_store is not None:
         return RTTEvaluator(topo_store)
-    if algorithm == "ml" and infer is not None:
+    if algorithm == "ml":
+        # infer may be None at boot; the model-refresh loop binds it when a
+        # trained version lands (base-score fallback covers the cold start)
         from .evaluator_ml import MLEvaluator
         return MLEvaluator(infer)
     return Evaluator()

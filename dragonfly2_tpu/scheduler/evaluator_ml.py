@@ -2,11 +2,18 @@
 
 Role parity: the slot the reference left as a TODO
 (``scheduler/scheduling/evaluator/evaluator.go:84-86`` falls back to base).
-Completing this loop is BASELINE config #5: the trainer fits the model on
-TPU (``trainer/training.py``) and the scheduler queries it here.
+Completing this loop is BASELINE config #5: records written by
+``scheduler/records.py`` flow to the trainer (``trainer/service.py``), the
+MLP fits on TPU (``trainer/training.py``), the manager versions the result,
+and the scheduler serves it here via ``trainer/serving.py``.
+
+``parent_feature_row`` is the single feature extractor used BOTH at record
+time and at scoring time (layout: ``trainer/features.PARENT_FEATURES``) —
+train/serve skew is a schema violation, not a runtime possibility.
 
 Falls back to the rule-based score whenever inference is unavailable or the
-feature row cannot be built.
+feature row cannot be built; ``infer`` may be (re)bound at runtime as new
+model versions land.
 """
 
 from __future__ import annotations
@@ -18,35 +25,45 @@ from .resource import Peer
 
 log = logging.getLogger("df.sched.eval_ml")
 
+_BASE = Evaluator()
+
+
+def parent_feature_row(child: Peer, parent: Peer, *,
+                       total_piece_count: int) -> list[float]:
+    """Feature layout per ``trainer/features.PARENT_FEATURES`` — keep in sync."""
+    return [
+        _BASE._piece_score(parent, total_piece_count),
+        parent.host.upload_success_ratio(),
+        _BASE._free_upload_score(parent),
+        _BASE._host_type_score(parent),
+        _BASE._locality_score(child, parent),
+        float(len(parent.finished_pieces)),
+        float(parent.host.concurrent_upload_count),
+    ]
+
 
 class MLEvaluator(Evaluator):
-    def __init__(self, infer):
+    def __init__(self, infer=None):
         """``infer(features: list[list[float]]) -> list[float]`` returns a
-        predicted goodness per row (higher = better parent)."""
+        predicted goodness per row (higher = better parent). ``None`` until
+        a model is served; the base score covers the cold start."""
         self.infer = infer
 
     def evaluate(self, child: Peer, parent: Peer, *,
                  total_piece_count: int) -> float:
-        try:
-            row = self.feature_row(child, parent,
-                                   total_piece_count=total_piece_count)
-            out = self.infer([row])
-            if out:
-                return float(out[0])
-        except Exception as exc:  # noqa: BLE001 - model serving is optional
-            log.debug("ml inference failed (%s); using base score", exc)
+        if self.infer is not None:
+            try:
+                row = self.feature_row(child, parent,
+                                       total_piece_count=total_piece_count)
+                out = self.infer([row])
+                if out:
+                    return float(out[0])
+            except Exception as exc:  # noqa: BLE001 - model serving is optional
+                log.debug("ml inference failed (%s); using base score", exc)
         return super().evaluate(child, parent,
                                 total_piece_count=total_piece_count)
 
     def feature_row(self, child: Peer, parent: Peer, *,
                     total_piece_count: int) -> list[float]:
-        """Feature layout shared with ``trainer/features.py`` — keep in sync."""
-        return [
-            self._piece_score(parent, total_piece_count),
-            parent.host.upload_success_ratio(),
-            self._free_upload_score(parent),
-            self._host_type_score(parent),
-            self._locality_score(child, parent),
-            float(len(parent.finished_pieces)),
-            float(parent.host.concurrent_upload_count),
-        ]
+        return parent_feature_row(child, parent,
+                                  total_piece_count=total_piece_count)

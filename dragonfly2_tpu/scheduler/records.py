@@ -1,0 +1,148 @@
+"""Download-record storage: the trainer's dataset, written at report time.
+
+Role parity: reference ``scheduler/storage/storage.go:142`` (CreateDownload
+CSV append with rotation) + the record schemas in
+``scheduler/storage/types.go:30-297``. TPU-native change: rows carry the
+exact ``trainer/features.py`` feature vector computed at piece-report time,
+so the trainer fits on precisely what the ``ml`` evaluator will see at
+scoring time — no train/serve skew (the reference's CSVs logged raw
+entities and left feature extraction to the unfinished trainer).
+
+Rows are JSONL: an in-memory ring for the announcer to drain + an optional
+append-only file with size rotation for post-mortems.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from ..trainer.features import FEATURE_DIM, label_from_cost
+from .evaluator_ml import parent_feature_row
+from .resource import Peer
+
+log = logging.getLogger("df.sched.records")
+
+MAX_BUFFERED_ROWS = 50_000          # ring bound: drop-oldest beyond this
+ROTATE_BYTES = 64 << 20             # rotate download.jsonl past 64 MiB
+
+
+class DownloadRecords:
+    """Implements the ``records`` hook of ``SchedulerService``."""
+
+    def __init__(self, records_dir: str = ""):
+        self.records_dir = records_dir
+        self._rows: list[dict] = []
+        self._peer_rows: list[dict] = []
+        self._file = None
+        self._file_bytes = 0
+        if records_dir:
+            os.makedirs(records_dir, exist_ok=True)
+            self._open_file()
+
+    def _open_file(self) -> None:
+        path = os.path.join(self.records_dir, "download.jsonl")
+        if os.path.exists(path) and os.path.getsize(path) > ROTATE_BYTES:
+            os.replace(path, path + ".1")
+        self._file = open(path, "a", encoding="utf-8")
+        self._file_bytes = self._file.tell()
+
+    # -- hooks called by SchedulerService ------------------------------
+
+    def on_piece(self, peer: Peer, result) -> None:
+        """One row per successful piece fetched from a parent: the features
+        the scheduler saw + the throughput label it observed."""
+        if not result.dst_peer_id or result.piece_info is None:
+            return
+        parent = peer.task.peers.get(result.dst_peer_id)
+        if parent is None:
+            return
+        info = result.piece_info
+        features = parent_feature_row(
+            peer, parent, total_piece_count=peer.task.total_piece_count)
+        row = {
+            "kind": "piece",
+            "task_id": peer.task.id,
+            "peer_id": peer.id,
+            "host_id": peer.host.id,
+            "parent_peer_id": parent.id,
+            "parent_host_id": parent.host.id,
+            "piece_num": info.piece_num,
+            "piece_length": info.range_size,
+            "cost_ms": info.download_cost_ms,
+            "features": features,
+            "label": label_from_cost(info.range_size, info.download_cost_ms),
+            "created_at": time.time(),
+        }
+        self._append(row)
+
+    def on_peer(self, peer: Peer, result) -> None:
+        """Terminal row per peer run (reference Download record: one line
+        per finished download with task/host/parent context)."""
+        row = {
+            "kind": "peer",
+            "task_id": peer.task.id,
+            "peer_id": peer.id,
+            "host_id": peer.host.id,
+            "state": peer.state.value,
+            "success": bool(result.success),
+            "content_length": result.content_length,
+            "total_piece_count": result.total_piece_count,
+            "cost_ms": result.cost_ms,
+            "finished_pieces": len(peer.finished_pieces),
+            "schedule_count": peer.schedule_count,
+            "report_fail_count": peer.report_fail_count,
+            "created_at": time.time(),
+        }
+        self._peer_rows.append(row)
+        if len(self._peer_rows) > MAX_BUFFERED_ROWS:
+            self._peer_rows = self._peer_rows[-MAX_BUFFERED_ROWS:]
+        self._write(row)
+
+    # -- internals -----------------------------------------------------
+
+    def _append(self, row: dict) -> None:
+        self._rows.append(row)
+        if len(self._rows) > MAX_BUFFERED_ROWS:
+            self._rows = self._rows[-MAX_BUFFERED_ROWS:]
+        self._write(row)
+
+    def _write(self, row: dict) -> None:
+        if self._file is None:
+            return
+        line = json.dumps(row) + "\n"
+        self._file.write(line)
+        self._file_bytes += len(line)
+        if self._file_bytes > ROTATE_BYTES:
+            self._file.close()
+            self._open_file()
+
+    # -- consumption ---------------------------------------------------
+
+    def piece_row_count(self) -> int:
+        return len(self._rows)
+
+    def drain(self) -> list[dict]:
+        """Hand all buffered piece+peer rows to the announcer and clear the
+        ring (the file copy, if any, is untouched)."""
+        rows, self._rows = self._rows, []
+        peer_rows, self._peer_rows = self._peer_rows, []
+        return rows + peer_rows
+
+    def requeue(self, rows: list[dict]) -> None:
+        """Return drained rows after a failed upload (oldest first; the
+        ring bound still applies)."""
+        piece = [r for r in rows if r.get("kind") == "piece"]
+        peer = [r for r in rows if r.get("kind") == "peer"]
+        self._rows = (piece + self._rows)[-MAX_BUFFERED_ROWS:]
+        self._peer_rows = (peer + self._peer_rows)[-MAX_BUFFERED_ROWS:]
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+assert FEATURE_DIM == 7  # drift guard: schema changes must touch all parties

@@ -80,6 +80,12 @@ class Host:
     def free_upload_slots(self) -> int:
         return max(0, self.upload_limit - self.concurrent_upload_count)
 
+    def acquire_upload_slot(self) -> None:
+        self.concurrent_upload_count += 1
+
+    def release_upload_slot(self) -> None:
+        self.concurrent_upload_count = max(0, self.concurrent_upload_count - 1)
+
     def touch(self, msg: HostMsg | None = None) -> None:
         if msg is not None:
             self.msg = msg
@@ -157,7 +163,7 @@ class Task:
         self.pieces: dict[int, PieceInfo] = {}   # canonical piece metadata
         self.peers: dict[str, Peer] = {}
         self.dag: DAG[str] = DAG()               # edges parent -> child
-        self.back_source_count = 0
+        self.back_source_peers: set[str] = set()  # peers holding an origin slot
         self.seed_triggered = False
         self.seed_job = None                     # asyncio.Task of the trigger
         self.created_at = time.time()
@@ -209,25 +215,49 @@ class Task:
         self.touch()
 
     def remove_peer(self, peer_id: str) -> None:
-        self.peers.pop(peer_id, None)
-        try:
-            self.dag.delete_vertex(peer_id)
-        except DAGError:
-            pass
+        peer = self.peers.pop(peer_id, None)
+        if peer_id in self.dag:
+            # release upload slots: this peer's parents each lose one child
+            # (their slot), and this peer's host frees one slot per child
+            for pid in self.dag.parents(peer_id):
+                parent = self.peers.get(pid)
+                if parent is not None:
+                    parent.host.release_upload_slot()
+            if peer is not None:
+                for _ in self.dag.children(peer_id):
+                    peer.host.release_upload_slot()
+            try:
+                self.dag.delete_vertex(peer_id)
+            except DAGError:
+                pass
+        self.back_source_peers.discard(peer_id)
         self.touch()
 
     def set_parents(self, child_id: str, parent_ids: list[str]) -> None:
         """Re-point the child's in-edges at the new parent set (re-parenting
-        on reschedule must drop stale edges or the DAG fills with cycles)."""
+        on reschedule must drop stale edges or the DAG fills with cycles).
+        Upload-slot accounting rides the edge changes: one in-flight upload
+        per parent→child edge (reference ``resource/host.go`` accounting)."""
+        old = self.dag.parents(child_id)
         self.dag.delete_in_edges(child_id)
+        new: set[str] = set()
         for pid in parent_ids:
             if pid == child_id or pid not in self.dag:
                 continue
             try:
                 self.dag.add_edge(pid, child_id)
+                new.add(pid)
             except DAGError:
                 log.debug("edge %s->%s would cycle; skipped", pid[-12:],
                           child_id[-12:])
+        for pid in old - new:
+            parent = self.peers.get(pid)
+            if parent is not None:
+                parent.host.release_upload_slot()
+        for pid in new - old:
+            parent = self.peers.get(pid)
+            if parent is not None:
+                parent.host.acquire_upload_slot()
 
     def would_cycle(self, parent_id: str, child_id: str) -> bool:
         return self.dag.can_reach(child_id, parent_id)

@@ -33,9 +33,13 @@ class Scheduler:
                                    infer=infer)
         self.scheduling = Scheduling(cfg, evaluator)
         self.seed_client = SeedPeerClient(self.resource, cfg.seed_peers)
+        if records is None and (cfg.records_dir or cfg.trainer_address):
+            from .records import DownloadRecords
+            records = DownloadRecords(cfg.records_dir)
         self.service = SchedulerService(cfg, self.resource, self.scheduling,
                                         self.seed_client, self.topo,
                                         records=records)
+        self.announcer = None
         self.rpc: RPCServer | None = None
         self.gc = GC()
         self.port: int | None = None
@@ -55,6 +59,12 @@ class Scheduler:
         self.gc.add(GCTask("resource", self.cfg.gc_interval_s,
                            self.resource.gc))
         self.gc.start()
+        # records → trainer upload + model → evaluator refresh (ML loop)
+        from .announcer import SchedulerAnnouncer
+        self.announcer = SchedulerAnnouncer(
+            self, upload_interval_s=self.cfg.train_upload_interval_s,
+            refresh_interval_s=self.cfg.model_refresh_interval_s)
+        self.announcer.start()
         log.info("scheduler up on %s (cluster=%d, algorithm=%s, seeds=%d)",
                  self.address, self.cfg.cluster_id, self.cfg.algorithm,
                  len(self.seed_client.seed_peers))
@@ -83,7 +93,8 @@ class Scheduler:
             self.manager.start_keepalive(source_type="scheduler",
                                          hostname=hostname,
                                          ip=self.cfg.advertise_ip,
-                                         cluster_id=self.cfg.cluster_id)
+                                         cluster_id=self.cfg.cluster_id,
+                                         port=self.port)
             if not self.cfg.seed_peers:
                 resp = await self.manager.get_seed_peers()
                 seeds = [SeedPeerAddr(host_id=f"{e.hostname}-{e.ip}",
@@ -97,6 +108,10 @@ class Scheduler:
             log.warning("manager attach failed (%s); running standalone", exc)
 
     async def stop(self) -> None:
+        if self.announcer is not None:
+            await self.announcer.stop()
+        if self.service.records is not None:
+            self.service.records.close()
         if getattr(self, "manager", None) is not None:
             await self.manager.close()
         await self.gc.stop()

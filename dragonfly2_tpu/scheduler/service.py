@@ -196,15 +196,21 @@ class SchedulerService:
 
     def _rule_back_source(self, peer: Peer) -> PeerPacket | None:
         task = peer.task
-        if task.back_source_count >= self.cfg.back_source_concurrent:
+        if len(task.back_source_peers) >= self.cfg.back_source_concurrent:
             _schedules.labels("busy").inc()
             return PeerPacket(task_id=task.id, src_peer_id=peer.id,
                               code=int(Code.SCHED_TASK_STATUS_ERROR))
-        task.back_source_count += 1
         try:
             peer.transit(PeerState.BACK_SOURCE)
         except DFError:
             return None
+        # slot held only while the peer is actively back-sourcing; released
+        # on its terminal peer result or departure so a failed origin fetch
+        # cannot permanently exhaust back_source_concurrent
+        task.back_source_peers.add(peer.id)
+        # no longer fetching from parents: free their upload slots
+        task.set_parents(peer.id, [])
+        peer.last_offer_ids = set()
         _schedules.labels("back_source").inc()
         return PeerPacket(task_id=task.id, src_peer_id=peer.id,
                           code=int(Code.SCHED_NEED_BACK_SOURCE))
@@ -288,6 +294,7 @@ class SchedulerService:
         if peer is None:
             return Empty()
         task = peer.task
+        task.back_source_peers.discard(peer.id)
         if result.success:
             task.set_content_info(result.content_length, 0,
                                   result.total_piece_count)
@@ -298,6 +305,11 @@ class SchedulerService:
         else:
             if not peer.is_done():
                 peer.transit(PeerState.FAILED)
+        # download over: drop the child's in-edges so its parents' upload
+        # slots free up for other children (the DAG keeps the peer as a
+        # piece-holder vertex — only the active-transfer edges go)
+        task.set_parents(peer.id, [])
+        peer.last_offer_ids = set()
         if self.records is not None:
             self.records.on_peer(peer, result)
         return Empty()

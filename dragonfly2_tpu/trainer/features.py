@@ -1,0 +1,139 @@
+"""Shared feature schema: scheduler records → model tensors.
+
+Role parity: reference ``scheduler/storage/types.go:30-297`` defines the
+download-record schema the trainer consumes; the reference never finished
+the consuming side (``trainer/training/training.go:80-97`` stubs). Here the
+schema is the contract between three parties, kept in one module:
+
+* ``scheduler/records.py`` writes rows with ``PARENT_FEATURES`` +
+  ``label_from_cost`` labels at piece-report time;
+* ``scheduler/evaluator_ml.py`` builds the identical row at scoring time
+  (``MLEvaluator.feature_row`` delegates here);
+* this module turns accumulated rows into dense numpy arrays for
+  ``trainer/models.py`` (MLP) and topology snapshots into padded graph
+  batches (GNN).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+# Feature layout for one (child, parent) candidate row. Any change here is
+# a model-version bump: the scheduler refuses models whose feature_dim
+# doesn't match (see trainer/training.py metadata).
+# Registry names (numpy-only module so the scheduler can import them
+# without dragging jax/optax into its process)
+MLP_MODEL_NAME = "bandwidth_mlp"
+GNN_MODEL_NAME = "topology_gnn"
+
+PARENT_FEATURES = (
+    "piece_score",            # parent finished pieces / total
+    "upload_success_ratio",   # parent host historical upload success
+    "free_upload_score",      # free slots / limit on parent host
+    "host_type_score",        # seed classes rank above normal peers
+    "locality_score",         # LOCAL > ICI > DCN > WAN (tpu/topology.py)
+    "finished_pieces",        # absolute piece count held by parent
+    "concurrent_uploads",     # in-flight uploads on parent host
+)
+FEATURE_DIM = len(PARENT_FEATURES)
+
+# GNN graph schema: nodes = hosts, edges = probed (src, dst) links.
+NODE_FEATURES = ("host_type", "upload_ratio", "upload_load", "slice_id",
+                 "coord_x", "coord_y")
+EDGE_FEATURES = ("log_rtt", "link_class")
+
+# Pad edge lists to the next bucket so XLA recompiles only on bucket growth
+# (static shapes; SURVEY §7 "emulating a pod in CI" note applies to shapes
+# too — dynamic shapes would retrace per report).
+_EDGE_BUCKETS = (32, 128, 512, 2048, 8192)
+_NODE_BUCKETS = (16, 64, 256, 1024)
+
+
+def label_from_cost(piece_length: int, cost_ms: float) -> float:
+    """Observed goodness of a parent from one piece download.
+
+    Bounded (0, 1]: log-throughput squashed so the MLP regresses a target
+    in the same range as the rule-based score it replaces. 4 MiB in 40 ms
+    (~100 MB/s) ≈ 0.62; 4 MiB in 4 ms (1 GB/s, ICI-class) ≈ 0.78; stalls
+    (<1 MB/s) fall below 0.3.
+    """
+    mbps = (piece_length / 1e6) / (max(cost_ms, 0.1) / 1e3)
+    return 1.0 / (1.0 + math.exp(-0.7 * (math.log10(max(mbps, 1e-3)) - 0.5)))
+
+
+def records_to_arrays(rows: list[dict]) -> dict[str, np.ndarray] | None:
+    """Download-record rows → {"x": [N, FEATURE_DIM] f32, "y": [N] f32}.
+
+    Rows missing features (back-source records have no parent) are skipped.
+    """
+    xs, ys = [], []
+    for row in rows:
+        feats = row.get("features")
+        label = row.get("label")
+        if feats is None or label is None or len(feats) != FEATURE_DIM:
+            continue
+        xs.append(feats)
+        ys.append(label)
+    if not xs:
+        return None
+    return {"x": np.asarray(xs, np.float32), "y": np.asarray(ys, np.float32)}
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def _node_row(host_row: dict) -> list[float]:
+    return [float(host_row.get("host_type", 0.5)),
+            float(host_row.get("upload_ratio", 1.0)),
+            float(host_row.get("upload_load", 0.0)),
+            float(host_row.get("slice_id", -1)),
+            float(host_row.get("coord_x", -1)),
+            float(host_row.get("coord_y", -1))]
+
+
+def topology_to_graph(topo_rows: list[dict],
+                      host_rows: dict[str, dict] | None = None
+                      ) -> dict[str, np.ndarray] | None:
+    """Topology snapshot rows → padded GNN batch.
+
+    topo_rows: ``TopologyStore.snapshot_rows()`` dicts (src, dst,
+    avg_rtt_us, count). host_rows: optional per-host feature dicts keyed by
+    host id. Label = observed inverse log-RTT (bandwidth proxy) — the GNN
+    learns to impute it for unprobed links.
+    """
+    if not topo_rows:
+        return None
+    ids: list[str] = []
+    index: dict[str, int] = {}
+    for row in topo_rows:
+        for hid in (row["src"], row["dst"]):
+            if hid not in index:
+                index[hid] = len(ids)
+                ids.append(hid)
+    n_pad = _bucket(len(ids), _NODE_BUCKETS)
+    e_pad = _bucket(len(topo_rows), _EDGE_BUCKETS)
+    nodes = np.zeros((n_pad, len(NODE_FEATURES)), np.float32)
+    for hid, i in index.items():
+        nodes[i] = _node_row((host_rows or {}).get(hid, {}))
+    edge_src = np.zeros((e_pad,), np.int32)
+    edge_dst = np.zeros((e_pad,), np.int32)
+    edge_feat = np.zeros((e_pad, len(EDGE_FEATURES)), np.float32)
+    edge_mask = np.zeros((e_pad,), np.float32)
+    y = np.zeros((e_pad,), np.float32)
+    for e, row in enumerate(topo_rows[:e_pad]):
+        edge_src[e] = index[row["src"]]
+        edge_dst[e] = index[row["dst"]]
+        log_rtt = math.log10(max(float(row["avg_rtt_us"]), 1.0))
+        edge_feat[e] = (log_rtt, float(row.get("link_class", 0.0)))
+        edge_mask[e] = 1.0
+        # bandwidth proxy: 10us (ICI) -> ~1.0, 10ms (DCN/WAN) -> ~0.2
+        y[e] = 1.0 / (1.0 + max(0.0, log_rtt - 1.0))
+    return {"nodes": nodes, "edge_src": edge_src, "edge_dst": edge_dst,
+            "edge_feat": edge_feat, "edge_mask": edge_mask, "y": y,
+            "host_ids": np.asarray(ids)}

@@ -1,0 +1,132 @@
+"""Trainer gRPC service: dataset sink + training kick + parity inference.
+
+Role parity: reference ``trainer/service/service_v1.go:59-162`` — the
+``Train`` client-stream receives gzip'd datasets keyed by (hostname, ip),
+lands them in ``trainer/storage``, and on stream close kicks a training
+run. The reference stopped there (fitting was a stub and the model never
+reached the manager); here the run fits the JAX models
+(``trainer/training.py``) and registers the result with the manager's model
+registry, closing BASELINE config #5.
+
+``ModelInfer`` serves the latest fitted MLP for parity with the reference's
+Triton client surface (``pkg/rpc/inference``); production scoring pulls the
+model into the scheduler instead (see ``trainer/serving.py`` rationale).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..common.errors import Code, DFError
+from ..idl.messages import (CreateModelRequest, ModelInferRequest,
+                            ModelInferResponse, TrainResponse)
+from ..rpc.server import ServiceDef
+from . import serving, training
+from .storage import TrainerStorage
+
+log = logging.getLogger("df.trainer.service")
+
+TRAINER_SERVICE = "df.trainer.Trainer"
+
+
+class TrainerService:
+    def __init__(self, storage: TrainerStorage, *, manager=None,
+                 min_rows: int = 32, train_in_thread: bool = True):
+        """``manager``: a ManagerLink used to register fitted models; None
+        keeps models local (tests, standalone runs)."""
+        self.storage = storage
+        self.manager = manager
+        self.min_rows = min_rows
+        self.train_in_thread = train_in_thread
+        self.latest: dict[str, tuple[bytes, dict]] = {}   # name -> (blob, metrics)
+        self._train_lock = asyncio.Lock()
+
+    # -- Train (client-stream) -----------------------------------------
+
+    async def train(self, request_iter, context) -> TrainResponse:
+        # one gzip stream per dataset may span many chunks — buffer until
+        # the stream ends, then decompress whole (a sliced gzip stream is
+        # not independently decompressible)
+        bufs: dict[str, bytearray] = {}
+        uploader = ("", "")
+        cluster_id = 0
+        async for req in request_iter:
+            if not req.dataset:
+                raise DFError(Code.INVALID_ARGUMENT, "dataset required")
+            uploader = (req.hostname, req.ip)
+            cluster_id = req.cluster_id or cluster_id
+            if req.chunk:
+                bufs.setdefault(req.dataset, bytearray()).extend(req.chunk)
+        got: dict[str, int] = {}
+        for dataset, buf in bufs.items():
+            got[dataset] = await asyncio.to_thread(
+                self.storage.append_chunk, dataset, uploader[0],
+                uploader[1], bytes(buf))
+        log.info("dataset upload from %s@%s (cluster %d): %s", uploader[0],
+                 uploader[1], cluster_id, got or "empty")
+        version = await self._maybe_train(cluster_id)
+        return TrainResponse(ok=True, model_version=version,
+                             message=f"rows={got}")
+
+    async def _maybe_train(self, cluster_id: int = 0) -> str:
+        async with self._train_lock:
+            rows = await asyncio.to_thread(self.storage.rows, "download")
+            topo_rows = await asyncio.to_thread(self.storage.rows,
+                                                "networktopology")
+            if len(rows) < self.min_rows and len(topo_rows) < 4:
+                return ""
+            version = ""
+            if self.train_in_thread:
+                mlp = await asyncio.to_thread(training.train_mlp, rows)
+                gnn = await asyncio.to_thread(training.train_gnn, topo_rows)
+            else:
+                mlp = training.train_mlp(rows)
+                gnn = training.train_gnn(topo_rows)
+            for name, fitted in ((training.MLP_MODEL_NAME, mlp),
+                                 (training.GNN_MODEL_NAME, gnn)):
+                if fitted is None:
+                    continue
+                blob, metrics = fitted
+                self.latest[name] = (blob, metrics)
+                version = metrics["version"]
+                await self._publish(name, blob, metrics, cluster_id)
+            if mlp is not None:
+                # consumed: a new upload cycle starts a fresh dataset
+                await asyncio.to_thread(self.storage.clear, "download")
+            if gnn is not None:
+                await asyncio.to_thread(self.storage.clear, "networktopology")
+            return version
+
+    async def _publish(self, name: str, blob: bytes, metrics: dict,
+                       cluster_id: int) -> None:
+        if self.manager is None:
+            return
+        try:
+            await self.manager._unary("CreateModel", CreateModelRequest(
+                name=name, version=metrics["version"], data=blob,
+                metrics=metrics, scheduler_cluster_id=cluster_id))
+        except Exception as exc:  # noqa: BLE001 - registry may be down
+            log.warning("model %s@%s not registered: %s", name,
+                        metrics["version"], exc)
+
+    # -- ModelInfer (parity surface) -----------------------------------
+
+    async def model_infer(self, req: ModelInferRequest,
+                          context) -> ModelInferResponse:
+        fitted = self.latest.get(req.model_name or training.MLP_MODEL_NAME)
+        if fitted is None:
+            raise DFError(Code.NOT_FOUND,
+                          f"no trained model {req.model_name!r}")
+        blob, metrics = fitted
+        infer = serving.make_mlp_infer(blob)
+        outputs = await asyncio.to_thread(infer, req.features or [])
+        return ModelInferResponse(outputs=outputs,
+                                  model_version=metrics["version"])
+
+
+def build_service(svc: TrainerService) -> ServiceDef:
+    d = ServiceDef(TRAINER_SERVICE)
+    d.stream_unary("Train", svc.train)
+    d.unary_unary("ModelInfer", svc.model_infer)
+    return d

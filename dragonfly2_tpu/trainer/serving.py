@@ -1,0 +1,63 @@
+"""Model serving: turn a registered model blob into an ``infer`` callable.
+
+Role parity: reference ``pkg/rpc/inference/client/client_v1.go:76-102`` — a
+Triton ``ModelInfer`` client intended for the ``ml`` evaluator but unused
+in-tree. TPU-native change: the evaluator scores a handful of candidates
+per schedule tick, thousands of times a second — an RPC per tick would
+dominate scheduling latency. So models are *pulled* from the manager
+registry and served in-process with a pure-numpy forward pass (the jax/TPU
+side is training-only); the trainer also exposes a ``ModelInfer`` RPC for
+parity and tests (``trainer/service.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+import numpy as np
+
+from . import features, params_io
+
+log = logging.getLogger("df.trainer.serving")
+
+Infer = Callable[[list[list[float]]], list[float]]
+
+
+def _gelu(x: np.ndarray) -> np.ndarray:
+    # tanh approximation — matches jax.nn.gelu's default closely enough for
+    # a ranking model (monotone, max abs diff ~1e-3)
+    return 0.5 * x * (1.0 + np.tanh(0.7978845608 * (x + 0.044715 * x ** 3)))
+
+
+def mlp_forward_np(params: dict, x: np.ndarray) -> np.ndarray:
+    h = x.astype(np.float32)
+    layers = params["layers"]
+    for layer in layers[:-1]:
+        h = _gelu(h @ layer["w"] + layer["b"])
+    out = h @ layers[-1]["w"] + layers[-1]["b"]
+    return out[..., 0]
+
+
+def make_mlp_infer(model_bytes: bytes) -> Infer:
+    """Deserialize a ``bandwidth_mlp`` blob into ``infer(rows) -> scores``.
+
+    Raises ValueError on feature-schema mismatch — the scheduler must not
+    score with a model trained on a different layout.
+    """
+    params, meta = params_io.deserialize_params(model_bytes)
+    dim = int(meta.get("feature_dim", features.FEATURE_DIM))
+    if dim != features.FEATURE_DIM:
+        raise ValueError(
+            f"model feature_dim {dim} != scheduler {features.FEATURE_DIM}")
+    version = meta.get("version", params_io.version_of(model_bytes))
+
+    def infer(rows: list[list[float]]) -> list[float]:
+        x = np.asarray(rows, np.float32)
+        if x.ndim != 2 or x.shape[1] != dim:
+            raise ValueError(f"expected [n, {dim}] features, got {x.shape}")
+        return mlp_forward_np(params, x).tolist()
+
+    infer.version = version          # type: ignore[attr-defined]
+    infer.meta = meta                # type: ignore[attr-defined]
+    return infer

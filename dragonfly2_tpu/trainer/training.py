@@ -1,0 +1,152 @@
+"""Training runs: fit the MLP/GNN on uploaded scheduler records.
+
+Role parity: reference ``trainer/training/training.go:60-97`` — the
+pipeline exists there, the fitting is a TODO stub. This module completes
+it: minibatch adamw over the fused ``sharded_train_step`` from
+``trainer/models.py`` (dp×tp mesh when >1 device; single-device jit
+otherwise), with model serialization + content-addressed versioning for the
+manager registry (reference ``manager/models/model.go:36``).
+
+Serialization is npz (numpy archive) of the flattened param pytree — no
+pickle; the scheduler's serving side (``trainer/serving.py``) reloads it
+with plain numpy and never needs jax on the hot path.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from . import features, models
+from .params_io import serialize_params, version_of  # noqa: F401 - re-export
+
+log = logging.getLogger("df.trainer.training")
+
+MLP_MODEL_NAME = features.MLP_MODEL_NAME
+GNN_MODEL_NAME = features.GNN_MODEL_NAME
+
+
+# ---------------------------------------------------------------- fitting
+
+def _make_step(loss_fn, opt, mesh):
+    if mesh is not None and mesh.devices.size > 1:
+        return models.sharded_train_step(loss_fn, opt, mesh)
+    import jax
+    return jax.jit(models.make_train_step(loss_fn, opt))
+
+
+def train_mlp(rows: list[dict], *, epochs: int = 40, batch_size: int = 512,
+              lr: float = 1e-3, seed: int = 0,
+              use_mesh: bool = True) -> tuple[bytes, dict] | None:
+    """Fit the parent-goodness MLP on download-record rows.
+
+    Returns (model_bytes, metrics) or None when the rows hold no usable
+    feature/label pairs. Batch dp-sharded + weights tp-sharded when more
+    than one device is visible.
+    """
+    import jax
+
+    data = features.records_to_arrays(rows)
+    if data is None or data["x"].shape[0] < 8:
+        return None
+    n = data["x"].shape[0]
+    rng = np.random.default_rng(seed)
+    key = jax.random.PRNGKey(seed)
+    params = models.init_mlp(key)
+    opt = models.make_optimizer(lr)
+    mesh = models.make_mesh() if use_mesh and len(jax.devices()) > 1 else None
+    if mesh is not None:
+        params = models.shard_params(params, mesh)
+    opt_state = opt.init(params)
+    step = _make_step(models.mlp_loss, opt, mesh)
+
+    bs = min(batch_size, n)
+    # static batch shape: pad the epoch to a multiple of bs via wraparound
+    steps_per_epoch = max(1, n // bs)
+    first_loss = last_loss = None
+    t0 = time.monotonic()
+    for _ in range(epochs):
+        order = rng.permutation(n)
+        for s in range(steps_per_epoch):
+            idx = order[(s * bs) % n:(s * bs) % n + bs]
+            if idx.size < bs:
+                idx = np.concatenate([idx, order[:bs - idx.size]])
+            batch = {"x": data["x"][idx], "y": data["y"][idx]}
+            if mesh is not None:
+                batch = models.shard_batch(batch, mesh)
+            params, opt_state, loss = step(params, opt_state, batch)
+        loss_f = float(loss)
+        if first_loss is None:
+            first_loss = loss_f
+        last_loss = loss_f
+    metrics = {
+        "model": MLP_MODEL_NAME,
+        "rows": int(n),
+        "epochs": epochs,
+        "first_epoch_loss": first_loss,
+        "final_loss": last_loss,
+        "train_seconds": time.monotonic() - t0,
+        "feature_dim": features.FEATURE_DIM,
+        "feature_names": list(features.PARENT_FEATURES),
+        "devices": len(jax.devices()),
+    }
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    data_bytes = serialize_params(host_params, metrics)
+    metrics["version"] = version_of(data_bytes)
+    log.info("mlp fit: rows=%d loss %.4f -> %.4f (%.1fs, %d devices)",
+             n, first_loss, last_loss, metrics["train_seconds"],
+             metrics["devices"])
+    return data_bytes, metrics
+
+
+def train_gnn(topo_rows: list[dict], *, epochs: int = 60, lr: float = 1e-3,
+              seed: int = 0, use_mesh: bool = True
+              ) -> tuple[bytes, dict] | None:
+    """Fit the host-graph GNN on topology snapshot rows (bandwidth
+    imputation for unprobed links)."""
+    import jax
+
+    graph = features.topology_to_graph(topo_rows)
+    if graph is None or float(graph["edge_mask"].sum()) < 4:
+        return None
+    batch = {k: v for k, v in graph.items() if k != "host_ids"}
+    key = jax.random.PRNGKey(seed)
+    params = models.init_gnn(key)
+    opt = models.make_optimizer(lr)
+    mesh = models.make_mesh() if use_mesh and len(jax.devices()) > 1 else None
+    if mesh is not None:
+        params = models.shard_params(params, mesh)
+        # graph batches replicate (node/edge dims aren't batch dims)
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        batch = {k: _jax.device_put(v, NamedSharding(mesh, P()))
+                 for k, v in batch.items()}
+    opt_state = opt.init(params)
+    step = _make_step(models.gnn_loss, opt, mesh)
+    first_loss = last_loss = None
+    t0 = time.monotonic()
+    for _ in range(epochs):
+        params, opt_state, loss = step(params, opt_state, batch)
+        loss_f = float(loss)
+        if first_loss is None:
+            first_loss = loss_f
+        last_loss = loss_f
+    metrics = {
+        "model": GNN_MODEL_NAME,
+        "edges": int(graph["edge_mask"].sum()),
+        "nodes": int(len(graph["host_ids"])),
+        "epochs": epochs,
+        "first_epoch_loss": first_loss,
+        "final_loss": last_loss,
+        "train_seconds": time.monotonic() - t0,
+        "devices": len(jax.devices()),
+    }
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    data_bytes = serialize_params(host_params, metrics)
+    metrics["version"] = version_of(data_bytes)
+    log.info("gnn fit: edges=%d loss %.4f -> %.4f (%.1fs)",
+             metrics["edges"], first_loss, last_loss,
+             metrics["train_seconds"])
+    return data_bytes, metrics

@@ -589,6 +589,8 @@ class GetModelRequest:
     name: str = ""
     version: str = ""               # "" = latest active version
     scheduler_cluster_id: int = 0
+    if_none_match: str = ""         # client's current version: matching
+                                    # reply omits the blob (poll cheaply)
 
 
 @message

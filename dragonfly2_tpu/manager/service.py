@@ -98,11 +98,14 @@ class ManagerService:
                 scheduler_cluster_id=req.scheduler_cluster_id))
         if row is None:
             return GetModelResponse(model=None)
+        unchanged = bool(req.if_none_match
+                         and row["version"] == req.if_none_match)
         return GetModelResponse(model=ModelEntity(
             id=row["id"], name=row["name"], version=row["version"],
             state=row["state"],
             scheduler_cluster_id=row["scheduler_cluster_id"],
-            metrics=row["metrics"], data=row["data"],
+            metrics=row["metrics"],
+            data=b"" if unchanged else row["data"],
             created_at=row["created_at"]))
 
     async def keep_alive(self, request_iter, context) -> Empty:

@@ -71,6 +71,12 @@ class ManagerLink:
         return await self._unary(
             "GetSeedPeers", GetSeedPeersRequest(cluster_id=cluster_id))
 
+    async def create_model(self, req) -> None:
+        await self._unary("CreateModel", req, timeout=60.0)
+
+    async def get_model(self, req):
+        return await self._unary("GetModel", req, timeout=60.0)
+
     # -- keepalive -----------------------------------------------------
 
     def start_keepalive(self, *, source_type: str, hostname: str, ip: str,

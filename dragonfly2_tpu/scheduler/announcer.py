@@ -82,13 +82,19 @@ class SchedulerAnnouncer:
         ip = self.scheduler.cfg.advertise_ip
         cluster_id = self.scheduler.cfg.cluster_id
 
+        def compress(payload):
+            return gzip.compress(
+                "\n".join(json.dumps(r) for r in payload).encode())
+
+        # serialize+compress off the event loop — tens of MB of JSON inline
+        # would stall every scheduling RPC for the duration
+        blobs = {dataset: await asyncio.to_thread(compress, payload)
+                 for dataset, payload in (("download", rows),
+                                          ("networktopology", topo_rows))
+                 if payload}
+
         async def chunks():
-            for dataset, payload in (("download", rows),
-                                     ("networktopology", topo_rows)):
-                if not payload:
-                    continue
-                blob = gzip.compress(
-                    "\n".join(json.dumps(r) for r in payload).encode())
+            for dataset, blob in blobs.items():
                 for off in range(0, len(blob), UPLOAD_CHUNK_BYTES):
                     yield TrainRequest(
                         hostname=hostname, ip=ip, cluster_id=cluster_id,
@@ -129,12 +135,13 @@ class SchedulerAnnouncer:
         evaluator = self._evaluator()
         if evaluator is None or self.scheduler.manager is None:
             return False
-        resp = await self.scheduler.manager._unary(
-            "GetModel", GetModelRequest(
-                name=MLP_MODEL_NAME,
-                scheduler_cluster_id=self.scheduler.cfg.cluster_id))
+        resp = await self.scheduler.manager.get_model(GetModelRequest(
+            name=MLP_MODEL_NAME,
+            scheduler_cluster_id=self.scheduler.cfg.cluster_id,
+            if_none_match=self.model_version))
         model = resp.model
-        if model is None or model.version == self.model_version:
+        if model is None or model.version == self.model_version \
+                or not model.data:
             return False
         from ..trainer.serving import make_mlp_infer
         infer = make_mlp_infer(model.data)

@@ -16,9 +16,12 @@ schema is the contract between three parties, kept in one module:
 
 from __future__ import annotations
 
+import logging
 import math
 
 import numpy as np
+
+_log = logging.getLogger("df.trainer.features")
 
 # Feature layout for one (child, parent) candidate row. Any change here is
 # a model-version bump: the scheduler refuses models whose feature_dim
@@ -117,10 +120,24 @@ def topology_to_graph(topo_rows: list[dict],
                 index[hid] = len(ids)
                 ids.append(hid)
     n_pad = _bucket(len(ids), _NODE_BUCKETS)
+    if len(ids) > n_pad:
+        # beyond the largest bucket: keep edges whose hosts fit, drop the
+        # rest loudly (no silent caps)
+        kept = [r for r in topo_rows
+                if index[r["src"]] < n_pad and index[r["dst"]] < n_pad]
+        _log.warning("topology graph truncated: %d hosts > bucket %d; "
+                     "%d/%d edges kept", len(ids), n_pad, len(kept),
+                     len(topo_rows))
+        topo_rows = kept
+        ids = ids[:n_pad]
     e_pad = _bucket(len(topo_rows), _EDGE_BUCKETS)
+    if len(topo_rows) > e_pad:
+        _log.warning("topology graph truncated: %d edges > bucket %d",
+                     len(topo_rows), e_pad)
     nodes = np.zeros((n_pad, len(NODE_FEATURES)), np.float32)
     for hid, i in index.items():
-        nodes[i] = _node_row((host_rows or {}).get(hid, {}))
+        if i < n_pad:
+            nodes[i] = _node_row((host_rows or {}).get(hid, {}))
     edge_src = np.zeros((e_pad,), np.int32)
     edge_dst = np.zeros((e_pad,), np.int32)
     edge_feat = np.zeros((e_pad, len(EDGE_FEATURES)), np.float32)

@@ -40,6 +40,7 @@ class TrainerService:
         self.min_rows = min_rows
         self.train_in_thread = train_in_thread
         self.latest: dict[str, tuple[bytes, dict]] = {}   # name -> (blob, metrics)
+        self._infer_cache: dict[str, object] = {}         # name -> callable
         self._train_lock = asyncio.Lock()
 
     # -- Train (client-stream) -----------------------------------------
@@ -58,52 +59,59 @@ class TrainerService:
             cluster_id = req.cluster_id or cluster_id
             if req.chunk:
                 bufs.setdefault(req.dataset, bytearray()).extend(req.chunk)
-        got: dict[str, int] = {}
-        for dataset, buf in bufs.items():
-            got[dataset] = await asyncio.to_thread(
-                self.storage.append_chunk, dataset, uploader[0],
-                uploader[1], bytes(buf))
-        log.info("dataset upload from %s@%s (cluster %d): %s", uploader[0],
-                 uploader[1], cluster_id, got or "empty")
-        version = await self._maybe_train(cluster_id)
+        # spool-append and train-and-clear run under one lock: a concurrent
+        # stream's fresh rows must never be deleted by another stream's
+        # clear() before they were ever trained on
+        async with self._train_lock:
+            got: dict[str, int] = {}
+            for dataset, buf in bufs.items():
+                got[dataset] = await asyncio.to_thread(
+                    self.storage.append_chunk, dataset, uploader[0],
+                    uploader[1], bytes(buf))
+            log.info("dataset upload from %s@%s (cluster %d): %s",
+                     uploader[0], uploader[1], cluster_id, got or "empty")
+            version = await self._maybe_train(cluster_id)
         return TrainResponse(ok=True, model_version=version,
                              message=f"rows={got}")
 
     async def _maybe_train(self, cluster_id: int = 0) -> str:
-        async with self._train_lock:
-            rows = await asyncio.to_thread(self.storage.rows, "download")
-            topo_rows = await asyncio.to_thread(self.storage.rows,
-                                                "networktopology")
-            if len(rows) < self.min_rows and len(topo_rows) < 4:
-                return ""
-            version = ""
-            if self.train_in_thread:
-                mlp = await asyncio.to_thread(training.train_mlp, rows)
-                gnn = await asyncio.to_thread(training.train_gnn, topo_rows)
-            else:
-                mlp = training.train_mlp(rows)
-                gnn = training.train_gnn(topo_rows)
-            for name, fitted in ((training.MLP_MODEL_NAME, mlp),
-                                 (training.GNN_MODEL_NAME, gnn)):
-                if fitted is None:
-                    continue
-                blob, metrics = fitted
-                self.latest[name] = (blob, metrics)
-                version = metrics["version"]
-                await self._publish(name, blob, metrics, cluster_id)
-            if mlp is not None:
-                # consumed: a new upload cycle starts a fresh dataset
-                await asyncio.to_thread(self.storage.clear, "download")
-            if gnn is not None:
-                await asyncio.to_thread(self.storage.clear, "networktopology")
-            return version
+        """Fit on the spooled datasets (caller holds ``_train_lock``).
+        Returns the MLP version (the one schedulers serve); falls back to
+        the GNN's when only the GNN fit."""
+        rows = await asyncio.to_thread(self.storage.rows, "download")
+        topo_rows = await asyncio.to_thread(self.storage.rows,
+                                            "networktopology")
+        if len(rows) < self.min_rows and len(topo_rows) < 4:
+            return ""
+        if self.train_in_thread:
+            mlp = await asyncio.to_thread(training.train_mlp, rows)
+            gnn = await asyncio.to_thread(training.train_gnn, topo_rows)
+        else:
+            mlp = training.train_mlp(rows)
+            gnn = training.train_gnn(topo_rows)
+        for name, fitted in ((training.MLP_MODEL_NAME, mlp),
+                             (training.GNN_MODEL_NAME, gnn)):
+            if fitted is None:
+                continue
+            blob, metrics = fitted
+            self.latest[name] = (blob, metrics)
+            self._infer_cache.pop(name, None)
+            await self._publish(name, blob, metrics, cluster_id)
+        if mlp is not None:
+            # consumed: a new upload cycle starts a fresh dataset
+            await asyncio.to_thread(self.storage.clear, "download")
+        if gnn is not None:
+            await asyncio.to_thread(self.storage.clear, "networktopology")
+        if mlp is not None:
+            return mlp[1]["version"]
+        return gnn[1]["version"] if gnn is not None else ""
 
     async def _publish(self, name: str, blob: bytes, metrics: dict,
                        cluster_id: int) -> None:
         if self.manager is None:
             return
         try:
-            await self.manager._unary("CreateModel", CreateModelRequest(
+            await self.manager.create_model(CreateModelRequest(
                 name=name, version=metrics["version"], data=blob,
                 metrics=metrics, scheduler_cluster_id=cluster_id))
         except Exception as exc:  # noqa: BLE001 - registry may be down
@@ -114,12 +122,15 @@ class TrainerService:
 
     async def model_infer(self, req: ModelInferRequest,
                           context) -> ModelInferResponse:
-        fitted = self.latest.get(req.model_name or training.MLP_MODEL_NAME)
+        name = req.model_name or training.MLP_MODEL_NAME
+        fitted = self.latest.get(name)
         if fitted is None:
-            raise DFError(Code.NOT_FOUND,
-                          f"no trained model {req.model_name!r}")
+            raise DFError(Code.NOT_FOUND, f"no trained model {name!r}")
         blob, metrics = fitted
-        infer = serving.make_mlp_infer(blob)
+        infer = self._infer_cache.get(name)
+        if infer is None:
+            infer = serving.make_mlp_infer(blob)
+            self._infer_cache[name] = infer
         outputs = await asyncio.to_thread(infer, req.features or [])
         return ModelInferResponse(outputs=outputs,
                                   model_version=metrics["version"])

@@ -1,0 +1,248 @@
+"""Stage-9: the complete ML loop (BASELINE config #5).
+
+Records flow from a fan-out into the scheduler's record sink, the
+announcer ships them to the trainer, the trainer fits the MLP on the
+uploaded records (loss decreases), registers a versioned model with the
+manager, the scheduler pulls it into the ``ml`` evaluator — and then makes
+*different* parent choices than the rule-based default, preferring the
+parent that historically delivered fast pieces.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.idl.messages import (Host, HostType, PieceInfo,
+                                         PieceResult, PeerResult,
+                                         TopologyInfo)
+from dragonfly2_tpu.manager import Manager, ManagerConfig
+from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+from dragonfly2_tpu.scheduler.announcer import SchedulerAnnouncer
+from dragonfly2_tpu.scheduler.evaluator import Evaluator
+from dragonfly2_tpu.scheduler.evaluator_ml import (MLEvaluator,
+                                                   parent_feature_row)
+from dragonfly2_tpu.scheduler.records import DownloadRecords
+from dragonfly2_tpu.scheduler.resource import PeerState
+from dragonfly2_tpu.trainer import features, params_io, serving, training
+from dragonfly2_tpu.trainer.server import Trainer, TrainerConfig
+
+from conftest import run
+
+
+# ---------------------------------------------------------------- units
+
+class TestFeatures:
+    def test_label_monotone_in_throughput(self):
+        fast = features.label_from_cost(4 << 20, 4.0)      # ~1 GB/s
+        mid = features.label_from_cost(4 << 20, 40.0)      # ~100 MB/s
+        slow = features.label_from_cost(4 << 20, 4000.0)   # ~1 MB/s
+        assert fast > mid > slow
+        assert 0.0 < slow and fast <= 1.0
+
+    def test_records_to_arrays_skips_unlabeled(self):
+        rows = [{"features": [0.0] * features.FEATURE_DIM, "label": 0.5},
+                {"kind": "peer"}]
+        data = features.records_to_arrays(rows)
+        assert data["x"].shape == (1, features.FEATURE_DIM)
+
+    def test_topology_graph_padding(self):
+        rows = [{"src": "a", "dst": "b", "avg_rtt_us": 50.0, "count": 3}]
+        g = features.topology_to_graph(rows)
+        assert g["edge_mask"].sum() == 1
+        assert g["nodes"].shape[0] >= 2          # padded bucket
+
+
+class TestParamsIO:
+    def test_round_trip(self):
+        tree = {"layers": [{"w": np.ones((3, 4), np.float32),
+                            "b": np.zeros((4,), np.float32)}],
+                "scalar": np.float32(2.5)}
+        blob = params_io.serialize_params(tree, {"k": "v"})
+        back, meta = params_io.deserialize_params(blob)
+        assert meta == {"k": "v"}
+        assert isinstance(back["layers"], list)
+        np.testing.assert_array_equal(back["layers"][0]["w"],
+                                      tree["layers"][0]["w"])
+
+    def test_numpy_serving_matches_jax_forward(self):
+        import jax
+
+        from dragonfly2_tpu.trainer import models
+
+        params = models.init_mlp(jax.random.PRNGKey(1))
+        x = np.random.default_rng(0).uniform(
+            size=(8, features.FEATURE_DIM)).astype(np.float32)
+        jax_out = np.asarray(models.mlp_forward(params, x))
+        host = jax.tree_util.tree_map(np.asarray, params)
+        np_out = serving.mlp_forward_np(host, x)
+        # bf16 matmul on the jax side vs f32 numpy: loose but honest bound
+        np.testing.assert_allclose(jax_out, np_out, atol=0.15, rtol=0.15)
+
+
+class TestTraining:
+    def test_mlp_fits_synthetic_records(self):
+        rng = np.random.default_rng(3)
+        rows = []
+        for _ in range(256):
+            feats = rng.uniform(size=features.FEATURE_DIM)
+            label = float(np.clip(feats[0] * 0.8 + 0.1, 0, 1))
+            rows.append({"features": feats.tolist(), "label": label})
+        fitted = training.train_mlp(rows, epochs=10, use_mesh=False)
+        assert fitted is not None
+        blob, metrics = fitted
+        assert metrics["final_loss"] < metrics["first_epoch_loss"]
+        infer = serving.make_mlp_infer(blob)
+        hi = [1.0] + [0.5] * (features.FEATURE_DIM - 1)
+        lo = [0.0] + [0.5] * (features.FEATURE_DIM - 1)
+        assert infer([hi])[0] > infer([lo])[0]
+
+    def test_too_few_rows_returns_none(self):
+        assert training.train_mlp([], use_mesh=False) is None
+
+
+# ---------------------------------------------------------------- e2e loop
+
+def _host(hid, *, slice_name="slice-0", coords=(0, 0)):
+    return Host(id=hid, ip="127.0.0.1", port=1, download_port=2,
+                type=HostType.NORMAL,
+                topology=TopologyInfo(slice_name=slice_name, worker_index=0,
+                                      ici_coords=coords, num_chips=4,
+                                      zone="z-a"))
+
+
+def _simulate_fanout(scheduler, *, n_pieces=40):
+    """Drive the resource model + record sink the way a real fan-out does:
+    child c pulls from two parents — the same-slice (ICI) parent is SLOW,
+    the cross-slice (DCN) parent is FAST. The rule-based evaluator prefers
+    ICI; the learned model must discover the opposite."""
+    svc = scheduler.service
+    res = scheduler.resource
+    task = res.get_or_create_task("t" * 64, "http://origin/blob")
+    task.set_content_info(n_pieces * (4 << 20), 4 << 20, n_pieces)
+
+    child_host = res.store_host(_host("h-child", coords=(0, 0)))
+    ici_host = res.store_host(_host("h-ici", coords=(0, 1)))
+    dcn_host = res.store_host(_host("h-dcn", slice_name="slice-1",
+                                    coords=(3, 3)))
+
+    child = res.get_or_create_peer("p-child" * 8, task, child_host)
+    ici = res.get_or_create_peer("p-ici" * 8, task, ici_host)
+    dcn = res.get_or_create_peer("p-dcn" * 8, task, dcn_host)
+    for p in (child, ici, dcn):
+        p.transit(PeerState.RUNNING)
+    ici.finished_pieces.update(range(n_pieces))
+    dcn.finished_pieces.update(range(n_pieces))
+
+    records = svc.records
+    for num in range(n_pieces):
+        # ICI parent: stalls (~4 MB/s); DCN parent: ~800 MB/s
+        for parent, cost in ((ici, 1000), (dcn, 5)):
+            info = PieceInfo(piece_num=num, range_start=num * (4 << 20),
+                             range_size=4 << 20, download_cost_ms=cost)
+            records.on_piece(child, PieceResult(
+                task_id=task.id, src_peer_id=child.id,
+                dst_peer_id=parent.id, piece_info=info, success=True))
+    records.on_peer(child, PeerResult(
+        task_id=task.id, peer_id=child.id, success=True,
+        content_length=task.content_length, total_piece_count=n_pieces,
+        cost_ms=12000))
+    return task, child, ici, dcn
+
+
+def test_ml_loop_end_to_end(tmp_path):
+    async def main():
+        mgr = Manager(ManagerConfig(listen_ip="127.0.0.1", rest_port=0,
+                                    grpc_port=0, db_path=str(tmp_path / "m.db")))
+        await mgr.start()
+        trainer = Trainer(TrainerConfig(
+            listen_ip="127.0.0.1", data_dir=str(tmp_path / "spool"),
+            manager_addresses=[f"127.0.0.1:{mgr.port}"], min_rows=32))
+        await trainer.start()
+
+        cfg = SchedulerConfig(listen_ip="127.0.0.1", algorithm="ml",
+                              trainer_address=f"127.0.0.1:{trainer.port}",
+                              records_dir=str(tmp_path / "records"))
+        sched = Scheduler(cfg)
+        await sched.start()
+        # manager link normally comes from _attach_manager; wire directly
+        from dragonfly2_tpu.rpc.manager_link import ManagerLink
+        sched.manager = ManagerLink([f"127.0.0.1:{mgr.port}"])
+
+        try:
+            evaluator = sched.scheduling.evaluator
+            assert isinstance(evaluator, MLEvaluator)
+            assert evaluator.infer is None          # cold start
+
+            task, child, ici, dcn = _simulate_fanout(sched)
+            assert sched.service.records.piece_row_count() >= 64
+
+            # rule-based ordering before the model lands: ICI parent wins
+            base = Evaluator()
+            total = task.total_piece_count
+            assert base.evaluate(child, ici, total_piece_count=total) > \
+                base.evaluate(child, dcn, total_piece_count=total)
+
+            ann = sched.announcer or SchedulerAnnouncer(sched)
+            assert await ann.upload_once()           # records -> trainer(+fit)
+            assert trainer.service.latest, "trainer produced no model"
+            _, metrics = trainer.service.latest[features.MLP_MODEL_NAME]
+            assert metrics["final_loss"] < metrics["first_epoch_loss"]
+
+            assert await ann.refresh_model_once()    # manager -> evaluator
+            assert evaluator.infer is not None
+            assert ann.model_version == metrics["version"]
+
+            # the learned evaluator flips the choice: fast DCN beats slow ICI
+            row_ici = parent_feature_row(child, ici, total_piece_count=total)
+            row_dcn = parent_feature_row(child, dcn, total_piece_count=total)
+            s_ici, s_dcn = evaluator.infer([row_ici, row_dcn])
+            assert s_dcn > s_ici, (s_dcn, s_ici)
+            assert evaluator.evaluate(child, dcn, total_piece_count=total) > \
+                evaluator.evaluate(child, ici, total_piece_count=total)
+
+            # parity surface: trainer-side inference serves the same model
+            from dragonfly2_tpu.idl.messages import ModelInferRequest
+            resp = await trainer.service.model_infer(
+                ModelInferRequest(features=[row_dcn, row_ici]), None)
+            assert resp.outputs[0] > resp.outputs[1]
+            assert resp.model_version == metrics["version"]
+
+            # registry is queryable over REST
+            import aiohttp
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                        f"http://127.0.0.1:{mgr.rest.port}/api/v1/models"
+                ) as r:
+                    models_list = await r.json()
+            assert any(m["name"] == features.MLP_MODEL_NAME
+                       for m in models_list)
+        finally:
+            await sched.stop()
+            await trainer.stop()
+            await mgr.stop()
+
+    run(main())
+
+
+def test_records_requeue_on_trainer_outage(tmp_path):
+    async def main():
+        cfg = SchedulerConfig(listen_ip="127.0.0.1", algorithm="ml",
+                              trainer_address="127.0.0.1:1")   # nothing there
+        sched = Scheduler(cfg, records=DownloadRecords())
+        await sched.start()
+        try:
+            _simulate_fanout(sched, n_pieces=8)
+            before = sched.service.records.piece_row_count()
+            assert before > 0
+            ann = SchedulerAnnouncer(sched)
+            with pytest.raises(Exception):
+                await ann.upload_once()
+            # rows survived the failed upload
+            assert sched.service.records.piece_row_count() == before
+            await ann.stop()
+        finally:
+            await sched.stop()
+
+    run(main())

@@ -32,8 +32,13 @@ logging.basicConfig(
     stream=sys.stderr)
 
 SIZE_MB = int(os.environ.get("BENCH_SIZE_MB", "128"))
-N_LEECHERS = int(os.environ.get("BENCH_LEECHERS", "4"))
+N_LEECHERS = int(os.environ.get("BENCH_LEECHERS", "16"))
 ORIGIN_MBPS = float(os.environ.get("BENCH_ORIGIN_MBPS", "64"))
+# per-host upload NIC model (MB/s). On one machine loopback is ~free, which
+# makes a star (seed serves everyone) look optimal and measures nothing; the
+# cap restores the real constraint — each host's egress bandwidth — so the
+# mesh only wins by actually fanning out through intermediate peers.
+NIC_MBPS = float(os.environ.get("BENCH_NIC_MBPS", "128"))
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
@@ -116,11 +121,13 @@ async def role_origin(path: str, mbps: float) -> None:
 
 
 async def role_seed(workdir: str) -> None:
-    from dragonfly2_tpu.daemon.config import DaemonConfig, StorageSection
+    from dragonfly2_tpu.daemon.config import (DaemonConfig, StorageSection,
+                                              UploadConfig)
     from dragonfly2_tpu.daemon.daemon import Daemon
 
     cfg = DaemonConfig(workdir=workdir, host_ip="127.0.0.1", hostname="seed",
                        is_seed=True,
+                       upload=UploadConfig(rate_limit_bps=int(NIC_MBPS * 1e6)),
                        storage=StorageSection(gc_interval_s=3600))
     daemon = Daemon(cfg)
     await daemon.start()
@@ -144,7 +151,7 @@ async def role_leecher(workdir: str, name: str, sched_addr: str,
                        url: str) -> None:
     from dragonfly2_tpu.daemon.config import (DaemonConfig,
                                               SchedulerConfig as DSched,
-                                              StorageSection)
+                                              StorageSection, UploadConfig)
     from dragonfly2_tpu.daemon.daemon import Daemon
     from dragonfly2_tpu.idl.messages import DownloadRequest
     from dragonfly2_tpu.rpc.client import Channel, ServiceClient
@@ -152,6 +159,7 @@ async def role_leecher(workdir: str, name: str, sched_addr: str,
     cfg = DaemonConfig(workdir=workdir, host_ip="127.0.0.1", hostname=name,
                        scheduler=DSched(addresses=[sched_addr],
                                         schedule_timeout_s=60.0),
+                       upload=UploadConfig(rate_limit_bps=int(NIC_MBPS * 1e6)),
                        storage=StorageSection(gc_interval_s=3600))
     daemon = Daemon(cfg)
     await daemon.start()
@@ -163,10 +171,35 @@ async def role_leecher(workdir: str, name: str, sched_addr: str,
     out = os.path.join(workdir, "replica.bin")
     t0 = time.monotonic()
     task_id = None
+    timeline: list[tuple[float, int]] = []
+    sampler = None
+    if os.environ.get("BENCH_DEBUG_DIR"):
+        async def sample() -> None:
+            while True:
+                c = daemon.ptm.conductor(task_id) if task_id else None
+                n_seed = n_known = -1
+                if c is not None:
+                    n = len(c.ready)
+                    if c.storage is not None:
+                        n_seed = sum(1 for p in c.storage.md.pieces.values()
+                                     if "seed" in (p.source or ""))
+                    eng = c._p2p_engine
+                    if eng is not None:
+                        n_known = len(eng.dispatcher._pieces) + n
+                else:
+                    n = -1
+                timeline.append((time.monotonic() - t0, n, n_seed, n_known))
+                await asyncio.sleep(0.1)
+        sampler = asyncio.get_running_loop().create_task(sample())
     async for resp in client.unary_stream("Download", DownloadRequest(
             url=url, output=out, disable_back_source=True, timeout_s=600.0)):
         task_id = resp.task_id or task_id
     elapsed = time.monotonic() - t0
+    if sampler is not None:
+        sampler.cancel()
+        print(json.dumps({"timeline": [[round(t, 2), *rest]
+                                       for t, *rest in timeline]}),
+              file=sys.stderr, flush=True)
     size = os.path.getsize(out)
     sources: dict[str, int] = {}
     engine_state = {}
@@ -182,12 +215,16 @@ async def role_leecher(workdir: str, name: str, sched_addr: str,
                             "nspb": round(st.ns_per_byte, 1),
                             "try": st.attempts, "ann": st.announced}
                 for pid, st in engine.dispatcher.parents.items()}
-    await ch.close()
-    await daemon.stop()
     out_msg = {"elapsed": elapsed, "bytes": size, "sources": sources}
     if engine_state:
         out_msg["parents"] = engine_state
     print(json.dumps(out_msg), flush=True)
+    # stay up until the whole wave is done: a real fleet's daemons keep
+    # serving after their own download completes — early exit here would
+    # rip parents out from under the stragglers
+    await asyncio.get_running_loop().run_in_executor(None, sys.stdin.readline)
+    await ch.close()
+    await daemon.stop()
 
 
 async def role_direct(workdir: str, url: str) -> None:
@@ -206,6 +243,109 @@ async def role_direct(workdir: str, url: str) -> None:
                     got += len(chunk)
     elapsed = time.monotonic() - t0
     print(json.dumps({"elapsed": elapsed, "bytes": got}), flush=True)
+
+
+# ======================================================================
+# TPU device-ingest phase (runs in the MAIN process on the real chip)
+# ======================================================================
+
+async def tpu_ingest_bench(data_path: str, workdir: str) -> dict:
+    """BASELINE config #4's device leg: origin → pieces → device_put →
+    result() through the real daemon path (conductor + DeviceIngest), on
+    whatever jax.devices() provides. Reports:
+
+      device_ingest_gbps   — pure host-buffer → HBM transfer bandwidth
+      ingest_overlap_eff   — fraction of that transfer time hidden behind
+                             the download (1.0 = fully overlapped)
+    """
+    import numpy as np
+
+    from aiohttp import web
+
+    import jax
+
+    from dragonfly2_tpu.common.piece import parse_http_range
+    from dragonfly2_tpu.daemon.config import DaemonConfig, StorageSection
+    from dragonfly2_tpu.daemon.daemon import Daemon
+    from dragonfly2_tpu.idl.messages import DeviceSink, DownloadRequest
+
+    size = os.path.getsize(data_path)
+
+    async def handle(request: web.Request):
+        start, length = 0, size
+        status, headers = 200, {"Accept-Ranges": "bytes"}
+        rng = request.headers.get("Range")
+        if rng:
+            r = parse_http_range(rng, size)
+            start, length = r.start, r.length
+            status = 206
+            headers["Content-Range"] = f"bytes {r.start}-{r.end-1}/{size}"
+        headers["Content-Length"] = str(length)
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(request)
+        with open(data_path, "rb") as f:
+            f.seek(start)
+            remaining = length
+            while remaining > 0:
+                chunk = f.read(min(1 << 20, remaining))
+                if not chunk:
+                    break
+                await resp.write(chunk)
+                remaining -= len(chunk)
+        await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handle)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    from dragonfly2_tpu.common.aiohttp_util import resolve_port
+    base = f"http://127.0.0.1:{resolve_port(runner)}"
+
+    daemon = Daemon(DaemonConfig(
+        workdir=os.path.join(workdir, "tpudaemon"), host_ip="127.0.0.1",
+        hostname="tpubench", storage=StorageSection(gc_interval_s=3600)))
+    await daemon.start()
+    try:
+        # 1) pure device transfer bandwidth: same bytes, one put per DMA unit
+        buf = np.fromfile(data_path, dtype=np.uint8)
+        dev = jax.devices()[0]
+        jax.device_put(buf[:1 << 20], dev).block_until_ready()   # warm path
+        t0 = time.monotonic()
+        put = jax.device_put(buf, dev)
+        put.block_until_ready()
+        t_ingest = time.monotonic() - t0
+        del put
+
+        async def run_download(url: str, sink: DeviceSink | None) -> float:
+            t0 = time.monotonic()
+            task_id = None
+            async for resp in daemon.ptm.start_file_task(DownloadRequest(
+                    url=url, output=os.path.join(workdir, "tpu.out"),
+                    device_sink=sink, timeout_s=600.0)):
+                task_id = resp.task_id or task_id
+            conductor = daemon.ptm.conductor(task_id)
+            if sink is not None and conductor is not None \
+                    and conductor.device_ingest is not None:
+                conductor.device_ingest.result()   # block on last DMA
+            return time.monotonic() - t0
+
+        t_dl = await run_download(f"{base}/plain.bin", None)
+        t_overlap = await run_download(
+            f"{base}/sink.bin", DeviceSink(enabled=True))
+        hidden = max(0.0, min(1.0, (t_dl + t_ingest - t_overlap) / t_ingest))
+        gbps = size / 1e9 / t_ingest
+        log(f"tpu ingest: pure device_put {gbps:.2f} GB/s ({t_ingest:.2f}s), "
+            f"download {t_dl:.2f}s, overlapped {t_overlap:.2f}s -> "
+            f"{hidden:.0%} of ingest hidden [{jax.devices()[0].platform}]")
+        return {"device_ingest_gbps": round(gbps, 3),
+                "ingest_overlap_efficiency": round(hidden, 3),
+                "device_platform": jax.devices()[0].platform}
+    finally:
+        await daemon.stop()
+        await runner.cleanup()
 
 
 # ======================================================================
@@ -248,8 +388,11 @@ class Proc:
                 raise RuntimeError(f"worker died: rc={self.p.returncode}")
 
     def go(self) -> None:
-        self.p.stdin.write("\n")
-        self.p.stdin.flush()
+        try:
+            self.p.stdin.write("\n")
+            self.p.stdin.flush()
+        except (BrokenPipeError, OSError):
+            pass   # role already exited (direct pulls don't linger)
 
     def kill(self) -> None:
         if self.p.poll() is None:
@@ -257,19 +400,39 @@ class Proc:
             self.p.wait()
 
 
-def run_wave(procs: list[Proc]) -> float:
-    """READY-barrier, then GO all; returns max elapsed reported."""
+def run_wave(procs: list[Proc]) -> tuple[float, list[float]]:
+    """READY-barrier, then GO all; returns (max elapsed, per-proc
+    seed-sourced piece fractions)."""
     for p in procs:
         p.wait_ready()
     for p in procs:
         p.go()
     results = [p.read_json(timeout=600.0) for p in procs]
+    seed_fracs: list[float] = []
     for r in results:
         assert r["bytes"] == SIZE_MB << 20, f"short transfer: {r}"
         if r.get("sources"):
             log(f"  piece sources: {r['sources']} ({r['elapsed']:.2f}s)"
                 + (f" parents={r['parents']}" if r.get("parents") else ""))
-    return max(r["elapsed"] for r in results)
+            total = sum(r["sources"].values())
+            from_seed = sum(n for k, n in r["sources"].items() if "seed" in k)
+            seed_fracs.append(from_seed / total if total else 0.0)
+    for p in procs:
+        p.go()   # whole wave done: daemons may now exit
+    return max(r["elapsed"] for r in results), seed_fracs
+
+
+def fanout_wave(workdir: str, tag: str, n: int, sched_addr: str,
+                url: str, daemons: list["Proc"]) -> tuple[float, list[float]]:
+    leechers = [Proc(["--role", "leecher",
+                      os.path.join(workdir, f"{tag}{i}"), f"{tag}leech{i}",
+                      sched_addr, url],
+                     stderr_path=os.environ.get("BENCH_DEBUG_DIR") and
+                     os.path.join(os.environ["BENCH_DEBUG_DIR"],
+                                  f"{tag}{i}.err"))
+                for i in range(n)]
+    daemons.extend(leechers)   # killed on any failure path
+    return run_wave(leechers)
 
 
 def main() -> None:
@@ -296,39 +459,65 @@ def main() -> None:
             with urllib.request.urlopen(f"{origin_base}/__stats__") as r:
                 return json.loads(r.read())["bytes"]
 
-        log(f"bench: {SIZE_MB} MiB x {N_LEECHERS} leechers, origin capped "
-            f"at {ORIGIN_MBPS:.0f} MB/s (multi-process)")
+        log(f"bench: {SIZE_MB} MiB x {N_LEECHERS} leechers, origin "
+            f"{ORIGIN_MBPS:.0f} MB/s, per-host upload NIC {NIC_MBPS:.0f} MB/s "
+            f"(multi-process)")
+        # direct baseline: origin-capped, so aggregate throughput is the
+        # origin rate no matter how many clients pull — 4 processes measure
+        # it; egress for N direct clients is N x size by definition.
+        n_direct = min(N_LEECHERS, 4)
         direct = [Proc(["--role", "direct", os.path.join(workdir, f"d{i}"),
-                        url]) for i in range(N_LEECHERS)]
+                        url]) for i in range(n_direct)]
         daemons.extend(direct)   # killed on any failure path
-        for i in range(N_LEECHERS):
+        for i in range(n_direct):
             os.makedirs(os.path.join(workdir, f"d{i}"), exist_ok=True)
-        direct_s = run_wave(direct)
-        direct_egress = origin_bytes()
-        log(f"baseline direct: {direct_s:.2f}s "
-            f"(origin egress {direct_egress / 1e6:.0f} MB)")
+        direct_s, _ = run_wave(direct)
+        direct_rate = n_direct * (SIZE_MB << 20) / direct_s
+        direct_egress = N_LEECHERS * (SIZE_MB << 20)
+        log(f"baseline direct: {n_direct} pulls in {direct_s:.2f}s "
+            f"-> {direct_rate / 1e9:.3f} GB/s aggregate (egress for "
+            f"{N_LEECHERS} clients = {direct_egress / 1e6:.0f} MB)")
 
-        seed = Proc(["--role", "seed", os.path.join(workdir, "seed")])
+        dbg = os.environ.get("BENCH_DEBUG_DIR")
+        seed = Proc(["--role", "seed", os.path.join(workdir, "seed")],
+                    stderr_path=dbg and os.path.join(dbg, "seed.err"))
         daemons.append(seed)
         seed_info = seed.read_json()
         sched = Proc(["--role", "scheduler", str(seed_info["rpc_port"]),
-                      str(seed_info["download_port"])])
+                      str(seed_info["download_port"])],
+                     stderr_path=dbg and os.path.join(dbg, "sched.err"))
         daemons.append(sched)
         sched_addr = sched.read_json()["addr"]
 
+        # wave A: half-size fan-out on a cold task (sublinearity reference)
+        n_half = max(N_LEECHERS // 2, 1)
         pre = origin_bytes()
-        leechers = [Proc(["--role", "leecher",
-                          os.path.join(workdir, f"l{i}"), f"leech{i}",
-                          sched_addr, url],
-                         stderr_path=os.environ.get("BENCH_DEBUG_DIR") and
-                         os.path.join(os.environ["BENCH_DEBUG_DIR"], f"l{i}.err"))
-                    for i in range(N_LEECHERS)]
-        daemons.extend(leechers)   # killed on any failure path
-        fanout_s = run_wave(leechers)
+        half_s, _ = fanout_wave(workdir, "h", n_half, sched_addr,
+                                f"{origin_base}/wave-half.bin", daemons)
+        half_egress = origin_bytes() - pre
+        log(f"fan-out {n_half} leechers (cold): {half_s:.2f}s "
+            f"(origin egress {half_egress / 1e6:.0f} MB)")
+
+        # wave B: the measured fan-out, also cold
+        pre = origin_bytes()
+        fanout_s, seed_fracs = fanout_wave(workdir, "l", N_LEECHERS,
+                                           sched_addr,
+                                           f"{origin_base}/wave-full.bin",
+                                           daemons)
         p2p_egress = origin_bytes() - pre
         egress_saved = 1.0 - p2p_egress / max(direct_egress, 1)
-        log(f"framework fan-out: {fanout_s:.2f}s (origin egress "
-            f"{p2p_egress / 1e6:.0f} MB, saved {egress_saved:.0%})")
+        max_seed_frac = max(seed_fracs) if seed_fracs else 0.0
+        log(f"framework fan-out: {N_LEECHERS} leechers in {fanout_s:.2f}s "
+            f"(origin egress {p2p_egress / 1e6:.0f} MB, saved "
+            f"{egress_saved:.1%}); sublinearity {fanout_s / half_s:.2f}x for "
+            f"2x leechers; max seed-sourced fraction {max_seed_frac:.0%}")
+
+        # TPU leg: measured in THIS process on the real chip
+        try:
+            tpu_stats = asyncio.run(tpu_ingest_bench(data_path, workdir))
+        except Exception as exc:  # noqa: BLE001 - no-accelerator hosts still bench the mesh
+            log(f"tpu ingest phase unavailable: {exc}")
+            tpu_stats = {}
     finally:
         for p in daemons:
             p.kill()
@@ -337,12 +526,16 @@ def main() -> None:
 
     delivered_gb = (SIZE_MB << 20) * N_LEECHERS / 1e9
     value = delivered_gb / fanout_s
-    baseline = delivered_gb / direct_s
+    baseline = direct_rate / 1e9
     print(json.dumps({
         "metric": "p2p_fanout_aggregate_throughput",
         "value": round(value, 3),
         "unit": "GB/s",
         "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
+        "egress_saved": round(egress_saved, 3),
+        "max_seed_sourced_fraction": round(max_seed_frac, 3),
+        "sublinearity_2x": round(fanout_s / half_s, 3),
+        **tpu_stats,
     }))
 
 

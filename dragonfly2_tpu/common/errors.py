@@ -34,6 +34,7 @@ class Code(enum.IntEnum):
     SCHED_REREGISTER = 2004         # scheduler lost state; register again
 
     # data-plane
+    CLIENT_PEER_BUSY = 2999         # parent at upload concurrency limit; not a failure
     CLIENT_PIECE_DOWNLOAD_FAIL = 3000
     CLIENT_PIECE_NOT_FOUND = 3001
     CLIENT_BACK_SOURCE_ERROR = 3002

@@ -36,7 +36,7 @@ class DownloadConfig:
 class UploadConfig:
     port: int = 0                          # 0 = ephemeral
     rate_limit_bps: int = 0
-    concurrent_limit: int = 100
+    concurrent_limit: int = 0              # 0 = scheduler's per-type default
 
 
 @dataclass

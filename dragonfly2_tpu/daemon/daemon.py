@@ -68,6 +68,7 @@ class Daemon:
         self.upload_server = UploadServer(
             self.storage_mgr, port=cfg.upload.port,
             rate_limit_bps=cfg.upload.rate_limit_bps,
+            concurrent_limit=cfg.upload.concurrent_limit,
             host=cfg.listen_ip)
         self._scheduler_factory = scheduler_factory
         self._p2p_engine_factory = p2p_engine_factory
@@ -98,8 +99,18 @@ class Daemon:
         """Returns a factory(content_length) -> DeviceIngest honoring the
         request's sink spec."""
         def factory(content_length: int):
+            import jax
+
             from ..tpu.hbm_sink import DeviceIngest
-            return DeviceIngest(content_length, dtype=spec.dtype)
+            spd = spec.pipeline_shards
+            if spd <= 0:
+                # auto: ~32 MiB DMA units so streaming overlaps the
+                # download even on a 1-chip host, bounded so tiny tasks
+                # don't shatter into no-op transfers
+                per_dev = -(-content_length // len(jax.devices()))
+                spd = max(1, min(32, per_dev // (32 << 20)))
+            return DeviceIngest(content_length, dtype=spec.dtype,
+                                shards_per_device=spd)
         return factory
 
     async def start(self) -> None:

@@ -29,6 +29,7 @@ log = logging.getLogger("df.flow.dispatch")
 EXPLORE_RATIO = 0.1          # epsilon for random parent choice
 PARENT_FAIL_LIMIT = 3        # consecutive failures before ejection
 _EWMA_ALPHA = 0.3
+BUSY_BACKOFF_S = 0.04        # ~one piece transfer at fan-out rates
 
 
 class ParentState:
@@ -39,9 +40,13 @@ class ParentState:
         self.consecutive_fails = 0
         self.inflight = 0
         self.ejected = False
+        self.busy_until = 0.0           # 503 backpressure: skip until then
         # read by bench.py's engine-state dump (BENCH_DEBUG_DIR)
         self.attempts = 0               # pieces ever dispatched here
         self.announced = 0              # piece announcements received
+
+    def is_busy(self) -> bool:
+        return self.busy_until > time.monotonic()
 
     def observe(self, cost_ms: int, size: int, ok: bool) -> None:
         if ok:
@@ -127,6 +132,10 @@ class PieceDispatcher:
             st = self.parents.get(peer_id)
             if st is not None:
                 st.ejected = True
+            # drop it from holder sets too: rarest-first rarity counts must
+            # reflect live sources or removed parents skew piece choice
+            for ps in self._pieces.values():
+                ps.holders.discard(peer_id)
             self._cond.notify_all()
 
     async def announce(self, parent_id: str, infos: list[PieceInfo]) -> None:
@@ -168,7 +177,8 @@ class PieceDispatcher:
             if ps.inflight:
                 continue
             holders = [self.parents[h] for h in ps.holders
-                       if h in self.parents and not self.parents[h].ejected]
+                       if h in self.parents and not self.parents[h].ejected
+                       and not self.parents[h].is_busy()]
             if holders:
                 candidates.append((ps, holders))
         if not candidates:
@@ -204,10 +214,29 @@ class PieceDispatcher:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None
+                # busy parents expire on a clock, not on a notify: poll so a
+                # piece whose only holders hit 503 is retried promptly
+                if any(p.is_busy() and not p.ejected
+                       for p in self.parents.values()):
+                    remaining = min(remaining or BUSY_BACKOFF_S,
+                                    BUSY_BACKOFF_S)
                 try:
                     await asyncio.wait_for(self._cond.wait(), remaining)
                 except asyncio.TimeoutError:
-                    return None
+                    if deadline is not None and time.monotonic() >= deadline:
+                        return None
+
+    async def report_busy(self, d: Dispatch) -> None:
+        """Parent answered 503 (upload slots full): not a failure — back off
+        that parent briefly and requeue the piece so another holder (or the
+        same one, later) serves it."""
+        async with self._cond:
+            d.parent.inflight = max(0, d.parent.inflight - 1)
+            d.parent.busy_until = time.monotonic() + BUSY_BACKOFF_S
+            ps = self._pieces.get(d.piece.piece_num)
+            if ps is not None:
+                ps.inflight = False
+            self._cond.notify_all()
 
     async def report(self, d: Dispatch, *, ok: bool, cost_ms: int = 0) -> None:
         async with self._cond:
@@ -224,6 +253,24 @@ class PieceDispatcher:
                     if d.parent.ejected:
                         ps.holders.discard(d.parent.peer_id)
             self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def starving(self) -> bool:
+        """True when no pending piece has ANY live holder — i.e. more
+        announcements are needed. Busy holders don't count as starvation:
+        that's backpressure working, and pinging through it would turn
+        every 503 into an announcement flood."""
+        for ps in self._pieces.values():
+            if ps.inflight:
+                return False
+            for h in ps.holders:
+                p = self.parents.get(h)
+                if p is not None and not p.ejected:
+                    return False
+        return True
 
     def pending_count(self) -> int:
         return len(self._pieces)

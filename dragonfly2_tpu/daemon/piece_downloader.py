@@ -57,6 +57,12 @@ class PieceDownloader:
             async with self._get_session().get(
                     url, headers=headers,
                     params={"peerId": src_peer_id}) as resp:
+                if resp.status == 503:
+                    # upload-slot backpressure: the parent is at its
+                    # concurrency limit, not broken — the dispatcher reroutes
+                    # the piece to another holder or retries shortly
+                    raise DFError(Code.CLIENT_PEER_BUSY,
+                                  f"parent {dst_addr} busy")
                 if resp.status not in (200, 206):
                     raise DFError(
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,

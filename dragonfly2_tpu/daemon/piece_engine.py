@@ -49,15 +49,32 @@ class _Synchronizer:
         self.conductor = conductor
         self.parent = parent
         self.task: asyncio.Task | None = None
+        self.stream = None              # live SyncPieceTasks stream
 
     def start(self) -> None:
         self.task = asyncio.get_running_loop().create_task(self._run())
+
+    async def ping(self) -> None:
+        """Starvation signal: ask the parent for more work (super-seeding
+        parents respond by revealing more pieces; others re-announce)."""
+        stream = self.stream
+        if stream is None:
+            return
+        try:
+            await stream.write(PieceTaskRequest(
+                task_id=self.conductor.task_id,
+                src_peer_id=self.conductor.peer_id,
+                dst_peer_id=self.parent.peer_id,
+                start_num=0, limit=1 << 20))
+        except Exception:  # noqa: BLE001 - stream may be closing
+            pass
 
     async def _run(self) -> None:
         addr = f"{self.parent.ip}:{self.parent.rpc_port}"
         try:
             client = self.engine.peer_client(addr)
             stream = client.stream_stream("SyncPieceTasks")
+            self.stream = stream
             await stream.write(PieceTaskRequest(
                 task_id=self.conductor.task_id,
                 src_peer_id=self.conductor.peer_id,
@@ -70,6 +87,7 @@ class _Synchronizer:
                         break
                     await self._on_packet(packet)
             finally:
+                self.stream = None
                 stream.cancel()
         except asyncio.CancelledError:
             raise
@@ -118,6 +136,7 @@ class PieceEngine:
         self._synchronizers: dict[str, _Synchronizer] = {}
         self._need_back_source = False
         self._first_parent = asyncio.Event()
+        self._last_ping = 0.0
 
     def peer_client(self, addr: str) -> ServiceClient:
         return ServiceClient(self._channels.get(addr), DAEMON_SERVICE)
@@ -267,14 +286,40 @@ class PieceEngine:
                     self._synchronizers[parent.peer_id] = sync
                     sync.start()
             if parents:
+                # the packet is the scheduler's CURRENT parent assignment —
+                # dropped parents release their upload slot server-side, so
+                # continuing to pull from them would overload hosts the
+                # scheduler is actively shedding (the round-robin that keeps
+                # a loaded seed from serving every child rides on this)
+                assigned = {p.peer_id for p in parents}
+                for peer_id in list(self._synchronizers):
+                    if peer_id not in assigned:
+                        self._synchronizers.pop(peer_id).stop()
+                        await self.dispatcher.remove_parent(peer_id)
                 self._first_parent.set()
 
     async def _worker(self, conductor, session) -> None:
         while True:
-            d = await self.dispatcher.get()
+            d = await self.dispatcher.get(timeout=0.1)
             if d is None:
-                return
+                if self.dispatcher.closed:
+                    return
+                # idle worker with nothing dispatchable: pull-signal the
+                # parents (super-seeding seeds ration announcements and
+                # grow them on starvation pings — see rpcserver._SuperSeed)
+                await self._maybe_ping()
+                continue
             await self._download_one(conductor, session, d)
+
+    async def _maybe_ping(self) -> None:
+        if not self.dispatcher.starving():
+            return
+        now = time.monotonic()
+        if now - self._last_ping < 0.1:
+            return
+        self._last_ping = now
+        for sync in list(self._synchronizers.values()):
+            await sync.ping()
 
     async def _download_one(self, conductor, session, d: Dispatch) -> None:
         if conductor.rate_limiter is not None:
@@ -285,7 +330,15 @@ class PieceEngine:
                 dst_addr=d.parent.addr, task_id=conductor.task_id,
                 src_peer_id=conductor.peer_id, piece=d.piece)
         except DFError as exc:
+            if exc.code == Code.CLIENT_PEER_BUSY:
+                # backpressure, not failure: requeue; no scheduler report
+                # (a busy seed must not land on the blocklist)
+                _p2p_pieces.labels("busy").inc()
+                await self.dispatcher.report_busy(d)
+                return
             _p2p_pieces.labels("fail").inc()
+            log.debug("piece %d from %s failed: %s", d.piece.piece_num,
+                      d.parent.peer_id[-12:], exc)
             await self.dispatcher.report(d, ok=False)
             if d.parent.ejected:
                 # ejected parent: its sync stream must die too, or a dead

@@ -26,12 +26,106 @@ DAEMON_SERVICE = "df.daemon.Daemon"
 SEEDER_SERVICE = "df.daemon.Seeder"
 
 
+class _SuperSeed:
+    """Per-task super-seed announcement policy (seed daemons only).
+
+    A seed that reveals every piece to every child turns a fan-out into a
+    star: all children are starved on the origin-paced trickle and pull each
+    fresh piece straight off the seed, so the seed's NIC bounds the whole
+    swarm. Instead each piece is announced to at most ``fanout`` children
+    (spread least-loaded-first), forcing further replication through the
+    mesh. A rotation timer widens every piece by one more child per tick
+    (capped, see ``_rotate``) so a slow or dead child can never strand a
+    piece, a departing child's exclusive assignments return to the pool, and
+    a child whose mesh parents have nothing for it pulls more via starvation
+    pings (``reveal_to``). ``fanout=1`` is deliberate: with 2+, the first
+    couple of children to attach are both told about EVERY early piece and
+    source their whole prefix from the seed; with 1 they are forced to trade
+    with each other from the first piece on. This is the classic BitTorrent
+    "super-seeding" idea; the reference has no equivalent — its seeds
+    announce everything (``rpcserver.go SyncPieceTasks``).
+    """
+
+    def __init__(self, *, fanout: int = 1, rotate_interval_s: float = 1.0):
+        self.fanout = fanout
+        self.rotate_interval_s = rotate_interval_s
+        self.known: set[int] = set()
+        self.assigned: dict[int, set[str]] = {}   # piece -> peer ids told
+        self.subs: dict[str, asyncio.Queue] = {}  # peer id -> allowed nums
+        self._rotor: asyncio.Task | None = None
+
+    def _load(self, peer_id: str) -> int:
+        return sum(1 for owners in self.assigned.values() if peer_id in owners)
+
+    def _offer(self, num: int, target: int | None = None) -> None:
+        owners = self.assigned.setdefault(num, set())
+        want = (self.fanout if target is None else target) - len(owners)
+        for peer_id in sorted((s for s in self.subs if s not in owners),
+                              key=self._load)[:max(want, 0)]:
+            owners.add(peer_id)
+            self.subs[peer_id].put_nowait(num)
+
+    def on_piece(self, num: int) -> None:
+        self.known.add(num)
+        self._offer(num)
+
+    def reveal_to(self, peer_id: str, n: int = 2) -> None:
+        """Starvation pull: a child with idle workers and nothing
+        dispatchable asked for more work. Reveal it the ``n`` least-revealed
+        pieces it doesn't know yet. This is the growth path for reveals —
+        paced by actual mesh scarcity (a child the mesh feeds never pings),
+        so seed egress converges to exactly the demand the mesh cannot
+        meet."""
+        q = self.subs.get(peer_id)
+        if q is None:
+            return
+        cands = sorted(
+            (num for num in self.known
+             if peer_id not in self.assigned.get(num, ())),
+            key=lambda num: len(self.assigned.get(num, ())))
+        for num in cands[:n]:
+            self.assigned.setdefault(num, set()).add(peer_id)
+            q.put_nowait(num)
+
+    def subscribe(self, peer_id: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self.subs[peer_id] = q
+        for num in self.known:   # fill any under-assigned pieces
+            self._offer(num)
+        if self._rotor is None:
+            self._rotor = asyncio.get_running_loop().create_task(self._rotate())
+        return q
+
+    def unsubscribe(self, peer_id: str) -> None:
+        self.subs.pop(peer_id, None)
+        for owners in self.assigned.values():
+            owners.discard(peer_id)
+        if not self.subs and self._rotor is not None:
+            self._rotor.cancel()
+            self._rotor = None
+
+    async def _rotate(self) -> None:
+        # liveness net for alive-but-slow assignees, CAPPED at 2x fanout: an
+        # uncapped rotor converges to full broadcast whenever the swarm runs
+        # slower than the timer (e.g. CPU-starved hosts), resurrecting the
+        # star. Dead assignees are handled by unsubscribe() returning their
+        # pieces to the pool, and truly stuck children by starvation pings.
+        while True:
+            await asyncio.sleep(self.rotate_interval_s)
+            for num in list(self.known):
+                have = len(self.assigned.get(num, ()))
+                if have < 2 * self.fanout:
+                    self._offer(num, target=have + 1)
+
+
 class DaemonService:
     """Wire handlers; pure delegation to PeerTaskManager + storage."""
 
     def __init__(self, ptm: PeerTaskManager, *, upload_addr: str = ""):
         self.ptm = ptm
         self.upload_addr = upload_addr
+        self._superseed: dict[str, _SuperSeed] = {}
+        self._superseed_feeders: dict[str, asyncio.Task] = {}
 
     # -- local API -----------------------------------------------------
 
@@ -81,9 +175,16 @@ class DaemonService:
 
     async def sync_piece_tasks(self, request_iter, context) -> AsyncIterator:
         """Bidi: each request asks for piece metadata; responses stream as
-        pieces appear (push on piece arrival for running tasks)."""
+        pieces appear (push on piece arrival for running tasks). Seed daemons
+        route announcements through the super-seed policy instead of
+        broadcasting everything."""
         async for request in request_iter:
             conductor = self.ptm.conductor(request.task_id)
+            if self.ptm.is_seed:
+                async for packet in self._sync_superseed(request, request_iter,
+                                                         conductor, context):
+                    yield packet
+                continue
             sent: set[int] = set()
             packet = await self.get_piece_tasks(request, context)
             for p in packet.piece_infos or []:
@@ -113,6 +214,67 @@ class DaemonService:
                         break
             finally:
                 conductor.unsubscribe(q)
+
+    def _superseed_for(self, task_id: str, conductor) -> _SuperSeed:
+        policy = self._superseed.get(task_id)
+        if policy is None:
+            policy = self._superseed[task_id] = _SuperSeed()
+            ts = self.ptm.storage_mgr.get(task_id)
+            if ts is None and conductor is not None:
+                ts = conductor.storage
+            if ts is not None:
+                for p in ts.piece_infos():
+                    policy.known.add(p.num)
+            if conductor is not None and not conductor.done_event.is_set():
+                self._superseed_feeders[task_id] = (
+                    asyncio.get_running_loop().create_task(
+                        self._feed_superseed(task_id, policy, conductor)))
+        return policy
+
+    @staticmethod
+    async def _feed_superseed(task_id: str, policy: _SuperSeed,
+                              conductor) -> None:
+        q = conductor.subscribe()
+        try:
+            while True:
+                event = await q.get()
+                if event["type"] == "piece":
+                    policy.on_piece(event["num"])
+                elif event["type"] == "done":
+                    return
+        finally:
+            conductor.unsubscribe(q)
+
+    async def _sync_superseed(self, request: PieceTaskRequest, request_iter,
+                              conductor, context) -> AsyncIterator:
+        policy = self._superseed_for(request.task_id, conductor)
+        sq = policy.subscribe(request.src_peer_id)
+
+        async def read_pings() -> None:
+            # any follow-up request on the stream = "my workers are idle and
+            # I have nothing dispatchable" — reveal this child more pieces
+            async for _ in request_iter:
+                policy.reveal_to(request.src_peer_id)
+
+        pings = asyncio.get_running_loop().create_task(read_pings())
+        try:
+            # geometry-only opener (no piece list): the child needs sizes to
+            # set up its store before any piece is revealed to it
+            base = await self.get_piece_tasks(PieceTaskRequest(
+                task_id=request.task_id, src_peer_id=request.src_peer_id,
+                dst_peer_id=request.dst_peer_id, start_num=0, limit=1),
+                context)
+            base.piece_infos = []
+            yield base
+            while True:
+                num = await sq.get()
+                yield await self.get_piece_tasks(PieceTaskRequest(
+                    task_id=request.task_id, src_peer_id=request.src_peer_id,
+                    dst_peer_id=request.dst_peer_id,
+                    start_num=num, limit=1), context)
+        finally:
+            pings.cancel()
+            policy.unsubscribe(request.src_peer_id)
 
     # -- seeder API ----------------------------------------------------
 

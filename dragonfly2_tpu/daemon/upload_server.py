@@ -28,12 +28,24 @@ _upload_reqs = REGISTRY.counter("df_upload_requests_total",
 
 
 class UploadServer:
+    # Concurrent piece transfers served at once when the daemon config says
+    # "auto" (0). Beyond this the server answers 503 and the requesting
+    # child reroutes to another holder — per-transfer backpressure is what
+    # stops every starved child of a fan-out from pulling each fresh piece
+    # straight off the seed (the NIC would be split N ways and the mesh
+    # would never carry a byte). A few concurrent transfers keep the NIC
+    # full; more only dilute each one.
+    DEFAULT_CONCURRENT_LIMIT = 4
+
     def __init__(self, storage_mgr: StorageManager, *, port: int = 0,
-                 rate_limit_bps: int = 0, host: str = "0.0.0.0"):
+                 rate_limit_bps: int = 0, concurrent_limit: int = 0,
+                 host: str = "0.0.0.0"):
         self.storage_mgr = storage_mgr
         self.host = host
         self.port = port
         self.limiter = TokenBucket(rate_limit_bps or 0)
+        self.concurrent_limit = concurrent_limit or self.DEFAULT_CONCURRENT_LIMIT
+        self._active = 0
         self._runner: web.AppRunner | None = None
 
     async def start(self) -> None:
@@ -80,24 +92,37 @@ class UploadServer:
             _upload_reqs.labels("416").inc()
             raise web.HTTPRequestRangeNotSatisfiable(
                 text=f"bytes {rng.start}+{rng.length} not stored yet")
-        # whole-file tasks: serve via sendfile (FileResponse honors Range) so
-        # piece bytes never enter Python — the upload path is the hottest
-        # loop on a seed peer
-        data_path = getattr(ts, "data_path", None)
-        if data_path is not None and total >= 0:
-            await self.limiter.acquire(rng.length)
-            _upload_bytes.inc(rng.length)
-            _upload_reqs.labels("206").inc()
-            return web.FileResponse(data_path())
+        if self._active >= self.concurrent_limit:
+            _upload_reqs.labels("503").inc()
+            raise web.HTTPServiceUnavailable(
+                text="upload concurrency limit", headers={"Retry-After": "0"})
+        self._active += 1
         try:
-            data = await asyncio.to_thread(ts.read_range, rng.start, rng.length)
-        except DFError as exc:
-            _upload_reqs.labels("404").inc()
-            raise web.HTTPNotFound(text=exc.message)
-        await self.limiter.acquire(len(data))
-        _upload_bytes.inc(len(data))
-        _upload_reqs.labels("206").inc()
-        return web.Response(
-            status=206, body=data,
-            headers={"Content-Range": f"bytes {rng.start}-{rng.end - 1}/{total}",
-                     "Content-Type": "application/octet-stream"})
+            # whole-file tasks: serve via sendfile (FileResponse honors
+            # Range) so piece bytes never enter Python — the upload path is
+            # the hottest loop on a seed peer. The concurrency gate covers
+            # the token acquire (the pacing point); aiohttp prepares the
+            # response itself after the handler returns (preparing it here
+            # double-prepares and resets the connection).
+            data_path = getattr(ts, "data_path", None)
+            if data_path is not None and total >= 0:
+                await self.limiter.acquire(rng.length)
+                _upload_bytes.inc(rng.length)
+                _upload_reqs.labels("206").inc()
+                return web.FileResponse(data_path())
+            try:
+                data = await asyncio.to_thread(ts.read_range, rng.start,
+                                               rng.length)
+            except DFError as exc:
+                _upload_reqs.labels("404").inc()
+                raise web.HTTPNotFound(text=exc.message)
+            await self.limiter.acquire(len(data))
+            _upload_bytes.inc(len(data))
+            _upload_reqs.labels("206").inc()
+            return web.Response(
+                status=206, body=data,
+                headers={"Content-Range":
+                         f"bytes {rng.start}-{rng.end - 1}/{total}",
+                         "Content-Type": "application/octet-stream"})
+        finally:
+            self._active -= 1

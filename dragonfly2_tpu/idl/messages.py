@@ -133,7 +133,9 @@ class Host:
     memory: MemoryStat | None = None
     network: NetworkStat | None = None
     disk: DiskStat | None = None
-    concurrent_upload_limit: int = 100
+    # 0 = "auto": the scheduler applies its per-host-type default (peers
+    # serve few children each so fan-outs form trees, not stars)
+    concurrent_upload_limit: int = 0
     build_version: str = ""
 
 
@@ -170,6 +172,7 @@ class DeviceSink:
     shard_index: int = 0
     shard_count: int = 1
     donate: bool = True
+    pipeline_shards: int = 0       # DMA units per device; 0 = auto (~32MiB each)
 
 
 # ---------------------------------------------------------------- scheduler service

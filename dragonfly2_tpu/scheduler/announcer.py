@@ -39,6 +39,7 @@ class SchedulerAnnouncer:
         self._tasks: list[asyncio.Task] = []
         self._trainer_channel: Channel | None = None
         self.model_version = ""        # currently served version
+        self._last_topo_key = 0        # hash of last uploaded topo snapshot
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -76,6 +77,12 @@ class SchedulerAnnouncer:
         records = self.scheduler.service.records
         rows = records.drain() if records is not None else []
         topo_rows = self.scheduler.topo.snapshot_rows()
+        # the topology snapshot is state, not a stream: re-sending an
+        # unchanged snapshot every interval would duplicate every edge in
+        # the trainer's spool and skew the GNN fit
+        topo_key = hash(json.dumps(topo_rows, sort_keys=True))
+        if topo_key == self._last_topo_key:
+            topo_rows = []
         if not rows and not topo_rows:
             return False
         hostname = socket.gethostname()
@@ -109,10 +116,15 @@ class SchedulerAnnouncer:
                 "Train", chunks(), timeout=300.0)
         except Exception:
             # trainer away: put the interval's rows back so the next cycle
-            # retries instead of silently losing training data
+            # retries instead of silently losing training data. Delivery is
+            # at-least-once — a timeout AFTER the trainer consumed the
+            # stream re-sends these rows, which the fit tolerates (dupes are
+            # a mild reweighting, loss is a hole in the dataset).
             if records is not None:
                 records.requeue(rows)
             raise
+        if topo_rows:
+            self._last_topo_key = topo_key
         log.info("records uploaded: %d download + %d topology rows -> %s",
                  len(rows), len(topo_rows),
                  resp.model_version or "(no new model)")

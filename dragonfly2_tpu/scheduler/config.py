@@ -44,6 +44,11 @@ class SchedulerConfig:
     seed_peers: list[SeedPeerAddr] = field(default_factory=list)
     candidate_parent_limit: int = CANDIDATE_PARENT_LIMIT
     filter_parent_limit: int = FILTER_PARENT_LIMIT
+    # per-host concurrent-upload defaults applied when a daemon announces 0
+    # ("auto"); slots ride DAG edges, so this is max direct children per
+    # node of the distribution tree (see resource.Host)
+    peer_upload_limit: int = 0             # 0 -> Host.DEFAULT_PEER_UPLOAD_LIMIT
+    seed_upload_limit: int = 0             # 0 -> Host.DEFAULT_SEED_UPLOAD_LIMIT
     retry_limit: int = RETRY_LIMIT
     retry_back_source_limit: int = RETRY_BACK_SOURCE_LIMIT
     back_source_concurrent: int = DEFAULT_BACK_SOURCE_CONCURRENT

@@ -14,6 +14,7 @@ append-only file with size rotation for post-mortems.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -27,6 +28,8 @@ log = logging.getLogger("df.sched.records")
 
 MAX_BUFFERED_ROWS = 50_000          # ring bound: drop-oldest beyond this
 ROTATE_BYTES = 64 << 20             # rotate download.jsonl past 64 MiB
+FLUSH_BATCH_ROWS = 64               # file-write batch size
+FLUSH_MAX_AGE_S = 1.0               # flush at least this often while rows flow
 
 
 class DownloadRecords:
@@ -38,6 +41,9 @@ class DownloadRecords:
         self._peer_rows: list[dict] = []
         self._file = None
         self._file_bytes = 0
+        self._pending: list[str] = []
+        self._flush_task: asyncio.Task | None = None
+        self._last_flush = time.time()
         if records_dir:
             os.makedirs(records_dir, exist_ok=True)
             self._open_file()
@@ -110,11 +116,38 @@ class DownloadRecords:
         self._write(row)
 
     def _write(self, row: dict) -> None:
+        """Buffer the row's line; file IO happens in worker threads in
+        batches. This runs inside ``_handle_piece_result`` — one synchronous
+        disk write per piece report would stall every scheduling RPC on the
+        event loop at fan-out rates (thousands of reports/s)."""
         if self._file is None:
             return
-        line = json.dumps(row) + "\n"
-        self._file.write(line)
-        self._file_bytes += len(line)
+        self._pending.append(json.dumps(row) + "\n")
+        if (len(self._pending) >= FLUSH_BATCH_ROWS
+                or time.time() - self._last_flush > FLUSH_MAX_AGE_S):
+            self._schedule_flush()
+
+    def _schedule_flush(self) -> None:
+        batch, self._pending = self._pending, []
+        self._last_flush = time.time()
+        prev = self._flush_task
+
+        async def run() -> None:
+            if prev is not None and not prev.done():
+                await asyncio.shield(prev)      # keep append order
+            await asyncio.to_thread(self._flush_sync, batch)
+
+        try:
+            self._flush_task = asyncio.get_running_loop().create_task(run())
+        except RuntimeError:                    # no loop (sync tests/tools)
+            self._flush_sync(batch)
+
+    def _flush_sync(self, batch: list[str]) -> None:
+        if self._file is None:
+            return
+        data = "".join(batch)
+        self._file.write(data)
+        self._file_bytes += len(data)
         if self._file_bytes > ROTATE_BYTES:
             self._file.close()
             self._open_file()
@@ -140,9 +173,17 @@ class DownloadRecords:
         self._peer_rows = (peer + self._peer_rows)[-MAX_BUFFERED_ROWS:]
 
     def close(self) -> None:
+        if self._pending:
+            self._flush_sync(self._pending)
+            self._pending = []
         if self._file is not None:
             self._file.close()
             self._file = None
 
 
-assert FEATURE_DIM == 7  # drift guard: schema changes must touch all parties
+# drift guard: schema changes must touch all parties (not an assert — that
+# would be silently stripped under `python -O`)
+if FEATURE_DIM != 7:
+    raise RuntimeError(f"records schema expects FEATURE_DIM=7, trainer "
+                       f"declares {FEATURE_DIM}; update on_piece/features.py "
+                       f"together")

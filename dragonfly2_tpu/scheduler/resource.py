@@ -17,7 +17,7 @@ import time
 from ..common.dag import DAG, DAGError
 from ..common.errors import Code, DFError
 from ..idl.messages import Host as HostMsg
-from ..idl.messages import PieceInfo, SizeScope, TaskType
+from ..idl.messages import HostType, PieceInfo, SizeScope, TaskType
 
 log = logging.getLogger("df.sched.resource")
 
@@ -64,9 +64,27 @@ _TASK_TRANSITIONS: dict[TaskState, set[TaskState]] = {
 # ---------------------------------------------------------------- entities
 
 class Host:
-    def __init__(self, msg: HostMsg):
+    # Defaults when the daemon announces 0 ("auto"). Slots ride DAG edges
+    # (one slot per parent->child assignment for the child's whole download),
+    # so the limit is the node's max direct children in the distribution
+    # DAG. It bounds metadata fan-in, not bytes — per-transfer 503
+    # backpressure (upload_server) and super-seed announcement rationing
+    # (rpcserver._SuperSeed) are what keep a loaded host from serving
+    # every child. The limit must stay loose enough that every child can
+    # hold a few mesh parents (an edge-starved child degenerates to
+    # seed-only and the seed reveals everything to it); 2x the candidate
+    # set (4, reference scheduler/config/constants.go:33) leaves headroom.
+    # Overridable per host (daemon upload config) and per cluster
+    # (SchedulerConfig.{peer,seed}_upload_limit).
+    DEFAULT_PEER_UPLOAD_LIMIT = 8
+    DEFAULT_SEED_UPLOAD_LIMIT = 16
+
+    def __init__(self, msg: HostMsg, *, peer_upload_limit: int = 0,
+                 seed_upload_limit: int = 0):
         self.id = msg.id
         self.msg = msg
+        self.peer_upload_limit = peer_upload_limit or self.DEFAULT_PEER_UPLOAD_LIMIT
+        self.seed_upload_limit = seed_upload_limit or self.DEFAULT_SEED_UPLOAD_LIMIT
         self.concurrent_upload_count = 0
         self.upload_success = 0
         self.upload_fail = 0
@@ -75,7 +93,11 @@ class Host:
 
     @property
     def upload_limit(self) -> int:
-        return self.msg.concurrent_upload_limit or 100
+        if self.msg.concurrent_upload_limit > 0:
+            return self.msg.concurrent_upload_limit
+        if self.msg.type != HostType.NORMAL:
+            return self.seed_upload_limit
+        return self.peer_upload_limit
 
     def free_upload_slots(self) -> int:
         return max(0, self.upload_limit - self.concurrent_upload_count)
@@ -112,7 +134,7 @@ class Peer:
         self.piece_costs_ms: list[int] = []       # recent piece costs (bad-node)
         self.schedule_count = 0                   # packets sent to this peer
         self.report_fail_count = 0                # failed piece reports
-        self.blocked_parents: set[str] = set()
+        self.blocked_parents: dict[str, float] = {}   # parent id -> expiry
         self.last_offer_ids: set[str] = set()     # parents last pushed to peer
         self.packet_sink = None                   # set by the report stream
         self.created_at = time.time()
@@ -132,6 +154,22 @@ class Peer:
     def touch(self) -> None:
         self.updated_at = time.time()
 
+    def block_parent(self, parent_id: str, ttl_s: float = 10.0) -> None:
+        """Exclude a parent after a failed fetch. Time-bounded: a transient
+        wobble (restart, brief overload) must not sever the pair for the
+        rest of the task — permanent ejection is the Z-score bad-node
+        check's job, not the blocklist's."""
+        self.blocked_parents[parent_id] = time.time() + ttl_s
+
+    def is_blocked(self, parent_id: str) -> bool:
+        expiry = self.blocked_parents.get(parent_id)
+        if expiry is None:
+            return False
+        if time.time() >= expiry:
+            del self.blocked_parents[parent_id]
+            return False
+        return True
+
     def observe_piece_cost(self, cost_ms: int) -> None:
         self.piece_costs_ms.append(cost_ms)
         if len(self.piece_costs_ms) > 20:
@@ -142,11 +180,14 @@ class Peer:
                               PeerState.LEAVING)
 
     def has_content(self) -> bool:
-        """Usable as a parent: finished, or running with pieces to share."""
-        if self.state == PeerState.SUCCEEDED:
+        """Usable as a parent: finished, running with pieces to share, or
+        back-sourcing (its origin pull will announce pieces over the sync
+        stream moments from now — children attach early so the pipeline
+        preforms instead of polling for the seed's first piece; reference
+        ``scheduling.go:538-541`` similarly admits back-source parents)."""
+        if self.state in (PeerState.SUCCEEDED, PeerState.BACK_SOURCE):
             return True
-        return (self.state in (PeerState.RUNNING, PeerState.BACK_SOURCE)
-                and bool(self.finished_pieces))
+        return self.state == PeerState.RUNNING and bool(self.finished_pieces)
 
 
 class Task:
@@ -276,12 +317,16 @@ class Resource:
 
     def __init__(self, *, peer_ttl_s: float = 24 * 3600.0,
                  task_ttl_s: float = 24 * 3600.0,
-                 host_ttl_s: float = 6 * 3600.0):
+                 host_ttl_s: float = 6 * 3600.0,
+                 peer_upload_limit: int = 0,
+                 seed_upload_limit: int = 0):
         self.tasks: dict[str, Task] = {}
         self.hosts: dict[str, Host] = {}
         self.peer_ttl_s = peer_ttl_s
         self.task_ttl_s = task_ttl_s
         self.host_ttl_s = host_ttl_s
+        self.peer_upload_limit = peer_upload_limit
+        self.seed_upload_limit = seed_upload_limit
 
     # -- lookups -------------------------------------------------------
 
@@ -296,7 +341,8 @@ class Resource:
     def store_host(self, msg: HostMsg) -> Host:
         host = self.hosts.get(msg.id)
         if host is None:
-            host = Host(msg)
+            host = Host(msg, peer_upload_limit=self.peer_upload_limit,
+                        seed_upload_limit=self.seed_upload_limit)
             self.hosts[msg.id] = host
         else:
             host.touch(msg)

@@ -10,6 +10,7 @@ DAG-cycle, bad-node, free-upload-slot checks), with the
 from __future__ import annotations
 
 import logging
+import random
 
 from ..idl.messages import PeerAddr, PeerPacket
 from ..tpu.topology import link_type
@@ -28,26 +29,43 @@ class Scheduling:
     # ------------------------------------------------------------------
 
     def filter_candidates(self, child: Peer) -> list[Peer]:
-        """All legal parents for ``child``, pre-scoring (the filter half)."""
+        """All legal parents for ``child``, pre-scoring (the filter half).
+
+        The pool is sampled in random order (reference ``LoadRandomPeers``,
+        ``scheduling.go:511``): a deterministic iteration order would hand
+        every child the same first-N candidates and herd the fan-out onto
+        them."""
         task = child.task
+        pool = list(task.peers.values())
+        random.shuffle(pool)
         out: list[Peer] = []
-        for parent in task.peers.values():
+        for parent in pool:
             if len(out) >= self.cfg.filter_parent_limit:
                 break
             if parent.id == child.id:
                 continue
-            if parent.id in child.blocked_parents:
+            if child.is_blocked(parent.id):
+                self._trace(child, parent, "blocklist")
                 continue
             if not parent.has_content():
                 continue
             if parent.host.free_upload_slots() <= 0:
+                self._trace(child, parent, "no-slots")
                 continue
             if self.evaluator.is_bad_node(parent):
+                self._trace(child, parent, "bad-node")
                 continue
             if task.would_cycle(parent.id, child.id):
+                self._trace(child, parent, "cycle")
                 continue
             out.append(parent)
         return out
+
+    @staticmethod
+    def _trace(child: Peer, parent: Peer, reason: str) -> None:
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug("filter %s: parent %s excluded (%s)",
+                      child.id[-12:], parent.id[-12:], reason)
 
     def find_parents(self, child: Peer) -> list[Peer]:
         candidates = self.filter_candidates(child)
@@ -60,6 +78,23 @@ class Scheduling:
                                                   total_piece_count=total),
             reverse=True)
         return scored[:self.cfg.candidate_parent_limit]
+
+    def refresh_parents(self, child: Peer) -> list[Peer]:
+        """Sticky variant of ``find_parents`` for mid-download re-offers:
+        current parents that are still legal stay, best newcomers fill the
+        remaining candidate slots."""
+        candidates = self.filter_candidates(child)
+        if not candidates:
+            return []
+        total = child.task.total_piece_count
+        scored = sorted(
+            candidates,
+            key=lambda p: self.evaluator.evaluate(child, p,
+                                                  total_piece_count=total),
+            reverse=True)
+        kept = [p for p in scored if p.id in child.last_offer_ids]
+        fresh = [p for p in scored if p.id not in child.last_offer_ids]
+        return (kept + fresh)[:self.cfg.candidate_parent_limit]
 
     # ------------------------------------------------------------------
 

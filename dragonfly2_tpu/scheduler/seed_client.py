@@ -50,7 +50,7 @@ class SeedPeerClient:
         host = self.resource.store_host(HostMsg(
             id=hid, ip=seed.ip, hostname=hid, port=seed.rpc_port,
             download_port=seed.download_port, type=HostType.SUPER_SEED,
-            concurrent_upload_limit=300))
+            concurrent_upload_limit=0))  # 0 = auto -> seed_upload_limit
         client = ServiceClient(self._channels.get(f"{seed.ip}:{seed.rpc_port}"),
                                SEEDER_SERVICE)
         seed_peer: Peer | None = None

@@ -27,7 +27,9 @@ class Scheduler:
         self.cfg = cfg
         self.resource = Resource(peer_ttl_s=cfg.peer_ttl_s,
                                  task_ttl_s=cfg.task_ttl_s,
-                                 host_ttl_s=cfg.host_ttl_s)
+                                 host_ttl_s=cfg.host_ttl_s,
+                                 peer_upload_limit=cfg.peer_upload_limit,
+                                 seed_upload_limit=cfg.seed_upload_limit)
         self.topo = TopologyStore()
         evaluator = make_evaluator(cfg.algorithm, topo_store=self.topo,
                                    infer=infer)

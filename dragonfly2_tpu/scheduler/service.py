@@ -182,12 +182,22 @@ class SchedulerService:
                 peer.last_offer_ids = {p.id for p in parents}
                 peer.task.set_parents(peer.id, [p.id for p in parents])
                 _schedules.labels("parents").inc()
+                log.debug("offer %s -> parents %s", peer.id[-12:],
+                          [p.id[-12:] for p in parents])
                 sink.put_nowait(self.scheduling.build_packet(peer, parents))
                 return
             now = asyncio.get_running_loop().time()
             seed_pending = (peer.task.seed_job is not None
                             and not peer.task.seed_job.done())
-            if now >= deadline or not seed_pending:
+            # feeders = content is coming even though no parent is legal
+            # RIGHT NOW (seed still origin-pulling, or peers hold pieces but
+            # their upload slots are full). Keep retrying: with binding slot
+            # limits a cold 16-child fan-out legitimately queues most
+            # children for a few hundred ms while the tree's first tier
+            # forms — sending them to origin instead would erase the egress
+            # savings the mesh exists for.
+            feeders = seed_pending or peer.task.has_available_peer()
+            if now >= deadline or not feeders:
                 packet = self._rule_back_source(peer)
                 if packet is not None:
                     sink.put_nowait(packet)
@@ -235,8 +245,18 @@ class SchedulerService:
             # re-offer parents every few reports so children spread onto the
             # mesh instead of herding on the first assignment (usually the
             # seed). Only pushed when the best-parent set actually changed.
-            if len(peer.finished_pieces) % 8 == 0:
+            if len(peer.finished_pieces) % 4 == 0:
                 await self._refresh_parents(peer)
+            elif len(peer.finished_pieces) == 1:
+                # this peer just became a usable parent: top up every child
+                # still short on parents NOW — waiting for their own next
+                # %4 report would leave the whole early fan-out herded on
+                # the seed (the only content-holder at register time)
+                for sibling in list(peer.task.peers.values()):
+                    if (sibling.id != peer.id and not sibling.is_done()
+                            and len(sibling.last_offer_ids)
+                            < self.cfg.candidate_parent_limit):
+                        await self._refresh_parents(sibling)
             return
         _piece_reports.labels("fail").inc()
         peer.report_fail_count += 1
@@ -244,7 +264,7 @@ class SchedulerService:
             parent = task.peers.get(result.dst_peer_id)
             if parent is not None:
                 parent.host.observe_upload(False)
-            peer.blocked_parents.add(result.dst_peer_id)
+            peer.block_parent(result.dst_peer_id)
         # losing a parent: offer a fresh assignment (or the origin)
         await self._reschedule(peer)
 
@@ -252,7 +272,13 @@ class SchedulerService:
         if (peer.packet_sink is None or peer.is_done()
                 or peer.state == PeerState.BACK_SOURCE):
             return
-        parents = self.scheduling.find_parents(peer)
+        # STICKY top-up: keep every still-legal current parent and only fill
+        # free candidate slots with the best newcomers. A fresh top-4 pick
+        # every refresh looks harmless but churns the whole mesh — scores sit
+        # within noise of each other, so sets rotate, the daemon tears down
+        # the dropped parents' sync streams, and accumulated piece-holder
+        # knowledge is thrown away mid-download.
+        parents = self.scheduling.refresh_parents(peer)
         if not parents:
             return
         new_ids = {p.id for p in parents}
@@ -264,6 +290,8 @@ class SchedulerService:
         peer.last_offer_ids = new_ids
         peer.task.set_parents(peer.id, [p.id for p in parents])
         _schedules.labels("refresh").inc()
+        log.debug("refresh %s -> parents %s", peer.id[-12:],
+                  [p.id[-12:] for p in parents])
         peer.packet_sink.put_nowait(self.scheduling.build_packet(peer, parents))
 
     async def _reschedule(self, peer: Peer) -> None:

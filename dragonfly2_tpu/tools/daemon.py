@@ -31,20 +31,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 async def serve(cfg: DaemonConfig) -> None:
-    scheduler_factory = None
-    p2p_factory = None
-    if cfg.scheduler.addresses:
-        from ..daemon.scheduler_session import SchedulerClient
-        from ..daemon.piece_engine import P2PEngine
-
-        def scheduler_factory(daemon):  # noqa: F811
-            return SchedulerClient(cfg.scheduler, daemon.host_info)
-
-        def p2p_factory():
-            return P2PEngine(cfg.download)
-
-    daemon = Daemon(cfg, scheduler_factory=scheduler_factory,
-                    p2p_engine_factory=p2p_factory)
+    # Daemon wires its own SchedulerConnector / PieceEngine from cfg
+    daemon = Daemon(cfg)
     await daemon.start()
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

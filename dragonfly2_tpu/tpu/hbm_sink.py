@@ -78,10 +78,16 @@ class DeviceIngest:
     """Streams a task's bytes into per-device shards as pieces arrive."""
 
     def __init__(self, content_length: int, *, devices: Any = None,
-                 sharding: Any = None, dtype: str = "uint8"):
-        """``devices``: explicit device list (round-robin shards), or
-        ``sharding``: a 1-D jax NamedSharding to assemble a global array on.
-        """
+                 sharding: Any = None, dtype: str = "uint8",
+                 shards_per_device: int = 1):
+        """``devices``: explicit device list (contiguous shards per device),
+        or ``sharding``: a 1-D jax NamedSharding to assemble a global array
+        on. ``shards_per_device`` > 1 pipelines the host->HBM DMA: each
+        device's range is cut into that many transfer units so streaming can
+        overlap even on a single chip (a 1-device host would otherwise hold
+        its one transfer until the last byte arrived). Only 1 is supported
+        with ``sharding`` (global-array assembly needs one array per
+        device)."""
         import jax
 
         if content_length <= 0:
@@ -90,12 +96,16 @@ class DeviceIngest:
         self.dtype = np.dtype(dtype)
         self._sharding = sharding
         if sharding is not None:
+            if shards_per_device != 1:
+                raise ValueError("shards_per_device must be 1 with sharding")
             devices = list(sharding.mesh.devices.flat)
         elif devices is None:
             devices = jax.devices()
         self.devices = list(devices)
-        n = len(self.devices)
-        # equal shards padded to dtype & device-count alignment
+        self.shards_per_device = max(1, shards_per_device)
+        n = len(self.devices) * self.shards_per_device
+        self.n_shards = n
+        # equal shards padded to dtype & shard-count alignment
         itemsize = self.dtype.itemsize
         padded = -(-content_length // (n * itemsize)) * (n * itemsize)
         self.padded_length = padded
@@ -118,7 +128,7 @@ class DeviceIngest:
         self._coverage.add(offset, end)
         first = offset // self.shard_bytes
         last = (end - 1) // self.shard_bytes
-        for shard in range(first, min(last + 1, len(self.devices))):
+        for shard in range(first, min(last + 1, self.n_shards)):
             self._maybe_send(shard)
 
     def _maybe_send(self, shard: int) -> None:
@@ -131,12 +141,13 @@ class DeviceIngest:
             if not self._coverage.covers(s, min(e, self.content_length)):
                 return
             view = self.host[s:e].view(self.dtype)
+            device = self.devices[shard // self.shards_per_device]
             # async dispatch: returns immediately, DMA overlaps further pieces.
             # array assignment stays under the lock so result()'s all-sent
             # check can never observe a sent-but-None shard.
-            self._shard_arrays[shard] = jax.device_put(view, self.devices[shard])
+            self._shard_arrays[shard] = jax.device_put(view, device)
             self._shard_sent[shard] = True
-        log.debug("shard %d/%d -> %s", shard, len(self.devices), self.devices[shard])
+        log.debug("shard %d/%d -> %s", shard, self.n_shards, device)
 
     def done_fraction(self) -> float:
         return self._coverage.covered_bytes() / self.padded_length
@@ -146,7 +157,7 @@ class DeviceIngest:
         practice the padding-only tail shards that no write ever touches.
         Shards with missing content bytes are left unsent (result() will
         name them)."""
-        for shard in range(len(self.devices)):
+        for shard in range(self.n_shards):
             self._maybe_send(shard)
 
     def result(self):

@@ -41,7 +41,9 @@ class TrainerService:
         self.train_in_thread = train_in_thread
         self.latest: dict[str, tuple[bytes, dict]] = {}   # name -> (blob, metrics)
         self._infer_cache: dict[str, object] = {}         # name -> callable
-        self._train_lock = asyncio.Lock()
+        self._spool_lock = asyncio.Lock()        # guards spool append/snapshot
+        self._fit_lock = asyncio.Lock()          # serializes model fitting
+        self._spool_clusters: set[int] = set()   # clusters feeding the spool
 
     # -- Train (client-stream) -----------------------------------------
 
@@ -59,10 +61,11 @@ class TrainerService:
             cluster_id = req.cluster_id or cluster_id
             if req.chunk:
                 bufs.setdefault(req.dataset, bytearray()).extend(req.chunk)
-        # spool-append and train-and-clear run under one lock: a concurrent
-        # stream's fresh rows must never be deleted by another stream's
-        # clear() before they were ever trained on
-        async with self._train_lock:
+        # spool-append and the training snapshot share one lock, but the
+        # FIT runs outside it: holding a lock across a 100-epoch fit would
+        # park every other scheduler's upload stream behind the training
+        # run — wrong shape for a fleet of schedulers feeding one trainer
+        async with self._spool_lock:
             got: dict[str, int] = {}
             for dataset, buf in bufs.items():
                 got[dataset] = await asyncio.to_thread(
@@ -70,38 +73,64 @@ class TrainerService:
                     uploader[1], bytes(buf))
             log.info("dataset upload from %s@%s (cluster %d): %s",
                      uploader[0], uploader[1], cluster_id, got or "empty")
-            version = await self._maybe_train(cluster_id)
+            if cluster_id:
+                self._spool_clusters.add(cluster_id)
+            snap = await self._snapshot()
+        version = await self._fit(snap) if snap is not None else ""
         return TrainResponse(ok=True, model_version=version,
                              message=f"rows={got}")
 
-    async def _maybe_train(self, cluster_id: int = 0) -> str:
-        """Fit on the spooled datasets (caller holds ``_train_lock``).
-        Returns the MLP version (the one schedulers serve); falls back to
-        the GNN's when only the GNN fit."""
+    async def _snapshot(self):
+        """Under ``_spool_lock``: decide what to fit, take the rows, and
+        clear the consumed spools so concurrent uploads start a fresh
+        dataset. Returns None when no floor is met."""
         rows = await asyncio.to_thread(self.storage.rows, "download")
         topo_rows = await asyncio.to_thread(self.storage.rows,
                                             "networktopology")
-        if len(rows) < self.min_rows and len(topo_rows) < 4:
-            return ""
-        if self.train_in_thread:
-            mlp = await asyncio.to_thread(training.train_mlp, rows)
-            gnn = await asyncio.to_thread(training.train_gnn, topo_rows)
-        else:
-            mlp = training.train_mlp(rows)
-            gnn = training.train_gnn(topo_rows)
-        for name, fitted in ((training.MLP_MODEL_NAME, mlp),
-                             (training.GNN_MODEL_NAME, gnn)):
-            if fitted is None:
-                continue
-            blob, metrics = fitted
-            self.latest[name] = (blob, metrics)
-            self._infer_cache.pop(name, None)
-            await self._publish(name, blob, metrics, cluster_id)
-        if mlp is not None:
-            # consumed: a new upload cycle starts a fresh dataset
+        # each model gates on ITS OWN dataset floor — topo rows being
+        # present must not let the MLP fit on a handful of download rows
+        fit_mlp = len(rows) >= self.min_rows
+        fit_gnn = len(topo_rows) >= 4
+        if not fit_mlp and not fit_gnn:
+            return None
+        # a model fit on one cluster's rows belongs to that cluster; a
+        # mixed spool is a global model (cluster 0), not the last uploader's
+        clusters = self._spool_clusters
+        cluster_id = next(iter(clusters)) if len(clusters) == 1 else 0
+        if fit_mlp:
             await asyncio.to_thread(self.storage.clear, "download")
-        if gnn is not None:
+        if fit_gnn:
             await asyncio.to_thread(self.storage.clear, "networktopology")
+        if fit_mlp and fit_gnn:
+            self._spool_clusters = set()
+        return (rows if fit_mlp else None,
+                topo_rows if fit_gnn else None, cluster_id)
+
+    async def _fit(self, snap) -> str:
+        """Fit on a snapshot (serialized by ``_fit_lock``, uploads NOT
+        blocked). Returns the MLP version (the one schedulers serve);
+        falls back to the GNN's when only the GNN fit."""
+        rows, topo_rows, cluster_id = snap
+        async with self._fit_lock:
+            mlp = gnn = None
+            if self.train_in_thread:
+                if rows is not None:
+                    mlp = await asyncio.to_thread(training.train_mlp, rows)
+                if topo_rows is not None:
+                    gnn = await asyncio.to_thread(training.train_gnn,
+                                                  topo_rows)
+            else:
+                mlp = training.train_mlp(rows) if rows is not None else None
+                gnn = (training.train_gnn(topo_rows)
+                       if topo_rows is not None else None)
+            for name, fitted in ((training.MLP_MODEL_NAME, mlp),
+                                 (training.GNN_MODEL_NAME, gnn)):
+                if fitted is None:
+                    continue
+                blob, metrics = fitted
+                self.latest[name] = (blob, metrics)
+                self._infer_cache.pop(name, None)
+                await self._publish(name, blob, metrics, cluster_id)
         if mlp is not None:
             return mlp[1]["version"]
         return gnn[1]["version"] if gnn is not None else ""

@@ -92,6 +92,11 @@ async def role_origin(path: str, mbps: float) -> None:
             status = 206
             headers["Content-Range"] = f"bytes {r.start}-{r.end-1}/{size}"
         headers["Content-Length"] = str(length)
+        if request.method == "HEAD":
+            # NEVER write a body for HEAD: a manually-streamed body poisons
+            # the keep-alive connection (the client pools it as clean, the
+            # stale body bytes then hang the next GET that reuses it)
+            return web.Response(status=status, headers=headers)
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         with open(path, "rb") as f:
@@ -281,6 +286,9 @@ async def tpu_ingest_bench(data_path: str, workdir: str) -> dict:
             status = 206
             headers["Content-Range"] = f"bytes {r.start}-{r.end-1}/{size}"
         headers["Content-Length"] = str(length)
+        if request.method == "HEAD":
+            # see role_origin: a HEAD body poisons the pooled connection
+            return web.Response(status=status, headers=headers)
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         with open(data_path, "rb") as f:
@@ -329,7 +337,8 @@ async def tpu_ingest_bench(data_path: str, workdir: str) -> dict:
             conductor = daemon.ptm.conductor(task_id)
             if sink is not None and conductor is not None \
                     and conductor.device_ingest is not None:
-                conductor.device_ingest.result()   # block on last DMA
+                # block on the last DMA off-loop (result() is blocking)
+                await asyncio.to_thread(conductor.device_ingest.result)
             return time.monotonic() - t0
 
         t_dl = await run_download(f"{base}/plain.bin", None)

@@ -213,10 +213,16 @@ class PeerTaskConductor:
                                 piece_digest, cost_ms=cost_ms, source=source,
                                 pre_verified=pre_verified)
         if self.device_ingest is not None:
+            # write() is a ~1ms memcpy + transfer-queue enqueue — the DMA
+            # itself runs on the sink's own thread and is never awaited
+            # here. Called inline: routing it through to_thread would queue
+            # the memcpy behind multi-ms piece-hashing jobs in the shared
+            # executor and serialize ingest with storage writes.
             try:
-                await asyncio.to_thread(self.device_ingest.write, offset, data)
+                self.device_ingest.write(offset, data)
             except Exception:
                 self.log.exception("device ingest write failed; disabling sink")
+                self.device_ingest.close()
                 self.device_ingest = None
         if self.shaper is not None:
             self.shaper.record(self.task_id, len(data))
@@ -277,9 +283,10 @@ class PeerTaskConductor:
                 total_piece_count=self.total_pieces)
         if self.device_ingest is not None:
             try:
-                self.device_ingest.flush()
+                self.device_ingest.flush()   # enqueue-only, non-blocking
             except Exception:
                 self.log.exception("device sink flush failed")
+                self.device_ingest.close()
                 self.device_ingest = None
         self.state = self.SUCCESS
         self._publish({"type": "done", "success": True,
@@ -298,6 +305,9 @@ class PeerTaskConductor:
         self.state = self.FAILED
         self.fail_code = code
         self.fail_message = message
+        if self.device_ingest is not None:
+            self.device_ingest.close()
+            self.device_ingest = None
         if self.storage is not None:
             try:
                 await asyncio.to_thread(self.storage.mark_done, success=False)

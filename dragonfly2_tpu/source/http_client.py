@@ -58,16 +58,23 @@ class HTTPSourceClient:
             await session.close()
 
     async def _head(self, req: SourceRequest) -> tuple[int, dict]:
+        # Probes carry ``Connection: close`` so their connections never enter
+        # the pool: a misbehaving origin that writes a body for HEAD (seen in
+        # the wild; any hand-rolled streaming handler) otherwise leaves the
+        # stale body in the pooled connection and the next GET that reuses
+        # it hangs waiting for response headers that never come.
         session = await self._get_session()
+        probe_headers = {**req.header, "Connection": "close"}
         try:
-            async with session.head(req.url, headers=req.header, allow_redirects=True,
+            async with session.head(req.url, headers=probe_headers,
+                                    allow_redirects=True,
                                     timeout=_timeout(req)) as resp:
                 if resp.status < 400:
                     return resp.status, dict(resp.headers)
         except aiohttp.ClientError:
             pass
         # some origins reject HEAD: 1-byte ranged GET as metadata probe
-        probe = {**req.header, "Range": "bytes=0-0"}
+        probe = {**probe_headers, "Range": "bytes=0-0"}
         try:
             async with session.get(req.url, headers=probe, allow_redirects=True,
                                    timeout=_timeout(req)) as resp:

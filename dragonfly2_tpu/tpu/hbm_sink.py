@@ -8,12 +8,17 @@ in GPU-side distribution stacks (see BASELINE.json north star). Design:
   staging area) at their content offsets, zero extra copies in Python
   (memoryview slicing).
 - The content is split into ``shard_count`` contiguous byte shards. The
-  moment every byte of a shard is present, that shard is handed to
-  ``jax.device_put`` — transfers overlap the rest of the download instead of
-  waiting for completion (piece-verify ∥ device-DMA, the overlap SURVEY §7
-  flags as the hard part).
-- ``result()`` assembles per-device shards into ONE logically-global jax.Array
-  via ``jax.make_array_from_single_device_arrays`` when a mesh sharding is
+  moment every byte of a shard is present, that shard's index is enqueued to
+  a dedicated transfer thread that owns every ``jax.device_put`` call.
+  ``write()`` never waits on a device transfer — on real TPU hardware
+  ``device_put`` of an unpinned host buffer is synchronous (it blocks the
+  caller for the whole staging copy + DMA), so dispatching it from the
+  asyncio event loop or awaiting it from the piece-landing path stalls the
+  daemon's own sockets. The worker thread absorbs that blocking; the landing
+  path only memcpys.
+- ``result()`` drains the transfer queue, blocks until the DMAs finish, and
+  assembles per-device shards into ONE logically-global jax.Array via
+  ``jax.make_array_from_single_device_arrays`` when a mesh sharding is
   given, so downstream JAX code sees a normal sharded array on the mesh.
 
 Single-host by design: each daemon feeds its own host's devices; cross-host
@@ -23,8 +28,9 @@ distribution is the P2P fabric's job, not XLA's.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
-from typing import Any
+from typing import Any, Callable
 
 import numpy as np
 
@@ -75,19 +81,28 @@ class CoverageMap:
 
 
 class DeviceIngest:
-    """Streams a task's bytes into per-device shards as pieces arrive."""
+    """Streams a task's bytes into per-device shards as pieces arrive.
+
+    All device transfers run on one dedicated worker thread so neither the
+    asyncio event loop nor the piece-landing path ever blocks on DMA
+    (the round-3 TPU failure mode: ``device_put`` on-loop starved the
+    daemon's sockets mid-download).
+    """
 
     def __init__(self, content_length: int, *, devices: Any = None,
                  sharding: Any = None, dtype: str = "uint8",
-                 shards_per_device: int = 1):
+                 shards_per_device: int = 1,
+                 device_put_fn: Callable[[Any, Any], Any] | None = None):
         """``devices``: explicit device list (contiguous shards per device),
         or ``sharding``: a 1-D jax NamedSharding to assemble a global array
         on. ``shards_per_device`` > 1 pipelines the host->HBM DMA: each
         device's range is cut into that many transfer units so streaming can
         overlap even on a single chip (a 1-device host would otherwise hold
-        its one transfer until the last byte arrived). Only 1 is supported
-        with ``sharding`` (global-array assembly needs one array per
-        device)."""
+        its one transfer until the last byte arrived) and so no single
+        ``device_put`` blocks the worker for the whole file. Only 1 is
+        supported with ``sharding`` (global-array assembly needs one array
+        per device). ``device_put_fn`` is injectable for tests (defaults to
+        ``jax.device_put``)."""
         import jax
 
         if content_length <= 0:
@@ -113,14 +128,29 @@ class DeviceIngest:
         self.host = np.zeros(padded, dtype=np.uint8)
         self._coverage = CoverageMap()
         self._shard_arrays: list[Any | None] = [None] * n
-        self._shard_sent = [False] * n
+        self._shard_sent = [False] * n       # transfer COMPLETED
+        self._shard_queued = [False] * n     # enqueued to the worker
         self._lock = threading.Lock()
+        self._device_put = device_put_fn or jax.device_put
+        self._queue: queue.SimpleQueue = queue.SimpleQueue()
+        self._pending = 0                    # queued-but-unfinished transfers
+        self._idle = threading.Event()
+        self._idle.set()
+        self._error: BaseException | None = None
+        self._closed = False
+        self._worker = threading.Thread(target=self._transfer_loop,
+                                        name="hbm-sink", daemon=True)
+        self._worker.start()
         if content_length < padded:  # pad tail is trivially "present"
             self._coverage.add(content_length, padded)
 
+    # ------------------------------------------------------------------
+    # producer side (piece-landing path) — never blocks on DMA
+    # ------------------------------------------------------------------
+
     def write(self, offset: int, data: bytes | memoryview) -> None:
-        """Land one verified piece; fires device transfers for any shard the
-        piece completes."""
+        """Land one verified piece; enqueues device transfers for any shard
+        the piece completes. Returns as soon as the memcpy is done."""
         end = offset + len(data)
         if end > self.content_length:
             raise ValueError(f"write beyond content: {end} > {self.content_length}")
@@ -129,52 +159,117 @@ class DeviceIngest:
         first = offset // self.shard_bytes
         last = (end - 1) // self.shard_bytes
         for shard in range(first, min(last + 1, self.n_shards)):
-            self._maybe_send(shard)
+            self._maybe_enqueue(shard)
 
-    def _maybe_send(self, shard: int) -> None:
-        import jax
-
+    def _maybe_enqueue(self, shard: int) -> None:
         s, e = shard * self.shard_bytes, (shard + 1) * self.shard_bytes
         with self._lock:
-            if self._shard_sent[shard]:
+            if self._shard_queued[shard] or self._closed:
                 return
             if not self._coverage.covers(s, min(e, self.content_length)):
                 return
-            view = self.host[s:e].view(self.dtype)
-            device = self.devices[shard // self.shards_per_device]
-            # async dispatch: returns immediately, DMA overlaps further pieces.
-            # array assignment stays under the lock so result()'s all-sent
-            # check can never observe a sent-but-None shard.
-            self._shard_arrays[shard] = jax.device_put(view, device)
-            self._shard_sent[shard] = True
-        log.debug("shard %d/%d -> %s", shard, self.n_shards, device)
+            self._shard_queued[shard] = True
+            self._pending += 1
+            self._idle.clear()
+            # put stays under the lock (SimpleQueue.put never blocks): outside
+            # it, a concurrent close() could slip its sentinel in first and
+            # the worker would exit with this shard queued behind it, leaving
+            # _pending stuck > 0 and drain() hung
+            self._queue.put(shard)
+
+    def flush(self) -> None:
+        """Enqueue any fully-covered shard whose transfer hasn't fired — in
+        practice the padding-only tail shards that no write ever touches.
+        Non-blocking; shards with missing content bytes are left unsent
+        (result() will name them)."""
+        for shard in range(self.n_shards):
+            self._maybe_enqueue(shard)
+
+    # ------------------------------------------------------------------
+    # worker thread — owns every device_put
+    # ------------------------------------------------------------------
+
+    def _transfer_loop(self) -> None:
+        while True:
+            shard = self._queue.get()
+            if shard is None:            # shutdown sentinel
+                return
+            try:
+                s, e = shard * self.shard_bytes, (shard + 1) * self.shard_bytes
+                view = self.host[s:e].view(self.dtype)
+                device = self.devices[shard // self.shards_per_device]
+                arr = self._device_put(view, device)
+                with self._lock:
+                    self._shard_arrays[shard] = arr
+                    self._shard_sent[shard] = True
+                log.debug("shard %d/%d -> %s", shard, self.n_shards, device)
+            except BaseException as exc:  # noqa: BLE001 - surfaced by result()
+                with self._lock:
+                    if self._error is None:
+                        self._error = exc
+                log.exception("device transfer of shard %d failed", shard)
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.set()
+                    # self-terminate once every shard has shipped: a consumer
+                    # that never calls result()/close() (task finished, nobody
+                    # collected) must not leak this thread + the file-sized
+                    # host buffer it pins for the daemon's lifetime
+                    if all(self._shard_sent):
+                        self._closed = True
+                        return
+
+    # ------------------------------------------------------------------
+    # consumer side
+    # ------------------------------------------------------------------
 
     def done_fraction(self) -> float:
         return self._coverage.covered_bytes() / self.padded_length
 
-    def flush(self) -> None:
-        """Send any fully-covered shard whose transfer hasn't fired — in
-        practice the padding-only tail shards that no write ever touches.
-        Shards with missing content bytes are left unsent (result() will
-        name them)."""
-        for shard in range(self.n_shards):
-            self._maybe_send(shard)
+    def drain(self, timeout: float | None = None) -> None:
+        """Block (the CALLING thread — run via to_thread from async code)
+        until every enqueued transfer has completed. Raises the first
+        transfer error, if any."""
+        if not self._idle.wait(timeout):
+            raise TimeoutError("device transfers still in flight")
+        with self._lock:
+            if self._error is not None:
+                raise RuntimeError("device transfer failed") from self._error
 
-    def result(self):
-        """Block until transfers finish; return the device-resident data.
+    def close(self) -> None:
+        """Stop the worker thread. Idempotent; safe mid-stream (pending
+        transfers finish first — the sentinel queues behind them)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._queue.put(None)
 
-        With a sharding: one global jax.Array of shape (padded_length //
+    def result(self, timeout: float | None = None):
+        """Flush + drain, then return the device-resident data.
+
+        Blocking — call via ``asyncio.to_thread`` from the event loop. With
+        a sharding: one global jax.Array of shape (padded_length //
         itemsize,) sharded over the mesh axis. Without: list of per-device
         arrays.
         """
         import jax
 
-        with self._lock:
-            sent = list(self._shard_sent)
-            arrays = list(self._shard_arrays)
-        if not all(sent):
-            missing = [i for i, s in enumerate(sent) if not s]
-            raise RuntimeError(f"shards incomplete: {missing}")
+        try:
+            self.flush()
+            self.drain(timeout)
+            with self._lock:
+                sent = list(self._shard_sent)
+                arrays = list(self._shard_arrays)
+            if not all(sent):
+                missing = [i for i, s in enumerate(sent) if not s]
+                raise RuntimeError(f"shards incomplete: {missing}")
+        finally:
+            # stop the worker on EVERY exit — a raising result() must not
+            # leave the thread parked on queue.get holding the host buffer
+            self.close()
         for a in arrays:
             a.block_until_ready()
         if self._sharding is None:

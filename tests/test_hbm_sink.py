@@ -75,8 +75,84 @@ class TestDeviceIngest:
         n_dev = len(jax.devices())
         ingest = DeviceIngest(n_dev * 1000, devices=jax.devices())
         ingest.write(0, b"a" * 1000)  # completes shard 0 only
+        ingest.drain(timeout=10)      # wait for the worker, not the loop
         assert ingest._shard_sent[0]
         assert not any(ingest._shard_sent[1:])
+
+    def test_write_never_blocks_on_transfer(self):
+        """The round-3 TPU regression: device_put is synchronous on real
+        hardware; write() must not wait on it. A deliberately-slow fake
+        device_put proves the landing path and the event loop stay live
+        while transfers grind on the worker thread."""
+        import asyncio
+        import time
+
+        import jax
+
+        put_calls = []
+
+        def slow_put(view, device):
+            time.sleep(0.25)          # a real-TPU-sized stall
+            put_calls.append(device)
+            return jax.device_put(view, device)
+
+        raw = bytes(1000) * 8
+        ingest = DeviceIngest(len(raw), devices=[jax.devices()[0]],
+                              shards_per_device=8, device_put_fn=slow_put)
+
+        async def scenario():
+            ticks = 0
+
+            async def heartbeat():
+                nonlocal ticks
+                while True:
+                    await asyncio.sleep(0.01)
+                    ticks += 1
+
+            hb = asyncio.get_running_loop().create_task(heartbeat())
+            t0 = time.monotonic()
+            for off in range(0, len(raw), 1000):
+                ingest.write(off, raw[off:off + 1000])  # on-loop, like a piece landing
+            write_elapsed = time.monotonic() - t0
+            # 8 shards x 0.25s of fake DMA; writes must not have waited
+            assert write_elapsed < 0.25, f"write blocked: {write_elapsed:.2f}s"
+            arrays = await asyncio.to_thread(ingest.result, 30)
+            hb.cancel()
+            return ticks, arrays
+
+        ticks, arrays = asyncio.run(scenario())
+        assert len(put_calls) == 8
+        assert len(arrays) == 8
+        # the loop kept running during the ~2s of transfers
+        assert ticks > 50, f"event loop starved: only {ticks} heartbeats"
+
+    def test_transfer_error_surfaces_in_result(self):
+        import jax
+
+        def bad_put(view, device):
+            raise RuntimeError("boom")
+
+        ingest = DeviceIngest(100, devices=[jax.devices()[0]],
+                              device_put_fn=bad_put)
+        ingest.write(0, b"x" * 100)
+        with pytest.raises(RuntimeError):
+            ingest.result(timeout=10)
+        ingest._worker.join(5)   # raising result() must still stop the worker
+        assert not ingest._worker.is_alive()
+
+    def test_worker_self_terminates_when_complete(self):
+        """A task nobody collects must not leak the transfer thread (one
+        file-sized host buffer pinned per leaked thread on a long-lived
+        daemon)."""
+        import jax
+
+        ingest = DeviceIngest(1000, devices=[jax.devices()[0]])
+        ingest.write(0, b"y" * 1000)   # completes the only shard
+        ingest._worker.join(5)
+        assert not ingest._worker.is_alive()
+        # result() still works after self-termination
+        arrays = ingest.result(timeout=5)
+        assert len(arrays) == 1
 
 
 class TestTopology:

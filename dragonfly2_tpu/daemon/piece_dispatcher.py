@@ -28,22 +28,47 @@ log = logging.getLogger("df.flow.dispatch")
 
 EXPLORE_RATIO = 0.1          # epsilon for random parent choice
 PARENT_FAIL_LIMIT = 3        # consecutive failures before ejection
+PARENT_FAIL_HARD_LIMIT = 12  # lifetime failures before permanent removal
+EJECT_COOLDOWN_S = 4.0       # local ejection is a cooldown, not a divorce
 _EWMA_ALPHA = 0.3
 BUSY_BACKOFF_S = 0.04        # ~one piece transfer at fan-out rates
+# Seed parents cost-multiplied so mesh peers win whenever they can serve:
+# the seed is the lender of last resort (its egress is the scarce resource
+# a fan-out exists to conserve), not a peer among peers. Demand-side
+# steering — unlike round 3's supply-side announcement starvation, a child
+# with ONLY the seed holding a piece still pulls it immediately.
+SEED_COST_FACTOR = 16.0
 
 
 class ParentState:
-    def __init__(self, peer_id: str, addr: str):
+    """Ejection semantics: a LOCAL failure verdict is a cooldown
+    (``EJECT_COOLDOWN_S``), not a divorce — under load spikes a child that
+    permanently severs pairs diverges from the scheduler's (stable) view,
+    gets no corrective packet, and degenerates to seed-only for the rest of
+    the task (the round-4 straggler pathology: one child 100% seed-sourced
+    at 8x the swarm's wall-clock). A scheduler prune (``removed``) and the
+    lifetime ``PARENT_FAIL_HARD_LIMIT`` stay permanent; the scheduler's
+    Z-score bad-node check is the authoritative long-term ejector."""
+
+    def __init__(self, peer_id: str, addr: str, *, is_seed: bool = False):
         self.peer_id = peer_id
         self.addr = addr                # "ip:download_port"
+        self.is_seed = is_seed
         self.ns_per_byte = 0.0          # latency EWMA, 0 = no data yet
         self.consecutive_fails = 0
+        self.total_fails = 0
         self.inflight = 0
-        self.ejected = False
+        self.removed = False            # permanent (scheduler prune / hard cap)
+        self.eject_until = 0.0          # local failure cooldown window
         self.busy_until = 0.0           # 503 backpressure: skip until then
         # read by bench.py's engine-state dump (BENCH_DEBUG_DIR)
         self.attempts = 0               # pieces ever dispatched here
         self.announced = 0              # piece announcements received
+
+    @property
+    def ejected(self) -> bool:
+        """Not usable right now (kept as a property — engine + bench read it)."""
+        return self.removed or self.eject_until > time.monotonic()
 
     def is_busy(self) -> bool:
         return self.busy_until > time.monotonic()
@@ -59,19 +84,28 @@ class ParentState:
                     self.ns_per_byte += _EWMA_ALPHA * (sample - self.ns_per_byte)
         else:
             self.consecutive_fails += 1
-            if self.consecutive_fails >= PARENT_FAIL_LIMIT:
-                self.ejected = True
+            self.total_fails += 1
+            if self.total_fails >= PARENT_FAIL_HARD_LIMIT:
+                self.removed = True
+            elif self.consecutive_fails >= PARENT_FAIL_LIMIT:
+                self.eject_until = time.monotonic() + EJECT_COOLDOWN_S
+                self.consecutive_fails = 0   # fresh chances after cooldown
 
     def score(self) -> float:
         """Lower is better. Unprobed parents score best so they get traffic;
         in-flight load scales the expected latency (a parent already serving
         k pieces will deliver the k+1st ~k times slower), which spreads a
-        fan-out across parents instead of herding onto the single fastest."""
+        fan-out across parents instead of herding onto the single fastest.
+        Seed parents carry SEED_COST_FACTOR so any usable mesh peer
+        outranks them."""
         if self.ns_per_byte <= 0:
             # still best-in-class, but spread concurrent dispatches across
-            # multiple unprobed parents instead of herding onto the first
-            return -1.0 + self.inflight * 0.01
-        return self.ns_per_byte * (1.0 + self.inflight)
+            # multiple unprobed parents instead of herding onto the first;
+            # unprobed PEERS outrank unprobed seeds
+            base = -0.5 if self.is_seed else -1.0
+            return base + self.inflight * 0.01
+        cost = self.ns_per_byte * (1.0 + self.inflight)
+        return cost * SEED_COST_FACTOR if self.is_seed else cost
 
 
 class _PieceState:
@@ -83,14 +117,29 @@ class _PieceState:
         self.inflight = False
 
 
+GROUP_LIMIT = 2   # max contiguous pieces per dispatch (one ranged GET)
+
+
 class Dispatch:
-    """One unit of work handed to a worker."""
+    """One unit of work handed to a worker: one or more CONTIGUOUS pieces
+    from one parent, fetched in a single ranged GET. Grouping amortizes the
+    per-request cost (HTTP framing, asyncio dispatch, report round-trips)
+    that dominates piece transfer on fast links — the same reason the
+    back-source path reads piece groups (reference
+    ``piece_manager.go:815 concurrentDownloadSourceByPieceGroup``)."""
 
-    __slots__ = ("piece", "parent")
+    __slots__ = ("pieces", "parent")
 
-    def __init__(self, piece: PieceInfo, parent: ParentState):
-        self.piece = piece
+    def __init__(self, pieces: list[PieceInfo], parent: ParentState):
+        self.pieces = pieces
         self.parent = parent
+
+    @property
+    def piece(self) -> PieceInfo:   # single-piece convenience (tests, logs)
+        return self.pieces[0]
+
+    def size(self) -> int:
+        return sum(p.range_size for p in self.pieces)
 
 
 class PieceDispatcher:
@@ -113,25 +162,42 @@ class PieceDispatcher:
     # ------------------------------------------------------------------
 
     async def add_parent(self, peer_id: str, addr: str, *,
-                         resurrect: bool = False) -> ParentState:
+                         resurrect: bool = False,
+                         is_seed: bool = False) -> ParentState:
         """Known parents keep their state. An ejected parent stays ejected
         unless ``resurrect`` (an explicit scheduler re-assignment) — piece
         announcements must NOT revive a parent the failure limit removed."""
         async with self._cond:
             st = self.parents.get(peer_id)
             if st is None or (st.ejected and resurrect):
-                st = ParentState(peer_id, addr)
+                fresh = ParentState(peer_id, addr, is_seed=is_seed)
+                if st is not None:
+                    # carry HALVED lifetime failures across resurrection: a
+                    # genuinely recovered parent works it off, a persistently
+                    # bad one re-trips the hard cap quickly instead of
+                    # getting a clean slate each scheduler re-offer
+                    fresh.total_fails = st.total_fails // 2
+                st = fresh
                 self.parents[peer_id] = st
             else:
                 st.addr = addr
+                st.is_seed = st.is_seed or is_seed
             self._cond.notify_all()
             return st
+
+    def hard_removed(self, peer_id: str) -> bool:
+        """Parent tripped the lifetime failure cap — only an explicit
+        scheduler re-assignment may revive it, never the engine's automatic
+        sync-stream resurrection."""
+        st = self.parents.get(peer_id)
+        return (st is not None and st.removed
+                and st.total_fails >= PARENT_FAIL_HARD_LIMIT)
 
     async def remove_parent(self, peer_id: str) -> None:
         async with self._cond:
             st = self.parents.get(peer_id)
             if st is not None:
-                st.ejected = True
+                st.removed = True
             # drop it from holder sets too: rarest-first rarity counts must
             # reflect live sources or removed parents skew piece choice
             for ps in self._pieces.values():
@@ -191,13 +257,29 @@ class PieceDispatcher:
             ps, holders = random.choice(
                 [c for c in candidates if len(c[1]) == rarity])
         if len(holders) > 1 and random.random() < self.explore_ratio:
-            parent = random.choice(holders)
+            # exploration probes MESH capacity; the seed's latency is already
+            # known territory (and every random pick of it costs scarce
+            # origin-side egress)
+            peers_only = [h for h in holders if not h.is_seed]
+            parent = random.choice(peers_only or holders)
         else:
             parent = min(holders, key=ParentState.score)
-        ps.inflight = True
+        group = [ps]
+        # extend with contiguous follow-on pieces the same parent holds
+        by_start = {p.info.range_start: p for p in self._pieces.values()
+                    if not p.inflight}
+        while len(group) < GROUP_LIMIT:
+            last = group[-1].info
+            nxt = by_start.get(last.range_start + last.range_size)
+            if (nxt is None or nxt is ps or nxt.inflight
+                    or parent.peer_id not in nxt.holders):
+                break
+            group.append(nxt)
+        for g in group:
+            g.inflight = True
         parent.inflight += 1
-        parent.attempts += 1
-        return Dispatch(ps.info, parent)
+        parent.attempts += len(group)
+        return Dispatch([g.info for g in group], parent)
 
     async def get(self, timeout: float | None = None) -> Dispatch | None:
         """Next (piece, parent) to fetch; None when closed or timed out."""
@@ -214,12 +296,20 @@ class PieceDispatcher:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None
-                # busy parents expire on a clock, not on a notify: poll so a
-                # piece whose only holders hit 503 is retried promptly
-                if any(p.is_busy() and not p.ejected
-                       for p in self.parents.values()):
-                    remaining = min(remaining or BUSY_BACKOFF_S,
-                                    BUSY_BACKOFF_S)
+                # busy and cooldown windows expire on a clock, not on a
+                # notify: wake at the nearest expiry so a piece whose only
+                # holders hit 503 (or an eject cooldown) is retried promptly
+                now = time.monotonic()
+                wake = None
+                for p in self.parents.values():
+                    if p.removed:
+                        continue
+                    for until in (p.busy_until, p.eject_until):
+                        if until > now:
+                            dt = max(until - now, 0.02)
+                            wake = dt if wake is None else min(wake, dt)
+                if wake is not None:
+                    remaining = min(remaining or wake, wake)
                 try:
                     await asyncio.wait_for(self._cond.wait(), remaining)
                 except asyncio.TimeoutError:
@@ -228,30 +318,51 @@ class PieceDispatcher:
 
     async def report_busy(self, d: Dispatch) -> None:
         """Parent answered 503 (upload slots full): not a failure — back off
-        that parent briefly and requeue the piece so another holder (or the
-        same one, later) serves it."""
+        that parent briefly and requeue the pieces so another holder (or the
+        same one, later) serves them."""
         async with self._cond:
             d.parent.inflight = max(0, d.parent.inflight - 1)
             d.parent.busy_until = time.monotonic() + BUSY_BACKOFF_S
-            ps = self._pieces.get(d.piece.piece_num)
-            if ps is not None:
-                ps.inflight = False
-            self._cond.notify_all()
-
-    async def report(self, d: Dispatch, *, ok: bool, cost_ms: int = 0) -> None:
-        async with self._cond:
-            d.parent.inflight = max(0, d.parent.inflight - 1)
-            d.parent.observe(cost_ms, d.piece.range_size, ok)
-            num = d.piece.piece_num
-            if ok:
-                self._done.add(num)
-                self._pieces.pop(num, None)
-            else:
-                ps = self._pieces.get(num)
+            for info in d.pieces:
+                ps = self._pieces.get(info.piece_num)
                 if ps is not None:
                     ps.inflight = False
-                    if d.parent.ejected:
-                        ps.holders.discard(d.parent.peer_id)
+            self._cond.notify_all()
+
+    async def report(self, d: Dispatch, *, ok: bool, cost_ms: int = 0,
+                     completed: list[int] | None = None) -> None:
+        """Outcome of one dispatch. ``completed`` narrows success to a
+        subset of the group's piece nums (mid-group digest mismatch);
+        ``cost_ms`` covers the whole transfer."""
+        async with self._cond:
+            d.parent.inflight = max(0, d.parent.inflight - 1)
+            done_nums = set(completed) if completed is not None else (
+                {p.piece_num for p in d.pieces} if ok else set())
+            landed = sum(p.range_size for p in d.pieces
+                         if p.piece_num in done_nums)
+            if done_nums:
+                d.parent.observe(cost_ms, landed, True)
+            # every piece that did NOT land is a strike — a parent corrupting
+            # half its pieces must not launder failures behind its groupmates'
+            # successes (partial groups would otherwise reset the fail count)
+            for _ in range(len(d.pieces) - len(done_nums)):
+                d.parent.observe(0, 0, False)
+            for info in d.pieces:
+                num = info.piece_num
+                if num in done_nums:
+                    self._done.add(num)
+                    self._pieces.pop(num, None)
+                else:
+                    ps = self._pieces.get(num)
+                    if ps is not None:
+                        ps.inflight = False
+                        # drop the holder only on PERMANENT removal: a
+                        # cooldown-ejected parent comes back in seconds, and
+                        # the per-stream announcement dedup (rpcserver sent
+                        # set) means it will never re-announce this piece —
+                        # discarding here would orphan the piece meshside
+                        if d.parent.removed:
+                            ps.holders.discard(d.parent.peer_id)
             self._cond.notify_all()
 
     @property

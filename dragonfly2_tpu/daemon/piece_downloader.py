@@ -88,3 +88,63 @@ class PieceDownloader:
                               f"piece {piece.piece_num} from {dst_addr}: "
                               f"digest mismatch")
         return data, cost_ms
+
+    async def download_span(self, *, dst_addr: str, task_id: str,
+                            src_peer_id: str, pieces: list[PieceInfo],
+                            ) -> tuple[list[tuple[PieceInfo, bytes]], int]:
+        """Fetch CONTIGUOUS pieces in one ranged GET; split + verify each.
+
+        Returns ([(piece, data), ...] for every piece whose digest checked
+        out, cost_ms). A digest mismatch drops that piece (the dispatcher
+        requeues it) without failing its groupmates. Transport errors raise
+        like ``download_piece``.
+        """
+        if len(pieces) == 1:
+            p = pieces[0]
+            data, cost = await self.download_piece(
+                dst_addr=dst_addr, task_id=task_id,
+                src_peer_id=src_peer_id, piece=p)
+            return [(p, data)], cost
+        url = f"http://{dst_addr}/download/{task_id[:3]}/{task_id}"
+        start = pieces[0].range_start
+        size = sum(p.range_size for p in pieces)
+        headers = {"Range": f"bytes={start}-{start + size - 1}"}
+        t0 = time.monotonic()
+        try:
+            async with self._get_session().get(
+                    url, headers=headers,
+                    params={"peerId": src_peer_id}) as resp:
+                if resp.status == 503:
+                    raise DFError(Code.CLIENT_PEER_BUSY,
+                                  f"parent {dst_addr} busy")
+                if resp.status not in (200, 206):
+                    raise DFError(
+                        Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                        f"parent {dst_addr} span @{start}+{size}: "
+                        f"HTTP {resp.status}")
+                data = await resp.read()
+        except DFError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - network boundary
+            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                          f"parent {dst_addr} span @{start}+{size}: "
+                          f"{type(exc).__name__}: {exc}") from None
+        cost_ms = int((time.monotonic() - t0) * 1000)
+        if len(data) != size:
+            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                          f"parent {dst_addr} span @{start}: short read "
+                          f"{len(data)}/{size}")
+        out: list[tuple[PieceInfo, bytes]] = []
+        view = memoryview(data)
+        off = 0
+        for p in pieces:
+            chunk = view[off:off + p.range_size]
+            off += p.range_size
+            if p.digest:
+                algo, want = digestlib.parse(p.digest)
+                if digestlib.hash_bytes(algo, chunk) != want:
+                    log.debug("span piece %d from %s: digest mismatch",
+                              p.piece_num, dst_addr)
+                    continue
+            out.append((p, bytes(chunk)))
+        return out, cost_ms

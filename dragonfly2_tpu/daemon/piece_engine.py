@@ -50,13 +50,22 @@ class _Synchronizer:
         self.parent = parent
         self.task: asyncio.Task | None = None
         self.stream = None              # live SyncPieceTasks stream
+        self._seen: set[int] = set()    # piece nums this parent announced
 
     def start(self) -> None:
         self.task = asyncio.get_running_loop().create_task(self._run())
 
+    def exhausted(self) -> bool:
+        """Parent has announced every piece of the task — pinging it cannot
+        reveal anything new."""
+        total = self.conductor.total_pieces
+        return total >= 0 and len(self._seen) >= total
+
     async def ping(self) -> None:
         """Starvation signal: ask the parent for more work (super-seeding
         parents respond by revealing more pieces; others re-announce)."""
+        if self.exhausted():
+            return
         stream = self.stream
         if stream is None:
             return
@@ -107,7 +116,10 @@ class _Synchronizer:
             # origin mid-flight): skip — the done-refresh re-announces all
             return
         dst_addr = packet.dst_addr or f"{self.parent.ip}:{self.parent.download_port}"
-        await self.engine.dispatcher.add_parent(self.parent.peer_id, dst_addr)
+        await self.engine.dispatcher.add_parent(self.parent.peer_id, dst_addr,
+                                                is_seed=self.parent.is_seed)
+        for p in packet.piece_infos or []:
+            self._seen.add(p.piece_num)
         infos = [p for p in (packet.piece_infos or [])
                  if p.piece_num not in self.conductor.ready]
         if infos:
@@ -134,6 +146,7 @@ class PieceEngine:
         self._own_channels = channel_pool is None
         self.dispatcher = PieceDispatcher()
         self._synchronizers: dict[str, _Synchronizer] = {}
+        self._current_parents: dict[str, PeerAddr] = {}  # latest assignment
         self._need_back_source = False
         self._first_parent = asyncio.Event()
         self._last_ping = 0.0
@@ -279,7 +292,9 @@ class PieceEngine:
                     continue
                 dl_addr = f"{parent.ip}:{parent.download_port}"
                 await self.dispatcher.add_parent(parent.peer_id, dl_addr,
-                                                 resurrect=True)
+                                                 resurrect=True,
+                                                 is_seed=parent.is_seed)
+                self._current_parents[parent.peer_id] = parent
                 sync = self._synchronizers.get(parent.peer_id)
                 if sync is None or (sync.task is not None and sync.task.done()):
                     sync = _Synchronizer(self, conductor, parent)
@@ -295,6 +310,7 @@ class PieceEngine:
                 for peer_id in list(self._synchronizers):
                     if peer_id not in assigned:
                         self._synchronizers.pop(peer_id).stop()
+                        self._current_parents.pop(peer_id, None)
                         await self.dispatcher.remove_parent(peer_id)
                 self._first_parent.set()
 
@@ -320,15 +336,39 @@ class PieceEngine:
         self._last_ping = now
         for sync in list(self._synchronizers.values()):
             await sync.ping()
+        # resurrect dead sync streams for parents the scheduler still
+        # assigns us: a stream that failed at setup (connect refused under a
+        # load spike) otherwise stays dead until the scheduler pushes a NEW
+        # packet — and the sticky refresh only pushes on set-change, so a
+        # stable assignment means no retry ever. This divergence is the
+        # 100%-seed-sourced straggler: a child that lost its mesh at t=0 and
+        # never got it back. Paced by the starvation gate above.
+        for peer_id, parent in list(self._current_parents.items()):
+            sync = self._synchronizers.get(peer_id)
+            if sync is not None and sync.task is not None and sync.task.done():
+                if self.dispatcher.hard_removed(peer_id):
+                    # lifetime fail cap: stays dead until the SCHEDULER
+                    # re-offers it in a packet (its blocklists are the
+                    # authority); auto-resurrecting here would loop a child
+                    # against a corrupt parent forever
+                    continue
+                # the stream's failure path marked the parent removed in the
+                # dispatcher — this is an explicit assignment-backed retry
+                await self.dispatcher.add_parent(
+                    peer_id, f"{parent.ip}:{parent.download_port}",
+                    resurrect=True, is_seed=parent.is_seed)
+                fresh = _Synchronizer(self, sync.conductor, parent)
+                self._synchronizers[peer_id] = fresh
+                fresh.start()
 
     async def _download_one(self, conductor, session, d: Dispatch) -> None:
         if conductor.rate_limiter is not None:
-            await conductor.rate_limiter.acquire(d.piece.range_size)
+            await conductor.rate_limiter.acquire(d.size())
         t0 = int(time.time() * 1000)
         try:
-            data, cost = await self.downloader.download_piece(
+            landed, cost = await self.downloader.download_span(
                 dst_addr=d.parent.addr, task_id=conductor.task_id,
-                src_peer_id=conductor.peer_id, piece=d.piece)
+                src_peer_id=conductor.peer_id, pieces=d.pieces)
         except DFError as exc:
             if exc.code == Code.CLIENT_PEER_BUSY:
                 # backpressure, not failure: requeue; no scheduler report
@@ -337,27 +377,37 @@ class PieceEngine:
                 await self.dispatcher.report_busy(d)
                 return
             _p2p_pieces.labels("fail").inc()
-            log.debug("piece %d from %s failed: %s", d.piece.piece_num,
+            log.debug("pieces %s from %s failed: %s",
+                      [p.piece_num for p in d.pieces],
                       d.parent.peer_id[-12:], exc)
             await self.dispatcher.report(d, ok=False)
-            if d.parent.ejected:
-                # ejected parent: its sync stream must die too, or a dead
-                # parent keeps the engine looking alive forever
+            if d.parent.removed:
+                # permanently removed (hard fail cap): its sync stream dies
+                # too, or a dead parent keeps the engine looking alive
+                # forever. Cooldown ejections keep their stream — the parent
+                # keeps announcing and gets retried when the window expires.
                 sync = self._synchronizers.get(d.parent.peer_id)
                 if sync is not None:
                     sync.stop()
-            await session.report_piece(self._piece_result(
-                conductor, d.piece, d.parent.peer_id, t0, ok=False,
-                code=exc.code))
+            for info in d.pieces:   # every group member failed, report each
+                await session.report_piece(self._piece_result(
+                    conductor, info, d.parent.peer_id, t0, ok=False,
+                    code=exc.code))
             return
-        await conductor.on_piece_from_peer(
-            d.piece.piece_num, d.piece.range_start, data, cost,
-            d.parent.peer_id, piece_digest=d.piece.digest)
-        _p2p_pieces.labels("ok").inc()
-        await self.dispatcher.report(d, ok=True, cost_ms=cost)
-        await session.report_piece(self._piece_result(
-            conductor, d.piece, d.parent.peer_id, t0, ok=True, cost_ms=cost,
-            finished=len(conductor.ready)))
+        per_piece_cost = max(1, cost // max(len(landed), 1))
+        for info, data in landed:
+            await conductor.on_piece_from_peer(
+                info.piece_num, info.range_start, data, per_piece_cost,
+                d.parent.peer_id, piece_digest=info.digest)
+            _p2p_pieces.labels("ok").inc()
+            await session.report_piece(self._piece_result(
+                conductor, info, d.parent.peer_id, t0, ok=True,
+                cost_ms=per_piece_cost, finished=len(conductor.ready)))
+        await self.dispatcher.report(
+            d, ok=True, cost_ms=cost,
+            completed=[info.piece_num for info, _ in landed])
+        if len(landed) < len(d.pieces):
+            _p2p_pieces.labels("fail").inc()
 
     @staticmethod
     def _piece_result(conductor, info: PieceInfo, parent_id: str, t0: int, *,

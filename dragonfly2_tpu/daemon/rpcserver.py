@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import AsyncIterator
+from typing import Any, AsyncIterator
 
 from ..common.errors import Code, DFError
 from ..idl.messages import (DeleteTaskRequest, DownloadRequest, Empty,
@@ -38,20 +38,35 @@ class _SuperSeed:
     (capped, see ``_rotate``) so a slow or dead child can never strand a
     piece, a departing child's exclusive assignments return to the pool, and
     a child whose mesh parents have nothing for it pulls more via starvation
-    pings (``reveal_to``). ``fanout=1`` is deliberate: with 2+, the first
-    couple of children to attach are both told about EVERY early piece and
-    source their whole prefix from the seed; with 1 they are forced to trade
-    with each other from the first piece on. This is the classic BitTorrent
+    pings (``reveal_to``). The fanout is deliberately a few, not 1 and not
+    all: round 3 ran fanout=1 and starved the pipeline (children idled
+    waiting for reveals — BENCH_r03 halved); full broadcast resurrects the
+    star. Supply-side rationing is only the coarse filter now — the fine
+    control is demand-side: children's dispatchers price seed transfers at
+    SEED_COST_FACTOR (piece_dispatcher.py) and the upload server 503s past
+    its per-transfer concurrency, so revealed-but-mesh-available pieces are
+    pulled from the mesh anyway. This is the classic BitTorrent
     "super-seeding" idea; the reference has no equivalent — its seeds
     announce everything (``rpcserver.go SyncPieceTasks``).
     """
 
-    def __init__(self, *, fanout: int = 1, rotate_interval_s: float = 1.0):
+    # Starvation-ping reveals are budgeted PER CHILD: a child running ahead
+    # of the mesh is perpetually starving (nobody else has its frontier
+    # pieces yet), pings constantly, and un-budgeted reveals turn it into
+    # the seed's dedicated first tier — one child sourcing ~everything from
+    # the seed (the round-4 max_seed_sourced_fraction outlier). Budgeted,
+    # it waits a beat and the mesh catches up; the seed's egress spreads
+    # evenly instead of concentrating.
+    REVEAL_RATE_PER_S = 0.6
+    REVEAL_BURST = 2.0
+
+    def __init__(self, *, fanout: int = 2, rotate_interval_s: float = 0.5):
         self.fanout = fanout
         self.rotate_interval_s = rotate_interval_s
         self.known: set[int] = set()
         self.assigned: dict[int, set[str]] = {}   # piece -> peer ids told
         self.subs: dict[str, asyncio.Queue] = {}  # peer id -> allowed nums
+        self._reveal_budget: dict[str, Any] = {}  # peer id -> TokenBucket
         self._rotor: asyncio.Task | None = None
 
     def _load(self, peer_id: str) -> int:
@@ -71,19 +86,27 @@ class _SuperSeed:
 
     def reveal_to(self, peer_id: str, n: int = 2) -> None:
         """Starvation pull: a child with idle workers and nothing
-        dispatchable asked for more work. Reveal it the ``n`` least-revealed
-        pieces it doesn't know yet. This is the growth path for reveals —
-        paced by actual mesh scarcity (a child the mesh feeds never pings),
-        so seed egress converges to exactly the demand the mesh cannot
-        meet."""
+        dispatchable asked for more work. Reveal it up to ``n`` of the
+        least-revealed pieces it doesn't know yet, within its per-child
+        budget (see REVEAL_RATE_PER_S). This is the growth path for
+        reveals — paced by actual mesh scarcity (a child the mesh feeds
+        never pings), so seed egress converges to the demand the mesh
+        cannot meet without any child making the seed its main parent."""
         q = self.subs.get(peer_id)
         if q is None:
             return
+        budget = self._reveal_budget.get(peer_id)
+        if budget is None:
+            from ..common.rate import TokenBucket
+            budget = self._reveal_budget[peer_id] = TokenBucket(
+                self.REVEAL_RATE_PER_S, burst=self.REVEAL_BURST)
         cands = sorted(
             (num for num in self.known
              if peer_id not in self.assigned.get(num, ())),
             key=lambda num: len(self.assigned.get(num, ())))
         for num in cands[:n]:
+            if not budget.try_acquire(1):
+                return
             self.assigned.setdefault(num, set()).add(peer_id)
             q.put_nowait(num)
 
@@ -98,6 +121,7 @@ class _SuperSeed:
 
     def unsubscribe(self, peer_id: str) -> None:
         self.subs.pop(peer_id, None)
+        self._reveal_budget.pop(peer_id, None)
         for owners in self.assigned.values():
             owners.discard(peer_id)
         if not self.subs and self._rotor is not None:
@@ -173,11 +197,57 @@ class DaemonService:
                            content_length=md.content_length,
                            piece_size=md.piece_size)
 
+    def _storage_for(self, task_id: str, conductor):
+        ts = self.ptm.storage_mgr.get(task_id)
+        if ts is None and conductor is not None:
+            ts = conductor.storage
+        return ts
+
+    def _packet_for_nums(self, request: PieceTaskRequest, conductor,
+                         nums: list[int]) -> PiecePacket | None:
+        """Announcement packet carrying exactly ``nums`` (batch push)."""
+        ts = self._storage_for(request.task_id, conductor)
+        if ts is None:
+            return None
+        # direct dict lookups — a 70B-weights task has ~17k pieces and this
+        # runs per announcement wakeup per subscriber
+        infos = []
+        for n in nums:
+            p = ts.md.pieces.get(n)
+            if p is not None:
+                infos.append(p.to_info())
+        md = ts.md
+        return PiecePacket(task_id=request.task_id,
+                           dst_peer_id=request.dst_peer_id,
+                           dst_addr=self.upload_addr, piece_infos=infos,
+                           total_piece_count=md.total_piece_count,
+                           content_length=md.content_length,
+                           piece_size=md.piece_size)
+
+    @staticmethod
+    def _drain(q: asyncio.Queue, first) -> list:
+        """One awaited event + everything already queued behind it: under
+        load announcements batch into one packet per wakeup instead of one
+        per piece (the per-message overhead is what saturates a host fanning
+        out to many children)."""
+        events = [first]
+        while True:
+            try:
+                events.append(q.get_nowait())
+            except asyncio.QueueEmpty:
+                return events
+
     async def sync_piece_tasks(self, request_iter, context) -> AsyncIterator:
         """Bidi: each request asks for piece metadata; responses stream as
-        pieces appear (push on piece arrival for running tasks). Seed daemons
-        route announcements through the super-seed policy instead of
-        broadcasting everything."""
+        pieces appear (push on piece arrival for running tasks, batched per
+        wakeup). Seed daemons route announcements through the super-seed
+        policy instead of broadcasting everything."""
+        # sent survives ACROSS requests on one stream: follow-up requests are
+        # starvation pings, and answering each with the full piece list again
+        # (the old per-request reset) turns a starving swarm into an
+        # announcement flood — 10Hz x parents x children of full packets
+        sent: set[int] = set()
+        first_packet = True
         async for request in request_iter:
             conductor = self.ptm.conductor(request.task_id)
             if self.ptm.is_seed:
@@ -185,33 +255,41 @@ class DaemonService:
                                                          conductor, context):
                     yield packet
                 continue
-            sent: set[int] = set()
             packet = await self.get_piece_tasks(request, context)
-            for p in packet.piece_infos or []:
+            packet.piece_infos = [p for p in packet.piece_infos or []
+                                  if p.piece_num not in sent]
+            for p in packet.piece_infos:
                 sent.add(p.piece_num)
-            yield packet
+            if packet.piece_infos or first_packet:
+                first_packet = False
+                yield packet
             if conductor is None or conductor.done_event.is_set():
                 continue
             # live task: push updates until done
             q = conductor.subscribe()
             try:
-                while True:
-                    event = await q.get()
-                    if event["type"] == "piece" and event["num"] not in sent:
-                        sent.add(event["num"])
-                        refreshed = await self.get_piece_tasks(PieceTaskRequest(
-                            task_id=request.task_id,
-                            src_peer_id=request.src_peer_id,
-                            dst_peer_id=request.dst_peer_id,
-                            start_num=event["num"], limit=1), context)
-                        yield refreshed
-                    elif event["type"] == "done":
+                done = False
+                while not done:
+                    events = self._drain(q, await q.get())
+                    nums: list[int] = []
+                    for event in events:
+                        if (event["type"] == "piece"
+                                and event["num"] not in sent):
+                            sent.add(event["num"])
+                            nums.append(event["num"])
+                        elif event["type"] == "done":
+                            done = True
+                    if nums and not done:
+                        refreshed = self._packet_for_nums(request, conductor,
+                                                          nums)
+                        if refreshed is not None:
+                            yield refreshed
+                    elif done:
                         yield await self.get_piece_tasks(PieceTaskRequest(
                             task_id=request.task_id,
                             src_peer_id=request.src_peer_id,
                             dst_peer_id=request.dst_peer_id,
                             start_num=0, limit=0), context)
-                        break
             finally:
                 conductor.unsubscribe(q)
 
@@ -267,11 +345,10 @@ class DaemonService:
             base.piece_infos = []
             yield base
             while True:
-                num = await sq.get()
-                yield await self.get_piece_tasks(PieceTaskRequest(
-                    task_id=request.task_id, src_peer_id=request.src_peer_id,
-                    dst_peer_id=request.dst_peer_id,
-                    start_num=num, limit=1), context)
+                nums = self._drain(sq, await sq.get())
+                packet = self._packet_for_nums(request, conductor, nums)
+                if packet is not None:
+                    yield packet
         finally:
             pings.cancel()
             policy.unsubscribe(request.src_peer_id)

@@ -27,6 +27,67 @@ _upload_reqs = REGISTRY.counter("df_upload_requests_total",
                                 "piece requests served", ("status",))
 
 
+class _Slot:
+    """One concurrency-gate slot, held until the response BODY is fully
+    written (or the connection dies) — not merely until the handler
+    returns. aiohttp sends FileResponse/Response bodies after the handler
+    frame exits, so decrementing there would gate nothing on the transfer
+    path (the round-3 defect: with rate_limit_bps=0 the slot was held for
+    microseconds and the 503 backpressure never engaged)."""
+
+    __slots__ = ("server", "released")
+
+    def __init__(self, server: "UploadServer"):
+        self.server = server
+        self.released = False
+        server._active += 1
+
+    def release(self) -> None:
+        if not self.released:
+            self.released = True
+            self.server._active -= 1
+
+
+class _SlotFileResponse(web.FileResponse):
+    """FileResponse whose slot is held across the sendfile: aiohttp's
+    FileResponse transmits the body inside ``prepare()``."""
+
+    def __init__(self, path, slot: _Slot, **kwargs):
+        super().__init__(path, **kwargs)
+        self._slot = slot
+
+    async def prepare(self, request):
+        try:
+            return await super().prepare(request)
+        finally:
+            self._slot.release()
+
+
+class _SlotResponse(web.Response):
+    """Buffered response whose slot is held until write_eof (body bytes are
+    written by the server after the handler returns). prepare() also
+    releases on failure: a client that disconnects before the body is sent
+    makes aiohttp raise in prepare() and never call write_eof — without
+    this, each such disconnect leaks a slot until the peer 503s forever."""
+
+    def __init__(self, slot: _Slot, **kwargs):
+        super().__init__(**kwargs)
+        self._slot = slot
+
+    async def prepare(self, request):
+        try:
+            return await super().prepare(request)
+        except BaseException:
+            self._slot.release()
+            raise
+
+    async def write_eof(self, data: bytes = b""):
+        try:
+            return await super().write_eof(data)
+        finally:
+            self._slot.release()
+
+
 class UploadServer:
     # Concurrent piece transfers served at once when the daemon config says
     # "auto" (0). Beyond this the server answers 503 and the requesting
@@ -35,7 +96,7 @@ class UploadServer:
     # straight off the seed (the NIC would be split N ways and the mesh
     # would never carry a byte). A few concurrent transfers keep the NIC
     # full; more only dilute each one.
-    DEFAULT_CONCURRENT_LIMIT = 4
+    DEFAULT_CONCURRENT_LIMIT = 6
 
     def __init__(self, storage_mgr: StorageManager, *, port: int = 0,
                  rate_limit_bps: int = 0, concurrent_limit: int = 0,
@@ -96,20 +157,17 @@ class UploadServer:
             _upload_reqs.labels("503").inc()
             raise web.HTTPServiceUnavailable(
                 text="upload concurrency limit", headers={"Retry-After": "0"})
-        self._active += 1
+        slot = _Slot(self)   # held until the BODY is sent (slot classes)
         try:
             # whole-file tasks: serve via sendfile (FileResponse honors
             # Range) so piece bytes never enter Python — the upload path is
-            # the hottest loop on a seed peer. The concurrency gate covers
-            # the token acquire (the pacing point); aiohttp prepares the
-            # response itself after the handler returns (preparing it here
-            # double-prepares and resets the connection).
+            # the hottest loop on a seed peer.
             data_path = getattr(ts, "data_path", None)
             if data_path is not None and total >= 0:
                 await self.limiter.acquire(rng.length)
                 _upload_bytes.inc(rng.length)
                 _upload_reqs.labels("206").inc()
-                return web.FileResponse(data_path())
+                return _SlotFileResponse(data_path(), slot)
             try:
                 data = await asyncio.to_thread(ts.read_range, rng.start,
                                                rng.length)
@@ -119,10 +177,13 @@ class UploadServer:
             await self.limiter.acquire(len(data))
             _upload_bytes.inc(len(data))
             _upload_reqs.labels("206").inc()
-            return web.Response(
-                status=206, body=data,
+            return _SlotResponse(
+                slot, status=206, body=data,
                 headers={"Content-Range":
                          f"bytes {rng.start}-{rng.end - 1}/{total}",
                          "Content-Type": "application/octet-stream"})
-        finally:
-            self._active -= 1
+        except BaseException:
+            # never reached the transfer: give the slot back here (the
+            # response's own release only runs once it is being sent)
+            slot.release()
+            raise

@@ -234,6 +234,8 @@ class PeerAddr:
     rpc_port: int = 0
     download_port: int = 0
     link: LinkType = LinkType.DCN   # scheduler-computed locality to the child
+    is_seed: bool = False           # seed/super-seed host (dispatcher steers
+                                    # demand to mesh peers when they can serve)
 
 
 @message

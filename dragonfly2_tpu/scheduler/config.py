@@ -9,8 +9,15 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 
-# reference scheduler/config/constants.go:33-37
-CANDIDATE_PARENT_LIMIT = 4
+# The candidate set doubles the reference's 4
+# (scheduler/config/constants.go:33-37): piece-availability metadata flows
+# ONLY along parent->child sync streams, so the candidate limit is the
+# mesh's information fan-in. At 4 a cold fan-out's piece knowledge diffuses
+# slower than the origin trickles and children starve into seed pulls; at 8
+# a fresh piece is one peer-hop from most of a 16-child swarm. Transfers
+# stay bounded separately (upload-server concurrency), so extra parents
+# cost metadata streams, not bandwidth.
+CANDIDATE_PARENT_LIMIT = 8
 FILTER_PARENT_LIMIT = 15
 
 # reference scheduler/config/constants.go:63-71

@@ -67,17 +67,17 @@ class Host:
     # Defaults when the daemon announces 0 ("auto"). Slots ride DAG edges
     # (one slot per parent->child assignment for the child's whole download),
     # so the limit is the node's max direct children in the distribution
-    # DAG. It bounds metadata fan-in, not bytes — per-transfer 503
-    # backpressure (upload_server) and super-seed announcement rationing
-    # (rpcserver._SuperSeed) are what keep a loaded host from serving
-    # every child. The limit must stay loose enough that every child can
-    # hold a few mesh parents (an edge-starved child degenerates to
-    # seed-only and the seed reveals everything to it); 2x the candidate
-    # set (4, reference scheduler/config/constants.go:33) leaves headroom.
-    # Overridable per host (daemon upload config) and per cluster
-    # (SchedulerConfig.{peer,seed}_upload_limit).
-    DEFAULT_PEER_UPLOAD_LIMIT = 8
-    DEFAULT_SEED_UPLOAD_LIMIT = 16
+    # DAG — a loose safety valve against unbounded fan-in, NOT the transfer
+    # throttle. Reference parity: 200 peer / 500 seed
+    # (scheduler/config/constants.go:27-31). Round 3 set these to 8/16 and
+    # used them as the primary backpressure; combined with announcement
+    # rationing that starved the swarm (BENCH_r03 halved). The per-TRANSFER
+    # limits live where the bytes move: the upload server's concurrency
+    # gate + NIC token bucket, and the dispatcher's busy-backoff/load-aware
+    # scoring on the demand side. Overridable per host (daemon upload
+    # config) and per cluster (SchedulerConfig.{peer,seed}_upload_limit).
+    DEFAULT_PEER_UPLOAD_LIMIT = 200
+    DEFAULT_SEED_UPLOAD_LIMIT = 500
 
     def __init__(self, msg: HostMsg, *, peer_upload_limit: int = 0,
                  seed_upload_limit: int = 0):

@@ -49,7 +49,12 @@ class Scheduling:
                 continue
             if not parent.has_content():
                 continue
-            if parent.host.free_upload_slots() <= 0:
+            # a parent this child is ALREADY assigned to holds its edge (and
+            # slot) — re-checking free slots would evict current parents of
+            # any loaded host exactly when stickiness matters, and the
+            # engine's packet prune would then tear down their sync streams
+            if (parent.host.free_upload_slots() <= 0
+                    and parent.id not in child.last_offer_ids):
                 self._trace(child, parent, "no-slots")
                 continue
             if self.evaluator.is_bad_node(parent):
@@ -99,6 +104,8 @@ class Scheduling:
     # ------------------------------------------------------------------
 
     def build_packet(self, child: Peer, parents: list[Peer]) -> PeerPacket:
+        from ..idl.messages import HostType
+
         def addr(p: Peer) -> PeerAddr:
             same_host = p.host.id == child.host.id
             return PeerAddr(
@@ -106,7 +113,8 @@ class Scheduling:
                 rpc_port=p.host.msg.port,
                 download_port=p.host.msg.download_port,
                 link=link_type(child.host.msg.topology, p.host.msg.topology,
-                               same_host=same_host))
+                               same_host=same_host),
+                is_seed=p.host.msg.type != HostType.NORMAL)
         main = addr(parents[0]) if parents else None
         return PeerPacket(
             task_id=child.task.id, src_peer_id=child.id,

@@ -151,6 +151,8 @@ class SchedulerService:
         consumer = asyncio.get_running_loop().create_task(consume())
         scheduler_task = asyncio.get_running_loop().create_task(
             self._schedule_with_patience(peer, sink))
+        refresher = asyncio.get_running_loop().create_task(
+            self._refresh_loop(peer))
         try:
             while True:
                 packet = await sink.get()
@@ -163,10 +165,26 @@ class SchedulerService:
         finally:
             scheduler_task.cancel()
             consumer.cancel()
-            await asyncio.gather(consumer, scheduler_task,
+            refresher.cancel()
+            await asyncio.gather(consumer, scheduler_task, refresher,
                                  return_exceptions=True)
             if peer.packet_sink is sink:
                 peer.packet_sink = None
+
+    REFRESH_INTERVAL_S = 0.5
+
+    async def _refresh_loop(self, peer: Peer) -> None:
+        """Periodic sticky re-offer while the report stream is open: piece
+        distribution shifts continuously during a fan-out, and tying
+        re-offers to the child's own report cadence (round 3: every 4th
+        piece) leaves a slow child stuck with a stale parent set exactly
+        when it most needs fresh sources. No-ops (no push) whenever the
+        best sticky set is unchanged."""
+        while True:
+            await asyncio.sleep(self.REFRESH_INTERVAL_S)
+            if peer.is_done() or peer.state == PeerState.BACK_SOURCE:
+                return
+            await self._refresh_parents(peer)
 
     async def _schedule_with_patience(self, peer: Peer,
                                       sink: asyncio.Queue) -> None:
@@ -241,13 +259,9 @@ class SchedulerService:
                     parent.host.observe_upload(True)
             if self.records is not None and result.piece_info is not None:
                 self.records.on_piece(peer, result)
-            # periodic refresh: peers gain content as a fan-out progresses —
-            # re-offer parents every few reports so children spread onto the
-            # mesh instead of herding on the first assignment (usually the
-            # seed). Only pushed when the best-parent set actually changed.
-            if len(peer.finished_pieces) % 4 == 0:
-                await self._refresh_parents(peer)
-            elif len(peer.finished_pieces) == 1:
+            # the time-based _refresh_loop handles steady-state re-offers;
+            # the one event worth reacting to immediately:
+            if len(peer.finished_pieces) == 1:
                 # this peer just became a usable parent: top up every child
                 # still short on parents NOW — waiting for their own next
                 # %4 report would leave the whole early fan-out herded on

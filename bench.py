@@ -465,7 +465,29 @@ def fanout_wave(workdir: str, tag: str, n: int, sched_addr: str,
                                   f"{tag}{i}.err"))
                 for i in range(n)]
     daemons.extend(leechers)   # killed on any failure path
-    return run_wave(leechers)
+    result = run_wave(leechers)
+    # reap this wave's processes BEFORE the caller starts the next one:
+    # 16 daemons' teardown (channel close, daemon.stop, interpreter exit)
+    # costs seconds of CPU that would otherwise bleed into the next timed
+    # wave on a core-bound host
+    for p in leechers:
+        try:
+            p.p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    return result
+
+
+def _calibrate() -> float:
+    """Fixed-work CPU probe (GB/s of sha256 over 64 MiB): the bench host's
+    effective speed swings ~2-3x between runs (shared-host phases — the pure
+    device_put figure shows the same oscillation), so every run records the
+    host speed it saw alongside the numbers it produced."""
+    import hashlib
+    buf = b"\xa5" * (64 << 20)
+    t0 = time.monotonic()
+    hashlib.sha256(buf).hexdigest()
+    return round(len(buf) / 1e9 / (time.monotonic() - t0), 3)
 
 
 def main() -> None:
@@ -531,19 +553,44 @@ def main() -> None:
         log(f"fan-out {n_half} leechers (cold): {half_s:.2f}s "
             f"(origin egress {half_egress / 1e6:.0f} MB)")
 
-        # wave B: the measured fan-out, also cold
-        pre = origin_bytes()
-        fanout_s, seed_fracs, full_cpu = fanout_wave(workdir, "l", N_LEECHERS,
-                                           sched_addr,
-                                           f"{origin_base}/wave-full.bin",
-                                           daemons)
-        p2p_egress = origin_bytes() - pre
+        # wave B: the measured fan-out — MEDIAN of 3 cold waves. One wave's
+        # wall-clock on a contended host swings +-25%; the driver records a
+        # single bench invocation, so the stabilization has to live here.
+        runs = []
+        n_runs = int(os.environ.get("BENCH_FANOUT_RUNS", "3"))
+        for r in range(n_runs):
+            pre = origin_bytes()
+            fanout_s, seed_fracs, full_cpu = fanout_wave(
+                workdir, f"l{r}x", N_LEECHERS, sched_addr,
+                f"{origin_base}/wave-full-{r}.bin", daemons)
+            p2p_egress = origin_bytes() - pre
+            runs.append({"elapsed_s": fanout_s, "egress": p2p_egress,
+                         "seed_fracs": seed_fracs, "cpu": full_cpu})
+            seed_active = "?"
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{seed_info['download_port']}"
+                        f"/metrics", timeout=5) as resp:
+                    for line in resp.read().decode().splitlines():
+                        if line.startswith("df_upload_active_transfers"):
+                            seed_active = line.split()[-1]
+            except Exception:
+                pass
+            log(f"fan-out {N_LEECHERS} leechers (run {r}): {fanout_s:.2f}s "
+                f"(origin egress {p2p_egress / 1e6:.0f} MB, seed active "
+                f"slots after: {seed_active})")
+        runs.sort(key=lambda r: r["elapsed_s"])
+        med = runs[len(runs) // 2]
+        fanout_s, p2p_egress, full_cpu = (med["elapsed_s"], med["egress"],
+                                          med["cpu"])
+        seed_fracs = med["seed_fracs"]
         egress_saved = 1.0 - p2p_egress / max(direct_egress, 1)
         max_seed_frac = max(seed_fracs) if seed_fracs else 0.0
-        log(f"framework fan-out: {N_LEECHERS} leechers in {fanout_s:.2f}s "
-            f"(origin egress {p2p_egress / 1e6:.0f} MB, saved "
-            f"{egress_saved:.1%}); sublinearity {fanout_s / half_s:.2f}x for "
-            f"2x leechers; max seed-sourced fraction {max_seed_frac:.0%}")
+        log(f"framework fan-out (median of {n_runs}): {N_LEECHERS} leechers "
+            f"in {fanout_s:.2f}s (origin egress {p2p_egress / 1e6:.0f} MB, "
+            f"saved {egress_saved:.1%}); sublinearity "
+            f"{fanout_s / half_s:.2f}x for 2x leechers; max seed-sourced "
+            f"fraction {max_seed_frac:.0%}")
 
         # TPU leg: measured in THIS process on the real chip
         try:
@@ -569,8 +616,10 @@ def main() -> None:
         "max_seed_sourced_fraction": round(max_seed_frac, 3),
         "sublinearity_2x": round(fanout_s / half_s, 3),
         "host_cpus": os.cpu_count(),
+        "calib_sha256_gbps": _calibrate(),
         "wave_cpu_util": {"half": round(half_cpu, 3),
                           "full": round(full_cpu, 3)},
+        "fanout_runs_s": [round(r["elapsed_s"], 2) for r in runs],
         **tpu_stats,
     }))
 

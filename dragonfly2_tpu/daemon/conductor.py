@@ -71,6 +71,7 @@ class PeerTaskConductor:
         self.storage: TaskStorage | None = None
         self.device_ingest: Any = None
         self.ready: set[int] = set()          # piece numbers landed
+        self._landing: set[int] = set()       # pieces mid-write (dedup race)
         self.done_event = asyncio.Event()
         self._piece_cond = asyncio.Condition()
         self._subscribers: list[asyncio.Queue] = []
@@ -206,12 +207,22 @@ class PeerTaskConductor:
                           pre_verified: bool = False) -> None:
         if self.storage is None:
             raise DFError(Code.CLIENT_STORAGE_ERROR, "piece before content info")
-        if num in self.ready:
+        if num in self.ready or num in self._landing:
+            # _landing claims the piece BEFORE the await below: endgame
+            # duplicate racers land near-simultaneously, and a ready-only
+            # check would let both through (double-counted progress, double
+            # device-ingest writes, duplicate scheduler success reports)
             return
-        # hashing+write can take ms at 16MiB — keep the loop responsive
-        await asyncio.to_thread(self.storage.write_piece, num, offset, data,
-                                piece_digest, cost_ms=cost_ms, source=source,
-                                pre_verified=pre_verified)
+        self._landing.add(num)
+        try:
+            # hashing+write can take ms at 16MiB — keep the loop responsive
+            await asyncio.to_thread(self.storage.write_piece, num, offset,
+                                    data, piece_digest, cost_ms=cost_ms,
+                                    source=source, pre_verified=pre_verified)
+        finally:
+            self._landing.discard(num)
+        if num in self.ready:     # lost a race decided elsewhere
+            return
         if self.device_ingest is not None:
             # write() is a ~1ms memcpy + transfer-queue enqueue — the DMA
             # itself runs on the sink's own thread and is never awaited

@@ -109,15 +109,23 @@ class ParentState:
 
 
 class _PieceState:
-    __slots__ = ("info", "holders", "inflight")
+    __slots__ = ("info", "holders", "fetching")
 
     def __init__(self, info: PieceInfo):
         self.info = info
         self.holders: set[str] = set()   # parent peer ids that announced it
-        self.inflight = False
+        self.fetching: set[str] = set()  # parents currently transferring it
+
+    @property
+    def inflight(self) -> bool:
+        return bool(self.fetching)
 
 
 GROUP_LIMIT = 2   # max contiguous pieces per dispatch (one ranged GET)
+ENDGAME_PIECES = 2   # remaining-piece count at which duplicate racing is allowed
+# (kept tiny: each duplicate is a full extra transfer — on CPU-bound hosts
+# racing the whole tail measurably SLOWS the wave; this is stall insurance
+# for the final pieces, not a parallelism strategy)
 
 
 class Dispatch:
@@ -156,6 +164,11 @@ class PieceDispatcher:
         self._done: set[int] = set()
         self._closed = False
         self._cond = asyncio.Condition()
+        # endgame only when the TASK is nearly done (engine sets this from
+        # total_pieces - ready); the local _pieces count is useless as a
+        # gate because announcements are drip-fed — a child mid-swarm often
+        # knows few undone pieces while hundreds remain
+        self.endgame = False
 
     # ------------------------------------------------------------------
     # feeding: parents + announced pieces
@@ -248,7 +261,7 @@ class PieceDispatcher:
             if holders:
                 candidates.append((ps, holders))
         if not candidates:
-            return None
+            return self._pick_endgame()
         if self.ordered:
             ps, holders = min(candidates, key=lambda c: c[0].info.piece_num)
         else:
@@ -265,21 +278,65 @@ class PieceDispatcher:
         else:
             parent = min(holders, key=ParentState.score)
         group = [ps]
-        # extend with contiguous follow-on pieces the same parent holds
+        # extend with contiguous pieces the same parent holds, both
+        # directions (rarest-first may land mid-run or at a run's end)
         by_start = {p.info.range_start: p for p in self._pieces.values()
                     if not p.inflight}
+        by_end = {p.info.range_start + p.info.range_size: p
+                  for p in self._pieces.values() if not p.inflight}
+
+        def usable(cand) -> bool:
+            return (cand is not None and cand is not ps and not cand.inflight
+                    and parent.peer_id in cand.holders)
+
         while len(group) < GROUP_LIMIT:
             last = group[-1].info
             nxt = by_start.get(last.range_start + last.range_size)
-            if (nxt is None or nxt is ps or nxt.inflight
-                    or parent.peer_id not in nxt.holders):
+            if not usable(nxt):
                 break
             group.append(nxt)
+        while len(group) < GROUP_LIMIT:
+            head = group[0].info
+            prev = by_end.get(head.range_start)
+            if not usable(prev):
+                break
+            group.insert(0, prev)
         for g in group:
-            g.inflight = True
+            g.fetching.add(parent.peer_id)
         parent.inflight += 1
         parent.attempts += len(group)
         return Dispatch([g.info for g in group], parent)
+
+    def _pick_endgame(self) -> Dispatch | None:
+        """Tail latency killer: when only a handful of pieces remain and all
+        are already in flight, race a DUPLICATE request from another usable
+        holder — the first landing wins, the loser's bytes are discarded
+        (landing is idempotent). A slow or stalled parent on the last piece
+        otherwise sets the whole wave's wall-clock (BitTorrent's classic
+        endgame mode; the reference instead re-requests failed pieces only,
+        peertask_conductor.go:1089)."""
+        if not self.endgame or not self._pieces:
+            return None
+        best: tuple[int, _PieceState, ParentState] | None = None
+        for ps in self._pieces.values():
+            if not ps.fetching:
+                continue   # normal path will take it
+            alts = [self.parents[h] for h in ps.holders - ps.fetching
+                    if h in self.parents and not self.parents[h].ejected
+                    and not self.parents[h].is_busy()]
+            if not alts:
+                continue
+            parent = min(alts, key=ParentState.score)
+            key = len(ps.fetching)   # least-raced piece first
+            if best is None or key < best[0]:
+                best = (key, ps, parent)
+        if best is None:
+            return None
+        _, ps, parent = best
+        ps.fetching.add(parent.peer_id)
+        parent.inflight += 1
+        parent.attempts += 1
+        return Dispatch([ps.info], parent)
 
     async def get(self, timeout: float | None = None) -> Dispatch | None:
         """Next (piece, parent) to fetch; None when closed or timed out."""
@@ -326,7 +383,7 @@ class PieceDispatcher:
             for info in d.pieces:
                 ps = self._pieces.get(info.piece_num)
                 if ps is not None:
-                    ps.inflight = False
+                    ps.fetching.discard(d.parent.peer_id)
             self._cond.notify_all()
 
     async def report(self, d: Dispatch, *, ok: bool, cost_ms: int = 0,
@@ -342,10 +399,15 @@ class PieceDispatcher:
                          if p.piece_num in done_nums)
             if done_nums:
                 d.parent.observe(cost_ms, landed, True)
-            # every piece that did NOT land is a strike — a parent corrupting
-            # half its pieces must not launder failures behind its groupmates'
-            # successes (partial groups would otherwise reset the fail count)
-            for _ in range(len(d.pieces) - len(done_nums)):
+            if completed is not None:
+                # per-piece verdicts (digest checks): each corrupted piece is
+                # a strike — a parent corrupting half its pieces must not
+                # launder failures behind its groupmates' successes
+                for _ in range(len(d.pieces) - len(done_nums)):
+                    d.parent.observe(0, 0, False)
+            elif not ok:
+                # one failed TRANSFER is one strike, however many pieces
+                # happened to ride it
                 d.parent.observe(0, 0, False)
             for info in d.pieces:
                 num = info.piece_num
@@ -355,7 +417,7 @@ class PieceDispatcher:
                 else:
                     ps = self._pieces.get(num)
                     if ps is not None:
-                        ps.inflight = False
+                        ps.fetching.discard(d.parent.peer_id)
                         # drop the holder only on PERMANENT removal: a
                         # cooldown-ejected parent comes back in seconds, and
                         # the per-stream announcement dedup (rpcserver sent

@@ -25,7 +25,7 @@ from ..common.metrics import REGISTRY
 from ..idl.messages import (PeerAddr, PeerPacket, PieceInfo, PieceResult,
                             PieceTaskRequest, SizeScope)
 from ..rpc.client import ChannelPool, ServiceClient
-from .piece_dispatcher import Dispatch, PieceDispatcher
+from .piece_dispatcher import ENDGAME_PIECES, Dispatch, PieceDispatcher
 from .piece_downloader import PieceDownloader
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -234,6 +234,12 @@ class PieceEngine:
                 if (conductor.total_pieces >= 0
                         and len(conductor.ready) >= conductor.total_pieces):
                     return True
+                # endgame gate: duplicate-request racing only for the task's
+                # actual tail (see dispatcher._pick_endgame)
+                self.dispatcher.endgame = (
+                    conductor.total_pieces >= 0
+                    and conductor.total_pieces - len(conductor.ready)
+                    <= ENDGAME_PIECES)
                 if not self.dispatcher.has_live_parent():
                     # parents gone: give the scheduler a grace period to
                     # re-assign, then fall back to origin

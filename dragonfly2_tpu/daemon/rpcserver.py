@@ -119,7 +119,13 @@ class _SuperSeed:
             self._rotor = asyncio.get_running_loop().create_task(self._rotate())
         return q
 
-    def unsubscribe(self, peer_id: str) -> None:
+    def unsubscribe(self, peer_id: str, q: asyncio.Queue | None = None) -> None:
+        """``q`` guards reconnects: a child that re-subscribed on a new
+        stream must not have its fresh subscription torn down by the OLD
+        stream's cleanup (only the owner of the registered queue may
+        remove it)."""
+        if q is not None and self.subs.get(peer_id) is not q:
+            return
         self.subs.pop(peer_id, None)
         self._reveal_budget.pop(peer_id, None)
         for owners in self.assigned.values():
@@ -351,7 +357,16 @@ class DaemonService:
                     yield packet
         finally:
             pings.cancel()
-            policy.unsubscribe(request.src_peer_id)
+            policy.unsubscribe(request.src_peer_id, sq)
+            # last subscriber gone: evict the policy + feeder, or a
+            # long-lived seed leaks one _SuperSeed (known/assigned sets)
+            # and a finished feeder entry per task ever served. A later
+            # subscriber recreates both from storage.
+            if not policy.subs:
+                self._superseed.pop(request.task_id, None)
+                feeder = self._superseed_feeders.pop(request.task_id, None)
+                if feeder is not None:
+                    feeder.cancel()
 
     # -- seeder API ----------------------------------------------------
 

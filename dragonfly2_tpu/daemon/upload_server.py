@@ -25,6 +25,8 @@ _upload_bytes = REGISTRY.counter("df_upload_bytes_total",
                                  "bytes served to other peers")
 _upload_reqs = REGISTRY.counter("df_upload_requests_total",
                                 "piece requests served", ("status",))
+_upload_active = REGISTRY.gauge("df_upload_active_transfers",
+                                "concurrency-gate slots currently held")
 
 
 class _Slot:
@@ -41,11 +43,13 @@ class _Slot:
         self.server = server
         self.released = False
         server._active += 1
+        _upload_active.set(server._active)
 
     def release(self) -> None:
         if not self.released:
             self.released = True
             self.server._active -= 1
+            _upload_active.set(self.server._active)
 
 
 class _SlotFileResponse(web.FileResponse):

@@ -43,6 +43,7 @@ class DownloadRecords:
         self._file_bytes = 0
         self._pending: list[str] = []
         self._flush_task: asyncio.Task | None = None
+        self._timer_task: asyncio.Task | None = None
         self._last_flush = time.time()
         if records_dir:
             os.makedirs(records_dir, exist_ok=True)
@@ -123,9 +124,19 @@ class DownloadRecords:
         if self._file is None:
             return
         self._pending.append(json.dumps(row) + "\n")
+        self._ensure_timer()   # from the FIRST buffered row, not first flush
         if (len(self._pending) >= FLUSH_BATCH_ROWS
                 or time.time() - self._last_flush > FLUSH_MAX_AGE_S):
             self._schedule_flush()
+
+    def _ensure_timer(self) -> None:
+        if self._timer_task is not None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        self._timer_task = loop.create_task(self._timer_flush())
 
     def _schedule_flush(self) -> None:
         batch, self._pending = self._pending, []
@@ -134,13 +145,29 @@ class DownloadRecords:
 
         async def run() -> None:
             if prev is not None and not prev.done():
-                await asyncio.shield(prev)      # keep append order
+                try:
+                    await asyncio.shield(prev)  # keep append order
+                except Exception:               # noqa: BLE001
+                    # a failed earlier batch must not take this one with it
+                    log.warning("previous record flush failed", exc_info=True)
             await asyncio.to_thread(self._flush_sync, batch)
 
         try:
-            self._flush_task = asyncio.get_running_loop().create_task(run())
+            loop = asyncio.get_running_loop()
         except RuntimeError:                    # no loop (sync tests/tools)
             self._flush_sync(batch)
+            return
+        self._flush_task = loop.create_task(run())
+
+    async def _timer_flush(self) -> None:
+        """Age-based flush: _write only checks FLUSH_MAX_AGE_S on the next
+        row, so under a trickle the last <64 rows would sit buffered
+        indefinitely without this."""
+        while self._file is not None:
+            await asyncio.sleep(FLUSH_MAX_AGE_S)
+            if (self._pending
+                    and time.time() - self._last_flush > FLUSH_MAX_AGE_S):
+                self._schedule_flush()
 
     def _flush_sync(self, batch: list[str]) -> None:
         if self._file is None:
@@ -172,7 +199,27 @@ class DownloadRecords:
         self._rows = (piece + self._rows)[-MAX_BUFFERED_ROWS:]
         self._peer_rows = (peer + self._peer_rows)[-MAX_BUFFERED_ROWS:]
 
+    async def aclose(self) -> None:
+        """Drain the in-flight flush chain, write the tail, close the file.
+        The async variant is the correct one inside a running scheduler —
+        ``close()`` alone can race a background ``to_thread`` write against
+        the file close (rows lost or write-to-closed-file)."""
+        if self._timer_task is not None:
+            self._timer_task.cancel()
+            self._timer_task = None
+        task = self._flush_task
+        if task is not None and not task.done():
+            try:
+                await task
+            except Exception:                   # noqa: BLE001
+                log.warning("final record flush failed", exc_info=True)
+        self._flush_task = None
+        self.close()
+
     def close(self) -> None:
+        if self._timer_task is not None:
+            self._timer_task.cancel()
+            self._timer_task = None
         if self._pending:
             self._flush_sync(self._pending)
             self._pending = []

@@ -113,7 +113,7 @@ class Scheduler:
         if self.announcer is not None:
             await self.announcer.stop()
         if self.service.records is not None:
-            self.service.records.close()
+            await self.service.records.aclose()
         if getattr(self, "manager", None) is not None:
             await self.manager.close()
         await self.gc.stop()

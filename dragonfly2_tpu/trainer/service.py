@@ -76,7 +76,24 @@ class TrainerService:
             if cluster_id:
                 self._spool_clusters.add(cluster_id)
             snap = await self._snapshot()
-        version = await self._fit(snap) if snap is not None else ""
+        version = ""
+        if snap is not None:
+            try:
+                version = await self._fit(snap)
+            except BaseException:
+                # the snapshot cleared the spools; a failed fit (bad rows,
+                # OOM) must put the rows back or the dataset is silently
+                # lost — contradicting the announcer's at-least-once design
+                rows, topo_rows, _ = snap
+                async with self._spool_lock:
+                    if rows:
+                        await asyncio.to_thread(
+                            self.storage.requeue_rows, "download", rows)
+                    if topo_rows:
+                        await asyncio.to_thread(
+                            self.storage.requeue_rows, "networktopology",
+                            topo_rows)
+                raise
         return TrainResponse(ok=True, model_version=version,
                              message=f"rows={got}")
 

@@ -65,6 +65,17 @@ class TrainerStorage:
                         log.warning("bad row in %s skipped", name)
         return out
 
+    def requeue_rows(self, dataset: str, rows: list[dict]) -> None:
+        """Return consumed rows after a FAILED fit (at-least-once delivery:
+        the announcer's upload already succeeded, so losing the snapshot
+        here would silently drop the dataset)."""
+        if not rows:
+            return
+        path = self._path(dataset, "requeued", "local")
+        with open(path, "a", encoding="utf-8") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+
     def clear(self, dataset: str | None = None) -> None:
         """Drop consumed datasets after a training run (reference clears
         per-host files the same way)."""

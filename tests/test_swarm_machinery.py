@@ -1,0 +1,438 @@
+"""Unit tests for the fan-out control machinery (VERDICT r3 #3 / r4).
+
+Covers the concurrency mechanics that shipped untested in round 3 plus the
+round-4 rework: _SuperSeed rationing/rotation/unsubscribe/reveal budgets,
+dispatcher busy-backoff + cooldown ejection + group dispatch + seed
+pricing, sticky refresh keeping loaded parents, TTL blocklist expiry, and
+the upload server's transfer-held concurrency slots. Style mirrors the
+reference's scripted in-process harnesses
+(``peer/peertask_manager_test.go:91-289``).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dragonfly2_tpu.daemon.piece_dispatcher import (
+    BUSY_BACKOFF_S, EJECT_COOLDOWN_S, GROUP_LIMIT, PARENT_FAIL_HARD_LIMIT,
+    PARENT_FAIL_LIMIT, Dispatch, ParentState, PieceDispatcher)
+from dragonfly2_tpu.daemon.rpcserver import _SuperSeed
+from dragonfly2_tpu.idl.messages import Host as HostMsg
+from dragonfly2_tpu.idl.messages import HostType, PieceInfo
+
+
+def info(num: int, size: int = 100) -> PieceInfo:
+    return PieceInfo(piece_num=num, range_start=num * size, range_size=size)
+
+
+# ======================================================================
+# _SuperSeed
+# ======================================================================
+
+class TestSuperSeed:
+    def run(self, coro):
+        return asyncio.run(coro)
+
+    def test_fanout_rations_each_piece(self):
+        async def main():
+            ss = _SuperSeed(fanout=2, rotate_interval_s=3600)
+            queues = {f"p{i}": ss.subscribe(f"p{i}") for i in range(6)}
+            ss.on_piece(0)
+            told = [pid for pid, q in queues.items() if not q.empty()]
+            assert len(told) == 2          # exactly fanout children told
+            assert len(ss.assigned[0]) == 2
+            for pid in list(ss.subs):
+                ss.unsubscribe(pid)
+        self.run(main())
+
+    def test_load_spreads_across_children(self):
+        async def main():
+            ss = _SuperSeed(fanout=1, rotate_interval_s=3600)
+            for i in range(4):
+                ss.subscribe(f"p{i}")
+            for num in range(8):
+                ss.on_piece(num)
+            loads = [ss._load(f"p{i}") for i in range(4)]
+            assert max(loads) - min(loads) <= 1   # least-loaded-first spread
+            for pid in list(ss.subs):
+                ss.unsubscribe(pid)
+        self.run(main())
+
+    def test_rotation_widens_but_never_broadcasts(self):
+        async def main():
+            ss = _SuperSeed(fanout=1, rotate_interval_s=0.01)
+            for i in range(8):
+                ss.subscribe(f"p{i}")
+            ss.on_piece(0)
+            # poll until the rotor reaches the cap (a fixed sleep flakes on
+            # loaded CI hosts), then hold a few more ticks to prove the cap
+            deadline = time.monotonic() + 5.0
+            while (len(ss.assigned[0]) < 2 * ss.fanout
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.02)
+            await asyncio.sleep(0.1)   # extra ticks must NOT widen further
+            # cap is 2x fanout: even with the swarm "stuck", no broadcast
+            assert len(ss.assigned[0]) == 2 * ss.fanout
+            for pid in list(ss.subs):
+                ss.unsubscribe(pid)
+        self.run(main())
+
+    def test_unsubscribe_returns_assignments(self):
+        async def main():
+            ss = _SuperSeed(fanout=1, rotate_interval_s=3600)
+            ss.subscribe("gone")
+            ss.on_piece(0)
+            assert ss.assigned[0] == {"gone"}
+            ss.unsubscribe("gone")
+            assert ss.assigned[0] == set()
+            # a new subscriber picks the returned piece up
+            q = ss.subscribe("fresh")
+            assert q.get_nowait() == 0
+            ss.unsubscribe("fresh")
+        self.run(main())
+
+    def test_reveal_budget_paces_starving_child(self):
+        async def main():
+            ss = _SuperSeed(fanout=1, rotate_interval_s=3600)
+            other = ss.subscribe("other")
+            q = ss.subscribe("starved")
+            for num in range(30):
+                ss.on_piece(num)
+            base = q.qsize()
+            # ping hard: reveals must stop at the burst budget, not at 30
+            for _ in range(50):
+                ss.reveal_to("starved", n=4)
+            revealed = q.qsize() - base
+            assert 0 < revealed <= ss.REVEAL_BURST + 1
+            assert revealed < 30 - base
+            assert other is not None
+            ss.unsubscribe("starved")
+            ss.unsubscribe("other")
+        self.run(main())
+
+    def test_reveal_prefers_least_assigned(self):
+        async def main():
+            ss = _SuperSeed(fanout=1, rotate_interval_s=3600)
+            q1 = ss.subscribe("a")
+            ss.on_piece(0)          # assigned to a
+            ss.on_piece(1)          # assigned to a (only sub)
+            q2 = ss.subscribe("b")
+            ss.reveal_to("b", n=1)
+            # both pieces have 1 owner; b gets one of them (tie) — but after
+            # it, the OTHER piece is the least-assigned for the next reveal
+            first = q2.get_nowait()
+            ss.reveal_to("b", n=1)
+            second = q2.get_nowait()
+            assert {first, second} == {0, 1}
+            assert q1 is not None
+            ss.unsubscribe("a")
+            ss.unsubscribe("b")
+        self.run(main())
+
+
+# ======================================================================
+# PieceDispatcher
+# ======================================================================
+
+class TestDispatcher:
+    def test_busy_backoff_then_redispatch(self):
+        async def main():
+            d = PieceDispatcher()
+            await d.add_parent("pa", "127.0.0.1:1")
+            await d.announce("pa", [info(0)])
+            got = await d.get(timeout=0.5)
+            assert got is not None and got.piece.piece_num == 0
+            await d.report_busy(got)
+            st = d.parents["pa"]
+            assert st.is_busy()
+            # immediately: nothing dispatchable (sole holder is busy)
+            assert d._pick() is None
+            # after the backoff window the same piece re-dispatches
+            again = await d.get(timeout=BUSY_BACKOFF_S * 10)
+            assert again is not None and again.piece.piece_num == 0
+            assert not st.ejected    # busy is not a failure
+            assert st.consecutive_fails == 0
+        asyncio.run(main())
+
+    def test_cooldown_ejection_recovers(self):
+        async def main():
+            d = PieceDispatcher()
+            st = await d.add_parent("pa", "127.0.0.1:1")
+            await d.announce("pa", [info(i) for i in range(10)])
+            for _ in range(PARENT_FAIL_LIMIT):
+                got = await d.get(timeout=0.5)
+                await d.report(got, ok=False)
+            assert st.ejected          # cooldown engaged
+            assert not st.removed      # ...but not permanent
+            # holder survives a cooldown ejection (per-stream announcement
+            # dedup means the parent would never re-announce)
+            assert any("pa" in ps.holders for ps in d._pieces.values())
+            st.eject_until = time.monotonic() - 1   # fast-forward the clock
+            assert not st.ejected
+            got = await d.get(timeout=0.5)
+            assert got is not None     # dispatches to the recovered parent
+        asyncio.run(main())
+
+    def test_hard_limit_is_permanent(self):
+        async def main():
+            d = PieceDispatcher()
+            st = await d.add_parent("pa", "127.0.0.1:1")
+            await d.announce("pa", [info(i) for i in range(20)])
+            while not st.removed:
+                st.eject_until = 0.0    # bypass cooldowns to reach the cap
+                got = await d.get(timeout=0.5)
+                assert got is not None
+                await d.report(got, ok=False)
+            assert st.total_fails >= PARENT_FAIL_HARD_LIMIT
+            assert d.hard_removed("pa")
+            st.eject_until = 0.0
+            assert st.ejected           # removed stays ejected forever
+        asyncio.run(main())
+
+    def test_resurrect_halves_fail_count(self):
+        async def main():
+            d = PieceDispatcher()
+            st = await d.add_parent("pa", "127.0.0.1:1")
+            st.total_fails = 10
+            st.removed = True
+            fresh = await d.add_parent("pa", "127.0.0.1:1", resurrect=True)
+            assert fresh is not st
+            assert fresh.total_fails == 5   # decays, not cleared
+        asyncio.run(main())
+
+    def test_group_dispatch_contiguous_same_holder(self):
+        async def main():
+            d = PieceDispatcher()
+            await d.add_parent("pa", "127.0.0.1:1")
+            await d.announce("pa", [info(i) for i in range(GROUP_LIMIT + 2)])
+            got = await d.get(timeout=0.5)
+            assert got is not None
+            assert len(got.pieces) == GROUP_LIMIT
+            nums = [p.piece_num for p in got.pieces]
+            starts = [p.range_start for p in got.pieces]
+            assert starts == sorted(starts)
+            for a, b in zip(got.pieces, got.pieces[1:]):
+                assert b.range_start == a.range_start + a.range_size
+            # grouped pieces are all inflight: a second worker gets others
+            got2 = await d.get(timeout=0.5)
+            assert got2 is not None
+            assert not set(nums) & {p.piece_num for p in got2.pieces}
+        asyncio.run(main())
+
+    def test_group_partial_completion_requeues_failed_piece(self):
+        async def main():
+            d = PieceDispatcher(explore_ratio=0.0)
+            await d.add_parent("pa", "127.0.0.1:1")
+            await d.add_parent("pb", "127.0.0.1:2")
+            await d.announce("pa", [info(0), info(1)])
+            await d.announce("pb", [info(0), info(1)])
+            got = await d.get(timeout=0.5)
+            assert len(got.pieces) == 2
+            first = got.pieces[0].piece_num
+            other = got.pieces[1].piece_num
+            await d.report(got, ok=True, cost_ms=10, completed=[first])
+            assert first in d._done
+            assert other in d._pieces           # requeued
+            assert not d._pieces[other].inflight
+            # the failed group member counted as a strike
+            assert got.parent.consecutive_fails == 1
+        asyncio.run(main())
+
+    def test_seed_priced_out_when_peer_can_serve(self):
+        async def main():
+            d = PieceDispatcher(explore_ratio=0.0)
+            seed = await d.add_parent("seed", "127.0.0.1:1", is_seed=True)
+            peer = await d.add_parent("peer", "127.0.0.1:2")
+            seed.observe(10, 1000, True)    # seed is FASTER per byte
+            peer.observe(40, 1000, True)
+            await d.announce("seed", [info(0)])
+            await d.announce("peer", [info(0)])
+            got = await d.get(timeout=0.5)
+            assert got.parent.peer_id == "peer"   # 16x price beats 4x speed
+            # a piece ONLY the seed holds still dispatches immediately
+            await d.announce("seed", [info(5)])
+            got2 = await d.get(timeout=0.5)
+            assert got2 is not None and got2.parent.peer_id == "seed"
+        asyncio.run(main())
+
+    def test_endgame_duplicates_last_pieces(self):
+        async def main():
+            d = PieceDispatcher(explore_ratio=0.0)
+            await d.add_parent("slow", "127.0.0.1:1")
+            await d.add_parent("alt", "127.0.0.1:2")
+            await d.announce("slow", [info(0)])
+            await d.announce("alt", [info(0)])
+            d.endgame = True   # engine sets this when the task tail remains
+            first = await d.get(timeout=0.5)
+            assert first is not None
+            # piece 0 is in flight on one parent; endgame races the other
+            dup = await d.get(timeout=0.5)
+            assert dup is not None
+            assert dup.piece.piece_num == 0
+            assert dup.parent.peer_id != first.parent.peer_id
+            # no third racer exists -> nothing more to dispatch
+            assert d._pick() is None
+            # first landing wins; the loser's late report is harmless
+            await d.report(first, ok=True, cost_ms=5)
+            assert 0 in d._done
+            await d.report(dup, ok=True, cost_ms=50)
+            assert d.pending_count() == 0
+        asyncio.run(main())
+
+    def test_no_endgame_when_many_pieces_pending(self):
+        async def main():
+            from dragonfly2_tpu.daemon.piece_dispatcher import ENDGAME_PIECES
+            d = PieceDispatcher(explore_ratio=0.0)
+            await d.add_parent("pa", "127.0.0.1:1")
+            await d.add_parent("pb", "127.0.0.1:2")
+            n = ENDGAME_PIECES * 3
+            # non-contiguous announcements so grouping can't drain the pool
+            infos = [info(i * 2) for i in range(n)]
+            await d.announce("pa", infos)
+            await d.announce("pb", infos)
+            seen = set()
+            while True:
+                got = d._pick()
+                if got is None:
+                    break
+                for p in got.pieces:
+                    assert p.piece_num not in seen, "duplicate mid-swarm"
+                    seen.add(p.piece_num)
+            assert len(seen) == n   # every piece dispatched exactly once
+        asyncio.run(main())
+
+    def test_starving_definition(self):
+        async def main():
+            d = PieceDispatcher()
+            await d.add_parent("pa", "127.0.0.1:1")
+            assert d.starving()                 # no pieces at all
+            await d.announce("pa", [info(0)])
+            assert not d.starving()             # live holder exists
+            await d.remove_parent("pa")
+            assert d.starving()                 # holder is gone
+        asyncio.run(main())
+
+
+# ======================================================================
+# scheduler: sticky refresh + TTL blocklist
+# ======================================================================
+
+def _make_cluster():
+    from dragonfly2_tpu.scheduler.config import SchedulerConfig
+    from dragonfly2_tpu.scheduler.evaluator import Evaluator
+    from dragonfly2_tpu.scheduler.resource import Resource
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling
+
+    cfg = SchedulerConfig()
+    res = Resource()
+    sched = Scheduling(cfg, Evaluator())
+    task = res.get_or_create_task("t" * 32, "http://o/x")
+    task.set_content_info(100 << 20, 4 << 20, 25)
+
+    def add_peer(name: str, *, seed: bool = False):
+        from dragonfly2_tpu.scheduler.resource import PeerState
+        host = res.store_host(HostMsg(
+            id=f"h-{name}", ip="127.0.0.1", hostname=name, port=1,
+            download_port=2,
+            type=HostType.SUPER_SEED if seed else HostType.NORMAL))
+        peer = res.get_or_create_peer(f"peer-{name}", task, host)
+        peer.transit(PeerState.RUNNING)
+        return peer
+
+    return cfg, res, sched, task, add_peer
+
+
+class TestStickyRefresh:
+    def test_refresh_keeps_loaded_current_parent(self):
+        cfg, res, sched, task, add_peer = _make_cluster()
+        child = add_peer("child")
+        parent = add_peer("parent")
+        parent.finished_pieces.add(0)
+        # the child is already assigned to this parent...
+        child.last_offer_ids = {parent.id}
+        task.set_parents(child.id, [parent.id])
+        # ...and the parent's host is at its slot limit
+        parent.host.msg.concurrent_upload_limit = 1
+        assert parent.host.free_upload_slots() == 0
+        kept = sched.refresh_parents(child)
+        assert parent in kept, "current parent must survive the slot filter"
+        # a DIFFERENT child cannot take a new slot on the loaded host
+        other = add_peer("other")
+        assert sched.filter_candidates(other) == []
+
+    def test_ttl_blocklist_expires(self):
+        cfg, res, sched, task, add_peer = _make_cluster()
+        child = add_peer("child")
+        parent = add_peer("parent")
+        parent.finished_pieces.add(0)
+        child.block_parent(parent.id, ttl_s=0.05)
+        assert child.is_blocked(parent.id)
+        assert parent not in sched.filter_candidates(child)
+        time.sleep(0.06)
+        assert not child.is_blocked(parent.id)   # wobble forgiven
+        assert parent in sched.filter_candidates(child)
+
+
+# ======================================================================
+# upload server: slots held across the actual transfer
+# ======================================================================
+
+class TestUploadSlots:
+    def test_slot_held_until_body_sent_and_503(self, tmp_path):
+        """Two slow concurrent transfers must make a third request 503 even
+        though both HANDLERS returned long ago — the round-3 defect was
+        releasing the slot at handler return."""
+        import aiohttp
+        from aiohttp import web
+
+        from dragonfly2_tpu.daemon.upload_server import UploadServer
+        from dragonfly2_tpu.storage.manager import StorageConfig, StorageManager
+        from dragonfly2_tpu.storage.metadata import TaskMetadata
+
+        from dragonfly2_tpu.common.rate import TokenBucket
+
+        size = 128 << 10
+
+        async def main():
+            mgr = StorageManager(StorageConfig(data_dir=str(tmp_path)))
+            md = TaskMetadata(task_id="t" * 32, url="http://o/x",
+                              content_length=size, total_piece_count=1,
+                              piece_size=size)
+            ts = mgr.register_task(md)
+            ts.write_piece(0, 0, b"z" * size)
+            srv = UploadServer(mgr, host="127.0.0.1", concurrent_limit=2)
+            # burst=1 so EVERY transfer pays the full token wait (~0.33s)
+            # while holding its slot — the handler frame returns long before
+            srv.limiter = TokenBucket(4e5, burst=1)
+            await srv.start()
+            try:
+                url = (f"http://127.0.0.1:{srv.port}/download/"
+                       f"{'t' * 3}/{'t' * 32}")
+                rng = {"Range": f"bytes=0-{size - 1}"}
+                async with aiohttp.ClientSession() as s:
+                    async def pull():
+                        async with s.get(url, headers=rng) as r:
+                            await r.read()
+                            return r.status
+
+                    t1 = asyncio.create_task(pull())
+                    t2 = asyncio.create_task(pull())
+                    await asyncio.sleep(0.15)   # both transfers in flight
+                    async with s.get(url, headers=rng) as r3:
+                        assert r3.status == 503
+                    assert await t1 == 206
+                    assert await t2 == 206
+                    # slots released after the bodies finished
+                    assert srv._active == 0
+                    async with s.get(url, headers=rng) as r4:
+                        assert r4.status == 206
+                        await r4.read()
+            finally:
+                await srv.stop()
+
+        asyncio.run(main())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -30,6 +30,9 @@ class DownloadConfig:
     prefetch_whole_file: bool = False      # ranged requests warm the whole task
     first_piece_timeout_s: float = 30.0
     piece_timeout_s: float = 60.0
+    # TLS trust for https origins (private registries / custom CAs)
+    source_ca: str = ""                    # extra CA bundle path
+    source_insecure: bool = False          # disable verification (tests)
 
 
 @dataclass
@@ -55,6 +58,21 @@ class ProxyConfig:
     registry_mirror: str = ""              # upstream registry URL
     rules: list[str] = field(default_factory=list)  # regexes routed via P2P
     direct_rules: list[str] = field(default_factory=list)
+    # HTTPS interception (reference proxy/cert.go + proxy.go:268): CONNECTs
+    # to hijack-matching hosts are MITM'd with a CA-signed per-host leaf so
+    # TLS registry pulls ride the mesh instead of bypassing it in a blind
+    # tunnel. Empty hijack_hosts + hijack=True intercepts everything.
+    hijack: bool = False
+    hijack_hosts: list[str] = field(default_factory=list)   # host regexes
+    ca_cert: str = ""                      # PEM paths; empty -> auto-CA in
+    ca_key: str = ""                       # the daemon workdir
+    # SNI listener (reference proxy_sni.go): transparent-TLS port for
+    # clients that resolve the registry straight to this daemon (no proxy
+    # config needed); 0 disables, -1 binds an ephemeral port
+    sni_port: int = 0
+    # upstream TLS verification for intercepted fetches; disable only for
+    # self-signed upstreams in tests
+    verify_upstream: bool = True
 
 
 @dataclass

@@ -114,6 +114,15 @@ class Daemon:
         return factory
 
     async def start(self) -> None:
+        if self.cfg.download.source_ca or self.cfg.download.source_insecure:
+            # the source client is a process singleton: remember the prior
+            # trust setting so stop() restores it (co-resident daemons in
+            # one process — the test suite — must not inherit this one's)
+            from ..source.client import client_for
+            http = client_for("https://")
+            self._prev_source_tls = http._ssl
+            http.set_tls(insecure=self.cfg.download.source_insecure,
+                         ca_file=self.cfg.download.source_ca)
         await self.upload_server.start()
         self._peer_channels = ChannelPool()
         self._piece_downloader = PieceDownloader(
@@ -219,6 +228,10 @@ class Daemon:
             log.warning("manager attach failed (%s); back-source only", exc)
 
     async def stop(self) -> None:
+        if hasattr(self, "_prev_source_tls"):
+            from ..source.client import client_for
+            client_for("https://")._ssl = self._prev_source_tls
+            del self._prev_source_tls
         if getattr(self, "manager", None) is not None:
             await self.manager.close()
         if getattr(self, "prober", None) is not None:

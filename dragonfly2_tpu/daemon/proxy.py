@@ -1,12 +1,18 @@
-"""HTTP proxy + registry mirror: transparent P2P for HTTP(S) fetches.
+"""HTTP(S) proxy + registry mirror: transparent P2P for HTTP(S) fetches.
 
 Role parity: reference ``client/daemon/proxy/`` — a forward proxy whose
 regex rules decide P2P vs direct (``transport.go:223 NeedUseDragonfly``),
 a registry-mirror mode rewriting relative paths onto the upstream registry
-(how containerd pulls layers through the mesh), and CONNECT handling. The
-reference MITMs CONNECT with per-host certs; here CONNECT is a plain
-tunnel — HTTPS bytes pass through untouched, P2P applies to plain-HTTP and
-mirrored-registry traffic (the image-layer path that matters for config #3).
+(how containerd pulls layers through the mesh), CONNECT handling with
+HTTPS interception (``proxy.go:268`` + per-host leaf certs,
+``cert.go:37``), and an SNI listener (``proxy_sni.go:32``) for clients
+that resolve the registry's name straight to the daemon.
+
+With ``hijack`` on, a CONNECT to a matching host is answered 200 and the
+client socket is upgraded to TLS using a CA-signed leaf for that host
+(certs.py); the decrypted requests then flow through the same P2P/direct
+routing as plain HTTP — TLS registries stop bypassing the mesh. Without it
+CONNECT stays a blind byte tunnel.
 
 Implemented as a raw asyncio server: aiohttp's server can't speak CONNECT.
 """
@@ -16,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import re
+import ssl
 from urllib.parse import urlsplit
 
 import aiohttp
@@ -41,24 +48,56 @@ class ProxyServer:
         self.cfg = cfg
         self.rules = [re.compile(r) for r in cfg.rules]
         self.direct_rules = [re.compile(r) for r in cfg.direct_rules]
+        self.hijack_rules = [re.compile(r) for r in cfg.hijack_hosts]
         self.port = cfg.port
+        self.sni_port = cfg.sni_port
         self._server: asyncio.Server | None = None
+        self._sni_server: asyncio.Server | None = None
         self._client: aiohttp.ClientSession | None = None
+        self._issuer = None
+        if cfg.hijack or cfg.sni_port:
+            from .certs import CertIssuer
+            self._issuer = CertIssuer(
+                daemon.cfg.workdir, ca_cert_path=cfg.ca_cert,
+                ca_key_path=cfg.ca_key)
+
+    @property
+    def ca_cert_path(self) -> str:
+        """The CA file clients/containerd must trust when hijack is on."""
+        return self._issuer.ca_cert_path if self._issuer else ""
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._handle_conn, self.daemon.cfg.listen_ip, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        # upstream trust for relayed (non-P2P) fetches mirrors the source
+        # client's: a private-CA registry must work for manifests/auth too,
+        # not just the blob path (which goes through HTTPSourceClient)
+        upstream_ssl = None
+        if not self.cfg.verify_upstream:
+            upstream_ssl = False
+        elif self.daemon.cfg.download.source_ca:
+            upstream_ssl = ssl.create_default_context(
+                cafile=self.daemon.cfg.download.source_ca)
         self._client = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=300.0),
-            auto_decompress=False)
-        log.info("proxy on :%d (mirror=%s, %d p2p rules)", self.port,
-                 self.cfg.registry_mirror or "-", len(self.rules))
+            auto_decompress=False,
+            connector=aiohttp.TCPConnector(ssl=upstream_ssl))
+        if self.sni_port:
+            self._sni_server = await asyncio.start_server(
+                self._handle_sni_conn, self.daemon.cfg.listen_ip,
+                max(self.sni_port, 0), ssl=self._sni_ssl_context())
+            self.sni_port = self._sni_server.sockets[0].getsockname()[1]
+            log.info("SNI proxy on :%d", self.sni_port)
+        log.info("proxy on :%d (mirror=%s, %d p2p rules, hijack=%s)",
+                 self.port, self.cfg.registry_mirror or "-", len(self.rules),
+                 self.cfg.hijack)
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        for srv in (self._server, self._sni_server):
+            if srv is not None:
+                srv.close()
+                await srv.wait_closed()
         if self._client is not None:
             await self._client.close()
 
@@ -77,26 +116,9 @@ class ProxyServer:
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         try:
-            while True:
-                request_line = await reader.readline()
-                if not request_line:
-                    return
-                try:
-                    method, target, version = \
-                        request_line.decode("latin1").split(" ", 2)
-                except ValueError:
-                    writer.write(b"HTTP/1.1 400 Bad Request\r\n\r\n")
-                    return
-                headers = await self._read_headers(reader)
-                if method.upper() == "CONNECT":
-                    await self._tunnel(target, reader, writer)
-                    return
-                keep_alive = await self._handle_request(
-                    method.upper(), target, headers, reader, writer)
-                if not keep_alive:
-                    return
+            await self._serve_http_loop(reader, writer, scheme="http")
         except (asyncio.IncompleteReadError, ConnectionResetError,
-                BrokenPipeError):
+                BrokenPipeError, ssl.SSLError):
             pass
         except Exception:  # noqa: BLE001 - connection boundary
             log.exception("proxy connection failed")
@@ -106,6 +128,96 @@ class ProxyServer:
                 await writer.wait_closed()
             except OSError:
                 pass
+
+    async def _handle_sni_conn(self, reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter) -> None:
+        """TLS connections from clients that resolved the registry's name to
+        this daemon (reference ``proxy_sni.go``): asyncio completed the
+        handshake with an SNI-minted leaf; inner requests are origin-form
+        with a Host header and route exactly like hijacked CONNECTs."""
+        try:
+            sslobj = writer.get_extra_info("ssl_object")
+            sni = getattr(sslobj, "_df_sni", "") if sslobj else ""
+            await self._serve_http_loop(reader, writer, scheme="https",
+                                        authority=sni)
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError, ssl.SSLError):
+            pass
+        except Exception:  # noqa: BLE001 - connection boundary
+            log.exception("sni proxy connection failed")
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except OSError:
+                pass
+
+    def _sni_ssl_context(self) -> ssl.SSLContext:
+        """Base server context whose SNI callback swaps in a leaf minted for
+        whatever name the client asked for (reference ``proxy_sni.go``'s
+        GetCertificate)."""
+        assert self._issuer is not None
+        issuer = self._issuer
+        base = issuer.server_context(self.daemon.cfg.host_ip or "localhost")
+
+        def on_sni(sslobj, servername, _ctx):
+            # sync by protocol contract (ssl module callback); leaf minting
+            # is ~1ms EC keygen and one-time per host (cached 24h)
+            if servername:
+                sslobj.context = issuer.server_context(servername)
+                sslobj._df_sni = servername   # routing fallback (no Host)
+
+        base.sni_callback = on_sni
+        return base
+
+    def _hijack_match(self, host: str) -> bool:
+        if not self.hijack_rules:
+            return True                  # hijack on = intercept everything
+        return any(r.search(host) for r in self.hijack_rules)
+
+    async def _serve_http_loop(self, reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter, *,
+                               scheme: str,
+                               authority: str = "") -> None:
+        while True:
+            request_line = await reader.readline()
+            if not request_line:
+                return
+            try:
+                method, target, version = \
+                    request_line.decode("latin1").split(" ", 2)
+            except ValueError:
+                writer.write(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+                return
+            headers = await self._read_headers(reader)
+            if method.upper() == "CONNECT":
+                host = target.partition(":")[0]
+                if (self._issuer is not None and self.cfg.hijack
+                        and self._hijack_match(host)):
+                    # pause the transport BEFORE the 200: the client fires
+                    # its ClientHello the instant it sees the reply, and any
+                    # bytes the plaintext reader consumes before start_tls
+                    # swaps protocols are lost to the handshake (flaky
+                    # deadlock, window widened by the off-loop cert mint)
+                    writer.transport.pause_reading()
+                    writer.write(b"HTTP/1.1 200 Connection Established\r\n\r\n")
+                    await writer.drain()
+                    # keygen + signing + file IO off-loop (first hit per host)
+                    ctx = await asyncio.to_thread(
+                        self._issuer.server_context, host)
+                    # asyncio infers server_side=True for start_server
+                    # streams; the TLS transport resumes reading itself
+                    await writer.start_tls(ctx)
+                    _proxy_reqs.labels("hijack").inc()
+                    scheme, authority = "https", target
+                    continue        # decrypted requests re-enter this loop
+                await self._tunnel(target, reader, writer)
+                return
+            keep_alive = await self._handle_request(
+                method.upper(), target, headers, reader, writer,
+                scheme=scheme, authority=authority)
+            if not keep_alive:
+                return
 
     @staticmethod
     async def _read_headers(reader: asyncio.StreamReader) -> dict[str, str]:
@@ -156,9 +268,15 @@ class ProxyServer:
 
     # ------------------------------------------------------------------
 
-    def _resolve_url(self, target: str, headers: dict[str, str]) -> str:
+    def _resolve_url(self, target: str, headers: dict[str, str], *,
+                     scheme: str = "http", authority: str = "") -> str:
         if target.startswith("http://") or target.startswith("https://"):
             return target                       # forward-proxy form
+        # hijacked/SNI TLS: origin-form against the intercepted authority
+        if scheme == "https":
+            host = headers.get("host", "") or authority
+            host = host.removesuffix(":443")
+            return f"https://{host}{target}"
         # registry-mirror form: relative path against the upstream registry
         if self.cfg.registry_mirror:
             return self.cfg.registry_mirror.rstrip("/") + target
@@ -168,8 +286,11 @@ class ProxyServer:
     async def _handle_request(self, method: str, target: str,
                               headers: dict[str, str],
                               reader: asyncio.StreamReader,
-                              writer: asyncio.StreamWriter) -> bool:
-        url = self._resolve_url(target, headers)
+                              writer: asyncio.StreamWriter, *,
+                              scheme: str = "http",
+                              authority: str = "") -> bool:
+        url = self._resolve_url(target, headers, scheme=scheme,
+                                authority=authority)
         if method == "GET" and self.use_p2p(url):
             return await self._serve_p2p(url, headers, writer)
         return await self._serve_direct(method, url, headers, reader, writer)

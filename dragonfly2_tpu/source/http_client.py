@@ -36,6 +36,21 @@ class HTTPSourceClient:
         # sessions are loop-bound; the registry client is a process singleton
         # that may serve several asyncio.run lifetimes (CLIs, tests)
         self._sessions: dict[int, aiohttp.ClientSession] = {}
+        self._ssl = None           # None: system trust; False: no verify;
+                                   # SSLContext: custom CA bundle
+
+    def set_tls(self, *, insecure: bool = False, ca_file: str = "") -> None:
+        """TLS trust for https origins: a private registry signed by a
+        custom CA (or the proxy's own MITM CA) needs ``ca_file``;
+        ``insecure`` disables verification (tests only)."""
+        import ssl as _ssl
+
+        if insecure:
+            self._ssl = False
+        elif ca_file:
+            self._ssl = _ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ssl = None
 
     async def _get_session(self) -> aiohttp.ClientSession:
         import asyncio
@@ -67,7 +82,7 @@ class HTTPSourceClient:
         probe_headers = {**req.header, "Connection": "close"}
         try:
             async with session.head(req.url, headers=probe_headers,
-                                    allow_redirects=True,
+                                    allow_redirects=True, ssl=self._ssl,
                                     timeout=_timeout(req)) as resp:
                 if resp.status < 400:
                     return resp.status, dict(resp.headers)
@@ -77,6 +92,7 @@ class HTTPSourceClient:
         probe = {**probe_headers, "Range": "bytes=0-0"}
         try:
             async with session.get(req.url, headers=probe, allow_redirects=True,
+                                   ssl=self._ssl,
                                    timeout=_timeout(req)) as resp:
                 if resp.status >= 400:
                     raise _status_error(resp.status, req.url)
@@ -114,7 +130,7 @@ class HTTPSourceClient:
             headers["Range"] = req.range.http_header()
         try:
             resp = await session.get(req.url, headers=headers, allow_redirects=True,
-                                     timeout=_timeout(req))
+                                     ssl=self._ssl, timeout=_timeout(req))
         except aiohttp.ClientError as exc:
             raise DFError(Code.SOURCE_ERROR, f"origin get failed: {exc}") from None
         if resp.status >= 400:

@@ -97,26 +97,41 @@ class CertIssuer:
         with open(self.ca_key_path, "rb") as f:
             self.ca_key = serialization.load_pem_private_key(f.read(), None)
         self._lock = threading.Lock()
-        # host -> (ssl_ctx, not_after)
+        # host -> (ssl_ctx, not_after); insertion-ordered for LRU eviction
         self._cache: dict[str, tuple[ssl.SSLContext, datetime.datetime]] = {}
 
-    def _mint(self, host: str) -> tuple[bytes, bytes, datetime.datetime]:
-        key = ec.generate_private_key(ec.SECP256R1())
+    # client-controlled names (CONNECT targets, raw SNI bytes) feed the
+    # cache: bound it, or a client looping random names grows memory and
+    # CPU without limit
+    CACHE_MAX = 512
+
+    @staticmethod
+    def _sans(hosts: list[str]) -> list[x509.GeneralName]:
+        out: list[x509.GeneralName] = []
+        for h in hosts:
+            try:
+                out.append(x509.IPAddress(ipaddress.ip_address(h)))
+            except ValueError:
+                out.append(x509.DNSName(h))
+        return out
+
+    def sign_public_key(self, public_key, hosts: list[str],
+                        *, ttl: datetime.timedelta = LEAF_TTL) -> bytes:
+        """Sign a leaf for a key whose PRIVATE half the caller keeps
+        (manager-issued fleet certs: reference
+        ``manager/rpcserver/security_server_v1.go`` + ``pkg/issuer`` — the
+        private key never crosses the wire)."""
         now = datetime.datetime.now(datetime.timezone.utc)
-        not_after = now + LEAF_TTL
-        try:
-            san: x509.GeneralName = x509.IPAddress(ipaddress.ip_address(host))
-        except ValueError:
-            san = x509.DNSName(host)
         cert = (
             x509.CertificateBuilder()
-            .subject_name(_name(host))
+            .subject_name(_name(hosts[0] if hosts else "peer"))
             .issuer_name(self.ca_cert.subject)
-            .public_key(key.public_key())
+            .public_key(public_key)
             .serial_number(x509.random_serial_number())
             .not_valid_before(now - datetime.timedelta(hours=1))
-            .not_valid_after(not_after)
-            .add_extension(x509.SubjectAlternativeName([san]), critical=False)
+            .not_valid_after(now + ttl)
+            .add_extension(x509.SubjectAlternativeName(self._sans(hosts)),
+                           critical=False)
             .add_extension(x509.KeyUsage(
                 digital_signature=True, key_encipherment=True,
                 data_encipherment=True, key_agreement=True,
@@ -125,36 +140,61 @@ class CertIssuer:
                 critical=True)
             .sign(self.ca_key, hashes.SHA256())
         )
-        return (cert.public_bytes(serialization.Encoding.PEM),
+        return cert.public_bytes(serialization.Encoding.PEM)
+
+    def _mint(self, host: str) -> tuple[bytes, bytes, datetime.datetime]:
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        not_after = now + LEAF_TTL
+        cert_pem = self.sign_public_key(key.public_key(), [host])
+        return (cert_pem,
                 key.private_bytes(serialization.Encoding.PEM,
                                   serialization.PrivateFormat.PKCS8,
                                   serialization.NoEncryption()),
                 not_after)
 
     def server_context(self, host: str) -> ssl.SSLContext:
-        """TLS server context presenting a CA-signed leaf for ``host``."""
+        """TLS server context presenting a CA-signed leaf for ``host``.
+
+        Single-flight: mint + file write + load all happen under the lock —
+        concurrent cache misses for one host (containerd opening parallel
+        layer pulls) otherwise interleave their writes to shared paths and
+        load mismatched cert/key pairs (KEY_VALUES_MISMATCH at handshake).
+        """
         now = datetime.datetime.now(datetime.timezone.utc)
         with self._lock:
             hit = self._cache.get(host)
             if hit is not None and now < hit[1]:
+                self._cache[host] = self._cache.pop(host)   # LRU touch
                 return hit[0]
-        cert_pem, key_pem, not_after = self._mint(host)
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        # load_cert_chain wants files; keep them under the workdir tmp.
-        # The filename is built from a CLIENT-CONTROLLED host (CONNECT
-        # target / raw SNI bytes): strict whitelist sanitization, or a name
-        # like '../proxy-ca' would overwrite the CA key itself
-        leaf_dir = os.path.join(self.workdir, "leaves")
-        os.makedirs(leaf_dir, exist_ok=True)
-        safe = re.sub(r"[^A-Za-z0-9._-]", "_", host).strip(".") or "host"
-        base = os.path.join(leaf_dir, "leaf-" + safe)
-        with open(base + ".crt", "wb") as f:
-            f.write(cert_pem + self._ca_pem())
-        with open(base + ".key", "wb") as f:
-            f.write(key_pem)
-        os.chmod(base + ".key", 0o600)
-        ctx.load_cert_chain(base + ".crt", base + ".key")
-        with self._lock:
+            cert_pem, key_pem, not_after = self._mint(host)
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            # load_cert_chain wants files; they are TRANSIENT (deleted the
+            # moment the chain is loaded) so client-controlled names cost no
+            # disk. The filename is still sanitized: a name like
+            # '../proxy-ca' must never escape the leaves dir even briefly.
+            leaf_dir = os.path.join(self.workdir, "leaves")
+            os.makedirs(leaf_dir, exist_ok=True)
+            safe = re.sub(r"[^A-Za-z0-9._-]", "_", host).strip(".")[:64]
+            base = os.path.join(leaf_dir, f"leaf-{safe or 'host'}-{os.getpid()}")
+            try:
+                with open(base + ".crt", "wb") as f:
+                    f.write(cert_pem + self._ca_pem())
+                with open(base + ".key", "wb") as f:
+                    f.write(key_pem)
+                os.chmod(base + ".key", 0o600)
+                ctx.load_cert_chain(base + ".crt", base + ".key")
+            finally:
+                for suffix in (".crt", ".key"):
+                    try:
+                        os.unlink(base + suffix)
+                    except OSError:
+                        pass
+            # expired + LRU eviction keeps the cache bounded
+            for key in [k for k, v in self._cache.items() if now >= v[1]]:
+                del self._cache[key]
+            while len(self._cache) >= self.CACHE_MAX:
+                del self._cache[next(iter(self._cache))]
             self._cache[host] = (ctx, not_after)
         log.debug("minted leaf cert for %s", host)
         return ctx

@@ -176,7 +176,8 @@ class PeerTaskConductor:
 
     async def on_piece_from_source(self, num: int, offset: int, data: bytes,
                                    cost_ms: int) -> None:
-        await self._land_piece(num, offset, data, cost_ms, source="")
+        if not await self._land_piece(num, offset, data, cost_ms, source=""):
+            return
         self.traffic_source += len(data)
         if self._session is not None:
             # a back-source peer announces its pieces so the scheduler can
@@ -196,15 +197,21 @@ class PeerTaskConductor:
                                  cost_ms: int, parent_id: str,
                                  piece_digest: str = "") -> None:
         # the P2P downloader verified data against piece_digest already
-        await self._land_piece(num, offset, data, cost_ms, source=parent_id,
-                               piece_digest=piece_digest,
-                               pre_verified=bool(piece_digest))
-        self.traffic_p2p += len(data)
+        landed = await self._land_piece(num, offset, data, cost_ms,
+                                        source=parent_id,
+                                        piece_digest=piece_digest,
+                                        pre_verified=bool(piece_digest))
+        if landed:
+            # endgame-raced duplicates are dropped at landing and must not
+            # inflate the traffic accounting (egress-saved stats)
+            self.traffic_p2p += len(data)
 
     async def _land_piece(self, num: int, offset: int, data: bytes,
                           cost_ms: int, source: str,
                           piece_digest: str = "",
-                          pre_verified: bool = False) -> None:
+                          pre_verified: bool = False) -> bool:
+        """Returns True when THIS call landed the piece (duplicates from
+        endgame racing return False and change nothing)."""
         if self.storage is None:
             raise DFError(Code.CLIENT_STORAGE_ERROR, "piece before content info")
         if num in self.ready or num in self._landing:
@@ -212,7 +219,7 @@ class PeerTaskConductor:
             # duplicate racers land near-simultaneously, and a ready-only
             # check would let both through (double-counted progress, double
             # device-ingest writes, duplicate scheduler success reports)
-            return
+            return False
         self._landing.add(num)
         try:
             # hashing+write can take ms at 16MiB — keep the loop responsive
@@ -222,7 +229,7 @@ class PeerTaskConductor:
         finally:
             self._landing.discard(num)
         if num in self.ready:     # lost a race decided elsewhere
-            return
+            return False
         if self.device_ingest is not None:
             # write() is a ~1ms memcpy + transfer-queue enqueue — the DMA
             # itself runs on the sink's own thread and is never awaited
@@ -244,6 +251,7 @@ class PeerTaskConductor:
         self._publish({"type": "piece", "num": num, "size": len(data),
                        "completed": self.completed_length,
                        "total": self.content_length})
+        return True
 
     def on_source_complete(self, total: int) -> None:
         if self.content_length < 0:

@@ -56,7 +56,7 @@ class ProxyServer:
         self._client: aiohttp.ClientSession | None = None
         self._issuer = None
         if cfg.hijack or cfg.sni_port:
-            from .certs import CertIssuer
+            from ..common.certs import CertIssuer
             self._issuer = CertIssuer(
                 daemon.cfg.workdir, ca_cert_path=cfg.ca_cert,
                 ca_key_path=cfg.ca_key)
@@ -77,7 +77,9 @@ class ProxyServer:
         if not self.cfg.verify_upstream:
             upstream_ssl = False
         elif self.daemon.cfg.download.source_ca:
-            upstream_ssl = ssl.create_default_context(
+            # private CA ADDED to system trust, not replacing it
+            upstream_ssl = ssl.create_default_context()
+            upstream_ssl.load_verify_locations(
                 cafile=self.daemon.cfg.download.source_ca)
         self._client = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=300.0),

@@ -601,3 +601,23 @@ class GetModelRequest:
 @message
 class GetModelResponse:
     model: ModelEntity | None = None
+
+
+@message
+class CertificateRequest:
+    """Fleet cert issuance (reference security_server_v1.go IssueCertificate
+    + pkg/issuer): the requester keeps its private key and submits only the
+    public half plus the identities to certify."""
+
+    public_key_pem: bytes = b""
+    hosts: list[str] | None = None       # DNS names / IPs for the SAN
+    validity_s: int = 0                  # 0 = issuer default; server-capped
+    token: str = ""                      # issuance token (manager workdir
+                                         # issuer.token; distributed to the
+                                         # fleet out of band)
+
+
+@message
+class CertificateResponse:
+    cert_pem: bytes = b""
+    ca_cert_pem: bytes = b""

@@ -1,0 +1,146 @@
+"""Manager REST authentication + RBAC.
+
+Role parity: reference ``manager/middlewares/{jwt,personal_access_token,
+rbac}.go`` + ``manager/permission/rbac`` (casbin) + ``manager/auth``. The
+same three mechanisms, stdlib-shaped:
+
+- **Session tokens**: ``POST /api/v1/users/signin`` verifies a password
+  (scrypt, store-side) and mints an HMAC-SHA256 bearer token with expiry
+  (the reference's gin-jwt role).
+- **Personal access tokens**: ``dfp_*`` bearer tokens checked against
+  their sha256 in the store (reference middleware
+  ``personal_access_token.go:30``).
+- **RBAC**: method->action mapping (GET/HEAD = read, everything else =
+  write; reference ``rbac.HTTPMethodToAction``) with two preset roles —
+  ``root`` (all actions) and ``guest`` (read only), the reference's
+  bootstrap policy.
+
+The HMAC secret persists next to the DB so restarts don't invalidate
+sessions.
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import hashlib
+import json
+import logging
+import os
+import secrets
+import time
+
+from aiohttp import web
+
+log = logging.getLogger("df.mgr.auth")
+
+SESSION_TTL_S = 7 * 24 * 3600.0
+# paths served without credentials (health, metrics, and signin itself)
+PUBLIC_PATHS = {"/healthy", "/metrics", "/api/v1/users/signin"}
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(data: str) -> bytes:
+    pad = -len(data) % 4
+    return base64.urlsafe_b64decode(data + "=" * pad)
+
+
+class Authenticator:
+    def __init__(self, store, *, secret_path: str = ""):
+        self.store = store
+        if secret_path and os.path.exists(secret_path):
+            with open(secret_path, "rb") as f:
+                self._secret = f.read()
+        else:
+            self._secret = secrets.token_bytes(32)
+            if secret_path:
+                os.makedirs(os.path.dirname(secret_path) or ".",
+                            exist_ok=True)
+                with open(secret_path, "wb") as f:
+                    f.write(self._secret)
+                os.chmod(secret_path, 0o600)
+
+    # -- session tokens ------------------------------------------------
+
+    def mint_session(self, user: dict) -> str:
+        payload = json.dumps({"uid": user["id"], "name": user["name"],
+                              "role": user["role"],
+                              "exp": time.time() + SESSION_TTL_S})
+        body = _b64(payload.encode())
+        sig = _b64(hmac.new(self._secret, body.encode(),
+                            hashlib.sha256).digest())
+        return f"dfs_{body}.{sig}"
+
+    def verify_session(self, token: str) -> dict | None:
+        if not token.startswith("dfs_"):
+            return None
+        body, _, sig = token[4:].partition(".")
+        want = _b64(hmac.new(self._secret, body.encode(),
+                             hashlib.sha256).digest())
+        if not hmac.compare_digest(sig, want):
+            return None
+        try:
+            payload = json.loads(_unb64(body))
+        except (ValueError, json.JSONDecodeError):
+            return None
+        if time.time() > payload.get("exp", 0):
+            return None
+        return {"id": payload["uid"], "name": payload["name"],
+                "role": payload["role"]}
+
+    # -- request authentication ----------------------------------------
+
+    def authenticate(self, request: web.Request) -> dict | None:
+        """The user behind the request's bearer token, or None."""
+        auth = request.headers.get("Authorization", "")
+        fields = auth.split()
+        if len(fields) != 2 or fields[0] != "Bearer":
+            return None
+        token = fields[1]
+        if token.startswith("dfs_"):
+            return self.verify_session(token)
+        return self.store.pat_user(token)
+
+    @staticmethod
+    def allowed(user: dict, method: str) -> bool:
+        action = "read" if method in ("GET", "HEAD") else "write"
+        if user["role"] == "root":
+            return True
+        return action == "read"        # guest: read-only
+
+    def middleware(self):
+        @web.middleware
+        async def auth_middleware(request: web.Request, handler):
+            if request.path in PUBLIC_PATHS:
+                return await handler(request)
+            user = self.authenticate(request)
+            if user is None:
+                return web.json_response({"error": "unauthorized"},
+                                         status=401)
+            if not self.allowed(user, request.method):
+                return web.json_response({"error": "forbidden"}, status=403)
+            request["user"] = user
+            return await handler(request)
+        return auth_middleware
+
+
+def bootstrap_root(store, *, password_path: str = "") -> None:
+    """First-boot root user: generated password persisted 0600 next to the
+    DB (zero-touch bootstrap; the reference seeds a root user through its
+    database migrations instead)."""
+    rows = store._rows("SELECT id FROM users WHERE name='root'")
+    if rows:
+        return
+    password = secrets.token_urlsafe(16)
+    store.create_user("root", password, role="root")
+    if password_path:
+        with open(password_path, "w", encoding="utf-8") as f:
+            f.write(password + "\n")
+        os.chmod(password_path, 0o600)
+        log.info("bootstrapped root user; password at %s", password_path)
+    else:
+        log.warning("bootstrapped root user with ephemeral password "
+                    "(no password_path given): %s", password)

@@ -26,15 +26,19 @@ log = logging.getLogger("df.mgr.rest")
 
 class RestAPI:
     def __init__(self, store: Store, jobs: JobRunner, *, host: str = "0.0.0.0",
-                 port: int = 0):
+                 port: int = 0, auth=None):
+        """``auth``: an ``auth.Authenticator`` — None leaves the API open
+        (dev mode, reference parity with auth middleware disabled)."""
         self.store = store
         self.jobs = jobs
         self.host = host
         self.port = port
+        self.auth = auth
         self._runner: web.AppRunner | None = None
 
     async def start(self) -> None:
-        app = web.Application()
+        middlewares = [self.auth.middleware()] if self.auth else []
+        app = web.Application(middlewares=middlewares)
         r = app.router
         r.add_get("/healthy", self._healthy)
         r.add_get("/metrics", self._metrics)
@@ -48,6 +52,11 @@ class RestAPI:
         r.add_get("/api/v1/jobs", self._list_jobs)
         r.add_get("/api/v1/jobs/{id}", self._get_job)
         r.add_get("/api/v1/models", self._list_models)
+        r.add_post("/api/v1/users/signin", self._signin)
+        r.add_post("/api/v1/users", self._create_user)
+        r.add_post("/api/v1/personal-access-tokens", self._create_pat)
+        r.add_get("/api/v1/personal-access-tokens", self._list_pats)
+        r.add_delete("/api/v1/personal-access-tokens/{id}", self._revoke_pat)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -130,3 +139,48 @@ class RestAPI:
         job["args"] = json.loads(job["args"])
         job["result"] = json.loads(job["result"])
         return web.json_response(job)
+
+    # -- users + tokens (reference manager/handlers/user.go, pat.go) ----
+
+    async def _signin(self, request: web.Request) -> web.Response:
+        if self.auth is None:
+            return web.json_response({"error": "auth disabled"}, status=404)
+        body = await request.json()
+        user = await asyncio.to_thread(
+            self.store.verify_user, body.get("name", ""),
+            body.get("password", ""))
+        if user is None:
+            return web.json_response({"error": "bad credentials"}, status=401)
+        return web.json_response({"token": self.auth.mint_session(user),
+                                  "role": user["role"]})
+
+    async def _create_user(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            uid = await asyncio.to_thread(
+                lambda: self.store.create_user(
+                    body["name"], body["password"],
+                    role=body.get("role", "guest")))
+        except Exception as exc:  # noqa: BLE001 - dup name / bad role
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"id": uid}, status=201)
+
+    async def _create_pat(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        user = request.get("user") or {"id": body.get("user_id", 0)}
+        token = await asyncio.to_thread(
+            lambda: self.store.create_pat(
+                user["id"], label=body.get("label", ""),
+                ttl_s=float(body.get("ttl_s", 0))))
+        return web.json_response({"token": token}, status=201)
+
+    async def _list_pats(self, request: web.Request) -> web.Response:
+        user = request.get("user")
+        uid = user["id"] if user and user["role"] != "root" else None
+        return web.json_response(
+            await asyncio.to_thread(lambda: self.store.pats(uid)))
+
+    async def _revoke_pat(self, request: web.Request) -> web.Response:
+        await asyncio.to_thread(self.store.revoke_pat,
+                                int(request.match_info["id"]))
+        return web.json_response({"ok": True})

@@ -30,6 +30,13 @@ class ManagerConfig:
     db_path: str = ""                  # "" = in-memory
     keepalive_ttl_s: float = 60.0
     sweep_interval_s: float = 15.0
+    # REST auth (reference manager/middlewares jwt+PAT+rbac): requires a
+    # workdir for the session secret + bootstrap root password files
+    auth_enabled: bool = False
+    workdir: str = ""
+    # certificate issuance for fleet mTLS (reference
+    # manager/rpcserver/security_server_v1.go + pkg/issuer)
+    issue_certs: bool = False
 
 
 class Manager:
@@ -40,9 +47,45 @@ class Manager:
                         exist_ok=True)
         self.store = Store(cfg.db_path or ":memory:")
         self.jobs = JobRunner(self.store)
-        self.service = ManagerService(self.store)
+        workdir = cfg.workdir or (
+            os.path.dirname(os.path.abspath(cfg.db_path)) if cfg.db_path
+            else "")
+        issuer = None
+        issue_token = ""
+        if cfg.issue_certs:
+            import secrets
+
+            from ..common.certs import CertIssuer
+            issuer = CertIssuer(os.path.join(workdir or ".", "manager-ca"))
+            # issuance gate: generated once, persisted 0600, distributed to
+            # the fleet out of band (the reference gates issuance behind its
+            # deployment's network policy; an open signing oracle would make
+            # the mTLS layer authenticate nothing)
+            token_path = os.path.join(workdir or ".", "issuer.token")
+            if os.path.exists(token_path):
+                with open(token_path, encoding="utf-8") as f:
+                    issue_token = f.read().strip()
+            else:
+                issue_token = secrets.token_urlsafe(24)
+                with open(token_path, "w", encoding="utf-8") as f:
+                    f.write(issue_token + "\n")
+                os.chmod(token_path, 0o600)
+        self.issuer = issuer
+        self.issue_token = issue_token
+        self.service = ManagerService(self.store, issuer=issuer,
+                                      issue_token=issue_token)
+        auth = None
+        if cfg.auth_enabled:
+            from .auth import Authenticator, bootstrap_root
+            auth = Authenticator(
+                self.store,
+                secret_path=os.path.join(workdir, "session.secret")
+                if workdir else "")
+            bootstrap_root(self.store, password_path=os.path.join(
+                workdir, "root.password") if workdir else "")
+        self.auth = auth
         self.rest = RestAPI(self.store, self.jobs, host=cfg.listen_ip,
-                            port=cfg.rest_port)
+                            port=cfg.rest_port, auth=auth)
         self.rpc: RPCServer | None = None
         self.gc = GC()
         self.port: int | None = None

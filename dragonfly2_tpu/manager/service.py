@@ -13,7 +13,8 @@ import logging
 from typing import AsyncIterator
 
 from ..common.errors import Code, DFError
-from ..idl.messages import (CreateModelRequest, Empty, GetModelRequest,
+from ..idl.messages import (CertificateRequest, CertificateResponse,
+                            CreateModelRequest, Empty, GetModelRequest,
                             GetModelResponse, GetSchedulersRequest,
                             GetSchedulersResponse, GetSeedPeersRequest,
                             GetSeedPeersResponse, KeepAliveRequest,
@@ -28,9 +29,20 @@ log = logging.getLogger("df.mgr.service")
 MANAGER_SERVICE = "df.manager.Manager"
 
 
+MAX_CERT_VALIDITY_S = 30 * 24 * 3600     # caller may ask for less, not more
+
+
 class ManagerService:
-    def __init__(self, store: Store):
+    def __init__(self, store: Store, *, issuer=None,
+                 issue_token: str = ""):
+        """``issuer``: a ``common.certs.CertIssuer`` enabling fleet cert
+        issuance (IssueCertificate); None disables the RPC.
+        ``issue_token``: shared secret gating issuance — without a gate,
+        anyone reaching the gRPC port could get fleet-CA-signed certs and
+        the mTLS layer would authenticate nothing."""
         self.store = store
+        self.issuer = issuer
+        self.issue_token = issue_token
 
     async def get_schedulers(self, req: GetSchedulersRequest,
                              context) -> GetSchedulersResponse:
@@ -108,6 +120,36 @@ class ManagerService:
             data=b"" if unchanged else row["data"],
             created_at=row["created_at"]))
 
+    # -- fleet cert issuance (reference security_server_v1.go) ----------
+
+    async def issue_certificate(self, req: CertificateRequest,
+                                context) -> CertificateResponse:
+        if self.issuer is None:
+            raise DFError(Code.SCHED_FORBIDDEN,
+                          "certificate issuance not enabled")
+        import hmac as _hmac
+
+        if not self.issue_token or not _hmac.compare_digest(
+                req.token or "", self.issue_token):
+            raise DFError(Code.SCHED_FORBIDDEN, "bad issuance token")
+        if not req.public_key_pem or not req.hosts:
+            raise DFError(Code.INVALID_ARGUMENT,
+                          "public_key_pem and hosts required")
+        import datetime
+
+        from cryptography.hazmat.primitives import serialization
+
+        def sign() -> bytes:
+            pub = serialization.load_pem_public_key(req.public_key_pem)
+            want = req.validity_s if req.validity_s > 0 else 24 * 3600
+            ttl = datetime.timedelta(
+                seconds=min(want, MAX_CERT_VALIDITY_S))
+            return self.issuer.sign_public_key(pub, list(req.hosts), ttl=ttl)
+
+        cert_pem = await asyncio.to_thread(sign)
+        return CertificateResponse(cert_pem=cert_pem,
+                                   ca_cert_pem=self.issuer._ca_pem())
+
     async def keep_alive(self, request_iter, context) -> Empty:
         """Client-stream: one message per interval; instance goes inactive
         when the stream dies and the TTL sweep catches it."""
@@ -134,4 +176,5 @@ def build_service(svc: ManagerService) -> ServiceDef:
     d.stream_unary("KeepAlive", svc.keep_alive)
     d.unary_unary("CreateModel", svc.create_model)
     d.unary_unary("GetModel", svc.get_model)
+    d.unary_unary("IssueCertificate", svc.issue_certificate)
     return d

@@ -84,6 +84,22 @@ CREATE TABLE IF NOT EXISTS models (
   created_at REAL,
   UNIQUE(name, version, scheduler_cluster_id)
 );
+CREATE TABLE IF NOT EXISTS users (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL UNIQUE,
+  password_hash TEXT NOT NULL,
+  role TEXT NOT NULL DEFAULT 'guest',
+  created_at REAL
+);
+CREATE TABLE IF NOT EXISTS personal_access_tokens (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  token_hash TEXT NOT NULL UNIQUE,
+  label TEXT NOT NULL DEFAULT '',
+  user_id INTEGER NOT NULL,
+  revoked INTEGER NOT NULL DEFAULT 0,
+  expires_at REAL NOT NULL DEFAULT 0,
+  created_at REAL
+);
 """
 
 
@@ -371,3 +387,92 @@ class Store:
             return [dict(r) for r in self._rows(
                 "SELECT * FROM jobs WHERE state=? ORDER BY id", (state,))]
         return [dict(r) for r in self._rows("SELECT * FROM jobs ORDER BY id")]
+
+    # -- users + personal access tokens (reference manager/models/user.go,
+    # -- personal_access_token.go; middleware personal_access_token.go) ----
+
+    @staticmethod
+    def _hash_password(password: str, salt: bytes | None = None) -> str:
+        import hashlib
+        import os as _os
+        salt = salt or _os.urandom(16)
+        dk = hashlib.scrypt(password.encode(), salt=salt, n=2**14, r=8, p=1)
+        return salt.hex() + "$" + dk.hex()
+
+    def create_user(self, name: str, password: str, *,
+                    role: str = "guest") -> int:
+        if role not in ("root", "guest"):
+            raise ValueError(f"unknown role {role!r}")
+        cur = self._exec(
+            "INSERT INTO users(name, password_hash, role, created_at) "
+            "VALUES(?,?,?,?)",
+            (name, self._hash_password(password), role, _now()))
+        return cur.lastrowid
+
+    def verify_user(self, name: str, password: str) -> dict | None:
+        import hashlib
+        import hmac as _hmac
+        rows = self._rows("SELECT * FROM users WHERE name=?", (name,))
+        if not rows:
+            return None
+        user = dict(rows[0])
+        salt_hex, _, want = user["password_hash"].partition("$")
+        dk = hashlib.scrypt(password.encode(), salt=bytes.fromhex(salt_hex),
+                            n=2**14, r=8, p=1)
+        if not _hmac.compare_digest(dk.hex(), want):
+            return None
+        user.pop("password_hash", None)
+        return user
+
+    def user(self, user_id: int) -> dict | None:
+        rows = self._rows("SELECT id, name, role, created_at FROM users "
+                          "WHERE id=?", (user_id,))
+        return dict(rows[0]) if rows else None
+
+    @staticmethod
+    def _token_hash(token: str) -> str:
+        import hashlib
+        return hashlib.sha256(token.encode()).hexdigest()
+
+    def create_pat(self, user_id: int, *, label: str = "",
+                   ttl_s: float = 0.0) -> str:
+        """Mint a personal access token; only its HASH is stored (a DB leak
+        must not leak bearer credentials)."""
+        import secrets
+        token = "dfp_" + secrets.token_urlsafe(32)
+        expires = _now() + ttl_s if ttl_s > 0 else 0.0
+        self._exec(
+            "INSERT INTO personal_access_tokens"
+            "(token_hash, label, user_id, expires_at, created_at) "
+            "VALUES(?,?,?,?,?)",
+            (self._token_hash(token), label, user_id, expires, _now()))
+        return token
+
+    def pat_user(self, token: str) -> dict | None:
+        """The user behind a live PAT, or None (unknown/revoked/expired)."""
+        rows = self._rows(
+            "SELECT u.id, u.name, u.role, p.expires_at, p.revoked "
+            "FROM personal_access_tokens p JOIN users u ON u.id=p.user_id "
+            "WHERE p.token_hash=?", (self._token_hash(token),))
+        if not rows:
+            return None
+        row = dict(rows[0])
+        if row.pop("revoked"):
+            return None
+        expires = row.pop("expires_at")
+        if expires and _now() > expires:
+            return None
+        return row
+
+    def pats(self, user_id: int | None = None) -> list[dict]:
+        sql = ("SELECT id, label, user_id, revoked, expires_at, created_at "
+               "FROM personal_access_tokens")
+        args: list = []
+        if user_id is not None:
+            sql += " WHERE user_id=?"
+            args.append(user_id)
+        return [dict(r) for r in self._rows(sql + " ORDER BY id", args)]
+
+    def revoke_pat(self, pat_id: int) -> None:
+        self._exec("UPDATE personal_access_tokens SET revoked=1 WHERE id=?",
+                   (pat_id,))

@@ -43,14 +43,37 @@ def _translate(exc: grpc.aio.AioRpcError) -> Exception:
 
 
 class Channel:
-    """An insecure channel to one address, with lazily-created method stubs."""
+    """A channel to one address, with lazily-created method stubs.
 
-    def __init__(self, address: str, *, options: list | None = None):
+    Plaintext by default; ``tls_ca`` switches to TLS (trusting that CA —
+    typically the manager's issuing CA), and ``tls_cert``/``tls_key`` adds
+    the client certificate for mTLS servers (reference ``pkg/rpc/mux.go``
+    client credentials)."""
+
+    def __init__(self, address: str, *, options: list | None = None,
+                 tls_ca: str = "", tls_cert: str = "", tls_key: str = "",
+                 tls_server_name: str = ""):
         self.address = address
-        self._channel = grpc.aio.insecure_channel(address, options=options or [
+        opts = options or [
             ("grpc.max_send_message_length", 64 * 1024 * 1024),
             ("grpc.max_receive_message_length", 64 * 1024 * 1024),
-        ])
+        ]
+        if tls_ca or tls_cert:
+            def _read(path: str) -> bytes | None:
+                if not path:
+                    return None
+                with open(path, "rb") as f:
+                    return f.read()
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=_read(tls_ca),
+                private_key=_read(tls_key), certificate_chain=_read(tls_cert))
+            if tls_server_name:
+                opts = [*opts, ("grpc.ssl_target_name_override",
+                                tls_server_name)]
+            self._channel = grpc.aio.secure_channel(address, creds,
+                                                    options=opts)
+        else:
+            self._channel = grpc.aio.insecure_channel(address, options=opts)
         self._stubs: dict[tuple[str, str, str], Any] = {}
 
     def _stub(self, kind: str, service: str, method: str):

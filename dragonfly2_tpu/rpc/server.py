@@ -105,17 +105,46 @@ class _Health:
         return Empty()
 
 
+class TLSOptions:
+    """Server-side TLS (reference ``pkg/rpc/mux.go`` credentials +
+    ``security.go`` policies). ``ca_path`` set + ``require_client_cert``
+    gives mTLS with manager-issued certs (``pkg/issuer``)."""
+
+    def __init__(self, cert_path: str, key_path: str, *, ca_path: str = "",
+                 require_client_cert: bool = False):
+        self.cert_path = cert_path
+        self.key_path = key_path
+        self.ca_path = ca_path
+        self.require_client_cert = require_client_cert
+
+    def server_credentials(self) -> grpc.ServerCredentials:
+        with open(self.key_path, "rb") as f:
+            key = f.read()
+        with open(self.cert_path, "rb") as f:
+            cert = f.read()
+        roots = None
+        if self.ca_path:
+            with open(self.ca_path, "rb") as f:
+                roots = f.read()
+        return grpc.ssl_server_credentials(
+            [(key, cert)], root_certificates=roots,
+            require_client_auth=self.require_client_cert)
+
+
 class RPCServer:
     """One gRPC server hosting many ServiceDefs on one address.
 
     ``address`` may be "ip:port", "unix:/path", or "ip:0" (ephemeral —
-    resolved port available as ``.port`` after ``start``).
+    resolved port available as ``.port`` after ``start``). ``tls`` secures
+    the listener (TLSOptions above).
     """
 
-    def __init__(self, address: str, *, options: list | None = None):
+    def __init__(self, address: str, *, options: list | None = None,
+                 tls: TLSOptions | None = None):
         self.address = address
         self.port: int | None = None
         self.health = _Health()
+        self.tls = tls
         self._server = grpc.aio.server(options=options or [
             ("grpc.max_send_message_length", 64 * 1024 * 1024),
             ("grpc.max_receive_message_length", 64 * 1024 * 1024),
@@ -129,11 +158,16 @@ class RPCServer:
 
     async def start(self) -> None:
         self._server.add_generic_rpc_handlers(tuple(d.build() for d in self._defs))
-        port = self._server.add_insecure_port(self.address)
+        if self.tls is not None:
+            port = self._server.add_secure_port(
+                self.address, self.tls.server_credentials())
+        else:
+            port = self._server.add_insecure_port(self.address)
         if not self.address.startswith("unix:"):
             self.port = port
         await self._server.start()
-        log.info("rpc server on %s (port=%s): %s", self.address, self.port,
+        log.info("rpc server on %s (port=%s, tls=%s): %s", self.address,
+                 self.port, self.tls is not None,
                  ",".join(d.name for d in self._defs))
 
     async def stop(self, grace: float = 1.0) -> None:

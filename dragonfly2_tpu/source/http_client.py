@@ -41,14 +41,18 @@ class HTTPSourceClient:
 
     def set_tls(self, *, insecure: bool = False, ca_file: str = "") -> None:
         """TLS trust for https origins: a private registry signed by a
-        custom CA (or the proxy's own MITM CA) needs ``ca_file``;
-        ``insecure`` disables verification (tests only)."""
+        custom CA (or the proxy's own MITM CA) needs ``ca_file`` — added ON
+        TOP of system trust (public origins must keep working while a
+        private CA is configured); ``insecure`` disables verification
+        (tests only)."""
         import ssl as _ssl
 
         if insecure:
             self._ssl = False
         elif ca_file:
-            self._ssl = _ssl.create_default_context(cafile=ca_file)
+            ctx = _ssl.create_default_context()
+            ctx.load_verify_locations(cafile=ca_file)
+            self._ssl = ctx
         else:
             self._ssl = None
 

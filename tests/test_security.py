@@ -1,0 +1,228 @@
+"""Security layer: manager auth/RBAC, PATs, TLS rpc, cert issuance.
+
+VERDICT missing #2/#3. Reference surfaces covered: manager/middlewares
+(jwt, personal_access_token, rbac), manager/models/user.go + PATs,
+manager/rpcserver/security_server_v1.go IssueCertificate + pkg/issuer,
+pkg/rpc/mux.go TLS credentials.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from dragonfly2_tpu.manager.server import Manager, ManagerConfig
+from dragonfly2_tpu.manager.store import Store
+
+
+async def _mgr(tmp_path, **kw) -> Manager:
+    m = Manager(ManagerConfig(listen_ip="127.0.0.1",
+                              workdir=str(tmp_path), **kw))
+    await m.start()
+    return m
+
+
+def _root_password(tmp_path) -> str:
+    with open(os.path.join(str(tmp_path), "root.password")) as f:
+        return f.read().strip()
+
+
+class TestManagerAuth:
+    def test_unauthenticated_crud_rejected(self, tmp_path):
+        async def main():
+            import aiohttp
+
+            m = await _mgr(tmp_path, auth_enabled=True)
+            try:
+                base = f"http://127.0.0.1:{m.rest.port}"
+                async with aiohttp.ClientSession() as s:
+                    # health stays public
+                    async with s.get(f"{base}/healthy") as r:
+                        assert r.status == 200
+                    # CRUD without credentials: 401
+                    async with s.get(f"{base}/api/v1/schedulers") as r:
+                        assert r.status == 401
+                    async with s.post(f"{base}/api/v1/applications",
+                                      json={"name": "x"}) as r:
+                        assert r.status == 401
+            finally:
+                await m.stop()
+        asyncio.run(main())
+
+    def test_signin_session_and_rbac(self, tmp_path):
+        async def main():
+            import aiohttp
+
+            m = await _mgr(tmp_path, auth_enabled=True)
+            try:
+                base = f"http://127.0.0.1:{m.rest.port}"
+                password = _root_password(tmp_path)
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "root",
+                                            "password": password}) as r:
+                        assert r.status == 200
+                        token = (await r.json())["token"]
+                    hdr = {"Authorization": f"Bearer {token}"}
+                    # root: read + write
+                    async with s.get(f"{base}/api/v1/schedulers",
+                                     headers=hdr) as r:
+                        assert r.status == 200
+                    async with s.post(f"{base}/api/v1/users",
+                                      json={"name": "bob", "password": "pw",
+                                            "role": "guest"},
+                                      headers=hdr) as r:
+                        assert r.status == 201
+                    # guest: read ok, write forbidden (rbac)
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "bob",
+                                            "password": "pw"}) as r:
+                        guest = (await r.json())["token"]
+                    ghdr = {"Authorization": f"Bearer {guest}"}
+                    async with s.get(f"{base}/api/v1/schedulers",
+                                     headers=ghdr) as r:
+                        assert r.status == 200
+                    async with s.post(f"{base}/api/v1/applications",
+                                      json={"name": "app"},
+                                      headers=ghdr) as r:
+                        assert r.status == 403
+                    # bad password: 401
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "root",
+                                            "password": "nope"}) as r:
+                        assert r.status == 401
+            finally:
+                await m.stop()
+        asyncio.run(main())
+
+    def test_personal_access_tokens(self, tmp_path):
+        async def main():
+            import aiohttp
+
+            m = await _mgr(tmp_path, auth_enabled=True)
+            try:
+                base = f"http://127.0.0.1:{m.rest.port}"
+                password = _root_password(tmp_path)
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "root",
+                                            "password": password}) as r:
+                        hdr = {"Authorization":
+                               f"Bearer {(await r.json())['token']}"}
+                    async with s.post(
+                            f"{base}/api/v1/personal-access-tokens",
+                            json={"label": "ci"}, headers=hdr) as r:
+                        assert r.status == 201
+                        pat = (await r.json())["token"]
+                    assert pat.startswith("dfp_")
+                    phdr = {"Authorization": f"Bearer {pat}"}
+                    async with s.get(f"{base}/api/v1/schedulers",
+                                     headers=phdr) as r:
+                        assert r.status == 200
+                    # revoke -> 401
+                    async with s.get(
+                            f"{base}/api/v1/personal-access-tokens",
+                            headers=hdr) as r:
+                        pats = await r.json()
+                    async with s.delete(
+                            f"{base}/api/v1/personal-access-tokens/"
+                            f"{pats[0]['id']}", headers=hdr) as r:
+                        assert r.status == 200
+                    async with s.get(f"{base}/api/v1/schedulers",
+                                     headers=phdr) as r:
+                        assert r.status == 401
+            finally:
+                await m.stop()
+        asyncio.run(main())
+
+    def test_pat_only_hash_stored(self):
+        store = Store()
+        uid = store.create_user("u", "pw")
+        token = store.create_pat(uid)
+        rows = store._rows("SELECT token_hash FROM personal_access_tokens")
+        assert token not in rows[0]["token_hash"]   # DB leak != token leak
+        assert store.pat_user(token)["name"] == "u"
+
+
+class TestCertIssuanceAndTLSRPC:
+    def test_issue_certificate_and_tls_roundtrip(self, tmp_path):
+        """Full fleet-security loop: a peer generates a keypair, the
+        manager signs the public half, and a gRPC server/client pair talks
+        over TLS with the issued cert."""
+        async def main():
+            from cryptography.hazmat.primitives import serialization
+            from cryptography.hazmat.primitives.asymmetric import ec
+
+            from dragonfly2_tpu.idl.messages import CertificateRequest, Empty
+            from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+            from dragonfly2_tpu.rpc.server import (RPCServer, ServiceDef,
+                                                   TLSOptions)
+
+            m = await _mgr(tmp_path, issue_certs=True)
+            try:
+                # peer side: keypair stays local, public half goes up
+                key = ec.generate_private_key(ec.SECP256R1())
+                pub_pem = key.public_key().public_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PublicFormat.SubjectPublicKeyInfo)
+                ch = Channel(f"127.0.0.1:{m.port}")
+                mc = ServiceClient(ch, "df.manager.Manager")
+                # without the issuance token: refused
+                from dragonfly2_tpu.common.errors import DFError
+                with pytest.raises(DFError):
+                    await mc.unary("IssueCertificate", CertificateRequest(
+                        public_key_pem=pub_pem, hosts=["127.0.0.1"]))
+                resp = await mc.unary(
+                    "IssueCertificate",
+                    CertificateRequest(public_key_pem=pub_pem,
+                                       hosts=["127.0.0.1", "peer.test"],
+                                       token=m.issue_token))
+                await ch.close()
+                assert b"BEGIN CERTIFICATE" in resp.cert_pem
+                cert_p = tmp_path / "peer.crt"
+                key_p = tmp_path / "peer.key"
+                ca_p = tmp_path / "fleet-ca.crt"
+                cert_p.write_bytes(resp.cert_pem)
+                ca_p.write_bytes(resp.ca_cert_pem)
+                key_p.write_bytes(key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.PKCS8,
+                    serialization.NoEncryption()))
+
+                # TLS rpc server using the ISSUED cert
+                async def ping(req, ctx):
+                    return Empty()
+
+                svc = ServiceDef("df.test.Ping")
+                svc.unary_unary("Ping", ping)
+                srv = RPCServer("127.0.0.1:0",
+                                tls=TLSOptions(str(cert_p), str(key_p)))
+                srv.register(svc)
+                await srv.start()
+                try:
+                    tls_ch = Channel(f"127.0.0.1:{srv.port}",
+                                     tls_ca=str(ca_p))
+                    client = ServiceClient(tls_ch, "df.test.Ping")
+                    out = await client.unary("Ping", Empty())
+                    assert isinstance(out, Empty)
+                    await tls_ch.close()
+                    # a client trusting a DIFFERENT CA is refused
+                    from dragonfly2_tpu.common.certs import generate_ca
+                    other_ca, _ = generate_ca("other CA")
+                    other_p = tmp_path / "other-ca.crt"
+                    other_p.write_bytes(other_ca)
+                    bad_ch = Channel(f"127.0.0.1:{srv.port}",
+                                     tls_ca=str(other_p))
+                    bad = ServiceClient(bad_ch, "df.test.Ping")
+                    with pytest.raises(Exception):
+                        await asyncio.wait_for(bad.unary("Ping", Empty()), 10)
+                    await bad_ch.close()
+                finally:
+                    await srv.stop(0.2)
+            finally:
+                await m.stop()
+        asyncio.run(main())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -99,6 +99,10 @@ class CertIssuer:
         self._lock = threading.Lock()
         # host -> (ssl_ctx, not_after); insertion-ordered for LRU eviction
         self._cache: dict[str, tuple[ssl.SSLContext, datetime.datetime]] = {}
+        # per-host single-flight: minting host B must not block a cache HIT
+        # for host A (the SNI callback runs server_context synchronously on
+        # the event loop; a global mint lock would head-of-line block it)
+        self._mint_locks: dict[str, threading.Lock] = {}
 
     # client-controlled names (CONNECT targets, raw SNI bytes) feed the
     # cache: bound it, or a client looping random names grows memory and
@@ -167,22 +171,34 @@ class CertIssuer:
             if hit is not None and now < hit[1]:
                 self._cache[host] = self._cache.pop(host)   # LRU touch
                 return hit[0]
+            mint_lock = self._mint_locks.setdefault(host, threading.Lock())
+        with mint_lock:
+            # double-check: the racer that held the mint lock first filled it
+            with self._lock:
+                hit = self._cache.get(host)
+                if hit is not None and now < hit[1]:
+                    return hit[0]
             cert_pem, key_pem, not_after = self._mint(host)
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             # load_cert_chain wants files; they are TRANSIENT (deleted the
             # moment the chain is loaded) so client-controlled names cost no
-            # disk. The filename is still sanitized: a name like
-            # '../proxy-ca' must never escape the leaves dir even briefly.
+            # disk. The filename is sanitized (a name like '../proxy-ca'
+            # must never escape the leaves dir) and unique per thread so
+            # same-sanitization hosts cannot interleave writes.
             leaf_dir = os.path.join(self.workdir, "leaves")
             os.makedirs(leaf_dir, exist_ok=True)
             safe = re.sub(r"[^A-Za-z0-9._-]", "_", host).strip(".")[:64]
-            base = os.path.join(leaf_dir, f"leaf-{safe or 'host'}-{os.getpid()}")
+            base = os.path.join(
+                leaf_dir,
+                f"leaf-{safe or 'host'}-{os.getpid()}-"
+                f"{threading.get_ident()}")
             try:
                 with open(base + ".crt", "wb") as f:
                     f.write(cert_pem + self._ca_pem())
-                with open(base + ".key", "wb") as f:
+                fd = os.open(base + ".key",
+                             os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+                with os.fdopen(fd, "wb") as f:
                     f.write(key_pem)
-                os.chmod(base + ".key", 0o600)
                 ctx.load_cert_chain(base + ".crt", base + ".key")
             finally:
                 for suffix in (".crt", ".key"):
@@ -190,12 +206,17 @@ class CertIssuer:
                         os.unlink(base + suffix)
                     except OSError:
                         pass
-            # expired + LRU eviction keeps the cache bounded
-            for key in [k for k, v in self._cache.items() if now >= v[1]]:
-                del self._cache[key]
-            while len(self._cache) >= self.CACHE_MAX:
-                del self._cache[next(iter(self._cache))]
-            self._cache[host] = (ctx, not_after)
+            with self._lock:
+                # expired + LRU eviction keeps the cache bounded
+                for key in [k for k, v in self._cache.items()
+                            if now >= v[1]]:
+                    del self._cache[key]
+                    self._mint_locks.pop(key, None)
+                while len(self._cache) >= self.CACHE_MAX:
+                    evicted = next(iter(self._cache))
+                    del self._cache[evicted]
+                    self._mint_locks.pop(evicted, None)
+                self._cache[host] = (ctx, not_after)
         log.debug("minted leaf cert for %s", host)
         return ctx
 

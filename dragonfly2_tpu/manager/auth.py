@@ -59,9 +59,12 @@ class Authenticator:
             if secret_path:
                 os.makedirs(os.path.dirname(secret_path) or ".",
                             exist_ok=True)
-                with open(secret_path, "wb") as f:
+                # 0600 from CREATION: open+chmod leaves a world-readable
+                # window (and a crash in it leaves the secret exposed)
+                fd = os.open(secret_path,
+                             os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+                with os.fdopen(fd, "wb") as f:
                     f.write(self._secret)
-                os.chmod(secret_path, 0o600)
 
     # -- session tokens ------------------------------------------------
 
@@ -137,9 +140,10 @@ def bootstrap_root(store, *, password_path: str = "") -> None:
     password = secrets.token_urlsafe(16)
     store.create_user("root", password, role="root")
     if password_path:
-        with open(password_path, "w", encoding="utf-8") as f:
+        fd = os.open(password_path,
+                     os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
             f.write(password + "\n")
-        os.chmod(password_path, 0o600)
         log.info("bootstrapped root user; password at %s", password_path)
     else:
         log.warning("bootstrapped root user with ephemeral password "

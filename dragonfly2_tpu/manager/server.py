@@ -37,6 +37,12 @@ class ManagerConfig:
     # certificate issuance for fleet mTLS (reference
     # manager/rpcserver/security_server_v1.go + pkg/issuer)
     issue_certs: bool = False
+    # serve the manager's own gRPC port over TLS with a cert minted from
+    # the manager CA. REQUIRED wherever issue_certs rides an untrusted
+    # network: the issuance token travels in the request, and a plaintext
+    # listener would hand it to any on-path observer (open signing oracle).
+    # Clients trust manager-ca/proxy-ca.crt (distributed out of band).
+    grpc_tls: bool = False
 
 
 class Manager:
@@ -67,9 +73,10 @@ class Manager:
                     issue_token = f.read().strip()
             else:
                 issue_token = secrets.token_urlsafe(24)
-                with open(token_path, "w", encoding="utf-8") as f:
+                fd = os.open(token_path,
+                             os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
                     f.write(issue_token + "\n")
-                os.chmod(token_path, 0o600)
         self.issuer = issuer
         self.issue_token = issue_token
         self.service = ManagerService(self.store, issuer=issuer,
@@ -94,10 +101,34 @@ class Manager:
     def address(self) -> str:
         return f"{self.cfg.advertise_ip}:{self.port}"
 
+    @property
+    def ca_cert_path(self) -> str:
+        return self.issuer.ca_cert_path if self.issuer else ""
+
+    def _grpc_tls(self):
+        if not self.cfg.grpc_tls:
+            return None
+        if self.issuer is None:
+            raise ValueError("grpc_tls requires issue_certs (the manager CA "
+                             "signs its own server cert)")
+        import tempfile
+
+        from ..rpc.server import TLSOptions
+        cert_pem, key_pem, _ = self.issuer._mint(self.cfg.advertise_ip)
+        d = tempfile.mkdtemp(prefix="df-mgr-tls-")
+        cert_p, key_p = os.path.join(d, "s.crt"), os.path.join(d, "s.key")
+        with open(cert_p, "wb") as f:
+            f.write(cert_pem + self.issuer._ca_pem())
+        fd = os.open(key_p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(key_pem)
+        return TLSOptions(cert_p, key_p)
+
     async def start(self) -> None:
         # a default cluster always exists so self-registration lands somewhere
         self.store.default_scheduler_cluster()
-        self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.grpc_port}")
+        self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.grpc_port}",
+                             tls=self._grpc_tls())
         self.rpc.register(build_service(self.service))
         await self.rpc.start()
         self.port = self.rpc.port

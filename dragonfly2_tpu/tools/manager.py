@@ -1,0 +1,71 @@
+"""Manager launcher: ``python -m dragonfly2_tpu.tools.manager``.
+
+Role parity: reference ``cmd/manager`` (cobra launcher over
+``manager.New``/``Serve``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+from ..common import logging as dflog
+from ..common.config import env_overrides, load_config
+from ..manager.server import Manager, ManagerConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="df-manager")
+    p.add_argument("--config", default="", help="YAML/JSON config file")
+    p.add_argument("--grpc-port", type=int, default=0)
+    p.add_argument("--rest-port", type=int, default=0)
+    p.add_argument("--listen-ip", default="")
+    p.add_argument("--db", default="", help="sqlite path ('' = in-memory)")
+    p.add_argument("--workdir", default="")
+    p.add_argument("--auth", action="store_true",
+                   help="enable REST auth/RBAC (bootstraps a root user)")
+    p.add_argument("--issue-certs", action="store_true",
+                   help="enable fleet certificate issuance")
+    p.add_argument("--verbose", "-v", action="store_true")
+    return p
+
+
+async def serve(cfg: ManagerConfig) -> None:
+    mgr = Manager(cfg)
+    await mgr.start()
+    print(f"manager up: grpc={mgr.address} rest=:{mgr.rest.port}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await mgr.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    dflog.setup("DEBUG" if args.verbose else "INFO")
+    overrides: dict = env_overrides()
+    if args.grpc_port:
+        overrides["grpc_port"] = args.grpc_port
+    if args.rest_port:
+        overrides["rest_port"] = args.rest_port
+    if args.listen_ip:
+        overrides["listen_ip"] = args.listen_ip
+    if args.db:
+        overrides["db_path"] = args.db
+    if args.workdir:
+        overrides["workdir"] = args.workdir
+    if args.auth:
+        overrides["auth_enabled"] = True
+    if args.issue_certs:
+        overrides["issue_certs"] = True
+    cfg = load_config(ManagerConfig, args.config or None, overrides)
+    asyncio.run(serve(cfg))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

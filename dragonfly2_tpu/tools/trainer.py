@@ -1,0 +1,61 @@
+"""Trainer launcher: ``python -m dragonfly2_tpu.tools.trainer``.
+
+Role parity: reference ``cmd/trainer`` (cobra launcher over
+``trainer.New``/``Serve``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+from ..common import logging as dflog
+from ..common.config import env_overrides, load_config
+from ..trainer.server import Trainer, TrainerConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="df-trainer")
+    p.add_argument("--config", default="", help="YAML/JSON config file")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--listen-ip", default="")
+    p.add_argument("--data-dir", default="")
+    p.add_argument("--manager", action="append", default=[],
+                   help="manager address (repeatable)")
+    p.add_argument("--verbose", "-v", action="store_true")
+    return p
+
+
+async def serve(cfg: TrainerConfig) -> None:
+    trainer = Trainer(cfg)
+    await trainer.start()
+    print(f"trainer up: {trainer.address}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await trainer.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    dflog.setup("DEBUG" if args.verbose else "INFO")
+    overrides: dict = env_overrides()
+    if args.port:
+        overrides["port"] = args.port
+    if args.listen_ip:
+        overrides["listen_ip"] = args.listen_ip
+    if args.data_dir:
+        overrides["data_dir"] = args.data_dir
+    if args.manager:
+        overrides["manager_addresses"] = args.manager
+    cfg = load_config(TrainerConfig, args.config or None, overrides)
+    asyncio.run(serve(cfg))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

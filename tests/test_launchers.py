@@ -1,0 +1,166 @@
+"""Service launchers: the whole stack deployable from CLIs only.
+
+VERDICT missing #6 / next #9 (reference ``cmd/`` launchers). Real OS
+processes started via ``python -m dragonfly2_tpu.tools.{manager,scheduler,
+trainer,daemon}``, discovery through the manager (scheduler registers +
+adopts the seed-peer set; leecher discovers the scheduler), then a dfget
+CLI pull that must ride the mesh end to end.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def spawn(mod: str, *args: str) -> subprocess.Popen:
+    env = {**os.environ, "PYTHONPATH": REPO, "PYTHONUNBUFFERED": "1",
+           "JAX_PLATFORMS": "cpu"}
+    return subprocess.Popen(
+        [PY, "-m", f"dragonfly2_tpu.tools.{mod}", *args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        cwd=REPO)
+
+
+def wait_line(proc: subprocess.Popen, needle: str, timeout: float = 60.0) -> str:
+    deadline = time.monotonic() + timeout
+    lines = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"process died: {''.join(lines)[-2000:]}")
+            time.sleep(0.05)
+            continue
+        lines.append(line)
+        if needle in line:
+            return line
+    raise TimeoutError(f"{needle!r} not seen; got: {''.join(lines)[-2000:]}")
+
+
+def wait_http(url: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(url, timeout=2)
+            return
+        except Exception:
+            time.sleep(0.2)
+    raise TimeoutError(f"{url} not up")
+
+
+def test_full_stack_from_clis(tmp_path):
+    blob = os.urandom(5 << 20)
+    (tmp_path / "www").mkdir()
+    (tmp_path / "www" / "blob.bin").write_bytes(blob)
+
+    procs: list[subprocess.Popen] = []
+    try:
+        # origin
+        origin_port = free_port()
+        procs.append(subprocess.Popen(
+            [PY, "-m", "http.server", str(origin_port), "--bind",
+             "127.0.0.1"], cwd=str(tmp_path / "www"),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        url = f"http://127.0.0.1:{origin_port}/blob.bin"
+
+        # manager
+        grpc_port, rest_port = free_port(), free_port()
+        mgr = spawn("manager", "--grpc-port", str(grpc_port),
+                    "--rest-port", str(rest_port),
+                    "--workdir", str(tmp_path / "mgr"),
+                    "--db", str(tmp_path / "mgr" / "m.db"))
+        procs.append(mgr)
+        wait_line(mgr, "manager up:")
+        mgr_addr = f"127.0.0.1:{grpc_port}"
+
+        # seed daemon registers itself with the manager
+        seed_rpc, seed_up = free_port(), free_port()
+        seed_cfg = tmp_path / "seed.json"
+        seed_cfg.write_text(json.dumps({
+            "workdir": str(tmp_path / "seed"), "host_ip": "127.0.0.1",
+            "hostname": "seed-cli", "is_seed": True,
+            "rpc_port": seed_rpc,
+            "manager_addresses": [mgr_addr],
+            "upload": {"port": seed_up},
+            "storage": {"gc_interval_s": 3600}}))
+        seed = spawn("daemon", "--config", str(seed_cfg))
+        procs.append(seed)
+        wait_line(seed, "daemon up:")
+
+        # scheduler discovers the seed THROUGH the manager
+        sched_port = free_port()
+        sched = spawn("scheduler", "--port", str(sched_port),
+                      "--advertise-ip", "127.0.0.1",
+                      "--manager", mgr_addr)
+        procs.append(sched)
+        wait_line(sched, "scheduler up:")
+        sched_addr = f"127.0.0.1:{sched_port}"
+
+        # trainer attaches to the manager too
+        trainer = spawn("trainer", "--manager", mgr_addr,
+                        "--data-dir", str(tmp_path / "tr"))
+        procs.append(trainer)
+        wait_line(trainer, "trainer up:")
+
+        # manager REST sees both registered instances
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rest_port}/api/v1/schedulers") as r:
+            scheds = json.loads(r.read())
+        assert any(s["port"] == sched_port for s in scheds)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rest_port}/api/v1/seed-peers") as r:
+            seeds = json.loads(r.read())
+        assert any(s["port"] == seed_rpc for s in seeds)
+
+        # leecher daemon + dfget CLI: bytes must ride the mesh
+        sock = str(tmp_path / "leech.sock")
+        leech_cfg = tmp_path / "leech.json"
+        leech_cfg.write_text(json.dumps({
+            "workdir": str(tmp_path / "leech"), "host_ip": "127.0.0.1",
+            "hostname": "leech-cli", "unix_sock": sock,
+            "scheduler": {"addresses": [sched_addr]},
+            "storage": {"gc_interval_s": 3600}}))
+        leech = spawn("daemon", "--config", str(leech_cfg))
+        procs.append(leech)
+        wait_line(leech, "daemon up:")
+
+        out = tmp_path / "out.bin"
+        rc = subprocess.run(
+            [PY, "-m", "dragonfly2_tpu.tools.dfget", url, "-O", str(out),
+             "--daemon-sock", sock, "--quiet"],
+            env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert rc.returncode == 0, rc.stderr[-2000:]
+        assert out.read_bytes() == blob
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

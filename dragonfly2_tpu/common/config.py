@@ -175,11 +175,17 @@ def _scalar(s: str) -> Any:
     return s
 
 
+# DF_* vars that are NOT config-field overrides (consumed elsewhere:
+# dfpath default, tpu.topology injection)
+_ENV_NON_CONFIG = {"DF_WORKDIR", "DF_ZONE", "DF_DEFAULT_ZONE",
+                   "DF_ICI_COORDS"}
+
+
 def env_overrides(prefix: str = "DF_") -> dict[str, Any]:
     """DF_A__B=2 -> {"a": {"b": 2}} (double underscore nests)."""
     out: dict[str, Any] = {}
     for key, val in os.environ.items():
-        if not key.startswith(prefix) or key == "DF_WORKDIR":
+        if not key.startswith(prefix) or key in _ENV_NON_CONFIG:
             continue
         path = key[len(prefix):].lower().split("__")
         node = out

@@ -135,7 +135,9 @@ class Daemon:
                     schedule_timeout_s=self.cfg.scheduler.schedule_timeout_s,
                     piece_timeout_s=self.cfg.download.piece_timeout_s,
                     downloader=self._piece_downloader,
-                    channel_pool=self._peer_channels)
+                    channel_pool=self._peer_channels,
+                    slice_name=(self.topology.slice_name
+                                if self.topology else ""))
         self.shaper.start()
         self.ptm = PeerTaskManager(
             storage_mgr=self.storage_mgr, piece_mgr=self.piece_mgr,

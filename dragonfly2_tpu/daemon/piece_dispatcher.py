@@ -22,9 +22,33 @@ import logging
 import random
 import time
 
-from ..idl.messages import PieceInfo
+from ..common.metrics import REGISTRY
+from ..idl.messages import LinkType, PieceInfo
 
 log = logging.getLogger("df.flow.dispatch")
+
+# locality decision quality, scraped from /metrics by the fake-pod e2e:
+# "cross_local_known" = chose a cross-slice parent while a FREE same-slice
+# holder was known (only the explore epsilon should ever do this)
+_picks = REGISTRY.counter("df_dispatch_pick_total",
+                          "parent pick outcomes", ("outcome",))
+
+# Demand-side locality: the scheduler annotates each offered parent with
+# the link class it computed from pod topology (PeerAddr.link). Link class
+# is a strict TIER in parent choice — any usable ICI holder outranks any
+# DCN holder for the same piece. The bandwidth gap between tiers (ICI
+# ~TB/s vs 100-400Gbps DCN NICs vs WAN, tpu.topology.LINK_BANDWIDTH_SCORE)
+# is larger than any within-tier latency spread, so a scalar cost
+# multiplier would let measurement noise invert the ordering exactly when
+# links are uncongested. Saturation still escapes the tier: busy (503) and
+# cooldown-ejected parents drop out of the holder set, and in-flight load
+# shifts choice within the tier.
+LINK_TIER = {
+    LinkType.LOCAL: 0,
+    LinkType.ICI: 0,     # same-host and same-slice are both "don't leave
+    LinkType.DCN: 1,     # the slice" — ICI moves bytes at memory-ish rates
+    LinkType.WAN: 2,
+}
 
 EXPLORE_RATIO = 0.1          # epsilon for random parent choice
 PARENT_FAIL_LIMIT = 3        # consecutive failures before ejection
@@ -50,10 +74,12 @@ class ParentState:
     lifetime ``PARENT_FAIL_HARD_LIMIT`` stay permanent; the scheduler's
     Z-score bad-node check is the authoritative long-term ejector."""
 
-    def __init__(self, peer_id: str, addr: str, *, is_seed: bool = False):
+    def __init__(self, peer_id: str, addr: str, *, is_seed: bool = False,
+                 link: LinkType = LinkType.DCN):
         self.peer_id = peer_id
         self.addr = addr                # "ip:download_port"
         self.is_seed = is_seed
+        self.link = link
         self.ns_per_byte = 0.0          # latency EWMA, 0 = no data yet
         self.consecutive_fails = 0
         self.total_fails = 0
@@ -107,14 +133,21 @@ class ParentState:
         cost = self.ns_per_byte * (1.0 + self.inflight)
         return cost * SEED_COST_FACTOR if self.is_seed else cost
 
+    def rank(self) -> tuple:
+        """Full ordering for parent choice: seeds last, then link tier,
+        then observed cost (see LINK_TIER rationale)."""
+        return (1 if self.is_seed else 0,
+                LINK_TIER.get(self.link, 1), self.score())
+
 
 class _PieceState:
-    __slots__ = ("info", "holders", "fetching")
+    __slots__ = ("info", "holders", "fetching", "first_seen")
 
     def __init__(self, info: PieceInfo):
         self.info = info
         self.holders: set[str] = set()   # parent peer ids that announced it
         self.fetching: set[str] = set()  # parents currently transferring it
+        self.first_seen = time.monotonic()
 
     @property
     def inflight(self) -> bool:
@@ -122,6 +155,18 @@ class _PieceState:
 
 
 GROUP_LIMIT = 2   # max contiguous pieces per dispatch (one ranged GET)
+# Locality grace: a piece whose KNOWN holders are all worse-tier (DCN/WAN/
+# seed) is deferred this long after first sight, giving the same-slice
+# holder's announcement time to arrive — dispatch-on-first-announcement
+# otherwise coin-flips locality (announcement order is a network race, and
+# hungry workers grab pieces the moment the first holder appears). Never
+# idles a worker: deferred pieces dispatch immediately when nothing
+# better-tiered is available.
+LOCALITY_GRACE_S = 0.15
+# a BUSY same-slice holder is still worth a longer wait than a free DCN
+# one (503 backoff is 40ms; DCN costs the whole transfer at ~1/10th the
+# bandwidth) — bounded so a stuck local holder can't starve the piece
+BUSY_LOCAL_WAIT_S = 1.0
 ENDGAME_PIECES = 2   # remaining-piece count at which duplicate racing is allowed
 # (kept tiny: each duplicate is a full extra transfer — on CPU-bound hosts
 # racing the whole tail measurably SLOWS the wave; this is stall insurance
@@ -176,14 +221,16 @@ class PieceDispatcher:
 
     async def add_parent(self, peer_id: str, addr: str, *,
                          resurrect: bool = False,
-                         is_seed: bool = False) -> ParentState:
+                         is_seed: bool = False,
+                         link: LinkType = LinkType.DCN) -> ParentState:
         """Known parents keep their state. An ejected parent stays ejected
         unless ``resurrect`` (an explicit scheduler re-assignment) — piece
         announcements must NOT revive a parent the failure limit removed."""
         async with self._cond:
             st = self.parents.get(peer_id)
             if st is None or (st.ejected and resurrect):
-                fresh = ParentState(peer_id, addr, is_seed=is_seed)
+                fresh = ParentState(peer_id, addr, is_seed=is_seed,
+                                    link=link)
                 if st is not None:
                     # carry HALVED lifetime failures across resurrection: a
                     # genuinely recovered parent works it off, a persistently
@@ -195,6 +242,7 @@ class PieceDispatcher:
             else:
                 st.addr = addr
                 st.is_seed = st.is_seed or is_seed
+                st.link = link
             self._cond.notify_all()
             return st
 
@@ -251,24 +299,57 @@ class PieceDispatcher:
         return [p for p in self.parents.values() if not p.ejected]
 
     def _pick(self) -> Dispatch | None:
+        now = time.monotonic()
         candidates = []
+        deferred = []
+        # locality deferral only exists where locality does: a swarm with
+        # no same-slice parents at all (no topology, e.g. plain clusters)
+        # must not tax every fresh piece with the grace wait
+        any_local = any(not p.is_seed and not p.removed
+                        and LINK_TIER.get(p.link, 1) == 0
+                        for p in self.parents.values())
         for ps in self._pieces.values():
             if ps.inflight:
                 continue
-            holders = [self.parents[h] for h in ps.holders
-                       if h in self.parents and not self.parents[h].ejected
-                       and not self.parents[h].is_busy()]
-            if holders:
+            all_states = [self.parents[h] for h in ps.holders
+                          if h in self.parents
+                          and not self.parents[h].ejected]
+            holders = [h for h in all_states if not h.is_busy()]
+            if not holders:
+                continue
+
+            def _is_local(h) -> bool:
+                return not h.is_seed and LINK_TIER.get(h.link, 1) == 0
+
+            local_free = any(_is_local(h) for h in holders)
+            local_busy = any(_is_local(h) for h in all_states)
+            age = now - ps.first_seen
+            wait = (LOCALITY_GRACE_S if not local_busy
+                    else BUSY_LOCAL_WAIT_S)
+            if (any_local and not local_free and not self.ordered
+                    and age < wait):
+                deferred.append((ps, holders))   # see LOCALITY_GRACE_S
+            else:
                 candidates.append((ps, holders))
+        if not candidates:
+            candidates = deferred
         if not candidates:
             return self._pick_endgame()
         if self.ordered:
             ps, holders = min(candidates, key=lambda c: c[0].info.piece_num)
         else:
-            # rarest-first with random tie-break
+            # rarest-first; rarity ties (common early in a fan-out) break
+            # toward pieces a BEST-LINK-TIER holder can serve, then random —
+            # otherwise a child repeatedly picks rare pieces whose only
+            # holders sit across the DCN while same-slice supply idles
+            def best_tier(c) -> int:
+                return min(LINK_TIER.get(h.link, 1) + (3 if h.is_seed else 0)
+                           for h in c[1])
             rarity = min(len(c[1]) for c in candidates)
+            tied = [c for c in candidates if len(c[1]) == rarity]
+            top_tier = min(best_tier(c) for c in tied)
             ps, holders = random.choice(
-                [c for c in candidates if len(c[1]) == rarity])
+                [c for c in tied if best_tier(c) == top_tier])
         if len(holders) > 1 and random.random() < self.explore_ratio:
             # exploration probes MESH capacity; the seed's latency is already
             # known territory (and every random pick of it costs scarce
@@ -276,7 +357,7 @@ class PieceDispatcher:
             peers_only = [h for h in holders if not h.is_seed]
             parent = random.choice(peers_only or holders)
         else:
-            parent = min(holders, key=ParentState.score)
+            parent = min(holders, key=ParentState.rank)
         group = [ps]
         # extend with contiguous pieces the same parent holds, both
         # directions (rarest-first may land mid-run or at a run's end)
@@ -305,6 +386,16 @@ class PieceDispatcher:
             g.fetching.add(parent.peer_id)
         parent.inflight += 1
         parent.attempts += len(group)
+        if parent.is_seed:
+            outcome = "seed"
+        elif LINK_TIER.get(parent.link, 1) == 0:
+            outcome = "local"
+        elif any(not h.is_seed and LINK_TIER.get(h.link, 1) == 0
+                 for h in holders):
+            outcome = "cross_local_known"
+        else:
+            outcome = "cross_no_local"
+        _picks.labels(outcome).inc(len(group))
         return Dispatch([g.info for g in group], parent)
 
     def _pick_endgame(self) -> Dispatch | None:
@@ -326,7 +417,7 @@ class PieceDispatcher:
                     and not self.parents[h].is_busy()]
             if not alts:
                 continue
-            parent = min(alts, key=ParentState.score)
+            parent = min(alts, key=ParentState.rank)
             key = len(ps.fetching)   # least-raced piece first
             if best is None or key < best[0]:
                 best = (key, ps, parent)

@@ -74,7 +74,8 @@ class _Synchronizer:
                 task_id=self.conductor.task_id,
                 src_peer_id=self.conductor.peer_id,
                 dst_peer_id=self.parent.peer_id,
-                start_num=0, limit=1 << 20))
+                start_num=0, limit=1 << 20,
+                src_slice=self.engine.slice_name))
         except Exception:  # noqa: BLE001 - stream may be closing
             pass
 
@@ -88,7 +89,8 @@ class _Synchronizer:
                 task_id=self.conductor.task_id,
                 src_peer_id=self.conductor.peer_id,
                 dst_peer_id=self.parent.peer_id,
-                start_num=0, limit=1 << 20))
+                start_num=0, limit=1 << 20,
+                src_slice=self.engine.slice_name))
             try:
                 while True:
                     packet = await stream.read()
@@ -117,7 +119,8 @@ class _Synchronizer:
             return
         dst_addr = packet.dst_addr or f"{self.parent.ip}:{self.parent.download_port}"
         await self.engine.dispatcher.add_parent(self.parent.peer_id, dst_addr,
-                                                is_seed=self.parent.is_seed)
+                                                is_seed=self.parent.is_seed,
+                                                link=self.parent.link)
         for p in packet.piece_infos or []:
             self._seen.add(p.piece_num)
         infos = [p for p in (packet.piece_infos or [])
@@ -135,8 +138,10 @@ class PieceEngine:
                  schedule_timeout_s: float = 30.0,
                  piece_timeout_s: float = 60.0,
                  downloader: PieceDownloader | None = None,
-                 channel_pool: ChannelPool | None = None):
+                 channel_pool: ChannelPool | None = None,
+                 slice_name: str = ""):
         self.parallelism = parallelism
+        self.slice_name = slice_name    # advertised to super-seeding parents
         self.schedule_timeout_s = schedule_timeout_s
         self.piece_timeout_s = piece_timeout_s
         self.downloader = downloader or PieceDownloader(timeout_s=piece_timeout_s)
@@ -299,7 +304,8 @@ class PieceEngine:
                 dl_addr = f"{parent.ip}:{parent.download_port}"
                 await self.dispatcher.add_parent(parent.peer_id, dl_addr,
                                                  resurrect=True,
-                                                 is_seed=parent.is_seed)
+                                                 is_seed=parent.is_seed,
+                                                 link=parent.link)
                 self._current_parents[parent.peer_id] = parent
                 sync = self._synchronizers.get(parent.peer_id)
                 if sync is None or (sync.task is not None and sync.task.done()):
@@ -362,7 +368,8 @@ class PieceEngine:
                 # dispatcher — this is an explicit assignment-backed retry
                 await self.dispatcher.add_parent(
                     peer_id, f"{parent.ip}:{parent.download_port}",
-                    resurrect=True, is_seed=parent.is_seed)
+                    resurrect=True, is_seed=parent.is_seed,
+                    link=parent.link)
                 fresh = _Synchronizer(self, sync.conductor, parent)
                 self._synchronizers[peer_id] = fresh
                 fresh.start()

@@ -66,6 +66,7 @@ class _SuperSeed:
         self.known: set[int] = set()
         self.assigned: dict[int, set[str]] = {}   # piece -> peer ids told
         self.subs: dict[str, asyncio.Queue] = {}  # peer id -> allowed nums
+        self.slices: dict[str, str] = {}          # peer id -> TPU slice
         self._reveal_budget: dict[str, Any] = {}  # peer id -> TokenBucket
         self._rotor: asyncio.Task | None = None
 
@@ -73,12 +74,34 @@ class _SuperSeed:
         return sum(1 for owners in self.assigned.values() if peer_id in owners)
 
     def _offer(self, num: int, target: int | None = None) -> None:
+        """Reveal ``num`` to up to fanout children — ONE PER SLICE first
+        (TPU-native: each slice gets a local first-tier copy whose intra-
+        slice ICI fan-out is ~free; revealing twice into one slice while
+        another has no copy forces cross-DCN pulls for the whole other
+        slice), least-loaded within a slice."""
         owners = self.assigned.setdefault(num, set())
         want = (self.fanout if target is None else target) - len(owners)
-        for peer_id in sorted((s for s in self.subs if s not in owners),
-                              key=self._load)[:max(want, 0)]:
-            owners.add(peer_id)
-            self.subs[peer_id].put_nowait(num)
+        if want <= 0:
+            return
+        covered = {self.slices.get(pid, "") for pid in owners}
+        cands = sorted((s for s in self.subs if s not in owners),
+                       key=self._load)
+        picked: list[str] = []
+        for pid in cands:               # pass 1: uncovered slices
+            if len(picked) >= want:
+                break
+            sl = self.slices.get(pid, "")
+            if sl not in covered:
+                picked.append(pid)
+                covered.add(sl)
+        for pid in cands:               # pass 2: fill remaining fanout
+            if len(picked) >= want:
+                break
+            if pid not in picked:
+                picked.append(pid)
+        for pid in picked:
+            owners.add(pid)
+            self.subs[pid].put_nowait(num)
 
     def on_piece(self, num: int) -> None:
         self.known.add(num)
@@ -110,9 +133,11 @@ class _SuperSeed:
             self.assigned.setdefault(num, set()).add(peer_id)
             q.put_nowait(num)
 
-    def subscribe(self, peer_id: str) -> asyncio.Queue:
+    def subscribe(self, peer_id: str, *, slice_name: str = "") -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue()
         self.subs[peer_id] = q
+        if slice_name:
+            self.slices[peer_id] = slice_name
         for num in self.known:   # fill any under-assigned pieces
             self._offer(num)
         if self._rotor is None:
@@ -127,6 +152,7 @@ class _SuperSeed:
         if q is not None and self.subs.get(peer_id) is not q:
             return
         self.subs.pop(peer_id, None)
+        self.slices.pop(peer_id, None)
         self._reveal_budget.pop(peer_id, None)
         for owners in self.assigned.values():
             owners.discard(peer_id)
@@ -332,7 +358,8 @@ class DaemonService:
     async def _sync_superseed(self, request: PieceTaskRequest, request_iter,
                               conductor, context) -> AsyncIterator:
         policy = self._superseed_for(request.task_id, conductor)
-        sq = policy.subscribe(request.src_peer_id)
+        sq = policy.subscribe(request.src_peer_id,
+                              slice_name=request.src_slice)
 
         async def read_pings() -> None:
             # any follow-up request on the stream = "my workers are idle and

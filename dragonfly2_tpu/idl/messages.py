@@ -364,6 +364,10 @@ class PieceTaskRequest:
     dst_peer_id: str = ""           # owner being asked
     start_num: int = 0
     limit: int = 32
+    src_slice: str = ""             # requester's TPU slice: super-seeds
+                                    # spread reveals one-per-slice so each
+                                    # slice gets a local first-tier copy
+                                    # that ICI then fans out
 
 
 @message

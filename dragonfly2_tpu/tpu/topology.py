@@ -26,8 +26,22 @@ def detect() -> TopologyInfo:
     """
     slice_name = os.environ.get("TPU_SLICE_NAME", "")
     zone = os.environ.get("DF_ZONE", os.environ.get("CLOUD_ZONE", ""))
-    worker = int(os.environ.get("TPU_WORKER_ID", "-1"))
+    try:
+        worker = int(os.environ.get("TPU_WORKER_ID", "-1"))
+    except ValueError:
+        worker = -1
     coords = None
+    # explicit coord injection: multi-process fake-pod harnesses (and
+    # deployments where the runtime doesn't expose coords) set e.g.
+    # DF_ICI_COORDS=0,1,2 — malformed values degrade to None (a typo must
+    # not kill daemon startup), and the injected value takes precedence
+    # over jax detection below
+    coords_env = os.environ.get("DF_ICI_COORDS", "")
+    if coords_env:
+        try:
+            coords = tuple(int(x) for x in coords_env.split(","))
+        except ValueError:
+            coords = None
     num_chips = 0
     try:
         import jax
@@ -36,7 +50,8 @@ def detect() -> TopologyInfo:
         num_chips = len(devices)
         if devices:
             first = devices[0]
-            coords = tuple(getattr(first, "coords", ()) or ()) or None
+            if coords is None:   # explicit injection wins over detection
+                coords = tuple(getattr(first, "coords", ()) or ()) or None
             if not slice_name:
                 slice_name = f"{getattr(first, 'device_kind', 'tpu')}-{jax.device_count()}"
             if worker < 0:

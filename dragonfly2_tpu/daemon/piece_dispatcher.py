@@ -56,12 +56,6 @@ PARENT_FAIL_HARD_LIMIT = 12  # lifetime failures before permanent removal
 EJECT_COOLDOWN_S = 4.0       # local ejection is a cooldown, not a divorce
 _EWMA_ALPHA = 0.3
 BUSY_BACKOFF_S = 0.04        # ~one piece transfer at fan-out rates
-# Seed parents cost-multiplied so mesh peers win whenever they can serve:
-# the seed is the lender of last resort (its egress is the scarce resource
-# a fan-out exists to conserve), not a peer among peers. Demand-side
-# steering — unlike round 3's supply-side announcement starvation, a child
-# with ONLY the seed holding a piece still pulls it immediately.
-SEED_COST_FACTOR = 16.0
 
 
 class ParentState:
@@ -118,24 +112,23 @@ class ParentState:
                 self.consecutive_fails = 0   # fresh chances after cooldown
 
     def score(self) -> float:
-        """Lower is better. Unprobed parents score best so they get traffic;
-        in-flight load scales the expected latency (a parent already serving
-        k pieces will deliver the k+1st ~k times slower), which spreads a
-        fan-out across parents instead of herding onto the single fastest.
-        Seed parents carry SEED_COST_FACTOR so any usable mesh peer
-        outranks them."""
+        """Within-class cost, lower is better. Unprobed parents score best
+        so they get traffic; in-flight load scales the expected latency (a
+        parent already serving k pieces will deliver the k+1st ~k times
+        slower), which spreads a fan-out across parents instead of herding
+        onto the single fastest."""
         if self.ns_per_byte <= 0:
-            # still best-in-class, but spread concurrent dispatches across
-            # multiple unprobed parents instead of herding onto the first;
-            # unprobed PEERS outrank unprobed seeds
-            base = -0.5 if self.is_seed else -1.0
-            return base + self.inflight * 0.01
-        cost = self.ns_per_byte * (1.0 + self.inflight)
-        return cost * SEED_COST_FACTOR if self.is_seed else cost
+            return -1.0 + self.inflight * 0.01
+        return self.ns_per_byte * (1.0 + self.inflight)
 
     def rank(self) -> tuple:
-        """Full ordering for parent choice: seeds last, then link tier,
-        then observed cost (see LINK_TIER rationale)."""
+        """Full ordering for parent choice: seeds STRICTLY last, then link
+        tier, then observed cost (see LINK_TIER rationale). The seed-last
+        partition is absolute by design — the seed is the lender of last
+        resort (its egress is the scarce resource a fan-out exists to
+        conserve), so even a slow mesh peer outranks it; peers that are
+        BROKEN rather than slow leave via the failure/cooldown path, and a
+        busy-or-dead mesh means the seed still serves immediately."""
         return (1 if self.is_seed else 0,
                 LINK_TIER.get(self.link, 1), self.score())
 
@@ -366,9 +359,21 @@ class PieceDispatcher:
         by_end = {p.info.range_start + p.info.range_size: p
                   for p in self._pieces.values() if not p.inflight}
 
+        parent_class = (3 if parent.is_seed
+                        else LINK_TIER.get(parent.link, 1))
+
         def usable(cand) -> bool:
-            return (cand is not None and cand is not ps and not cand.inflight
-                    and parent.peer_id in cand.holders)
+            if (cand is None or cand is ps or cand.inflight
+                    or parent.peer_id not in cand.holders):
+                return False
+            # don't drag a piece onto a WORSE link than its own best free
+            # holder offers — grouping must not bypass the tier preference
+            # (and the pick metric) for its groupmates
+            best = min((3 if h.is_seed else LINK_TIER.get(h.link, 1))
+                       for h in (self.parents[hid] for hid in cand.holders
+                                 if hid in self.parents)
+                       if not h.ejected and not h.is_busy())
+            return parent_class <= best
 
         while len(group) < GROUP_LIMIT:
             last = group[-1].info

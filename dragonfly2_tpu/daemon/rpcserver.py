@@ -42,8 +42,9 @@ class _SuperSeed:
     all: round 3 ran fanout=1 and starved the pipeline (children idled
     waiting for reveals — BENCH_r03 halved); full broadcast resurrects the
     star. Supply-side rationing is only the coarse filter now — the fine
-    control is demand-side: children's dispatchers price seed transfers at
-    SEED_COST_FACTOR (piece_dispatcher.py) and the upload server 503s past
+    control is demand-side: children's dispatchers rank seed parents
+    strictly last (piece_dispatcher.ParentState.rank) and the upload
+    server 503s past
     its per-transfer concurrency, so revealed-but-mesh-available pieces are
     pulled from the mesh anyway. This is the classic BitTorrent
     "super-seeding" idea; the reference has no equivalent — its seeds

@@ -38,7 +38,8 @@ class SchedulerAnnouncer:
         self.refresh_interval_s = refresh_interval_s
         self._tasks: list[asyncio.Task] = []
         self._trainer_channel: Channel | None = None
-        self.model_version = ""        # currently served version
+        self.model_version = ""        # currently served MLP version
+        self.gnn_version = ""          # currently bound topology imputer
         self._last_topo_key = 0        # hash of last uploaded topo snapshot
 
     def start(self) -> None:
@@ -46,7 +47,10 @@ class SchedulerAnnouncer:
         if self.scheduler.cfg.trainer_address and \
                 self.scheduler.service.records is not None:
             self._tasks.append(loop.create_task(self._upload_loop()))
-        if self._evaluator() is not None and self.scheduler.manager is not None:
+        # the refresh loop feeds BOTH the ml evaluator (MLP) and the
+        # topology store's imputer (GNN) — nt schedulers without an
+        # MLEvaluator still want the imputer
+        if self.scheduler.manager is not None:
             self._tasks.append(loop.create_task(self._refresh_loop()))
 
     def _evaluator(self):
@@ -143,9 +147,20 @@ class SchedulerAnnouncer:
             await asyncio.sleep(self.refresh_interval_s)
 
     async def refresh_model_once(self) -> bool:
-        """Pull the latest model; True if a new version was bound."""
+        """Pull the latest models; True if a new MLP version was bound.
+        The topology GNN rides the same refresh: bound into the
+        TopologyStore as an RTT imputer for unprobed pairs so nt/ml
+        scoring stops treating them as unknowable."""
+        if self.scheduler.manager is None:
+            return False
+        try:
+            # best-effort and independent: a bad GNN artifact must not
+            # starve MLP refresh for every future cycle
+            await self._refresh_gnn_once()
+        except Exception as exc:  # noqa: BLE001
+            log.warning("topology gnn refresh failed: %s", exc)
         evaluator = self._evaluator()
-        if evaluator is None or self.scheduler.manager is None:
+        if evaluator is None:
             return False
         resp = await self.scheduler.manager.get_model(GetModelRequest(
             name=MLP_MODEL_NAME,
@@ -162,6 +177,26 @@ class SchedulerAnnouncer:
         log.info("ml evaluator now serving %s@%s (final_loss=%s)",
                  model.name, model.version,
                  (model.metrics or {}).get("final_loss"))
+        return True
+
+    async def _refresh_gnn_once(self) -> bool:
+        topo = getattr(self.scheduler, "topo", None)
+        if topo is None:
+            return False
+        from ..trainer.features import GNN_MODEL_NAME
+        resp = await self.scheduler.manager.get_model(GetModelRequest(
+            name=GNN_MODEL_NAME,
+            scheduler_cluster_id=self.scheduler.cfg.cluster_id,
+            if_none_match=self.gnn_version))
+        model = resp.model
+        if model is None or model.version == self.gnn_version \
+                or not model.data:
+            return False
+        from ..trainer.serving import make_gnn_impute
+        topo.bind_imputer(make_gnn_impute(model.data))
+        self.gnn_version = model.version
+        log.info("topology store now imputing with %s@%s",
+                 model.name, model.version)
         return True
 
     async def stop(self) -> None:

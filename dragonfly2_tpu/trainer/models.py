@@ -65,7 +65,12 @@ def init_gnn(key, *, node_dim: int = GNN_NODE_FEATURES,
             _dense_init(keys[1 + 2 * i], 2 * hidden + edge_dim, hidden))
         params["upd"].append(
             _dense_init(keys[2 + 2 * i], 2 * hidden, hidden))
-    params["head"] = _dense_init(keys[-1], 2 * hidden + edge_dim, 1)
+    # head reads NODE EMBEDDINGS only: feeding edge_feat (which contains
+    # the observed log-RTT the label is computed from) lets training learn
+    # the trivial copy-the-answer shortcut — the model must predict a
+    # link's quality from where its endpoints sit in the graph, which is
+    # the only information available for an UNPROBED pair at impute time
+    params["head"] = _dense_init(keys[-1], 2 * hidden, 1)
     return params
 
 
@@ -96,7 +101,13 @@ def gnn_forward(params: Params, nodes: jnp.ndarray, edge_src: jnp.ndarray,
 
     nodes:      [N, node_dim]   edge_src/dst: [E] int32 (padded)
     edge_feat:  [E, edge_dim]   edge_mask:    [E] {0,1}
-    returns     [E] predicted link bandwidth score (masked edges -> 0)
+    returns     [E] predicted link bandwidth score for EVERY edge index
+    (the caller masks; query edges ride with mask=0 so they never inject
+    fabricated messages into aggregation yet still get head scores)
+
+    Observed edges' features (incl. their measured log-RTT) inform the
+    MESSAGES — a node's links say where it sits — but the head scores a
+    pair from the two node embeddings alone (no label leak; see init_gnn).
 
     Static [N, E] shapes: the scheduler pads its host graph to the next
     bucket so recompilation only happens on bucket growth.
@@ -113,9 +124,8 @@ def gnn_forward(params: Params, nodes: jnp.ndarray, edge_src: jnp.ndarray,
         deg = jax.ops.segment_sum(mask, edge_dst, num_segments=n)
         agg = agg / jnp.maximum(deg, 1.0)
         h = jax.nn.gelu(_dense(upd_p, jnp.concatenate([h, agg], axis=-1)))
-    score = _dense(params["head"], jnp.concatenate(
-        [h[edge_src], h[edge_dst], edge_feat], axis=-1))[..., 0]
-    return score * edge_mask.astype(jnp.float32)
+    return _dense(params["head"], jnp.concatenate(
+        [h[edge_src], h[edge_dst]], axis=-1))[..., 0]
 
 
 # ------------------------------------------------------------------ training

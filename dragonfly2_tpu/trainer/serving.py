@@ -61,3 +61,93 @@ def make_mlp_infer(model_bytes: bytes) -> Infer:
     infer.version = version          # type: ignore[attr-defined]
     infer.meta = meta                # type: ignore[attr-defined]
     return infer
+
+
+# ------------------------------------------------------------------ GNN
+
+def gnn_forward_np(params: dict, graph: dict) -> np.ndarray:
+    """Numpy port of ``models.gnn_forward`` (same rationale as the MLP:
+    the scheduler imputes in-process, no RPC and no jax on the hot path)."""
+    nodes = graph["nodes"].astype(np.float32)
+    edge_src = graph["edge_src"]
+    edge_dst = graph["edge_dst"]
+    edge_feat = graph["edge_feat"].astype(np.float32)
+    mask = graph["edge_mask"].astype(np.float32)[:, None]
+    n = nodes.shape[0]
+
+    def dense(p, x):
+        return x @ p["w"] + p["b"]
+
+    h = _gelu(dense(params["encode"], nodes))
+    for msg_p, upd_p in zip(params["msg"], params["upd"]):
+        src_h = h[edge_src]
+        dst_h = h[edge_dst]
+        m = _gelu(dense(msg_p, np.concatenate(
+            [src_h, dst_h, edge_feat], axis=-1))) * mask
+        agg = np.zeros((n, m.shape[-1]), np.float32)
+        np.add.at(agg, edge_dst, m)
+        deg = np.zeros((n, 1), np.float32)
+        np.add.at(deg, edge_dst, mask)
+        agg = agg / np.maximum(deg, 1.0)
+        h = _gelu(dense(upd_p, np.concatenate([h, agg], axis=-1)))
+    # head scores every edge index from node embeddings only (query edges
+    # ride with mask=0: excluded from aggregation, still scored)
+    return dense(params["head"], np.concatenate(
+        [h[edge_src], h[edge_dst]], axis=-1))[..., 0]
+
+
+def make_gnn_impute(model_bytes: bytes):
+    """Deserialize a ``topology_gnn`` blob into
+    ``impute(topo_rows, pairs) -> {(src, dst): rtt_us}``.
+
+    Query links are appended to the observed graph with ``edge_mask=0``:
+    they contribute NOTHING to message passing (a fabricated edge must not
+    perturb the embeddings that score it), but the head — which reads only
+    the two node embeddings — still scores them; the score is inverted
+    back to an RTT estimate (``features.topology_to_graph`` label
+    transform; reference intent:
+    ``scheduler/networktopology/network_topology.go:334`` Neighbours).
+    """
+    import math
+
+    params, meta = params_io.deserialize_params(model_bytes)
+    version = meta.get("version", params_io.version_of(model_bytes))
+
+    def impute(topo_rows: list[dict],
+               pairs: list[tuple[str, str]]) -> dict[tuple[str, str], float]:
+        if not topo_rows or not pairs:
+            return {}
+        graph = features.topology_to_graph(topo_rows)
+        if graph is None:
+            return {}
+        index = {hid: i for i, hid in enumerate(graph["host_ids"].tolist())}
+        known = [(s, d) for s, d in pairs if s in index and d in index]
+        if not known:
+            return {}
+        # append query edges (numpy arrays, not jax: shape changes free)
+        q = len(known)
+        graph = {k: (v.copy() if isinstance(v, np.ndarray) else v)
+                 for k, v in graph.items()}
+        graph["edge_src"] = np.concatenate(
+            [graph["edge_src"],
+             np.asarray([index[s] for s, _ in known], np.int32)])
+        graph["edge_dst"] = np.concatenate(
+            [graph["edge_dst"],
+             np.asarray([index[d] for _, d in known], np.int32)])
+        graph["edge_feat"] = np.concatenate(
+            [graph["edge_feat"], np.zeros((q, graph["edge_feat"].shape[1]),
+                                          np.float32)])
+        graph["edge_mask"] = np.concatenate(
+            [graph["edge_mask"], np.zeros((q,), np.float32)])
+        scores = gnn_forward_np(params, graph)[-q:]
+        out: dict[tuple[str, str], float] = {}
+        for (s, d), y in zip(known, scores):
+            y = float(np.clip(y, 1e-3, 1.0))
+            # invert the label transform: y = 1/(1+max(0, log10(rtt)-1))
+            log_rtt = 1.0 + (1.0 / y - 1.0)
+            out[(s, d)] = float(math.pow(10.0, min(log_rtt, 7.0)))
+        return out
+
+    impute.version = version         # type: ignore[attr-defined]
+    impute.meta = meta               # type: ignore[attr-defined]
+    return impute

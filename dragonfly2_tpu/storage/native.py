@@ -59,6 +59,23 @@ def _bind(lib) -> None:
     # uint32 df_crc32c(const uint8_t* data, size_t n, uint32 seed) — chainable
     lib.df_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
     lib.df_crc32c.restype = ctypes.c_uint32
+    # Newer exports bind OPTIONALLY: a stale .so built before they existed
+    # must keep its working hash path (losing ALL native acceleration to an
+    # AttributeError here would silently drop crc32c to the pure-Python
+    # fallback fleet-wide).
+    try:
+        # int df_piece_write(path, offset, data, n, uint32* crc_out)
+        lib.df_piece_write.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_char_p, ctypes.c_size_t,
+                                       ctypes.POINTER(ctypes.c_uint32)]
+        lib.df_piece_write.restype = ctypes.c_int
+        # int64 df_piece_read(path, offset, uint8* out, n)
+        lib.df_piece_read.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_char_p, ctypes.c_size_t]
+        lib.df_piece_read.restype = ctypes.c_int64
+        lib._df_has_piece_io = True
+    except AttributeError:
+        lib._df_has_piece_io = False
 
 
 def available() -> bool:
@@ -87,3 +104,40 @@ def hash_bytes(algo: str, data: bytes | memoryview) -> str | None:
     if rc != 0:
         return None
     return out.value.decode()
+
+
+def piece_write(path: str, offset: int, data: bytes | memoryview
+                ) -> str | None:
+    """Fused write+hash: pwrite ``data`` at ``offset`` while computing its
+    crc32c in the same pass (one memory traversal instead of Python's
+    hash-then-write two). Returns the crc32c hex, or None to signal
+    fallback to the pure-Python path. Raises OSError on IO failure."""
+    lib = load()
+    if lib is None or not getattr(lib, "_df_has_piece_io", False):
+        return None
+    if isinstance(data, memoryview):
+        data = bytes(data)
+    crc = ctypes.c_uint32(0)
+    rc = lib.df_piece_write(path.encode(), offset, data, len(data),
+                            ctypes.byref(crc))
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+    return f"{crc.value:08x}"
+
+
+def piece_read(path: str, offset: int, length: int) -> bytes | None:
+    """pread a piece straight into a fresh buffer via the native lib, or
+    None to signal fallback. Raises OSError on IO failure; short reads
+    past EOF return the available bytes."""
+    lib = load()
+    if lib is None or not getattr(lib, "_df_has_piece_io", False):
+        return None
+    # one allocation, no zero-fill pass, no .raw copy: pread fills the
+    # bytearray in place and full reads (the normal case) return it as-is
+    buf = bytearray(length)
+    got = lib.df_piece_read(path.encode(), offset,
+                            (ctypes.c_char * length).from_buffer(buf),
+                            length)
+    if got < 0:
+        raise OSError(-got, os.strerror(-got), path)
+    return bytes(buf) if got == length else bytes(buf[:got])

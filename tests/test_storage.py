@@ -160,3 +160,49 @@ class TestNative:
         data = os.urandom(64 * 1024 + 17)
         assert native.hash_bytes("sha256", data) == hashlib.sha256(data).hexdigest()
         assert native.hash_bytes("md5", data) == hashlib.md5(data).hexdigest()
+
+
+class TestNativePieceIO:
+    """native/dfnative.cc piece IO (VERDICT carried item: the bindings'
+    'aligned file piece IO' claim must match the exports)."""
+
+    def test_piece_write_read_roundtrip(self, tmp_path):
+        from dragonfly2_tpu.storage import native
+        if not native.available():
+            pytest.skip("native lib not built")
+        path = str(tmp_path / "f.bin")
+        open(path, "wb").write(b"\0" * 256)
+        data = os.urandom(100)
+        crc = native.piece_write(path, 50, data)
+        assert crc is not None and len(crc) == 8
+        # fused crc matches the standalone hash
+        from dragonfly2_tpu.common import digest as digestlib
+        assert digestlib.hash_bytes("crc32c", data) == crc
+        assert native.piece_read(path, 50, 100) == data
+        # short read past EOF returns what exists
+        assert len(native.piece_read(path, 200, 100)) == 56
+
+    def test_piece_write_missing_file_raises(self, tmp_path):
+        from dragonfly2_tpu.storage import native
+        if not native.available():
+            pytest.skip("native lib not built")
+        with pytest.raises(OSError):
+            native.piece_write(str(tmp_path / "nope.bin"), 0, b"x")
+
+    def test_store_fused_path_detects_corruption(self, tmp_path):
+        """A wrong crc32c digest is caught by the fused write pass and the
+        piece is NOT recorded (the region stays absent)."""
+        from dragonfly2_tpu.storage import native
+        if not native.available():
+            pytest.skip("native lib not built")
+        from dragonfly2_tpu.common.errors import DFError
+        from dragonfly2_tpu.storage.metadata import TaskMetadata
+        from dragonfly2_tpu.storage.store import TaskStorage
+        md = TaskMetadata(task_id="t" * 64, url="u", content_length=200,
+                          total_piece_count=2, piece_size=100)
+        ts = TaskStorage(str(tmp_path), md)
+        with pytest.raises(DFError):
+            ts.write_piece(0, 0, b"a" * 100,
+                           piece_digest="crc32c:00000000")
+        assert 0 not in ts.md.pieces
+        assert not ts.has_range(0, 100)

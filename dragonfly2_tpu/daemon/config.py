@@ -79,8 +79,14 @@ class ProxyConfig:
 class ObjectStorageConfig:
     enabled: bool = False
     port: int = 0
-    # bucket name -> source-client base URL (file:///path, http(s)://, gs://)
+    # bucket name -> source-client base URL (file:///path, http(s)://,
+    # gs://, s3://) — the P2P-accelerated READ path
     buckets: dict[str, str] = field(default_factory=dict)
+    # bucket name -> backend client config for the WRITE path
+    # ({kind: file|s3, base, bucket, access_key, secret_key, region};
+    # reference pkg/objectstorage backends). file:// read buckets get an
+    # implicit file backend.
+    backends: dict[str, dict] = field(default_factory=dict)
 
 
 @dataclass

@@ -39,6 +39,17 @@ class ObjectGateway:
         self.cfg = cfg
         self.port = cfg.port
         self._runner: web.AppRunner | None = None
+        # write-path backends (reference pkg/objectstorage clients);
+        # file:// read buckets get an implicit file backend
+        from ..common.objectstorage import BackendConfig, make_backend
+        self._backends = {}
+        for bucket, bcfg in (cfg.backends or {}).items():
+            self._backends[bucket] = make_backend(BackendConfig(**bcfg))
+        for bucket, base in cfg.buckets.items():
+            if bucket not in self._backends and base.startswith("file://"):
+                self._backends[bucket] = make_backend(BackendConfig(
+                    kind="file", base=base[len("file://"):]))
+        self._writebacks: set[asyncio.Task] = set()
 
     def _object_url(self, bucket: str, key: str) -> str:
         base = self.cfg.buckets.get(bucket)
@@ -155,6 +166,17 @@ class ObjectGateway:
         return resp
 
     async def _put_object(self, request: web.Request) -> web.Response:
+        """PUT with write-back replication (reference
+        ``objectstorage.go:369`` modes):
+
+        - ``write_back`` (default): spool, write to the BACKEND, then
+          import into the local piece cache — 201 only after the backend
+          durably has the object;
+        - ``async_write_back``: 202 as soon as the local import lands, the
+          backend upload continues in the background (latency over
+          durability; a failed background upload is logged + counted as
+          put/writeback_err).
+        """
         bucket = request.match_info["bucket"]
         key = request.match_info["key"]
         try:
@@ -162,42 +184,132 @@ class ObjectGateway:
         except DFError as exc:
             _obj_reqs.labels("put", "404").inc()
             return web.json_response({"error": exc.message}, status=404)
-        if not url.startswith("file://"):
+        backend = self._backends.get(bucket)
+        if backend is None:
             _obj_reqs.labels("put", "501").inc()
             return web.json_response(
-                {"error": "PUT supported only for file:// backends"},
+                {"error": f"bucket {bucket!r} has no write backend"},
                 status=501)
-        dest = url[len("file://"):]
-        os.makedirs(os.path.dirname(dest) or "/", exist_ok=True)
-        tmp_fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(dest))
+        mode = (request.headers.get("X-Dragonfly-Write-Back-Mode")
+                or request.query.get("mode") or "write_back")
+        if mode not in ("write_back", "async_write_back"):
+            _obj_reqs.labels("put", "400").inc()
+            return web.json_response({"error": f"unknown mode {mode!r}"},
+                                     status=400)
+        # spool the body once; both the local import and the backend
+        # upload read from the spool
+        tmp_fd, tmp_path = tempfile.mkstemp(prefix="df-objput-")
         try:
             with os.fdopen(tmp_fd, "wb") as f:
                 async for chunk in request.content.iter_chunked(1 << 20):
-                    f.write(chunk)
-            os.replace(tmp_path, dest)
-        except Exception:
-            with open(tmp_path, "ab"):
-                pass
-            os.unlink(tmp_path)
-            raise
-        # import into the local cache so peers can fetch it immediately
-        # without a second backend read (reference's WriteBack mode)
-        try:
-            await self.daemon.ptm.import_file(dest, url,
-                                              UrlMeta(tag="objstore"),
-                                              task_type=TaskType.STANDARD)
+                    await asyncio.to_thread(f.write, chunk)
+
+            async def import_local() -> None:
+                # a re-PUT of an existing key must replace the cached task,
+                # or the mesh serves the OLD bytes while the backend holds
+                # the new ones (import_file no-ops on existing task ids)
+                task_id = self.daemon.ptm._task_id(url,
+                                                   UrlMeta(tag="objstore"))
+                try:
+                    await self.daemon.ptm.delete_task(task_id)
+                except DFError:
+                    pass
+                await self.daemon.ptm.import_file(
+                    tmp_path, url, UrlMeta(tag="objstore"),
+                    task_type=TaskType.STANDARD)
+
+            async def write_back() -> None:
+                size = os.path.getsize(tmp_path)
+
+                async def chunks():
+                    with open(tmp_path, "rb") as f:
+                        while True:
+                            # off-loop reads: a multi-GB upload must not
+                            # stall the daemon's sockets per block
+                            block = await asyncio.to_thread(f.read, 1 << 20)
+                            if not block:
+                                return
+                            yield block
+
+                backend_bucket = getattr(backend, "bucket", "") or bucket
+                await backend.put_object(backend_bucket, key, chunks(),
+                                         content_length=size)
+
+            if mode == "write_back":
+                # backend FIRST: 201 promises the origin has the object,
+                # and a failed backend write must not leave the mesh
+                # serving bytes the origin never accepted
+                await write_back()
+                try:
+                    await import_local()
+                except DFError as exc:
+                    log.warning("PUT import of %s failed: %s", key,
+                                exc.message)
+                try:
+                    os.unlink(tmp_path)
+                except OSError:
+                    pass
+            else:
+                # async mode explicitly trades durability for latency: the
+                # local import serves immediately, the backend converges
+                try:
+                    await import_local()
+                except DFError as exc:
+                    log.warning("PUT import of %s failed: %s", key,
+                                exc.message)
+
+                async def write_back_bg() -> None:
+                    try:
+                        await write_back()
+                    except Exception as exc:  # noqa: BLE001
+                        _obj_reqs.labels("put", "writeback_err").inc()
+                        log.error("async write-back of %s/%s FAILED — the "
+                                  "object exists only in the volatile "
+                                  "cache: %s", bucket, key, exc)
+                    finally:
+                        try:
+                            os.unlink(tmp_path)
+                        except OSError:
+                            pass
+
+                task = asyncio.get_running_loop().create_task(write_back_bg())
+                self._writebacks.add(task)
+                task.add_done_callback(self._writebacks.discard)
         except DFError as exc:
-            log.warning("post-PUT import of %s failed: %s", key, exc.message)
+            _obj_reqs.labels("put", "err").inc()
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+            return web.json_response({"error": exc.message}, status=502)
+        except BaseException:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+            raise
         _obj_reqs.labels("put", "ok").inc()
-        return web.Response(status=201)
+        return web.Response(status=201 if mode == "write_back" else 202)
 
     async def _delete_object(self, request: web.Request) -> web.Response:
+        bucket = request.match_info["bucket"]
+        key = request.match_info["key"]
         try:
-            url = self._object_url(request.match_info["bucket"],
-                                   request.match_info["key"])
+            url = self._object_url(bucket, key)
         except DFError as exc:
             return web.json_response({"error": exc.message}, status=404)
-        if url.startswith("file://"):
+        # delete from the WRITE BACKEND first — dropping only the cache
+        # would let the next read-through GET resurrect the object from
+        # the origin and report the delete a success anyway
+        backend = self._backends.get(bucket)
+        if backend is not None:
+            try:
+                await backend.delete_object(
+                    getattr(backend, "bucket", "") or bucket, key)
+            except DFError as exc:
+                _obj_reqs.labels("delete", "err").inc()
+                return web.json_response({"error": exc.message}, status=502)
+        elif url.startswith("file://"):
             try:
                 await asyncio.to_thread(os.unlink, url[len("file://"):])
             except FileNotFoundError:

@@ -65,7 +65,10 @@ def sign_v4(creds: S3Credentials, method: str, url: str,
     now = now or datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     date = now.strftime("%Y%m%d")
-    out = dict(headers)
+    # lower-case ALL keys first: a caller-supplied "Host"/"Range" colliding
+    # case-insensitively with the injected names would otherwise appear
+    # twice in SignedHeaders — guaranteed SignatureDoesNotMatch
+    out = {k.lower(): v for k, v in headers.items()}
     out["host"] = parts.netloc
     out["x-amz-date"] = amz_date
     out["x-amz-content-sha256"] = payload_hash
@@ -81,9 +84,9 @@ def sign_v4(creds: S3Credentials, method: str, url: str,
     canonical_query = "&".join(
         f"{urllib.parse.quote(k, safe='-_.~')}="
         f"{urllib.parse.quote(v, safe='-_.~')}" for k, v in query_pairs)
-    signed_names = sorted(k.lower() for k in out)
+    signed_names = sorted(out)
     canonical_headers = "".join(
-        f"{k}:{out[_orig(out, k)].strip()}\n" for k in signed_names)
+        f"{k}:{out[k].strip()}\n" for k in signed_names)
     signed_headers = ";".join(signed_names)
     canonical_request = "\n".join([
         method.upper(), canonical_uri, canonical_query, canonical_headers,
@@ -102,13 +105,6 @@ def sign_v4(creds: S3Credentials, method: str, url: str,
         f"AWS4-HMAC-SHA256 Credential={creds.access_key}/{scope}, "
         f"SignedHeaders={signed_headers}, Signature={signature}")
     return out
-
-
-def _orig(headers: dict[str, str], lower: str) -> str:
-    for k in headers:
-        if k.lower() == lower:
-            return k
-    return lower
 
 
 # ------------------------------------------------------------------ clients

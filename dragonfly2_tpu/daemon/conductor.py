@@ -100,6 +100,12 @@ class PeerTaskConductor:
         self.rate_limiter = shaper.register(self.task_id)
 
     async def _run(self) -> None:
+        from ..common import tracing
+        with tracing.span("peertask", task_id=self.task_id[:16],
+                          peer_id=self.peer_id[-16:], url=self.url) as sp:
+            await self._run_traced(sp)
+
+    async def _run_traced(self, sp) -> None:
         try:
             used_p2p = False
             if self.scheduler is not None:
@@ -121,6 +127,9 @@ class PeerTaskConductor:
             self.log.exception("task failed")
             await self._finish_fail(Code.UNKNOWN, str(exc))
         finally:
+            sp.set(state=self.state, pieces=len(self.ready),
+                   traffic_p2p=self.traffic_p2p,
+                   traffic_source=self.traffic_source)
             # closed only after finalize so the PeerResult carries the real
             # outcome — a half-pulled peer must never be advertised complete
             if self._session is not None:

@@ -20,6 +20,14 @@ class SchedulerConfig:
 
 
 @dataclass
+class TracingConfig:
+    enabled: bool = False
+    jsonl_path: str = ""              # "" -> <workdir>/logs/traces.jsonl
+    otlp_endpoint: str = ""           # e.g. http://collector:4318
+    sample_ratio: float = 1.0
+
+
+@dataclass
 class DownloadConfig:
     piece_parallelism: int = 4             # piece download workers per task
     back_source_parallelism: int = 4       # concurrent origin range streams
@@ -40,6 +48,7 @@ class UploadConfig:
     port: int = 0                          # 0 = ephemeral
     rate_limit_bps: int = 0
     concurrent_limit: int = 0              # 0 = scheduler's per-type default
+    debug_endpoints: bool = False          # /debug/{stacks,profile} (pprof)
 
 
 @dataclass
@@ -103,6 +112,7 @@ class DaemonConfig:
     download: DownloadConfig = field(default_factory=DownloadConfig)
     upload: UploadConfig = field(default_factory=UploadConfig)
     storage: StorageSection = field(default_factory=StorageSection)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
     proxy: ProxyConfig = field(default_factory=ProxyConfig)
     object_storage: ObjectStorageConfig = field(default_factory=ObjectStorageConfig)
     announce_interval_s: float = 30.0

@@ -68,6 +68,7 @@ class Daemon:
         self.upload_server = UploadServer(
             self.storage_mgr, port=cfg.upload.port,
             rate_limit_bps=cfg.upload.rate_limit_bps,
+            debug_endpoints=cfg.upload.debug_endpoints,
             concurrent_limit=cfg.upload.concurrent_limit,
             host=cfg.listen_ip)
         self._scheduler_factory = scheduler_factory
@@ -114,6 +115,14 @@ class Daemon:
         return factory
 
     async def start(self) -> None:
+        if self.cfg.tracing.enabled:
+            from ..common import tracing
+            tracing.configure(
+                service=f"dfdaemon/{self.hostname}",
+                jsonl_path=self.cfg.tracing.jsonl_path or os.path.join(
+                    self.paths.log_dir, "traces.jsonl"),
+                otlp_endpoint=self.cfg.tracing.otlp_endpoint,
+                sample_ratio=self.cfg.tracing.sample_ratio)
         if self.cfg.download.source_ca or self.cfg.download.source_insecure:
             # the source client is a process singleton: remember the prior
             # trust setting so stop() restores it (co-resident daemons in
@@ -230,6 +239,9 @@ class Daemon:
             log.warning("manager attach failed (%s); back-source only", exc)
 
     async def stop(self) -> None:
+        if self.cfg.tracing.enabled:
+            from ..common import tracing
+            tracing.TRACER.flush()
         if hasattr(self, "_prev_source_tls"):
             from ..source.client import client_for
             client_for("https://")._ssl = self._prev_source_tls

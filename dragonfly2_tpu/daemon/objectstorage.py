@@ -50,11 +50,33 @@ class ObjectGateway:
                 self._backends[bucket] = make_backend(BackendConfig(
                     kind="file", base=base[len("file://"):]))
         self._writebacks: set[asyncio.Task] = set()
+        # reads of s3-backed buckets must use the backend's credentials
+        # (the s3 source client is a process singleton; one credential set
+        # per process — matching the env-var model it replaces)
+        for bcfg in (cfg.backends or {}).values():
+            if bcfg.get("kind") == "s3" and bcfg.get("access_key"):
+                from ..common.objectstorage import S3Credentials
+                from ..source.client import client_for
+                client_for("s3://x/x").set_credentials(S3Credentials(
+                    bcfg["access_key"], bcfg["secret_key"],
+                    bcfg.get("region", "us-east-1")))
+                break
 
     def _object_url(self, bucket: str, key: str) -> str:
         base = self.cfg.buckets.get(bucket)
         if base is None:
             raise DFError(Code.NOT_FOUND, f"bucket {bucket!r} not configured")
+        bcfg = (self.cfg.backends or {}).get(bucket)
+        if base.startswith("s3://") and bcfg and bcfg.get("kind") == "s3":
+            # tie the READ path to the configured backend endpoint/bucket:
+            # resolving s3:// from process env while writes go to the
+            # configured endpoint would 404 after a cache loss (divergent
+            # worlds). s3+http(s):// carries the endpoint in the URL.
+            endpoint = bcfg["base"].rstrip("/")
+            scheme = "s3+https" if endpoint.startswith("https") else "s3+http"
+            host = endpoint.split("://", 1)[1]
+            backend_bucket = bcfg.get("bucket") or bucket
+            base = f"{scheme}://{host}/{backend_bucket}"
         # aiohttp percent-decodes match_info, so a key may arrive as a
         # literal '../..' regardless of how it was escaped on the wire;
         # reject dot segments outright, and for file:// backends verify the
@@ -94,6 +116,20 @@ class ObjectGateway:
     async def stop(self) -> None:
         if self._runner:
             await self._runner.cleanup()
+        # drain in-flight async write-backs: a 202 promised eventual
+        # backend durability — cancelling them on shutdown silently loses
+        # the only durable copy
+        if self._writebacks:
+            log.info("draining %d async write-backs", len(self._writebacks))
+            done, pending = await asyncio.wait(self._writebacks, timeout=30)
+            for t in pending:
+                t.cancel()
+                log.error("async write-back cancelled at shutdown — object "
+                          "may exist only in the cache")
+        for backend in self._backends.values():
+            close = getattr(backend, "close", None)
+            if close is not None:
+                await close()
 
     # ------------------------------------------------------------------
 

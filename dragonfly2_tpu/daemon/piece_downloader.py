@@ -18,6 +18,7 @@ import time
 import aiohttp
 
 from ..common import digest as digestlib
+from ..common import tracing
 from ..common.errors import Code, DFError
 from ..idl.messages import PieceInfo
 
@@ -52,6 +53,9 @@ class PieceDownloader:
         url = f"http://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start, size = piece.range_start, piece.range_size
         headers = {"Range": f"bytes={start}-{start + size - 1}"}
+        tp = tracing.traceparent()
+        if tp:   # trace ctx rides the piece request (ref piece_downloader.go:227)
+            headers["traceparent"] = tp
         t0 = time.monotonic()
         try:
             async with self._get_session().get(
@@ -109,6 +113,9 @@ class PieceDownloader:
         start = pieces[0].range_start
         size = sum(p.range_size for p in pieces)
         headers = {"Range": f"bytes={start}-{start + size - 1}"}
+        tp = tracing.traceparent()
+        if tp:
+            headers["traceparent"] = tp
         t0 = time.monotonic()
         try:
             async with self._get_session().get(

@@ -378,10 +378,17 @@ class PieceEngine:
         if conductor.rate_limiter is not None:
             await conductor.rate_limiter.acquire(d.size())
         t0 = int(time.time() * 1000)
+        from ..common import tracing
         try:
-            landed, cost = await self.downloader.download_span(
-                dst_addr=d.parent.addr, task_id=conductor.task_id,
-                src_peer_id=conductor.peer_id, pieces=d.pieces)
+            with tracing.span("piece.download",
+                              piece=d.piece.piece_num,
+                              n_pieces=len(d.pieces),
+                              parent=None,   # inherit the task span
+                              ) as psp:
+                psp.set(dst=d.parent.peer_id[-16:], link=int(d.parent.link))
+                landed, cost = await self.downloader.download_span(
+                    dst_addr=d.parent.addr, task_id=conductor.task_id,
+                    src_peer_id=conductor.peer_id, pieces=d.pieces)
         except DFError as exc:
             if exc.code == Code.CLIENT_PEER_BUSY:
                 # backpressure, not failure: requeue; no scheduler report

@@ -12,6 +12,7 @@ import logging
 
 from aiohttp import web
 
+from ..common import tracing
 from ..common.aiohttp_util import resolve_port
 from ..common.errors import DFError
 from ..common.metrics import REGISTRY
@@ -104,12 +105,13 @@ class UploadServer:
 
     def __init__(self, storage_mgr: StorageManager, *, port: int = 0,
                  rate_limit_bps: int = 0, concurrent_limit: int = 0,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", debug_endpoints: bool = False):
         self.storage_mgr = storage_mgr
         self.host = host
         self.port = port
         self.limiter = TokenBucket(rate_limit_bps or 0)
         self.concurrent_limit = concurrent_limit or self.DEFAULT_CONCURRENT_LIMIT
+        self.debug_endpoints = debug_endpoints
         self._active = 0
         self._runner: web.AppRunner | None = None
 
@@ -121,9 +123,16 @@ class UploadServer:
             return web.Response(text=REGISTRY.expose())
 
         app = web.Application()
-        app.router.add_get("/download/{prefix}/{task_id}", self._handle)
+        app.router.add_get("/download/{prefix}/{task_id}", self._traced)
         app.router.add_get("/healthy", healthy)
         app.router.add_get("/metrics", metrics)
+        if self.debug_endpoints:
+            # pprof-equivalent debug surface (reference cmd/dependency
+            # InitMonitor --pprof-port) — OFF by default: profiling slows
+            # every Python call on the loop thread, and this port is
+            # reachable by any mesh peer
+            app.router.add_get("/debug/stacks", _debug_stacks)
+            app.router.add_get("/debug/profile", _debug_profile)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -134,6 +143,21 @@ class UploadServer:
     async def stop(self) -> None:
         if self._runner:
             await self._runner.cleanup()
+
+    async def _traced(self, request: web.Request) -> web.StreamResponse:
+        """Server half of the piece-request trace: the child's traceparent
+        rides the GET (piece_downloader) and this span joins its trace, so
+        one trace id follows a slow transfer across both daemons."""
+        parent = tracing.from_traceparent(
+            request.headers.get("traceparent", ""))
+        if parent is None and not tracing.TRACER.enabled:
+            return await self._handle(request)
+        with tracing.span("upload.serve", parent=parent,
+                          peer=request.query.get("peerId", "")[-16:],
+                          range=request.headers.get("Range", "")) as sp:
+            resp = await self._handle(request)
+            sp.set(status=resp.status)
+            return resp
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         task_id = request.match_info["task_id"]
@@ -191,3 +215,59 @@ class UploadServer:
             # response's own release only runs once it is being sent)
             slot.release()
             raise
+
+
+async def _debug_stacks(_r: web.Request) -> web.Response:
+    """Every thread's stack + every asyncio task (the first question in any
+    hang investigation; reference serves net/pprof goroutine dumps)."""
+    import faulthandler
+    import io
+    import traceback
+
+    buf = io.StringIO()
+    import sys
+    frames = sys._current_frames()
+    import threading as _threading
+    names = {t.ident: t.name for t in _threading.enumerate()}
+    for tid, frame in frames.items():
+        buf.write(f"--- thread {names.get(tid, tid)} ---\n")
+        traceback.print_stack(frame, file=buf)
+    buf.write("--- asyncio tasks ---\n")
+    for task in asyncio.all_tasks():
+        buf.write(f"{task.get_name()}: {task.get_coro()}\n")
+        for entry in task.get_stack(limit=4):
+            buf.write(f"  {entry.f_code.co_filename}:{entry.f_lineno} "
+                      f"{entry.f_code.co_name}\n")
+    assert faulthandler  # imported for parity with CLI use
+    return web.Response(text=buf.getvalue())
+
+
+_profile_lock = asyncio.Lock()
+
+
+async def _debug_profile(request: web.Request) -> web.Response:
+    """cProfile the event-loop thread for ?seconds=N (default 5, max 60) —
+    the pprof 'profile' endpoint analog. Serialized: two concurrent
+    profilers on one thread corrupt each other."""
+    import cProfile
+    import io
+    import pstats
+
+    try:
+        seconds = min(max(float(request.query.get("seconds", "5")), 0.0),
+                      60.0)
+    except ValueError:
+        return web.Response(status=400, text="seconds must be a number")
+    if _profile_lock.locked():
+        return web.Response(status=409, text="a profile is already running")
+    async with _profile_lock:
+        prof = cProfile.Profile()
+        try:
+            prof.enable()
+            await asyncio.sleep(seconds)
+        finally:
+            prof.disable()
+        out = io.StringIO()
+        pstats.Stats(prof, stream=out).sort_stats(
+            "cumulative").print_stats(60)
+        return web.Response(text=out.getvalue())

@@ -67,6 +67,8 @@ class SchedulerConfig:
     trainer_address: str = ""
     keepalive_interval_s: float = 30.0
     records_dir: str = ""                  # download-record JSONL ("" = memory-only)
+    tracing_jsonl: str = ""                # span export path ("" = disabled)
+    tracing_otlp: str = ""                 # OTLP/HTTP collector endpoint
     train_upload_interval_s: float = 60.0  # records -> trainer cadence
     model_refresh_interval_s: float = 60.0  # manager -> ml evaluator cadence
     workdir: str = ""

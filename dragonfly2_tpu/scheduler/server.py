@@ -52,6 +52,11 @@ class Scheduler:
         return f"{self.cfg.advertise_ip}:{self.port}"
 
     async def start(self) -> None:
+        if self.cfg.tracing_jsonl or self.cfg.tracing_otlp:
+            from ..common import tracing
+            tracing.configure(service="dfscheduler",
+                              jsonl_path=self.cfg.tracing_jsonl,
+                              otlp_endpoint=self.cfg.tracing_otlp)
         self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.port}")
         self.rpc.register(build_service(self.service))
         await self.rpc.start()

@@ -65,6 +65,13 @@ class SchedulerService:
 
     async def register_peer_task(self, req: RegisterPeerTaskRequest,
                                  context) -> RegisterResult:
+        from ..common import tracing
+        with tracing.span("sched.register", task_id=req.task_id[:16],
+                          peer_id=req.peer_id[-16:]):
+            return await self._register_peer_task(req, context)
+
+    async def _register_peer_task(self, req: RegisterPeerTaskRequest,
+                                  context) -> RegisterResult:
         if not req.task_id or not req.peer_id or req.peer_host is None:
             raise DFError(Code.INVALID_ARGUMENT,
                           "task_id, peer_id, peer_host required")

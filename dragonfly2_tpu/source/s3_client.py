@@ -36,17 +36,26 @@ def _endpoint() -> str:
     return f"https://{host}"
 
 
-def _parse(url: str) -> tuple[str, str]:
-    rest = url.split("://", 1)[1]
+def _parse(url: str) -> tuple[str, str, str]:
+    """(endpoint, bucket, key). Plain ``s3://bucket/key`` resolves the
+    endpoint from env/AWS defaults; ``s3+http(s)://host[:port]/bucket/key``
+    carries it inline (the object gateway uses this so reads hit the SAME
+    backend its writes were configured for)."""
+    scheme, rest = url.split("://", 1)
+    if scheme in ("s3+http", "s3+https"):
+        host, _, rest = rest.partition("/")
+        endpoint = f"{scheme[3:]}://{host}"
+    else:
+        endpoint = _endpoint()
     bucket, _, key = rest.partition("/")
     if not bucket or not key:
         raise DFError(Code.INVALID_ARGUMENT, f"bad s3 url: {url}")
-    return bucket, key
+    return endpoint, bucket, key
 
 
 def _http_url(url: str) -> str:
-    bucket, key = _parse(url)
-    return (f"{_endpoint()}/{quote(bucket)}/"
+    endpoint, bucket, key = _parse(url)
+    return (f"{endpoint}/{quote(bucket)}/"
             f"{quote(key, safe='/-_.~')}")
 
 
@@ -159,4 +168,4 @@ class S3SourceClient:
                           content_length=await self.content_length(req))]
 
 
-register_client(["s3"], S3SourceClient())
+register_client(["s3", "s3+http", "s3+https"], S3SourceClient())

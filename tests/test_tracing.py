@@ -1,0 +1,246 @@
+"""Tracing + profiling (VERDICT missing #5).
+
+Reference surfaces: OTel bootstrap (cmd/dependency/dependency.go:95-137),
+trace ctx inside the piece request (piece_downloader.go:227), pprof. The
+money assertion: ONE trace id follows a piece transfer across two daemons
+(child span -> traceparent header -> parent's upload.serve span).
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from dragonfly2_tpu.common import tracing
+
+
+@pytest.fixture(autouse=True)
+def fresh_tracer():
+    old = tracing.TRACER
+    tracing.TRACER = tracing.Tracer()
+    tracing.configure = tracing.TRACER.configure
+    yield
+    tracing.TRACER.flush()
+    tracing.TRACER = old
+    tracing.configure = old.configure
+
+
+class TestSpans:
+    def test_traceparent_roundtrip(self):
+        ctx = tracing.SpanContext("a" * 32, "b" * 16, sampled=True)
+        header = f"00-{'a' * 32}-{'b' * 16}-01"
+        parsed = tracing.from_traceparent(header)
+        assert parsed == ctx
+        assert tracing.from_traceparent("garbage") is None
+        assert tracing.from_traceparent("") is None
+        assert not tracing.from_traceparent(
+            f"00-{'a' * 32}-{'b' * 16}-00").sampled
+
+    def test_span_nesting_and_export(self, tmp_path):
+        path = str(tmp_path / "traces.jsonl")
+        tracing.configure(service="test", jsonl_path=path)
+        with tracing.span("outer", kind="task") as outer:
+            header = tracing.traceparent()
+            assert outer.ctx.trace_id in header
+            with tracing.span("inner") as inner:
+                assert inner.ctx.trace_id == outer.ctx.trace_id
+                assert inner.parent_span_id == outer.ctx.span_id
+        tracing.TRACER.flush()
+        rows = [json.loads(l) for l in open(path)]
+        assert {r["name"] for r in rows} == {"outer", "inner"}
+        assert len({r["trace_id"] for r in rows}) == 1
+        assert all(r["duration_ms"] >= 0 for r in rows)
+
+    def test_error_status(self, tmp_path):
+        tracing.configure(jsonl_path=str(tmp_path / "t.jsonl"))
+        with pytest.raises(ValueError):
+            with tracing.span("boom"):
+                raise ValueError("nope")
+        tracing.TRACER.flush()
+        row = json.loads(open(tmp_path / "t.jsonl").read())
+        assert row["status"] == "error"
+        assert "nope" in row["attributes"]["error.message"]
+
+    def test_disabled_tracer_is_cheap_and_silent(self, tmp_path):
+        with tracing.span("x"):
+            pass
+        tracing.TRACER.flush()   # nothing configured: no files appear
+        assert os.listdir(tmp_path) == []
+
+    def test_otlp_export_shape(self, tmp_path):
+        async def main():
+            from aiohttp import web
+            got = []
+
+            async def collect(request):
+                got.append(await request.json())
+                return web.Response()
+
+            app = web.Application()
+            app.router.add_post("/v1/traces", collect)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            tracing.configure(service="otlp-test",
+                              otlp_endpoint=f"http://127.0.0.1:{port}")
+            with tracing.span("exported", foo="bar"):
+                pass
+            await asyncio.to_thread(tracing.TRACER.flush)
+            for _ in range(50):
+                if got:
+                    break
+                await asyncio.sleep(0.1)
+            await runner.cleanup()
+            assert got, "no OTLP payload arrived"
+            spans = got[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+            assert spans[0]["name"] == "exported"
+            assert len(spans[0]["traceId"]) == 32
+        asyncio.run(main())
+
+
+class TestCrossDaemonTrace:
+    def test_one_trace_id_spans_both_daemons(self, tmp_path):
+        """P2P transfer between two daemons with tracing on: the child's
+        peertask/piece spans and the PARENT's upload.serve span must share
+        one trace id (the header rode the piece GET)."""
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_daemon_e2e import start_origin
+        from test_p2p import (ScriptedScheduler, ScriptedSession,
+                              parent_addr)
+
+        from dragonfly2_tpu.daemon.config import (DaemonConfig,
+                                                  StorageSection,
+                                                  TracingConfig)
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import (DownloadRequest, PeerPacket,
+                                                 RegisterResult, SizeScope)
+
+        async def main():
+            data = os.urandom(6 << 20)
+            origin, base = await start_origin({"f.bin": data})
+            url = f"{base}/f.bin"
+
+            def cfg(name):
+                return DaemonConfig(
+                    workdir=str(tmp_path / name), host_ip="127.0.0.1",
+                    hostname=name,
+                    storage=StorageSection(gc_interval_s=3600),
+                    tracing=TracingConfig(
+                        enabled=True,
+                        jsonl_path=str(tmp_path / f"{name}-traces.jsonl")))
+
+            # NOTE: both daemons share one process; the tracer is global, so
+            # both write to whichever configure() ran last. Separate the
+            # files by reconfiguring per-start order: A first, then B — spans
+            # from both go to B's file; trace CONTINUITY (same trace id) is
+            # what's asserted, not file placement.
+            a = Daemon(cfg("pa"))
+            await a.start()
+            b = Daemon(cfg("pb"))
+            await b.start()
+            try:
+                # warm A via back-source
+                async for _ in a.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "a.out"),
+                        timeout_s=60.0)):
+                    pass
+
+                # B pulls from A via a scripted scheduler
+                def make_session(conductor):
+                    return ScriptedSession(
+                        RegisterResult(task_id=conductor.task_id,
+                                       size_scope=SizeScope.NORMAL),
+                        [PeerPacket(task_id=conductor.task_id,
+                                    src_peer_id=conductor.peer_id,
+                                    main_peer=parent_addr(
+                                        a, next(iter(a.ptm._conductors))
+                                        if a.ptm._conductors else ""))])
+
+                # find A's peer id for the task
+                task_id = next(iter(a.ptm._conductors))
+                apeer = a.ptm.conductor(task_id).peer_id
+                def make_session2(conductor):
+                    from dragonfly2_tpu.idl.messages import PeerAddr
+                    return ScriptedSession(
+                        RegisterResult(task_id=conductor.task_id,
+                                       size_scope=SizeScope.NORMAL),
+                        [PeerPacket(task_id=conductor.task_id,
+                                    src_peer_id=conductor.peer_id,
+                                    main_peer=PeerAddr(
+                                        peer_id=apeer, ip="127.0.0.1",
+                                        rpc_port=a.rpc.port,
+                                        download_port=a.upload_server.port))])
+                b.ptm.scheduler = ScriptedScheduler(make_session2)
+                async for _ in b.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "b.out"),
+                        disable_back_source=True, timeout_s=60.0)):
+                    pass
+                assert open(tmp_path / "b.out", "rb").read() == data
+            finally:
+                tracing.TRACER.flush()
+                await b.stop()
+                await a.stop()
+                await origin.cleanup()
+
+            rows = []
+            for name in ("pa", "pb"):
+                p = tmp_path / f"{name}-traces.jsonl"
+                if p.exists():
+                    rows += [json.loads(l) for l in open(p)]
+            by_name: dict[str, list] = {}
+            for r in rows:
+                by_name.setdefault(r["name"], []).append(r)
+            assert "peertask" in by_name and "upload.serve" in by_name \
+                and "piece.download" in by_name, sorted(by_name)
+            # B's piece.download and A's upload.serve share a trace id
+            piece_traces = {r["trace_id"] for r in by_name["piece.download"]}
+            serve_traces = {r["trace_id"] for r in by_name["upload.serve"]}
+            assert piece_traces & serve_traces, (piece_traces, serve_traces)
+            # and that trace is rooted at B's peertask span
+            task_traces = {r["trace_id"] for r in by_name["peertask"]}
+            assert piece_traces <= task_traces
+
+        asyncio.run(main())
+
+
+class TestDebugEndpoints:
+    def test_stacks_and_profile(self, tmp_path):
+        async def main():
+            import aiohttp
+
+            from dragonfly2_tpu.daemon.config import (DaemonConfig,
+                                                      StorageSection,
+                                                      UploadConfig)
+            from dragonfly2_tpu.daemon.daemon import Daemon
+
+            d = Daemon(DaemonConfig(workdir=str(tmp_path / "d"),
+                                    host_ip="127.0.0.1", hostname="dbg",
+                                    upload=UploadConfig(
+                                        debug_endpoints=True),
+                                    storage=StorageSection(
+                                        gc_interval_s=3600)))
+            await d.start()
+            try:
+                port = d.upload_server.port
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"http://127.0.0.1:{port}"
+                                     f"/debug/stacks") as r:
+                        text = await r.text()
+                        assert r.status == 200
+                        assert "asyncio tasks" in text
+                    async with s.get(f"http://127.0.0.1:{port}"
+                                     f"/debug/profile?seconds=0.2") as r:
+                        text = await r.text()
+                        assert r.status == 200
+                        assert "cumulative" in text
+            finally:
+                await d.stop()
+        asyncio.run(main())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -33,6 +33,7 @@ import contextvars
 import json
 import logging
 import os
+import queue
 import secrets
 import threading
 import time
@@ -84,6 +85,8 @@ class Tracer:
         self._lock = threading.Lock()
         self._buffer: list[Span] = []
         self._atexit_registered = False
+        self._export_q: "queue.Queue[list[Span] | None]" = queue.Queue(64)
+        self._exporter: threading.Thread | None = None
 
     def configure(self, *, service: str = "", jsonl_path: str = "",
                   otlp_endpoint: str = "",
@@ -104,7 +107,7 @@ class Tracer:
             if self.enabled and not self._atexit_registered:
                 # short-lived runs (the post-mortem case this module exists
                 # for) rarely hit the 64-span flush threshold
-                atexit.register(self.flush)
+                atexit.register(self._shutdown_flush)
                 self._atexit_registered = True
 
     def _sampled(self) -> bool:
@@ -132,6 +135,8 @@ class Tracer:
         if not self.enabled or not sp.ctx.sampled:
             return
         with self._lock:
+            if len(self._buffer) >= self.MAX_BUFFER:
+                self._buffer.pop(0)        # bounded: drop-oldest
             self._buffer.append(sp)
             if (len(self._buffer) >= 64
                     or sp.end_ns - sp.start_ns > 1_000_000_000):
@@ -140,6 +145,11 @@ class Tracer:
     def flush(self) -> None:
         with self._lock:
             self._flush_locked()
+
+    def _shutdown_flush(self) -> None:
+        self.flush()
+        if self._otlp_endpoint:
+            self.drain_exports()
 
     def _flush_locked(self) -> None:
         batch, self._buffer = self._buffer, []
@@ -157,8 +167,34 @@ class Tracer:
                     "attributes": sp.attributes}) + "\n")
             self._jsonl_file.flush()
         if self._otlp_endpoint:
-            threading.Thread(target=self._export_otlp, args=(batch,),
-                             daemon=True).start()
+            # single long-lived exporter thread draining a queue: a thread
+            # per batch piles up against a slow collector, and a daemon
+            # thread spawned from the atexit flush dies before sending
+            self._ensure_exporter()
+            try:
+                self._export_q.put_nowait(batch)
+            except queue.Full:
+                log.debug("otlp export queue full; batch dropped")
+
+    def _ensure_exporter(self) -> None:
+        if self._exporter is None or not self._exporter.is_alive():
+            self._exporter = threading.Thread(target=self._export_loop,
+                                              name="otlp-export",
+                                              daemon=True)
+            self._exporter.start()
+
+    def _export_loop(self) -> None:
+        while True:
+            batch = self._export_q.get()
+            if batch is None:
+                return
+            self._export_otlp(batch)
+
+    def drain_exports(self, timeout: float = 5.0) -> None:
+        """Best-effort: wait for queued OTLP batches to leave (shutdown)."""
+        deadline = time.monotonic() + timeout
+        while not self._export_q.empty() and time.monotonic() < deadline:
+            time.sleep(0.05)
 
     def _export_otlp(self, batch: list[Span]) -> None:
         """OTLP/HTTP JSON — the lingua franca every collector ingests."""
@@ -248,5 +284,8 @@ def from_traceparent(header: str) -> SpanContext | None:
         int(parts[1], 16), int(parts[2], 16)
     except ValueError:
         return None
-    return SpanContext(parts[1], parts[2],
-                       sampled=parts[3].endswith("1"))
+    try:
+        flags = int(parts[3], 16)
+    except ValueError:
+        return None
+    return SpanContext(parts[1], parts[2], sampled=bool(flags & 1))

@@ -118,3 +118,4 @@ class DaemonConfig:
     announce_interval_s: float = 30.0
     probe_enabled: bool = True             # RTT probing via SyncProbes
     metrics_port: int = 0                  # 0 = disabled
+    plugin_dir: str = ""                   # df_plugin_source_*.py schemes

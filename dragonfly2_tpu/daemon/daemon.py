@@ -115,6 +115,9 @@ class Daemon:
         return factory
 
     async def start(self) -> None:
+        if self.cfg.plugin_dir:
+            from ..common.plugins import load_source_plugins
+            load_source_plugins(self.cfg.plugin_dir)
         if self.cfg.tracing.enabled:
             from ..common import tracing
             tracing.configure(

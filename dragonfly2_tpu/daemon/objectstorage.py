@@ -53,14 +53,22 @@ class ObjectGateway:
         # reads of s3-backed buckets must use the backend's credentials
         # (the s3 source client is a process singleton; one credential set
         # per process — matching the env-var model it replaces)
-        for bcfg in (cfg.backends or {}).values():
-            if bcfg.get("kind") == "s3" and bcfg.get("access_key"):
-                from ..common.objectstorage import S3Credentials
-                from ..source.client import client_for
-                client_for("s3://x/x").set_credentials(S3Credentials(
-                    bcfg["access_key"], bcfg["secret_key"],
-                    bcfg.get("region", "us-east-1")))
-                break
+        s3_creds = {(b["access_key"], b["secret_key"],
+                     b.get("region", "us-east-1"))
+                    for b in (cfg.backends or {}).values()
+                    if b.get("kind") == "s3" and b.get("access_key")}
+        if len(s3_creds) > 1:
+            # one credential set per process (the source client is a
+            # singleton): silently signing bucket B's reads with bucket A's
+            # key yields 403s only at read time — fail loudly at config time
+            raise DFError(Code.INVALID_ARGUMENT,
+                          "multiple s3 backends with DIFFERENT credentials "
+                          "are not supported in one daemon")
+        if s3_creds:
+            from ..common.objectstorage import S3Credentials
+            from ..source.client import client_for
+            client_for("s3://x/x").set_credentials(
+                S3Credentials(*next(iter(s3_creds))))
 
     def _object_url(self, bucket: str, key: str) -> str:
         base = self.cfg.buckets.get(bucket)

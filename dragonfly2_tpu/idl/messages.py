@@ -625,3 +625,16 @@ class CertificateRequest:
 class CertificateResponse:
     cert_pem: bytes = b""
     ca_cert_pem: bytes = b""
+
+
+@message
+class SyncPeersRequest:
+    """Manager -> scheduler: dump your live host set (reference
+    scheduler/job/job.go:224 syncPeers consumed by manager/job/sync_peers)."""
+
+    cluster_id: int = 0
+
+
+@message
+class SyncPeersResponse:
+    hosts: list[Host] | None = None

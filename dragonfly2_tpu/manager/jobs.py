@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..idl.messages import PreheatRequest, UrlMeta
+from ..idl.messages import PreheatRequest, SyncPeersRequest, UrlMeta
 from ..rpc.client import ChannelPool, ServiceClient
 from .store import Store
 
@@ -37,10 +37,13 @@ class JobRunner:
         t.add_done_callback(self._running.discard)
         return job_id
 
-    async def _run_preheat(self, job_id: int, url: str,
-                           url_meta: UrlMeta | None,
-                           cluster_id: int | None) -> None:
-        await asyncio.to_thread(self.store.update_job, job_id, state="running")
+    async def _fan_out(self, job_id: int, cluster_id: int | None,
+                       kind: str, call) -> None:
+        """Shared job scaffold: mark running, call every active scheduler
+        with per-target isolation, aggregate, write the final state.
+        ``call(client, addr)`` returns (result_dict, ok_bool)."""
+        await asyncio.to_thread(self.store.update_job, job_id,
+                                state="running")
         schedulers = await asyncio.to_thread(
             lambda: self.store.schedulers(cluster_id=cluster_id,
                                           only_active=True))
@@ -56,19 +59,57 @@ class JobRunner:
             try:
                 client = ServiceClient(self._channels.get(addr),
                                        SCHEDULER_SERVICE)
-                resp = await client.unary(
-                    "Preheat", PreheatRequest(url=url, url_meta=url_meta,
-                                              wait=True), timeout=600.0)
-                results[addr] = {"state": resp.state, "task_id": resp.task_id}
-                if resp.state == "succeeded":
+                result, good = await call(client, addr)
+                results[addr] = result
+                if good:
                     ok += 1
             except Exception as exc:  # noqa: BLE001 - per-target isolation
                 results[addr] = {"state": "failed", "error": str(exc)}
         state = "succeeded" if ok else "failed"
         await asyncio.to_thread(self.store.update_job, job_id, state=state,
                                 result=results)
-        log.info("preheat job %d %s across %d scheduler(s)", job_id, state,
+        log.info("%s job %d %s across %d scheduler(s)", kind, job_id, state,
                  len(schedulers))
+
+    async def _run_preheat(self, job_id: int, url: str,
+                           url_meta: UrlMeta | None,
+                           cluster_id: int | None) -> None:
+        async def call(client, addr):
+            resp = await client.unary(
+                "Preheat", PreheatRequest(url=url, url_meta=url_meta,
+                                          wait=True), timeout=600.0)
+            return ({"state": resp.state, "task_id": resp.task_id},
+                    resp.state == "succeeded")
+
+        await self._fan_out(job_id, cluster_id, "preheat", call)
+
+    async def submit_sync_peers(self, *,
+                                cluster_id: int | None = None) -> int:
+        """Fan SyncPeers to active schedulers; the aggregated live-host
+        view lands in the job result (reference manager/job/sync_peers.go
+        aggregating scheduler/job syncPeers)."""
+        job_id = await asyncio.to_thread(
+            self.store.create_job, "sync_peers", {"cluster_id": cluster_id})
+        t = asyncio.get_running_loop().create_task(
+            self._run_sync_peers(job_id, cluster_id))
+        self._running.add(t)
+        t.add_done_callback(self._running.discard)
+        return job_id
+
+    async def _run_sync_peers(self, job_id: int,
+                              cluster_id: int | None) -> None:
+        async def call(client, addr):
+            resp = await client.unary(
+                "SyncPeers", SyncPeersRequest(cluster_id=cluster_id or 0),
+                timeout=60.0)
+            hosts = resp.hosts or []
+            return ({"state": "succeeded",
+                     "hosts": [{"id": h.id, "ip": h.ip,
+                                "hostname": h.hostname, "type": int(h.type),
+                                "download_port": h.download_port}
+                               for h in hosts]}, True)
+
+        await self._fan_out(job_id, cluster_id, "sync_peers", call)
 
     async def close(self) -> None:
         for t in list(self._running):

@@ -113,14 +113,22 @@ class RestAPI:
 
     async def _create_job(self, request: web.Request) -> web.Response:
         body = await request.json()
-        if body.get("type") != "preheat":
-            return web.json_response({"error": "unknown job type"}, status=400)
         args = body.get("args", {})
-        meta = UrlMeta(**args.get("url_meta", {})) if args.get("url_meta") \
-            else None
-        job_id = await self.jobs.submit_preheat(
-            url=args["url"], url_meta=meta,
-            cluster_id=args.get("cluster_id"))
+        if body.get("type") == "preheat":
+            if not args.get("url"):
+                return web.json_response(
+                    {"error": "preheat requires args.url"}, status=400)
+            meta = UrlMeta(**args.get("url_meta", {})) \
+                if args.get("url_meta") else None
+            job_id = await self.jobs.submit_preheat(
+                url=args["url"], url_meta=meta,
+                cluster_id=args.get("cluster_id"))
+        elif body.get("type") == "sync_peers":
+            job_id = await self.jobs.submit_sync_peers(
+                cluster_id=args.get("cluster_id"))
+        else:
+            return web.json_response({"error": "unknown job type"},
+                                     status=400)
         return web.json_response({"id": job_id}, status=201)
 
     async def _list_jobs(self, _r: web.Request) -> web.Response:

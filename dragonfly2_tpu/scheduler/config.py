@@ -69,6 +69,7 @@ class SchedulerConfig:
     records_dir: str = ""                  # download-record JSONL ("" = memory-only)
     tracing_jsonl: str = ""                # span export path ("" = disabled)
     tracing_otlp: str = ""                 # OTLP/HTTP collector endpoint
+    plugin_dir: str = ""                   # df_plugin_*.py extensions
     train_upload_interval_s: float = 60.0  # records -> trainer cadence
     model_refresh_interval_s: float = 60.0  # manager -> ml evaluator cadence
     workdir: str = ""

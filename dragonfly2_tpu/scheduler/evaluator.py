@@ -113,7 +113,8 @@ class RTTEvaluator(Evaluator):
         return max(0.05, min(1.0, 50.0 / max(rtt_us, 50.0) + 0.05))
 
 
-def make_evaluator(algorithm: str, *, topo_store=None, infer=None) -> Evaluator:
+def make_evaluator(algorithm: str, *, topo_store=None, infer=None,
+                   plugin_dir: str = "") -> Evaluator:
     if algorithm == "nt" and topo_store is not None:
         return RTTEvaluator(topo_store)
     if algorithm == "ml":
@@ -121,4 +122,21 @@ def make_evaluator(algorithm: str, *, topo_store=None, infer=None) -> Evaluator:
         # trained version lands (base-score fallback covers the cold start)
         from .evaluator_ml import MLEvaluator
         return MLEvaluator(infer)
+    if algorithm.startswith("plugin:"):
+        # operator-supplied scorer (reference evaluator 'plugin' algorithm
+        # + internal/dfplugin); the plugin object must expose
+        # evaluate(child, parent, total_piece_count) -> float
+        from ..common import plugins
+        impl, _meta = plugins.load(plugin_dir, "evaluator",
+                                   algorithm.split(":", 1)[1])
+        return _PluginEvaluator(impl)
     return Evaluator()
+
+
+class _PluginEvaluator(Evaluator):
+    def __init__(self, impl):
+        self.impl = impl
+
+    def evaluate(self, child, parent, *, total_piece_count: int) -> float:
+        return float(self.impl.evaluate(
+            child, parent, total_piece_count=total_piece_count))

@@ -32,7 +32,7 @@ class Scheduler:
                                  seed_upload_limit=cfg.seed_upload_limit)
         self.topo = TopologyStore()
         evaluator = make_evaluator(cfg.algorithm, topo_store=self.topo,
-                                   infer=infer)
+                                   infer=infer, plugin_dir=cfg.plugin_dir)
         self.scheduling = Scheduling(cfg, evaluator)
         self.seed_client = SeedPeerClient(self.resource, cfg.seed_peers)
         if records is None and (cfg.records_dir or cfg.trainer_address):

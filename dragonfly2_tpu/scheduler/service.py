@@ -431,6 +431,13 @@ class SchedulerService:
                                content_length=task.content_length,
                                total_piece_count=task.total_piece_count)
 
+    async def sync_peers(self, req, context):
+        """Dump live hosts for the manager's sync_peers job (reference
+        scheduler/job/job.go:224)."""
+        from ..idl.messages import SyncPeersResponse
+        return SyncPeersResponse(hosts=[h.msg
+                                        for h in self.resource.hosts.values()])
+
     async def sync_probes(self, request_iter,
                           context) -> AsyncIterator[SyncProbesResponse]:
         async for req in request_iter:
@@ -459,5 +466,6 @@ def build_service(svc: SchedulerService) -> ServiceDef:
     d.unary_unary("LeavePeer", svc.leave_peer)
     d.unary_unary("StatTask", svc.stat_task)
     d.unary_unary("Preheat", svc.preheat)
+    d.unary_unary("SyncPeers", svc.sync_peers)
     d.stream_stream("SyncProbes", svc.sync_probes)
     return d

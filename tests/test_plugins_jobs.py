@@ -1,0 +1,149 @@
+"""Plugin loading + sync_peers job (VERDICT missing #7/#9).
+
+Reference: internal/dfplugin/dfplugin.go:43-80 (contract checks),
+scheduler/job/job.go:224 syncPeers + manager/job/sync_peers.go.
+"""
+
+import asyncio
+import textwrap
+
+import pytest
+
+from dragonfly2_tpu.common import plugins
+
+
+EVALUATOR_PLUGIN = textwrap.dedent('''
+    class TopFirstEvaluator:
+        """Toy scorer: peers whose id sorts first win."""
+        def __init__(self, option):
+            self.bias = float(option.get("bias", 0))
+        def evaluate(self, child, parent, *, total_piece_count):
+            return self.bias - ord(parent.id[0])
+
+    def dragonfly_plugin_init(option):
+        return TopFirstEvaluator(option), {"type": "evaluator",
+                                           "name": "topfirst"}
+''')
+
+SOURCE_PLUGIN = textwrap.dedent('''
+    class NullSource:
+        async def content_length(self, req):
+            return 4
+        async def supports_range(self, req):
+            return False
+        async def last_modified(self, req):
+            return ""
+        async def download(self, req):
+            from dragonfly2_tpu.source.client import SourceResponse
+            async def chunks():
+                yield b"xyzw"
+            return SourceResponse(status=200, content_length=4,
+                                  total_length=4, chunks=chunks())
+        async def list(self, req):
+            return []
+        async def close(self):
+            pass
+
+    def dragonfly_plugin_init(option):
+        return NullSource(), {"type": "source", "name": "nullsrc",
+                              "schemes": ["null"]}
+''')
+
+
+class TestPluginLoading:
+    def test_load_with_contract_checks(self, tmp_path):
+        (tmp_path / "df_plugin_evaluator_topfirst.py").write_text(
+            EVALUATOR_PLUGIN)
+        impl, meta = plugins.load(str(tmp_path), "evaluator", "topfirst",
+                                  {"bias": 1000})
+        assert meta["name"] == "topfirst"
+        assert impl.bias == 1000
+
+    def test_contract_violations(self, tmp_path):
+        with pytest.raises(plugins.PluginError):
+            plugins.load(str(tmp_path), "evaluator", "missing")
+        (tmp_path / "df_plugin_evaluator_nosym.py").write_text("x = 1\n")
+        with pytest.raises(plugins.PluginError):
+            plugins.load(str(tmp_path), "evaluator", "nosym")
+        (tmp_path / "df_plugin_evaluator_liar.py").write_text(
+            "def dragonfly_plugin_init(option):\n"
+            "    return object(), {'type': 'manager', 'name': 'liar'}\n")
+        with pytest.raises(plugins.PluginError):
+            plugins.load(str(tmp_path), "evaluator", "liar")
+
+    def test_scheduler_uses_plugin_evaluator(self, tmp_path):
+        (tmp_path / "df_plugin_evaluator_topfirst.py").write_text(
+            EVALUATOR_PLUGIN)
+        from dragonfly2_tpu.scheduler.evaluator import make_evaluator
+        ev = make_evaluator("plugin:topfirst", plugin_dir=str(tmp_path))
+
+        class P:
+            def __init__(self, pid):
+                self.id = pid
+
+        assert ev.evaluate(P("c"), P("a"), total_piece_count=1) \
+            > ev.evaluate(P("c"), P("b"), total_piece_count=1)
+
+    def test_source_plugin_registers_scheme(self, tmp_path):
+        (tmp_path / "df_plugin_source_nullsrc.py").write_text(SOURCE_PLUGIN)
+        n = plugins.load_source_plugins(str(tmp_path))
+        assert n == 1
+        from dragonfly2_tpu.source import SourceRequest, client_for
+
+        async def main():
+            client = client_for("null://whatever/x")
+            resp = await client.download(SourceRequest(url="null://w/x"))
+            assert await resp.read_all() == b"xyzw"
+        asyncio.run(main())
+
+
+class TestSyncPeers:
+    def test_sync_peers_job_aggregates_live_hosts(self, tmp_path):
+        """Manager job -> scheduler SyncPeers RPC -> aggregated host view
+        in the job result, driven over real gRPC."""
+        async def main():
+            import aiohttp
+
+            from dragonfly2_tpu.idl.messages import Host, HostType
+            from dragonfly2_tpu.manager.server import (Manager,
+                                                       ManagerConfig)
+            from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+
+            mgr = Manager(ManagerConfig(listen_ip="127.0.0.1",
+                                        workdir=str(tmp_path)))
+            await mgr.start()
+            sched = Scheduler(SchedulerConfig(
+                listen_ip="127.0.0.1", advertise_ip="127.0.0.1",
+                manager_addresses=[f"127.0.0.1:{mgr.port}"]))
+            await sched.start()
+            try:
+                # two live hosts in the scheduler's resource model
+                for name in ("h-a", "h-b"):
+                    sched.resource.store_host(Host(
+                        id=name, ip="127.0.0.1", hostname=name, port=1,
+                        download_port=2, type=HostType.NORMAL))
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                            f"http://127.0.0.1:{mgr.rest.port}/api/v1/jobs",
+                            json={"type": "sync_peers"}) as r:
+                        assert r.status == 201
+                        job_id = (await r.json())["id"]
+                    for _ in range(100):
+                        async with s.get(
+                                f"http://127.0.0.1:{mgr.rest.port}"
+                                f"/api/v1/jobs/{job_id}") as r:
+                            job = await r.json()
+                        if job["state"] in ("succeeded", "failed"):
+                            break
+                        await asyncio.sleep(0.1)
+                assert job["state"] == "succeeded", job
+                hosts = next(iter(job["result"].values()))["hosts"]
+                assert {h["id"] for h in hosts} >= {"h-a", "h-b"}
+            finally:
+                await sched.stop()
+                await mgr.stop()
+        asyncio.run(main())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

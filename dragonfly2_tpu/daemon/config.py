@@ -20,6 +20,18 @@ class SchedulerConfig:
 
 
 @dataclass
+class SecurityConfig:
+    """Fleet mTLS via manager-issued certs (reference pkg/issuer +
+    certify-style auto-issuance in client/daemon/daemon.go:367-458)."""
+
+    enabled: bool = False
+    issue_token: str = ""             # manager issuer.token (out of band)
+    issue_token_path: str = ""        # or a file holding it
+    ca_cert: str = ""                 # fleet CA path (manager proxy-ca.crt)
+    cert_validity_s: int = 24 * 3600
+
+
+@dataclass
 class TracingConfig:
     enabled: bool = False
     jsonl_path: str = ""              # "" -> <workdir>/logs/traces.jsonl
@@ -113,6 +125,7 @@ class DaemonConfig:
     upload: UploadConfig = field(default_factory=UploadConfig)
     storage: StorageSection = field(default_factory=StorageSection)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    security: SecurityConfig = field(default_factory=SecurityConfig)
     proxy: ProxyConfig = field(default_factory=ProxyConfig)
     object_storage: ObjectStorageConfig = field(default_factory=ObjectStorageConfig)
     announce_interval_s: float = 30.0

@@ -114,6 +114,25 @@ class Daemon:
                                 shards_per_device=spd)
         return factory
 
+    async def _enroll_security(self):
+        from ..rpc.security import obtain_certificate
+        from ..rpc.server import TLSOptions
+
+        sec = self.cfg.security
+        token = sec.issue_token
+        if not token and sec.issue_token_path:
+            with open(sec.issue_token_path, encoding="utf-8") as f:
+                token = f.read().strip()
+        cert, key, ca = await obtain_certificate(
+            self.cfg.manager_addresses,
+            hosts=[self.host_ip, self.hostname],
+            token=token, out_dir=os.path.join(self.paths.cache_dir, "tls"),
+            validity_s=sec.cert_validity_s, tls_ca=sec.ca_cert)
+        self.fleet_ca = sec.ca_cert or ca
+        # every peer channel (sync streams) now verifies against the CA
+        self._peer_tls_ca = self.fleet_ca
+        return TLSOptions(cert, key)
+
     async def start(self) -> None:
         if self.cfg.plugin_dir:
             from ..common.plugins import load_source_plugins
@@ -126,6 +145,11 @@ class Daemon:
                     self.paths.log_dir, "traces.jsonl"),
                 otlp_endpoint=self.cfg.tracing.otlp_endpoint,
                 sample_ratio=self.cfg.tracing.sample_ratio)
+        # mTLS enrollment FIRST: the peer channel pool and the rpc server
+        # both depend on the issued material
+        self._rpc_tls = None
+        if self.cfg.security.enabled:
+            self._rpc_tls = await self._enroll_security()
         if self.cfg.download.source_ca or self.cfg.download.source_insecure:
             # the source client is a process singleton: remember the prior
             # trust setting so stop() restores it (co-resident daemons in
@@ -136,7 +160,8 @@ class Daemon:
             http.set_tls(insecure=self.cfg.download.source_insecure,
                          ca_file=self.cfg.download.source_ca)
         await self.upload_server.start()
-        self._peer_channels = ChannelPool()
+        self._peer_channels = ChannelPool(
+            tls_ca=getattr(self, "_peer_tls_ca", ""))
         self._piece_downloader = PieceDownloader(
             timeout_s=self.cfg.download.piece_timeout_s)
         engine_factory = self._p2p_engine_factory
@@ -160,8 +185,11 @@ class Daemon:
             is_seed=self.cfg.is_seed, shaper=self.shaper)
         svc = DaemonService(self.ptm,
                             upload_addr=f"{self.host_ip}:{self.upload_server.port}")
+        # fleet mTLS: enroll with the manager, serve the peer RPC port with
+        # the issued leaf, dial other peers trusting the fleet CA
         # peer-facing TCP server: bind the listen address, advertise host_ip
-        self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.rpc_port}")
+        self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.rpc_port}",
+                             tls=self._rpc_tls)
         for sdef in build_service(svc):
             self.rpc.register(sdef)
         await self.rpc.start()

@@ -101,9 +101,11 @@ class ChannelPool:
     recently used channels are closed as new addresses arrive.
     """
 
-    def __init__(self, limit: int = 128, evict_grace_s: float = 120.0):
+    def __init__(self, limit: int = 128, evict_grace_s: float = 120.0,
+                 tls_ca: str = ""):
         self.limit = limit
         self.evict_grace_s = evict_grace_s
+        self.tls_ca = tls_ca          # fleet CA: all pooled channels use TLS
         self._channels: dict[str, Channel] = {}
         self._evicted: list[Channel] = []
         self._closers: set[asyncio.Task] = set()
@@ -111,7 +113,7 @@ class ChannelPool:
     def get(self, address: str) -> Channel:
         ch = self._channels.pop(address, None)
         if ch is None:
-            ch = Channel(address)
+            ch = Channel(address, tls_ca=self.tls_ca)
             while len(self._channels) >= self.limit:
                 oldest = next(iter(self._channels))
                 self._evict(self._channels.pop(oldest))

@@ -1,0 +1,64 @@
+"""Fleet cert enrollment: obtain a manager-signed certificate.
+
+Role parity: reference ``pkg/issuer`` + certify integration
+(``client/daemon/daemon.go:367-458``) — the daemon generates a keypair
+locally, submits the PUBLIC half to the manager's ``IssueCertificate``
+(gated by the issuance token, ideally over the manager's TLS port), and
+serves its own listeners with the returned leaf. Private keys never cross
+the wire.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("df.rpc.security")
+
+
+async def obtain_certificate(manager_addresses: list[str], *,
+                             hosts: list[str], token: str,
+                             out_dir: str, validity_s: int = 24 * 3600,
+                             tls_ca: str = "") -> tuple[str, str, str]:
+    """Enroll with the first reachable manager; returns
+    (cert_path, key_path, ca_path) written 0600 under ``out_dir``."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    from ..idl.messages import CertificateRequest
+    from .client import Channel, ServiceClient
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    pub_pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    last_exc: Exception | None = None
+    for addr in manager_addresses:
+        ch = Channel(addr, tls_ca=tls_ca)
+        try:
+            mc = ServiceClient(ch, "df.manager.Manager")
+            resp = await mc.unary("IssueCertificate", CertificateRequest(
+                public_key_pem=pub_pem, hosts=hosts, token=token,
+                validity_s=validity_s), timeout=30.0)
+            os.makedirs(out_dir, exist_ok=True)
+            cert_path = os.path.join(out_dir, "peer.crt")
+            key_path = os.path.join(out_dir, "peer.key")
+            ca_path = os.path.join(out_dir, "fleet-ca.crt")
+            with open(cert_path, "wb") as f:
+                f.write(resp.cert_pem)
+            fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                         0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.PKCS8,
+                    serialization.NoEncryption()))
+            with open(ca_path, "wb") as f:
+                f.write(resp.ca_cert_pem)
+            log.info("fleet certificate issued by %s for %s", addr, hosts)
+            return cert_path, key_path, ca_path
+        except Exception as exc:  # noqa: BLE001 - try next manager
+            last_exc = exc
+        finally:
+            await ch.close()
+    raise RuntimeError(f"certificate enrollment failed: {last_exc}")

@@ -226,3 +226,89 @@ class TestCertIssuanceAndTLSRPC:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-v"])
+
+
+class TestFleetMTLS:
+    def test_daemon_peer_plane_over_issued_certs(self, tmp_path):
+        """Two daemons enroll with the manager (issuance token), serve
+        their peer RPC over the issued leafs, and complete a P2P transfer
+        whose sync streams ride TLS; a plaintext client is refused."""
+        async def main():
+            import os as _os
+            import sys
+            sys.path.insert(0, _os.path.dirname(__file__))
+            from test_daemon_e2e import start_origin
+            from test_p2p import ScriptedScheduler, ScriptedSession
+
+            from dragonfly2_tpu.daemon.config import (DaemonConfig,
+                                                      SecurityConfig,
+                                                      StorageSection)
+            from dragonfly2_tpu.daemon.daemon import Daemon
+            from dragonfly2_tpu.idl.messages import (DownloadRequest,
+                                                     PeerAddr, PeerPacket,
+                                                     RegisterResult,
+                                                     SizeScope)
+            from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+
+            m = await _mgr(tmp_path / "mgr", issue_certs=True)
+            try:
+                def cfg(name):
+                    return DaemonConfig(
+                        workdir=str(tmp_path / name), host_ip="127.0.0.1",
+                        hostname=name,
+                        manager_addresses=[f"127.0.0.1:{m.port}"],
+                        security=SecurityConfig(
+                            enabled=True, issue_token=m.issue_token),
+                        storage=StorageSection(gc_interval_s=3600))
+
+                data = os.urandom(5 << 20)
+                origin, base = await start_origin({"f.bin": data})
+                a = Daemon(cfg("tls-a"))
+                await a.start()
+                b = Daemon(cfg("tls-b"))
+                await b.start()
+                try:
+                    async for _ in a.ptm.start_file_task(DownloadRequest(
+                            url=f"{base}/f.bin",
+                            output=str(tmp_path / "a.out"),
+                            timeout_s=60.0)):
+                        pass
+                    task_id = next(iter(a.ptm._conductors))
+                    apeer = a.ptm.conductor(task_id).peer_id
+
+                    def mk(conductor):
+                        return ScriptedSession(
+                            RegisterResult(task_id=conductor.task_id,
+                                           size_scope=SizeScope.NORMAL),
+                            [PeerPacket(
+                                task_id=conductor.task_id,
+                                src_peer_id=conductor.peer_id,
+                                main_peer=PeerAddr(
+                                    peer_id=apeer, ip="127.0.0.1",
+                                    rpc_port=a.rpc.port,
+                                    download_port=a.upload_server.port))])
+
+                    b.ptm.scheduler = ScriptedScheduler(mk)
+                    async for _ in b.ptm.start_file_task(DownloadRequest(
+                            url=f"{base}/f.bin",
+                            output=str(tmp_path / "b.out"),
+                            disable_back_source=True, timeout_s=60.0)):
+                        pass
+                    assert open(tmp_path / "b.out", "rb").read() == data
+
+                    # a PLAINTEXT client cannot speak to A's TLS rpc port
+                    ch = Channel(f"127.0.0.1:{a.rpc.port}")
+                    client = ServiceClient(ch, "df.health.Health",
+                                           max_attempts=1)
+                    from dragonfly2_tpu.idl.messages import Empty
+                    with pytest.raises(Exception):
+                        await asyncio.wait_for(
+                            client.unary("Check", Empty()), 10)
+                    await ch.close()
+                finally:
+                    await b.stop()
+                    await a.stop()
+                    await origin.cleanup()
+            finally:
+                await m.stop()
+        asyncio.run(main())

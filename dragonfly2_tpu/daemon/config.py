@@ -28,7 +28,13 @@ class SecurityConfig:
     issue_token: str = ""             # manager issuer.token (out of band)
     issue_token_path: str = ""        # or a file holding it
     ca_cert: str = ""                 # fleet CA path (manager proxy-ca.crt)
-    cert_validity_s: int = 24 * 3600
+    cert_validity_s: int = 7 * 24 * 3600
+    # NOTE scope: with security enabled, BOTH peer planes are mTLS — the
+    # gRPC sync streams and the HTTPS piece uploads (client certs required
+    # on each). The renewal loop refreshes the issued material at 2/3
+    # validity: outbound channels/sessions pick it up as they rotate;
+    # LISTENERS load certs at bind time and need a daemon restart within
+    # the validity window (default 7d) to serve the fresh leaf.
 
 
 @dataclass

@@ -123,15 +123,49 @@ class Daemon:
         if not token and sec.issue_token_path:
             with open(sec.issue_token_path, encoding="utf-8") as f:
                 token = f.read().strip()
+        if not sec.ca_cert:
+            log.warning(
+                "security: enrolling over a channel with NO pinned fleet "
+                "CA — the issuance token travels unprotected and the CA is "
+                "trust-on-first-use; set security.ca_cert (and a TLS "
+                "manager port) for untrusted networks")
         cert, key, ca = await obtain_certificate(
             self.cfg.manager_addresses,
             hosts=[self.host_ip, self.hostname],
             token=token, out_dir=os.path.join(self.paths.cache_dir, "tls"),
             validity_s=sec.cert_validity_s, tls_ca=sec.ca_cert)
         self.fleet_ca = sec.ca_cert or ca
-        # every peer channel (sync streams) now verifies against the CA
+        # peer channels verify the CA AND present our leaf; the server
+        # REQUIRES client certs — that is the mutual half of mTLS
         self._peer_tls_ca = self.fleet_ca
-        return TLSOptions(cert, key)
+        self._peer_tls_cert = cert
+        self._peer_tls_key = key
+        loop = asyncio.get_running_loop()
+        self._cert_renewal = loop.create_task(self._renew_certs_loop())
+        return TLSOptions(cert, key, ca_path=self.fleet_ca,
+                          require_client_cert=True)
+
+    async def _renew_certs_loop(self) -> None:
+        """Re-enroll at 2/3 validity (reference: certify re-issues on
+        demand). Outbound material rotates live; see SecurityConfig NOTE
+        for the listener restart window."""
+        from ..rpc.security import obtain_certificate
+        sec = self.cfg.security
+        while True:
+            await asyncio.sleep(max(sec.cert_validity_s * 2 / 3, 60))
+            try:
+                token = sec.issue_token
+                if not token and sec.issue_token_path:
+                    with open(sec.issue_token_path, encoding="utf-8") as f:
+                        token = f.read().strip()
+                await obtain_certificate(
+                    self.cfg.manager_addresses,
+                    hosts=[self.host_ip, self.hostname], token=token,
+                    out_dir=os.path.join(self.paths.cache_dir, "tls"),
+                    validity_s=sec.cert_validity_s, tls_ca=sec.ca_cert)
+                log.info("fleet certificate renewed")
+            except Exception as exc:  # noqa: BLE001 - retry next cycle
+                log.error("fleet certificate renewal failed: %s", exc)
 
     async def start(self) -> None:
         if self.cfg.plugin_dir:
@@ -148,8 +182,14 @@ class Daemon:
         # mTLS enrollment FIRST: the peer channel pool and the rpc server
         # both depend on the issued material
         self._rpc_tls = None
+        self._peer_tls_ca = ""
+        self._peer_tls_cert = ""
+        self._peer_tls_key = ""
         if self.cfg.security.enabled:
             self._rpc_tls = await self._enroll_security()
+        if self._peer_tls_cert:
+            self.upload_server.tls = (self._peer_tls_cert,
+                                      self._peer_tls_key, self._peer_tls_ca)
         if self.cfg.download.source_ca or self.cfg.download.source_insecure:
             # the source client is a process singleton: remember the prior
             # trust setting so stop() restores it (co-resident daemons in
@@ -161,9 +201,14 @@ class Daemon:
                          ca_file=self.cfg.download.source_ca)
         await self.upload_server.start()
         self._peer_channels = ChannelPool(
-            tls_ca=getattr(self, "_peer_tls_ca", ""))
+            tls_ca=self._peer_tls_ca, tls_cert=self._peer_tls_cert,
+            tls_key=self._peer_tls_key)
+        tls_triple = ((self._peer_tls_cert, self._peer_tls_key,
+                       self._peer_tls_ca)
+                      if self._peer_tls_cert else None)
+        self.upload_server.tls = tls_triple
         self._piece_downloader = PieceDownloader(
-            timeout_s=self.cfg.download.piece_timeout_s)
+            timeout_s=self.cfg.download.piece_timeout_s, tls=tls_triple)
         engine_factory = self._p2p_engine_factory
         if engine_factory is None:
             def engine_factory() -> PieceEngine:
@@ -270,6 +315,9 @@ class Daemon:
             log.warning("manager attach failed (%s); back-source only", exc)
 
     async def stop(self) -> None:
+        renewal = getattr(self, "_cert_renewal", None)
+        if renewal is not None:
+            renewal.cancel()
         if self.cfg.tracing.enabled:
             from ..common import tracing
             tracing.TRACER.flush()

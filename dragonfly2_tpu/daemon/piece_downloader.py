@@ -26,15 +26,33 @@ log = logging.getLogger("df.flow.piecedl")
 
 
 class PieceDownloader:
-    def __init__(self, *, timeout_s: float = 30.0, max_connections: int = 64):
+    def __init__(self, *, timeout_s: float = 30.0, max_connections: int = 64,
+                 tls: tuple[str, str, str] | None = None):
+        """``tls``: (cert, key, ca) — fleet mTLS material; piece GETs then
+        ride https presenting the client leaf."""
         self.timeout_s = timeout_s
         self.max_connections = max_connections
+        self.tls = tls
         self._session: aiohttp.ClientSession | None = None
+
+    @property
+    def scheme(self) -> str:
+        return "https" if self.tls is not None else "http"
 
     def _get_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
+            ssl_ctx = None
+            if self.tls is not None:
+                import ssl as _ssl
+                cert, key, ca = self.tls
+                ssl_ctx = _ssl.create_default_context(cafile=ca)
+                ssl_ctx.load_cert_chain(cert, key)
+                ssl_ctx.check_hostname = False   # peers are dialed by IP;
+                # the fleet CA signature is the authentication
+                ssl_ctx.verify_mode = _ssl.CERT_REQUIRED
             self._session = aiohttp.ClientSession(
-                connector=aiohttp.TCPConnector(limit=self.max_connections),
+                connector=aiohttp.TCPConnector(limit=self.max_connections,
+                                               ssl=ssl_ctx),
                 timeout=aiohttp.ClientTimeout(total=self.timeout_s))
         return self._session
 
@@ -50,7 +68,7 @@ class PieceDownloader:
         CLIENT_DIGEST_MISMATCH when the bytes do not match the announced
         piece digest (the caller treats both as retry-on-another-parent).
         """
-        url = f"http://{dst_addr}/download/{task_id[:3]}/{task_id}"
+        url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start, size = piece.range_start, piece.range_size
         headers = {"Range": f"bytes={start}-{start + size - 1}"}
         tp = tracing.traceparent()
@@ -109,7 +127,7 @@ class PieceDownloader:
                 dst_addr=dst_addr, task_id=task_id,
                 src_peer_id=src_peer_id, piece=p)
             return [(p, data)], cost
-        url = f"http://{dst_addr}/download/{task_id[:3]}/{task_id}"
+        url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start = pieces[0].range_start
         size = sum(p.range_size for p in pieces)
         headers = {"Range": f"bytes={start}-{start + size - 1}"}

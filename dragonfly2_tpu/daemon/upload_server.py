@@ -109,6 +109,7 @@ class UploadServer:
         self.storage_mgr = storage_mgr
         self.host = host
         self.port = port
+        self.tls: tuple[str, str, str] | None = None   # (cert, key, ca)
         self.limiter = TokenBucket(rate_limit_bps or 0)
         self.concurrent_limit = concurrent_limit or self.DEFAULT_CONCURRENT_LIMIT
         self.debug_endpoints = debug_endpoints
@@ -135,10 +136,24 @@ class UploadServer:
             app.router.add_get("/debug/profile", _debug_profile)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        ssl_ctx = None
+        if self.tls is not None:
+            # the DATA plane carries the actual piece bytes: under fleet
+            # mTLS it serves the issued leaf and REQUIRES a fleet client
+            # cert, or "mTLS" would protect metadata while every artifact
+            # crosses the wire in clear
+            import ssl as _ssl
+            cert, key, ca = self.tls
+            ssl_ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(cert, key)
+            ssl_ctx.load_verify_locations(cafile=ca)
+            ssl_ctx.verify_mode = _ssl.CERT_REQUIRED
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=ssl_ctx)
         await site.start()
         self.port = resolve_port(self._runner)
-        log.info("upload server on %s:%d", self.host, self.port)
+        log.info("upload server on %s:%d (tls=%s)", self.host, self.port,
+                 self.tls is not None)
 
     async def stop(self) -> None:
         if self._runner:

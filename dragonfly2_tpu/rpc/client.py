@@ -102,10 +102,13 @@ class ChannelPool:
     """
 
     def __init__(self, limit: int = 128, evict_grace_s: float = 120.0,
-                 tls_ca: str = ""):
+                 tls_ca: str = "", tls_cert: str = "", tls_key: str = ""):
         self.limit = limit
         self.evict_grace_s = evict_grace_s
-        self.tls_ca = tls_ca          # fleet CA: all pooled channels use TLS
+        # fleet mTLS: verify peers against the CA AND present our leaf
+        self.tls_ca = tls_ca
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         self._channels: dict[str, Channel] = {}
         self._evicted: list[Channel] = []
         self._closers: set[asyncio.Task] = set()
@@ -113,7 +116,8 @@ class ChannelPool:
     def get(self, address: str) -> Channel:
         ch = self._channels.pop(address, None)
         if ch is None:
-            ch = Channel(address, tls_ca=self.tls_ca)
+            ch = Channel(address, tls_ca=self.tls_ca,
+                         tls_cert=self.tls_cert, tls_key=self.tls_key)
             while len(self._channels) >= self.limit:
                 oldest = next(iter(self._channels))
                 self._evict(self._channels.pop(oldest))

@@ -70,6 +70,10 @@ class SchedulerConfig:
     tracing_jsonl: str = ""                # span export path ("" = disabled)
     tracing_otlp: str = ""                 # OTLP/HTTP collector endpoint
     plugin_dir: str = ""                   # df_plugin_*.py extensions
+    # fleet mTLS toward security-enabled seed daemons: enroll via the
+    # manager with this issuance token (daemon SecurityConfig parity)
+    security_issue_token: str = ""
+    security_ca_cert: str = ""             # pinned fleet CA for enrollment
     train_upload_interval_s: float = 60.0  # records -> trainer cadence
     model_refresh_interval_s: float = 60.0  # manager -> ml evaluator cadence
     workdir: str = ""

@@ -24,11 +24,20 @@ SEEDER_SERVICE = "df.daemon.Seeder"
 
 
 class SeedPeerClient:
-    def __init__(self, resource: Resource, seed_peers: list[SeedPeerAddr]):
+    def __init__(self, resource: Resource, seed_peers: list[SeedPeerAddr],
+                 *, tls: tuple[str, str, str] | None = None):
+        """``tls``: (cert, key, ca) fleet material — security-enabled seed
+        daemons serve their rpc port over mTLS, and a plaintext trigger
+        would silently fail every seed fleet-wide."""
         self.resource = resource
         self.seed_peers = {self._host_id(s): s for s in seed_peers}
         self._ring = HashRing(list(self.seed_peers))
-        self._channels = ChannelPool(limit=32)
+        if tls is not None:
+            cert, key, ca = tls
+            self._channels = ChannelPool(limit=32, tls_ca=ca,
+                                         tls_cert=cert, tls_key=key)
+        else:
+            self._channels = ChannelPool(limit=32)
 
     @staticmethod
     def _host_id(s: SeedPeerAddr) -> str:

@@ -63,6 +63,8 @@ class Scheduler:
         self.port = self.rpc.port
         if self.cfg.manager_addresses:
             await self._attach_manager()
+        if self.cfg.security_issue_token and self.cfg.manager_addresses:
+            await self._enroll_security()
         self.gc.add(GCTask("resource", self.cfg.gc_interval_s,
                            self.resource.gc))
         self.gc.start()
@@ -75,6 +77,32 @@ class Scheduler:
         log.info("scheduler up on %s (cluster=%d, algorithm=%s, seeds=%d)",
                  self.address, self.cfg.cluster_id, self.cfg.algorithm,
                  len(self.seed_client.seed_peers))
+
+    async def _enroll_security(self) -> None:
+        """Obtain fleet TLS material so seed triggers can reach
+        security-enabled seed daemons (their rpc ports require client
+        certs)."""
+        import os
+
+        from ..rpc.security import obtain_certificate
+        try:
+            cert, key, ca = await obtain_certificate(
+                self.cfg.manager_addresses,
+                hosts=[self.cfg.advertise_ip],
+                token=self.cfg.security_issue_token,
+                out_dir=os.path.join(self.cfg.workdir or ".",
+                                     "scheduler-tls"),
+                tls_ca=self.cfg.security_ca_cert)
+        except Exception as exc:  # noqa: BLE001 - seeds then unreachable
+            log.error("fleet TLS enrollment failed (%s): seed triggers to "
+                      "mTLS seed daemons WILL fail", exc)
+            return
+        tls = (cert, key, self.cfg.security_ca_cert or ca)
+        await self.seed_client.close()
+        self.seed_client = SeedPeerClient(
+            self.resource, list(self.seed_client.seed_peers.values()),
+            tls=tls)
+        self.service.seed_client = self.seed_client
 
     async def _attach_manager(self) -> None:
         """Register with the manager, keep alive, and adopt its seed-peer

@@ -305,6 +305,28 @@ class TestFleetMTLS:
                         await asyncio.wait_for(
                             client.unary("Check", Empty()), 10)
                     await ch.close()
+                    # a TLS client WITHOUT a fleet client cert is refused
+                    # too — mutual auth, not just transport encryption
+                    ca = a._peer_tls_ca
+                    ch2 = Channel(f"127.0.0.1:{a.rpc.port}", tls_ca=ca)
+                    nocert = ServiceClient(ch2, "df.health.Health",
+                                           max_attempts=1)
+                    with pytest.raises(Exception):
+                        await asyncio.wait_for(
+                            nocert.unary("Check", Empty()), 10)
+                    await ch2.close()
+                    # the DATA plane is HTTPS and refuses certless clients
+                    import aiohttp
+                    import ssl as _ssl
+                    cctx = _ssl.create_default_context(cafile=ca)
+                    cctx.check_hostname = False
+                    async with aiohttp.ClientSession() as s:
+                        with pytest.raises(Exception):
+                            await s.get(
+                                f"https://127.0.0.1:"
+                                f"{a.upload_server.port}/healthy",
+                                ssl=cctx, timeout=aiohttp.ClientTimeout(
+                                    total=10))
                 finally:
                     await b.stop()
                     await a.stop()

@@ -12,4 +12,5 @@ from .client import (  # noqa: F401
     SourceRequest, SourceResponse, ResourceClient, ListEntry,
     register_client, client_for, content_length, supports_range, download,
 )
-from . import file_client, http_client, memory_client, gcs_client, s3_client  # noqa: F401
+from . import (file_client, http_client, memory_client, gcs_client,  # noqa: F401
+               s3_client, hdfs_client, oras_client)

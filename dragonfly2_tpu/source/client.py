@@ -89,3 +89,46 @@ async def supports_range(req: SourceRequest) -> bool:
 
 async def download(req: SourceRequest) -> SourceResponse:
     return await client_for(req.url).download(req)
+
+
+def timeout_for(req: "SourceRequest"):
+    """Per-request aiohttp timeout: honor req.timeout_s; otherwise no total
+    cap (multi-GB origin streams legitimately run >5min) with sane
+    connect/read bounds."""
+    import aiohttp
+
+    if req.timeout_s and req.timeout_s > 0:
+        return aiohttp.ClientTimeout(total=req.timeout_s)
+    return aiohttp.ClientTimeout(total=None, sock_connect=30, sock_read=120)
+
+
+class SessionPool:
+    """Loop-bound aiohttp sessions (one per running loop, closed ones
+    pruned). The origin clients are process singletons serving several
+    asyncio.run lifetimes (CLIs, tests) — a session from a dead loop must
+    never be reused."""
+
+    def __init__(self, factory=None):
+        import aiohttp
+
+        self._factory = factory or (lambda: aiohttp.ClientSession())
+        self._sessions: dict[int, object] = {}
+
+    async def get(self):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        s = self._sessions.get(id(loop))
+        if s is None or s.closed:
+            s = self._factory()
+            self._sessions[id(loop)] = s
+            self._sessions = {k: v for k, v in self._sessions.items()
+                              if not v.closed}
+        return s
+
+    async def close(self):
+        import asyncio
+
+        s = self._sessions.pop(id(asyncio.get_running_loop()), None)
+        if s is not None and not s.closed:
+            await s.close()

@@ -1,0 +1,197 @@
+"""oras:// origin client — OCI-registry artifacts as download sources.
+
+Role parity: reference ``pkg/source/clients/oras`` — model weights and
+datasets increasingly ship as OCI artifacts (ORAS). URL form:
+
+    oras://registry.example.com/repo/name:tag
+
+Resolution: GET ``/v2/<repo>/manifests/<tag>`` (OCI + Docker manifest
+accept headers), pick the artifact's single layer (multi-layer artifacts:
+first layer, the ORAS file convention), then stream
+``/v2/<repo>/blobs/<digest>`` — blob GETs honor standard Range headers, so
+piece-group reads work like any HTTP origin. Auth: anonymous, with the
+WWW-Authenticate bearer-token dance (``realm``/``service``/``scope``)
+handled transparently; static tokens via ``DF_ORAS_TOKEN``.
+``DF_ORAS_INSECURE=1`` uses http (local registries/tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import AsyncIterator
+
+import aiohttp
+
+from ..common.errors import Code, DFError
+from .client import (ListEntry, SessionPool, SourceRequest, SourceResponse,
+                     register_client, timeout_for)
+
+_CHUNK = 1 << 20
+_MANIFEST_ACCEPT = ", ".join([
+    "application/vnd.oci.image.manifest.v1+json",
+    "application/vnd.docker.distribution.manifest.v2+json",
+    "application/vnd.oci.artifact.manifest.v1+json",
+])
+
+
+def _scheme() -> str:
+    return "http" if os.environ.get("DF_ORAS_INSECURE") else "https"
+
+
+def _parse(url: str) -> tuple[str, str, str]:
+    """(registry, repo, tag)."""
+    rest = url.split("://", 1)[1]
+    registry, _, repo_tag = rest.partition("/")
+    repo, _, tag = repo_tag.rpartition(":")
+    if not registry or not repo or not tag:
+        raise DFError(Code.INVALID_ARGUMENT,
+                      f"bad oras url (registry/repo:tag): {url}")
+    return registry, repo, tag
+
+
+class ORASSourceClient:
+    def __init__(self) -> None:
+        self._pool = SessionPool()
+        self._tokens: dict[str, str] = {}      # registry -> bearer token
+
+    async def _session(self) -> aiohttp.ClientSession:
+        return await self._pool.get()
+
+    async def close(self) -> None:
+        await self._pool.close()
+
+    def _auth_headers(self, registry: str) -> dict[str, str]:
+        token = self._tokens.get(registry) or os.environ.get(
+            "DF_ORAS_TOKEN", "")
+        return {"Authorization": f"Bearer {token}"} if token else {}
+
+    async def _bearer_dance(self, registry: str, challenge: str) -> bool:
+        """WWW-Authenticate: Bearer realm=...,service=...,scope=... ->
+        fetch an anonymous token (the public-registry flow)."""
+        if not challenge.lower().startswith("bearer"):
+            return False
+        _, _, param_str = challenge.partition(" ")
+        if not param_str:
+            return False                # bare "Bearer": nothing to dance with
+        # split on commas OUTSIDE quotes (scope="repository:x:pull,push")
+        import re as _re
+        parts = _re.findall(r'(\w+)="([^"]*)"|(\w+)=([^,\s]+)', param_str)
+        fields = {(a or c): (b or d) for a, b, c, d in parts}
+        realm = fields.get("realm", "")
+        if not realm:
+            return False
+        params = {k: v for k, v in fields.items()
+                  if k in ("service", "scope")}
+        s = await self._session()
+        async with s.get(realm, params=params) as resp:
+            if resp.status >= 400:
+                return False
+            body = await resp.json()
+        token = body.get("token") or body.get("access_token", "")
+        if not token:
+            return False
+        self._tokens[registry] = token
+        return True
+
+    async def _get(self, registry: str, path: str,
+                   headers: dict[str, str],
+                   req: SourceRequest | None = None):
+        """GET with one automatic bearer-challenge retry."""
+        url = f"{_scheme()}://{registry}{path}"
+        s = await self._session()
+        timeout = timeout_for(req) if req is not None else None
+        for attempt in (0, 1):
+            h = {**headers, **self._auth_headers(registry)}
+            try:
+                resp = await s.get(url, headers=h, timeout=timeout)
+            except aiohttp.ClientError as exc:
+                raise DFError(Code.SOURCE_ERROR,
+                              f"oras: {exc}") from None
+            if resp.status == 401 and attempt == 0:
+                challenge = resp.headers.get("WWW-Authenticate", "")
+                resp.close()
+                if await self._bearer_dance(registry, challenge):
+                    continue
+                raise DFError(Code.SOURCE_AUTH_ERROR, f"oras 401: {url}")
+            return resp
+        raise DFError(Code.SOURCE_AUTH_ERROR, url)   # pragma: no cover
+
+    async def _resolve_layer(self, req: SourceRequest) -> tuple[str, str, dict]:
+        """(registry, blob path, layer descriptor) for the artifact's
+        payload layer."""
+        registry, repo, tag = _parse(req.url)
+        resp = await self._get(registry, f"/v2/{repo}/manifests/{tag}",
+                               {"Accept": _MANIFEST_ACCEPT, **req.header},
+                               req=req)
+        try:
+            if resp.status == 404:
+                raise DFError(Code.SOURCE_NOT_FOUND, req.url)
+            if resp.status >= 400:
+                raise DFError(Code.SOURCE_ERROR,
+                              f"oras manifest {resp.status}: {req.url}")
+            manifest = json.loads(await resp.read())
+        finally:
+            resp.close()
+        layers = manifest.get("layers") or manifest.get("blobs") or []
+        if not layers:
+            raise DFError(Code.SOURCE_ERROR,
+                          f"oras manifest has no layers: {req.url}")
+        layer = layers[0]
+        digest = layer.get("digest", "")
+        if not digest:
+            raise DFError(Code.SOURCE_ERROR, f"layer missing digest: {req.url}")
+        return registry, f"/v2/{repo}/blobs/{digest}", layer
+
+    async def content_length(self, req: SourceRequest) -> int:
+        _, _, layer = await self._resolve_layer(req)
+        total = int(layer.get("size", -1))
+        if req.range is not None and total >= 0:
+            return min(req.range.length, max(0, total - req.range.start))
+        return total
+
+    async def supports_range(self, req: SourceRequest) -> bool:
+        return True                    # OCI blob GETs serve ranges
+
+    async def last_modified(self, req: SourceRequest) -> str:
+        return ""                      # content-addressed: digest is identity
+
+    async def download(self, req: SourceRequest) -> SourceResponse:
+        registry, blob_path, layer = await self._resolve_layer(req)
+        headers = dict(req.header)
+        if req.range is not None:
+            headers["Range"] = req.range.http_header()
+        resp = await self._get(registry, blob_path, headers, req=req)
+        if resp.status >= 400:
+            status = resp.status
+            resp.close()
+            raise DFError(Code.SOURCE_ERROR,
+                          f"oras blob {status}: {req.url}")
+        if req.range is not None and resp.status != 206:
+            # OCI makes blob Range support OPTIONAL: a 200-with-full-body
+            # answer would make every piece-group slice wrong bytes from
+            # offset 0 (http_client.py has the same guard)
+            resp.close()
+            raise DFError(Code.SOURCE_RANGE_UNSUPPORTED,
+                          f"registry ignored Range: {req.url}")
+        length = int(resp.headers.get("Content-Length", "-1"))
+
+        async def chunks() -> AsyncIterator[bytes]:
+            try:
+                async for data in resp.content.iter_chunked(_CHUNK):
+                    yield data
+            finally:
+                resp.close()
+
+        return SourceResponse(
+            status=resp.status, content_length=length,
+            total_length=int(layer.get("size", -1)), supports_range=True,
+            header=dict(resp.headers), chunks=chunks())
+
+    async def list(self, req: SourceRequest) -> list[ListEntry]:
+        return [ListEntry(url=req.url, name=req.url.rsplit("/", 1)[-1],
+                          is_dir=False,
+                          content_length=await self.content_length(req))]
+
+
+register_client(["oras"], ORASSourceClient())

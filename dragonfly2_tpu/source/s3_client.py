@@ -21,7 +21,8 @@ import aiohttp
 
 from ..common.errors import Code, DFError
 from ..common.objectstorage import S3Credentials, _sha256_hex, sign_v4
-from .client import ListEntry, SourceRequest, SourceResponse, register_client
+from .client import (ListEntry, SessionPool, SourceRequest,
+                     SourceResponse, register_client)
 
 _CHUNK = 1 << 20
 
@@ -61,7 +62,7 @@ def _http_url(url: str) -> str:
 
 class S3SourceClient:
     def __init__(self) -> None:
-        self._sessions: dict[int, aiohttp.ClientSession] = {}
+        self._pool = SessionPool()
         self._creds: S3Credentials | None = None
 
     def set_credentials(self, creds: S3Credentials) -> None:
@@ -71,21 +72,10 @@ class S3SourceClient:
         return self._creds or S3Credentials.from_env()
 
     async def _session(self) -> aiohttp.ClientSession:
-        import asyncio
-        loop = asyncio.get_running_loop()
-        s = self._sessions.get(id(loop))
-        if s is None or s.closed:
-            s = aiohttp.ClientSession()
-            self._sessions[id(loop)] = s
-            self._sessions = {k: v for k, v in self._sessions.items()
-                              if not v.closed}
-        return s
+        return await self._pool.get()
 
     async def close(self) -> None:
-        import asyncio
-        s = self._sessions.pop(id(asyncio.get_running_loop()), None)
-        if s is not None and not s.closed:
-            await s.close()
+        await self._pool.close()
 
     def _signed(self, method: str, url: str,
                 headers: dict[str, str]) -> dict[str, str]:

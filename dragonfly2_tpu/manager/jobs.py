@@ -28,9 +28,13 @@ class JobRunner:
 
     async def submit_preheat(self, *, url: str, url_meta: UrlMeta | None = None,
                              cluster_id: int | None = None) -> int:
+        import dataclasses
         job_id = await asyncio.to_thread(
             self.store.create_job, "preheat",
-            {"url": url, "cluster_id": cluster_id})
+            {"url": url, "cluster_id": cluster_id,
+             # persisted so a crash-resume preheats the SAME task id
+             # (UrlMeta participates in the task id)
+             "url_meta": dataclasses.asdict(url_meta) if url_meta else None})
         t = asyncio.get_running_loop().create_task(
             self._run_preheat(job_id, url, url_meta, cluster_id))
         self._running.add(t)
@@ -110,6 +114,54 @@ class JobRunner:
                                for h in hosts]}, True)
 
         await self._fan_out(job_id, cluster_id, "sync_peers", call)
+
+    async def resume_interrupted(self) -> int:
+        """Durable-queue semantics (reference internal/job over Redis keeps
+        jobs across restarts): jobs the previous process left in
+        pending/running are re-dispatched at boot. Both job types are
+        idempotent — preheat re-triggers a seed that may already hold the
+        content, sync_peers just re-reads state."""
+        import json as _json
+
+        # ONE snapshot before any dispatch: spawning from a first query and
+        # then querying again would pick up the same job twice (a spawned
+        # task flips pending->running between the queries)
+        snapshot = [job
+                    for state in ("pending", "running")
+                    for job in await asyncio.to_thread(
+                        lambda s=state: self.store.jobs(state=s))]
+        seen: set[int] = set()
+        resumed = 0
+        for job in snapshot:
+            if job["id"] in seen:
+                continue
+            seen.add(job["id"])
+            args = _json.loads(job["args"] or "{}")
+            if job["type"] == "preheat" and args.get("url"):
+                meta = (UrlMeta(**args["url_meta"])
+                        if args.get("url_meta") else None)
+                t = asyncio.get_running_loop().create_task(
+                    self._run_preheat(job["id"], args["url"], meta,
+                                      args.get("cluster_id")))
+            elif job["type"] == "sync_peers":
+                t = asyncio.get_running_loop().create_task(
+                    self._run_sync_peers(job["id"],
+                                         args.get("cluster_id")))
+            else:
+                # unresumable (unknown type / malformed args): park it in a
+                # terminal state — perpetual 'running' is the stuck state
+                # this scan exists to eliminate
+                await asyncio.to_thread(
+                    self.store.update_job, job["id"], state="failed",
+                    result={"error": f"unresumable job "
+                                     f"type={job['type']!r}"})
+                continue
+            self._running.add(t)
+            t.add_done_callback(self._running.discard)
+            resumed += 1
+        if resumed:
+            log.info("resumed %d interrupted job(s)", resumed)
+        return resumed
 
     async def close(self) -> None:
         for t in list(self._running):

@@ -132,6 +132,9 @@ class Manager:
         self.rpc.register(build_service(self.service))
         await self.rpc.start()
         self.port = self.rpc.port
+        # resume BEFORE the REST listener: a job submitted during the boot
+        # window must not be double-dispatched by the scan
+        await self.jobs.resume_interrupted()
         await self.rest.start()
         self.gc.add(GCTask(
             "keepalive-sweep", self.cfg.sweep_interval_s,

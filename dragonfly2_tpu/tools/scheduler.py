@@ -29,6 +29,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--algorithm", default="",
                    choices=["", "default", "nt", "ml"])
     p.add_argument("--records-dir", default="")
+    p.add_argument("--tracing-jsonl", default="",
+                   help="span export path (tracing off when empty)")
+    p.add_argument("--tracing-otlp", default="",
+                   help="OTLP/HTTP collector endpoint")
     p.add_argument("--verbose", "-v", action="store_true")
     return p
 
@@ -63,6 +67,10 @@ def main(argv: list[str] | None = None) -> int:
         overrides["algorithm"] = args.algorithm
     if args.records_dir:
         overrides["records_dir"] = args.records_dir
+    if args.tracing_jsonl:
+        overrides["tracing_jsonl"] = args.tracing_jsonl
+    if args.tracing_otlp:
+        overrides["tracing_otlp"] = args.tracing_otlp
     cfg = load_config(SchedulerConfig, args.config or None, overrides)
     asyncio.run(serve(cfg))
     return 0

@@ -147,3 +147,36 @@ class TestSyncPeers:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-v"])
+
+
+class TestDurableJobs:
+    def test_interrupted_job_resumes_on_restart(self, tmp_path):
+        """A job left 'running' by a dead manager is re-dispatched when a
+        new manager boots on the same DB (durable-queue semantics)."""
+        async def main():
+            from dragonfly2_tpu.manager.server import (Manager,
+                                                       ManagerConfig)
+            from dragonfly2_tpu.manager.store import Store
+
+            db = str(tmp_path / "m.db")
+            # simulate a crash: a sync_peers job stuck in 'running'
+            store = Store(db)
+            jid = store.create_job("sync_peers", {})
+            store.update_job(jid, state="running")
+            store.close()
+
+            m = Manager(ManagerConfig(listen_ip="127.0.0.1", db_path=db,
+                                      workdir=str(tmp_path)))
+            await m.start()
+            try:
+                for _ in range(100):
+                    job = m.store.job(jid)
+                    if job["state"] in ("succeeded", "failed"):
+                        break
+                    await asyncio.sleep(0.05)
+                # no schedulers registered -> the resumed job FAILS, which
+                # proves it ran to a terminal state instead of staying stuck
+                assert job["state"] == "failed", job
+            finally:
+                await m.stop()
+        asyncio.run(main())

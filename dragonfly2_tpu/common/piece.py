@@ -15,6 +15,13 @@ from .unit import MiB
 
 DEFAULT_PIECE_SIZE = 4 * MiB
 MAX_PIECE_SIZE = 16 * MiB          # reference caps at 15 MiB; we keep a pow2 cap
+
+# One host->HBM DMA unit. Shared by the DeviceIngest auto-sizer (daemon) and
+# the back-source group sizer (piece_manager): ingest shards complete
+# progressively — and their transfers overlap the download — only while a
+# back-source work-queue group is no larger than one ingest shard, so the two
+# sizes must move together.
+INGEST_DMA_UNIT_BYTES = 32 * MiB
 _GROWTH_STEP_BYTES = 100 * MiB     # grow 1 MiB per 100 MiB beyond the threshold
 _GROWTH_THRESHOLD = 200 * MiB
 

@@ -105,11 +105,14 @@ class Daemon:
             from ..tpu.hbm_sink import DeviceIngest
             spd = spec.pipeline_shards
             if spd <= 0:
-                # auto: ~32 MiB DMA units so streaming overlaps the
-                # download even on a 1-chip host, bounded so tiny tasks
-                # don't shatter into no-op transfers
+                # auto: one shard per DMA unit. Measured on the real chip:
+                # smaller units lose (8 MiB ≈ serial, 16-per-file
+                # pathological); the overlap comes from back-source's
+                # front-to-back work-queue coverage completing these units
+                # progressively, not from shrinking them.
+                from ..common.piece import INGEST_DMA_UNIT_BYTES
                 per_dev = -(-content_length // len(jax.devices()))
-                spd = max(1, min(32, per_dev // (32 << 20)))
+                spd = max(1, min(32, per_dev // INGEST_DMA_UNIT_BYTES))
             return DeviceIngest(content_length, dtype=spec.dtype,
                                 shards_per_device=spd)
         return factory
@@ -166,6 +169,8 @@ class Daemon:
                 log.info("fleet certificate renewed")
             except Exception as exc:  # noqa: BLE001 - retry next cycle
                 log.error("fleet certificate renewal failed: %s", exc)
+
+    _active_in_process = 0   # daemons started but not yet stopped (this proc)
 
     async def start(self) -> None:
         if self.cfg.plugin_dir:
@@ -278,6 +283,12 @@ class Daemon:
             from .networktopology import NetworkTopologyProber
             self.prober = NetworkTopologyProber(self)
             await self.prober.start()
+        # counted only after everything above succeeded, consumed exactly
+        # once by stop(): a failed start() or a double stop() must neither
+        # strand the count high (leak fix disabled) nor drive it to zero
+        # early (shared sessions yanked from a still-running daemon)
+        self._counted_active = True
+        Daemon._active_in_process += 1
         log.info("daemon up: host=%s ip=%s rpc=%s upload=%d sock=%s seed=%s",
                  self.hostname, self.host_ip, self.rpc.port,
                  self.upload_server.port, sock, self.cfg.is_seed)
@@ -353,3 +364,12 @@ class Daemon:
                 await self.scheduler.leave_host()
             if hasattr(self.scheduler, "close"):
                 await self.scheduler.close()
+        # source-client sessions are process singletons shared by every
+        # co-resident daemon: close them only when the LAST daemon leaves,
+        # or asyncio reports them leaked on loop close (bench tpu phase)
+        if getattr(self, "_counted_active", False):
+            self._counted_active = False
+            Daemon._active_in_process -= 1
+            if Daemon._active_in_process == 0:
+                from ..source.client import close_clients
+                await close_clients()

@@ -91,6 +91,22 @@ async def download(req: SourceRequest) -> SourceResponse:
     return await client_for(req.url).download(req)
 
 
+async def close_clients() -> None:
+    """Close every registered client's session bound to the CURRENT loop.
+
+    In-process daemons (tests, the bench's tpu phase) share the process-wide
+    client registry; without this their back-source aiohttp sessions outlive
+    ``Daemon.stop()`` and asyncio reports them as leaked on loop close."""
+    seen: set[int] = set()
+    for client in _REGISTRY.values():
+        if id(client) in seen:
+            continue
+        seen.add(id(client))
+        close = getattr(client, "close", None)
+        if close is not None:
+            await close()
+
+
 def timeout_for(req: "SourceRequest"):
     """Per-request aiohttp timeout: honor req.timeout_s; otherwise no total
     cap (multi-GB origin streams legitimately run >5min) with sane

@@ -30,6 +30,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -130,6 +131,10 @@ class DeviceIngest:
         self._shard_arrays: list[Any | None] = [None] * n
         self._shard_sent = [False] * n       # transfer COMPLETED
         self._shard_queued = [False] * n     # enqueued to the worker
+        # (monotonic start, end) of each completed device transfer — lets
+        # callers measure how much DMA ran concurrently with the download
+        # without run-to-run wall-clock subtraction (bench + tracing)
+        self.transfer_spans: list[tuple[float, float]] = []
         self._lock = threading.Lock()
         self._device_put = device_put_fn or jax.device_put
         self._queue: queue.SimpleQueue = queue.SimpleQueue()
@@ -198,10 +203,18 @@ class DeviceIngest:
                 s, e = shard * self.shard_bytes, (shard + 1) * self.shard_bytes
                 view = self.host[s:e].view(self.dtype)
                 device = self.devices[shard // self.shards_per_device]
+                t0 = time.monotonic()
                 arr = self._device_put(view, device)
+                # span must end at transfer COMPLETION, not dispatch — on
+                # backends where device_put returns before the DMA lands,
+                # a dispatch-end span would report overlap that never ran
+                wait = getattr(arr, "block_until_ready", None)
+                if wait is not None:
+                    wait()
                 with self._lock:
                     self._shard_arrays[shard] = arr
                     self._shard_sent[shard] = True
+                    self.transfer_spans.append((t0, time.monotonic()))
                 log.debug("shard %d/%d -> %s", shard, self.n_shards, device)
             except BaseException as exc:  # noqa: BLE001 - surfaced by result()
                 with self._lock:

@@ -345,3 +345,86 @@ class TestGCAbandoned:
         with _pytest.raises(_DFError) as ei:
             sub.write_piece(0, 900, b"x" * 4096)
         assert ei.value.code == _Code.CLIENT_STORAGE_ERROR
+
+
+class TestPieceGroupWorkQueue:
+    """Back-source piece groups are a dynamic work queue, not a static
+    per-worker partition: a fast origin stream claims more groups, and a
+    large file produces more groups than workers (front-to-back coverage —
+    what lets DeviceIngest shards ship mid-download)."""
+
+    def _run(self, n_pieces, piece_size, slow_first_group):
+        from dragonfly2_tpu.daemon.config import DownloadConfig
+        from dragonfly2_tpu.daemon.piece_manager import PieceManager
+        from dragonfly2_tpu.source import SourceResponse, register_client
+        from dragonfly2_tpu.source.client import SourceRequest
+
+        total = n_pieces * piece_size
+        payload = bytes(total)
+        requests: list = []
+
+        class FakeClient:
+            async def content_length(self, req):
+                return total
+
+            async def supports_range(self, req):
+                return True
+
+            async def last_modified(self, req):
+                return ""
+
+            async def list(self, req):
+                return []
+
+            async def download(self, req: SourceRequest) -> SourceResponse:
+                start = req.range.start if req.range else 0
+                length = req.range.length if req.range else total
+                requests.append((start, length))
+                first_group = start == 0 and slow_first_group
+
+                async def chunks():
+                    body = payload[start:start + length]
+                    for i in range(0, len(body), piece_size):
+                        if first_group:
+                            await asyncio.sleep(0.05)
+                        yield body[i:i + piece_size]
+                return SourceResponse(status=206, content_length=length,
+                                      total_length=total, supports_range=True,
+                                      chunks=chunks())
+
+        register_client("groupq", FakeClient())
+        pm = PieceManager(DownloadConfig(back_source_group_min_bytes=1))
+        landed: list[tuple[int, int]] = []
+
+        class FakeConductor:
+            rate_limiter = None
+
+            async def on_piece_from_source(self, num, rel, data, cost_ms):
+                landed.append((num, len(data)))
+
+        async def go():
+            await pm._download_piece_groups(
+                FakeConductor(),
+                SourceRequest(url="groupq://f"),
+                total, piece_size, n_pieces)
+
+        asyncio.run(go())
+        return requests, landed
+
+    def test_small_file_keeps_one_group_per_worker(self):
+        # group_pieces = min(32MiB // piece_size, ceil(n / workers)): with
+        # 64 × 64 KiB pieces the ceil(64/4)=16 bound wins -> exactly 4
+        # groups, same request count as the old static split
+        requests, landed = self._run(64, 64 * 1024, slow_first_group=False)
+        assert sorted(num for num, _ in landed) == list(range(64))
+        assert sum(size for _, size in landed) == 64 * 64 * 1024
+        assert len(requests) == 4
+
+    def test_fast_workers_steal_groups_from_slow(self):
+        # piece_size 1 MiB, 40 pieces -> group_pieces = min(32, ceil(40/4))
+        # = 10 ... to get >workers groups use piece_size 8 MiB: group_pieces
+        # = min(4, 10) = 4 -> 10 groups over 4 workers; the slow worker
+        # (first group) must not strand the tail: others drain the queue
+        requests, landed = self._run(40, 8 * 1024 * 1024, slow_first_group=True)
+        assert sorted(num for num, _ in landed) == list(range(40))
+        assert len(requests) == 10

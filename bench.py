@@ -55,6 +55,12 @@ def ensure_native() -> None:
     if not os.path.exists(so):
         subprocess.run(["make", "-C", os.path.join(REPO, "native")],
                        capture_output=True, check=False)
+    # environments with PYTHONDONTWRITEBYTECODE make every spawned role
+    # re-compile the whole package (~170 modules, seconds per process, ×17
+    # processes): compile once so the .pyc cache serves the fleet
+    import compileall
+    compileall.compile_dir(os.path.join(REPO, "dragonfly2_tpu"),
+                           quiet=2, workers=0)
 
 
 def base_tmp() -> str:
@@ -136,7 +142,12 @@ async def role_seed(workdir: str) -> None:
 
     cfg = DaemonConfig(workdir=workdir, host_ip="127.0.0.1", hostname="seed",
                        is_seed=True,
-                       upload=UploadConfig(rate_limit_bps=int(NIC_MBPS * 1e6)),
+                       upload=UploadConfig(
+                           rate_limit_bps=int(NIC_MBPS * 1e6),
+                           # live /debug/{stacks,profile} on the upload port
+                           # for wave-stall investigations
+                           debug_endpoints=bool(
+                               os.environ.get("BENCH_DEBUG_DIR"))),
                        storage=StorageSection(gc_interval_s=3600))
     daemon = Daemon(cfg)
     await daemon.start()
@@ -160,16 +171,22 @@ async def role_leecher(workdir: str, name: str, sched_addr: str,
                        url: str) -> None:
     from dragonfly2_tpu.daemon.config import (DaemonConfig,
                                               SchedulerConfig as DSched,
-                                              StorageSection, UploadConfig)
+                                              StorageSection, TracingConfig,
+                                              UploadConfig)
     from dragonfly2_tpu.daemon.daemon import Daemon
     from dragonfly2_tpu.idl.messages import DownloadRequest
     from dragonfly2_tpu.rpc.client import Channel, ServiceClient
 
+    dbg = os.environ.get("BENCH_DEBUG_DIR")
     cfg = DaemonConfig(workdir=workdir, host_ip="127.0.0.1", hostname=name,
                        scheduler=DSched(addresses=[sched_addr],
                                         schedule_timeout_s=60.0),
                        upload=UploadConfig(rate_limit_bps=int(NIC_MBPS * 1e6)),
-                       storage=StorageSection(gc_interval_s=3600))
+                       storage=StorageSection(gc_interval_s=3600),
+                       tracing=TracingConfig(
+                           enabled=bool(dbg),
+                           jsonl_path=dbg and os.path.join(
+                               dbg, f"{name}.traces.jsonl") or ""))
     daemon = Daemon(cfg)
     await daemon.start()
     print("READY", flush=True)
@@ -569,21 +586,24 @@ def main() -> None:
         daemons.append(sched)
         sched_addr = sched.read_json()["addr"]
 
-        # wave A: half-size fan-out on a cold task (sublinearity reference)
+        # Interleaved half/full cold waves, MEDIAN of each: one wave's
+        # wall-clock on this shared host swings 2-3x within minutes, so a
+        # single half wave against median-of-3 full waves measures drift,
+        # not sublinearity (one run read 8.9x from a lucky half wave).
+        # Alternating H,F,H,F,... exposes both sizes to the same drift.
         n_half = max(N_LEECHERS // 2, 1)
-        pre = origin_bytes()
-        half_s, _, half_cpu = fanout_wave(workdir, "h", n_half, sched_addr,
-                                f"{origin_base}/wave-half.bin", daemons)
-        half_egress = origin_bytes() - pre
-        log(f"fan-out {n_half} leechers (cold): {half_s:.2f}s "
-            f"(origin egress {half_egress / 1e6:.0f} MB)")
-
-        # wave B: the measured fan-out — MEDIAN of 3 cold waves. One wave's
-        # wall-clock on a contended host swings +-25%; the driver records a
-        # single bench invocation, so the stabilization has to live here.
         runs = []
+        half_runs = []
         n_runs = int(os.environ.get("BENCH_FANOUT_RUNS", "3"))
         for r in range(n_runs):
+            pre = origin_bytes()
+            half_s_r, _, half_cpu_r = fanout_wave(
+                workdir, f"h{r}x", n_half, sched_addr,
+                f"{origin_base}/wave-half-{r}.bin", daemons)
+            half_egress = origin_bytes() - pre
+            half_runs.append({"elapsed_s": half_s_r, "cpu": half_cpu_r})
+            log(f"fan-out {n_half} leechers (half run {r}): {half_s_r:.2f}s "
+                f"(origin egress {half_egress / 1e6:.0f} MB)")
             pre = origin_bytes()
             fanout_s, seed_fracs, full_cpu = fanout_wave(
                 workdir, f"l{r}x", N_LEECHERS, sched_addr,
@@ -609,6 +629,11 @@ def main() -> None:
         fanout_s, p2p_egress, full_cpu = (med["elapsed_s"], med["egress"],
                                           med["cpu"])
         seed_fracs = med["seed_fracs"]
+        # elapsed AND cpu from the median half run (mixing the median
+        # elapsed with the last run's cpu pairs different machine moments)
+        half_runs.sort(key=lambda h: h["elapsed_s"])
+        half_med = half_runs[len(half_runs) // 2]
+        half_s, half_cpu = half_med["elapsed_s"], half_med["cpu"]
         egress_saved = 1.0 - p2p_egress / max(direct_egress, 1)
         max_seed_frac = max(seed_fracs) if seed_fracs else 0.0
         log(f"framework fan-out (median of {n_runs}): {N_LEECHERS} leechers "
@@ -627,6 +652,17 @@ def main() -> None:
         for p in daemons:
             p.kill()
         import shutil
+        if os.environ.get("BENCH_DEBUG_DIR"):
+            # keep the role daemons' file logs (dflog writes per-concern
+            # files under each workdir, not stderr) for stall forensics
+            dst = os.path.join(os.environ["BENCH_DEBUG_DIR"], "workdir")
+            shutil.rmtree(dst, ignore_errors=True)
+            try:
+                shutil.copytree(workdir, dst,
+                                ignore=shutil.ignore_patterns(
+                                    "*.bin", "*.out", "data", "pieces"))
+            except Exception:  # noqa: BLE001 - forensics only
+                pass
         shutil.rmtree(workdir, ignore_errors=True)
 
     delivered_gb = (SIZE_MB << 20) * N_LEECHERS / 1e9
@@ -645,6 +681,7 @@ def main() -> None:
         "wave_cpu_util": {"half": round(half_cpu, 3),
                           "full": round(full_cpu, 3)},
         "fanout_runs_s": [round(r["elapsed_s"], 2) for r in runs],
+        "half_runs_s": [round(h["elapsed_s"], 2) for h in half_runs],
         **tpu_stats,
     }))
 

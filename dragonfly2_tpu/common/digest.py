@@ -46,9 +46,11 @@ def hash_bytes(algo: str, data: bytes | memoryview) -> str:
         out = native.hash_bytes(algo, data)
         if out is not None:
             return out
-        return f"{_crc32c_py(bytes(data)):08x}"
+        return f"{_crc32c_py(data):08x}"
     if algo == "crc32":
-        return f"{zlib.crc32(bytes(data)) & 0xFFFFFFFF:08x}"
+        # zlib.crc32 takes any buffer — a bytes() conversion here would
+        # re-copy every piece on hosts without the native lib
+        return f"{zlib.crc32(data) & 0xFFFFFFFF:08x}"
     if algo == "blake2b":
         return hashlib.blake2b(data, digest_size=32).hexdigest()
     return hashlib.new(algo, data).hexdigest()
@@ -121,7 +123,7 @@ _CRC32C_POLY = 0x82F63B78
 _crc32c_table: list[int] | None = None
 
 
-def _crc32c_py(data: bytes, crc: int = 0) -> int:
+def _crc32c_py(data, crc: int = 0) -> int:
     global _crc32c_table
     if _crc32c_table is None:
         tbl = []

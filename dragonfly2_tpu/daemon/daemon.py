@@ -232,7 +232,8 @@ class Daemon:
             scheduler=None,
             p2p_engine_factory=engine_factory,
             device_sink_builder=self.device_sink_builder,
-            is_seed=self.cfg.is_seed, shaper=self.shaper)
+            is_seed=self.cfg.is_seed, shaper=self.shaper,
+            prefetch_whole_file=self.cfg.download.prefetch_whole_file)
         svc = DaemonService(self.ptm,
                             upload_addr=f"{self.host_ip}:{self.upload_server.port}")
         # fleet mTLS: enroll with the manager, serve the peer RPC port with

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from dataclasses import replace
 from typing import Any, AsyncIterator
 
 from ..common import ids
@@ -28,7 +29,7 @@ class PeerTaskManager:
                  hostname: str, host_ip: str, scheduler: Any = None,
                  p2p_engine_factory: Any = None,
                  device_sink_builder: Any = None, is_seed: bool = False,
-                 shaper: Any = None):
+                 shaper: Any = None, prefetch_whole_file: bool = False):
         self.storage_mgr = storage_mgr
         self.piece_mgr = piece_mgr
         self.hostname = hostname
@@ -38,7 +39,12 @@ class PeerTaskManager:
         self.device_sink_builder = device_sink_builder
         self.is_seed = is_seed
         self.shaper = shaper
+        self.prefetch_whole_file = prefetch_whole_file
         self._conductors: dict[str, PeerTaskConductor] = {}
+        self._prefetching: set[str] = set()
+        # strong refs: the loop only weak-refs tasks, and a GC'd prefetch
+        # would strand its id in _prefetching forever
+        self._prefetch_tasks: set[asyncio.Task] = set()
         self._lock = asyncio.Lock()
 
     # ------------------------------------------------------------------
@@ -87,6 +93,28 @@ class PeerTaskManager:
     def conductor(self, task_id: str) -> PeerTaskConductor | None:
         return self._conductors.get(task_id)
 
+    def _start_prefetch(self, url: str, meta: UrlMeta) -> None:
+        """Fire-and-forget whole-file download backing a ranged request."""
+        whole = replace(meta, range="")
+        task_id = self._task_id(url, whole)
+        if (task_id in self._prefetching
+                or self.storage_mgr.find_completed_task(task_id) is not None):
+            return
+        self._prefetching.add(task_id)
+
+        async def run() -> None:
+            try:
+                conductor = await self.get_or_create_conductor(url, whole)
+                await conductor.wait_done()
+            except Exception:  # noqa: BLE001 - prefetch is best-effort
+                log.exception("whole-file prefetch of %s failed", url)
+            finally:
+                self._prefetching.discard(task_id)
+
+        t = asyncio.get_running_loop().create_task(run())
+        self._prefetch_tasks.add(t)
+        t.add_done_callback(self._prefetch_tasks.discard)
+
     # ------------------------------------------------------------------
     # file task: download -> progress events -> land at output path
     # ------------------------------------------------------------------
@@ -107,8 +135,15 @@ class PeerTaskManager:
                 digest=meta.digest,
                 filtered_query_params=list(meta.filtered_query_params or []))
             parent = self.storage_mgr.get(parent_id)
-            if (parent is not None and getattr(parent.md, "done", False)
-                    and parent.md.content_length >= 0):
+            parent_done = (parent is not None
+                           and getattr(parent.md, "done", False)
+                           and parent.md.content_length >= 0)
+            if self.prefetch_whole_file and not parent_done:
+                # warm the whole file in the background so later ranged
+                # requests are local subtask reads (reference
+                # ``client/daemon/peer/peertask_manager.go:262-287``)
+                self._start_prefetch(req.url, meta)
+            if parent_done:
                 total = parent.md.content_length
                 try:
                     rng = parse_http_range(meta.range, total)

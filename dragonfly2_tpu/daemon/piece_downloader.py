@@ -60,8 +60,33 @@ class PieceDownloader:
         if self._session is not None and not self._session.closed:
             await self._session.close()
 
+    @staticmethod
+    async def _read_body(resp, size: int, hasher, what: str) -> bytearray:
+        """Stream the body into ONE preallocated buffer, folding each
+        cache-hot chunk into the digest as it arrives. Replaces
+        ``resp.read()``: no chunk-list join copy, and no second cold
+        traversal of a 4-16 MiB piece just to hash it — per-byte CPU is
+        the fan-out ceiling on core-bound hosts."""
+        buf = bytearray(size)
+        mv = memoryview(buf)
+        off = 0
+        async for chunk in resp.content.iter_any():
+            n = len(chunk)
+            if off + n > size:
+                raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                              f"{what}: long read {off + n} > {size}")
+            mv[off:off + n] = chunk
+            if hasher is not None:
+                hasher.update(chunk)
+            off += n
+        if off != size:
+            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                          f"{what}: short read {off}/{size}")
+        return buf
+
     async def download_piece(self, *, dst_addr: str, task_id: str,
-                             src_peer_id: str, piece: PieceInfo) -> tuple[bytes, int]:
+                             src_peer_id: str, piece: PieceInfo
+                             ) -> tuple[bytearray, int]:
         """Fetch one piece from a parent. Returns (data, cost_ms).
 
         Raises CLIENT_PIECE_DOWNLOAD_FAIL on transport/status errors and
@@ -74,6 +99,10 @@ class PieceDownloader:
         tp = tracing.traceparent()
         if tp:   # trace ctx rides the piece request (ref piece_downloader.go:227)
             headers["traceparent"] = tp
+        what = f"parent {dst_addr} piece {piece.piece_num}"
+        algo = want = ""
+        if piece.digest:
+            algo, want = digestlib.parse(piece.digest)
         t0 = time.monotonic()
         try:
             async with self._get_session().get(
@@ -82,51 +111,52 @@ class PieceDownloader:
                 if resp.status == 503:
                     # upload-slot backpressure: the parent is at its
                     # concurrency limit, not broken — the dispatcher reroutes
-                    # the piece to another holder or retries shortly
-                    raise DFError(Code.CLIENT_PEER_BUSY,
+                    # the piece to another holder or retries after the
+                    # parent's measured-transfer-time hint
+                    err = DFError(Code.CLIENT_PEER_BUSY,
                                   f"parent {dst_addr} busy")
+                    try:
+                        err.retry_after_ms = int(
+                            resp.headers.get("X-Retry-After-Ms", "0"))
+                    except ValueError:
+                        err.retry_after_ms = 0
+                    raise err
                 if resp.status not in (200, 206):
                     raise DFError(
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                        f"parent {dst_addr} piece {piece.piece_num}: "
-                        f"HTTP {resp.status}")
-                data = await resp.read()
+                        f"{what}: HTTP {resp.status}")
+                hasher = digestlib.Hasher(algo) if algo else None
+                data = await self._read_body(resp, size, hasher, what)
         except DFError:
             raise
         except Exception as exc:  # noqa: BLE001 - network boundary
             raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                          f"parent {dst_addr} piece {piece.piece_num}: "
-                          f"{type(exc).__name__}: {exc}") from None
+                          f"{what}: {type(exc).__name__}: {exc}") from None
         cost_ms = int((time.monotonic() - t0) * 1000)
-        if len(data) != size:
-            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                          f"parent {dst_addr} piece {piece.piece_num}: short "
-                          f"read {len(data)}/{size}")
-        if piece.digest:
-            algo, want = digestlib.parse(piece.digest)
-            got = digestlib.hash_bytes(algo, data)
-            if got != want:
-                raise DFError(Code.CLIENT_DIGEST_MISMATCH,
-                              f"piece {piece.piece_num} from {dst_addr}: "
-                              f"digest mismatch")
+        if hasher is not None and hasher.hexdigest() != want:
+            raise DFError(Code.CLIENT_DIGEST_MISMATCH,
+                          f"piece {piece.piece_num} from {dst_addr}: "
+                          f"digest mismatch")
         return data, cost_ms
 
     async def download_span(self, *, dst_addr: str, task_id: str,
                             src_peer_id: str, pieces: list[PieceInfo],
-                            ) -> tuple[list[tuple[PieceInfo, bytes]], int]:
+                            ) -> tuple[list[tuple[PieceInfo, memoryview]], int]:
         """Fetch CONTIGUOUS pieces in one ranged GET; split + verify each.
 
         Returns ([(piece, data), ...] for every piece whose digest checked
-        out, cost_ms). A digest mismatch drops that piece (the dispatcher
-        requeues it) without failing its groupmates. Transport errors raise
-        like ``download_piece``.
+        out, cost_ms) — data items are memoryviews over one shared buffer
+        (zero per-piece copies; consumers write them to storage and drop
+        them). A digest mismatch drops that piece (the dispatcher requeues
+        it) without failing its groupmates. Transport errors raise like
+        ``download_piece``.
         """
         if len(pieces) == 1:
             p = pieces[0]
             data, cost = await self.download_piece(
                 dst_addr=dst_addr, task_id=task_id,
                 src_peer_id=src_peer_id, piece=p)
-            return [(p, data)], cost
+            return [(p, memoryview(data))], cost
         url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start = pieces[0].range_start
         size = sum(p.range_size for p in pieces)
@@ -134,32 +164,33 @@ class PieceDownloader:
         tp = tracing.traceparent()
         if tp:
             headers["traceparent"] = tp
+        what = f"parent {dst_addr} span @{start}+{size}"
         t0 = time.monotonic()
         try:
             async with self._get_session().get(
                     url, headers=headers,
                     params={"peerId": src_peer_id}) as resp:
                 if resp.status == 503:
-                    raise DFError(Code.CLIENT_PEER_BUSY,
+                    err = DFError(Code.CLIENT_PEER_BUSY,
                                   f"parent {dst_addr} busy")
+                    try:
+                        err.retry_after_ms = int(
+                            resp.headers.get("X-Retry-After-Ms", "0"))
+                    except ValueError:
+                        err.retry_after_ms = 0
+                    raise err
                 if resp.status not in (200, 206):
                     raise DFError(
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                        f"parent {dst_addr} span @{start}+{size}: "
-                        f"HTTP {resp.status}")
-                data = await resp.read()
+                        f"{what}: HTTP {resp.status}")
+                data = await self._read_body(resp, size, None, what)
         except DFError:
             raise
         except Exception as exc:  # noqa: BLE001 - network boundary
             raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                          f"parent {dst_addr} span @{start}+{size}: "
-                          f"{type(exc).__name__}: {exc}") from None
+                          f"{what}: {type(exc).__name__}: {exc}") from None
         cost_ms = int((time.monotonic() - t0) * 1000)
-        if len(data) != size:
-            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                          f"parent {dst_addr} span @{start}: short read "
-                          f"{len(data)}/{size}")
-        out: list[tuple[PieceInfo, bytes]] = []
+        out: list[tuple[PieceInfo, memoryview]] = []
         view = memoryview(data)
         off = 0
         for p in pieces:
@@ -171,5 +202,5 @@ class PieceDownloader:
                     log.debug("span piece %d from %s: digest mismatch",
                               p.piece_num, dst_addr)
                     continue
-            out.append((p, bytes(chunk)))
+            out.append((p, chunk))
         return out, cost_ms

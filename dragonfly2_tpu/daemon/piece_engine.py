@@ -394,7 +394,8 @@ class PieceEngine:
                 # backpressure, not failure: requeue; no scheduler report
                 # (a busy seed must not land on the blocklist)
                 _p2p_pieces.labels("busy").inc()
-                await self.dispatcher.report_busy(d)
+                await self.dispatcher.report_busy(
+                    d, retry_after_ms=getattr(exc, "retry_after_ms", 0))
                 return
             _p2p_pieces.labels("fail").inc()
             log.debug("pieces %s from %s failed: %s",

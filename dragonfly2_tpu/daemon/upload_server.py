@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
+from collections import deque
 
 from aiohttp import web
 
@@ -38,19 +40,40 @@ class _Slot:
     path (the round-3 defect: with rate_limit_bps=0 the slot was held for
     microseconds and the 503 backpressure never engaged)."""
 
-    __slots__ = ("server", "released")
+    __slots__ = ("server", "released", "t0")
 
-    def __init__(self, server: "UploadServer"):
+    def __init__(self, server: "UploadServer", *, adopted: bool = False):
+        """``adopted``: this slot's capacity was transferred from a
+        releasing transfer (queued-request handoff) — _active already
+        counts it."""
         self.server = server
         self.released = False
-        server._active += 1
-        _upload_active.set(server._active)
+        self.t0 = time.monotonic()
+        if not adopted:
+            server._active += 1
+            _upload_active.set(server._active)
 
     def release(self) -> None:
         if not self.released:
             self.released = True
-            self.server._active -= 1
-            _upload_active.set(self.server._active)
+            srv = self.server
+            # feed the busy-hint EWMA with the observed hold time
+            held_ms = (time.monotonic() - self.t0) * 1000.0
+            srv._transfer_ms = (0.8 * srv._transfer_ms + 0.2 * held_ms
+                                if srv._transfer_ms > 0 else held_ms)
+            srv._transfer_ms_at = time.monotonic()
+            # hand the slot STRAIGHT to the longest-queued request
+            # (ownership transfer, _active unchanged): decrementing first
+            # would let a fresh arrival's gate check win the race against
+            # the woken waiter's resume — inverted fairness where the
+            # longest-waiting request is the one that 503s
+            while srv._slot_waiters:
+                fut = srv._slot_waiters.popleft()
+                if not fut.done():   # timed-out waiters are cancelled
+                    fut.set_result(None)
+                    return
+            srv._active -= 1
+            _upload_active.set(srv._active)
 
 
 class _SlotFileResponse(web.FileResponse):
@@ -102,6 +125,8 @@ class UploadServer:
     # would never carry a byte). A few concurrent transfers keep the NIC
     # full; more only dilute each one.
     DEFAULT_CONCURRENT_LIMIT = 6
+    # how long a request may queue for a slot before 503ing (see the gate)
+    SLOT_WAIT_S = 0.2
 
     def __init__(self, storage_mgr: StorageManager, *, port: int = 0,
                  rate_limit_bps: int = 0, concurrent_limit: int = 0,
@@ -114,6 +139,9 @@ class UploadServer:
         self.concurrent_limit = concurrent_limit or self.DEFAULT_CONCURRENT_LIMIT
         self.debug_endpoints = debug_endpoints
         self._active = 0
+        self._transfer_ms = 0.0     # EWMA slot-hold time -> 503 retry hint
+        self._transfer_ms_at = 0.0  # when the EWMA last saw a real transfer
+        self._slot_waiters: deque = deque()
         self._runner: web.AppRunner | None = None
 
     async def start(self) -> None:
@@ -196,11 +224,47 @@ class UploadServer:
             _upload_reqs.labels("416").inc()
             raise web.HTTPRequestRangeNotSatisfiable(
                 text=f"bytes {rng.start}+{rng.length} not stored yet")
-        if self._active >= self.concurrent_limit:
-            _upload_reqs.labels("503").inc()
-            raise web.HTTPServiceUnavailable(
-                text="upload concurrency limit", headers={"Retry-After": "0"})
-        slot = _Slot(self)   # held until the BODY is sent (slot classes)
+        slot = None
+        if self._active >= self.concurrent_limit or self._slot_waiters:
+            # bounded slot wait BEFORE 503ing: when the gate is full but
+            # moving, queueing ~one transfer-time is far cheaper than the
+            # client's error round-trip + re-dispatch. Only a gate that
+            # stays saturated past the wait answers 503 — with a measured
+            # retry hint, so clients back off for one observed transfer
+            # time instead of hammering (the r04 storm: 40 ms blind retries
+            # against a seed mid-transfer outnumbered real downloads).
+            # Fresh arrivals queue behind existing waiters (FIFO); a
+            # releasing transfer hands its slot to the queue head.
+            deadline = time.monotonic() + self.SLOT_WAIT_S
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    _upload_reqs.labels("503").inc()
+                    # a congested-era EWMA must not dictate backoffs after
+                    # the burst has passed (one bad wave would slow every
+                    # later one): hints older than ~10 transfer-times decay
+                    # to the floor
+                    ewma = self._transfer_ms
+                    age_ms = (time.monotonic() - self._transfer_ms_at) * 1e3
+                    if ewma > 0 and age_ms > 10 * max(ewma, 100.0):
+                        ewma = 0.0
+                    hint_ms = int(min(max(ewma, 50.0), 2000.0))
+                    raise web.HTTPServiceUnavailable(
+                        text="upload concurrency limit",
+                        headers={"Retry-After": str(-(-hint_ms // 1000)),
+                                 "X-Retry-After-Ms": str(hint_ms)})
+                fut = asyncio.get_running_loop().create_future()
+                self._slot_waiters.append(fut)
+                try:
+                    await asyncio.wait_for(fut, remaining)
+                except asyncio.TimeoutError:
+                    continue   # loop re-checks the deadline and 503s
+                # a releasing transfer handed us its slot (ownership
+                # transfer — _active already counts it)
+                slot = _Slot(self, adopted=True)
+                break
+        if slot is None:
+            slot = _Slot(self)   # held until the BODY is sent (slot classes)
         try:
             # whole-file tasks: serve via sendfile (FileResponse honors
             # Range) so piece bytes never enter Python — the upload path is

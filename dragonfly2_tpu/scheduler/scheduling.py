@@ -40,14 +40,30 @@ class Scheduling:
         random.shuffle(pool)
         out: list[Peer] = []
         for parent in pool:
-            if len(out) >= self.cfg.filter_parent_limit:
+            full = len(out) >= self.cfg.filter_parent_limit
+            if full and any(p.has_content() for p in out):
                 break
+            if full and not parent.has_content():
+                # truncated but holderless so far: keep scanning for a
+                # content-holder only — a fan-out wider than the filter
+                # limit could otherwise sample nothing but pieceless
+                # siblings and the offer would never name the seed
+                continue
             if parent.id == child.id:
                 continue
             if child.is_blocked(parent.id):
                 self._trace(child, parent, "blocklist")
                 continue
-            if not parent.has_content():
+            if not parent.has_content() and parent.is_done():
+                # finished-but-empty (failed) peers serve nothing. RUNNING
+                # pieceless siblings stay IN: the engine dispatches only to
+                # announcers, so they cost one sync stream — and that stream
+                # is how a child hears a sibling's first piece the moment it
+                # lands. Requiring content here meant every child's initial
+                # packet named only the seed, sibling meshing waited on
+                # first-piece top-ups, and a congested seed kept the mesh
+                # from ever forming (the r04 bimodal collapse: 18s waves
+                # with try=51 against the seed while siblings held pieces).
                 continue
             # a parent this child is ALREADY assigned to holds its edge (and
             # slot) — re-checking free slots would evict current parents of
@@ -72,6 +88,18 @@ class Scheduling:
             log.debug("filter %s: parent %s excluded (%s)",
                       child.id[-12:], parent.id[-12:], reason)
 
+    @staticmethod
+    def _ensure_holder(scored: list[Peer], top: list[Peer]) -> list[Peer]:
+        """Keep ≥1 content-holder in the offer when one exists: an offer of
+        only pieceless siblings (local links can outscore the remote seed)
+        would leave the child subscribed to peers that may never announce."""
+        if any(p.has_content() for p in top):
+            return top
+        holder = next((p for p in scored if p.has_content()), None)
+        if holder is None:
+            return top
+        return [*top[:-1], holder] if top else [holder]
+
     def find_parents(self, child: Peer) -> list[Peer]:
         candidates = self.filter_candidates(child)
         if not candidates:
@@ -82,7 +110,8 @@ class Scheduling:
             key=lambda p: self.evaluator.evaluate(child, p,
                                                   total_piece_count=total),
             reverse=True)
-        return scored[:self.cfg.candidate_parent_limit]
+        return self._ensure_holder(scored,
+                                   scored[:self.cfg.candidate_parent_limit])
 
     def refresh_parents(self, child: Peer) -> list[Peer]:
         """Sticky variant of ``find_parents`` for mid-download re-offers:
@@ -99,7 +128,8 @@ class Scheduling:
             reverse=True)
         kept = [p for p in scored if p.id in child.last_offer_ids]
         fresh = [p for p in scored if p.id not in child.last_offer_ids]
-        return (kept + fresh)[:self.cfg.candidate_parent_limit]
+        return self._ensure_holder(
+            scored, (kept + fresh)[:self.cfg.candidate_parent_limit])
 
     # ------------------------------------------------------------------
 

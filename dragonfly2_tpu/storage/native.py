@@ -82,25 +82,40 @@ def available() -> bool:
     return load() is not None
 
 
-def crc32c_update(data: bytes | memoryview, seed: int) -> int | None:
+def _buf_arg(data) -> tuple:
+    """(c_char_p-compatible pointer, length) WITHOUT copying writable
+    buffers: bytes pass through; bytearray / writable memoryview expose
+    their storage via from_buffer. Only readonly views pay a copy. The
+    download path hands 4-16 MiB bytearrays here — a per-piece bytes()
+    conversion would re-copy every P2P byte."""
+    if isinstance(data, bytes):
+        return data, len(data)
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    if mv.readonly or not mv.contiguous:
+        b = mv.tobytes()
+        return b, len(b)
+    n = mv.nbytes
+    return ctypes.cast((ctypes.c_char * n).from_buffer(mv),
+                       ctypes.c_char_p), n
+
+
+def crc32c_update(data: bytes | bytearray | memoryview, seed: int) -> int | None:
     """Chainable crc32c via the native lib, or None to signal fallback."""
     lib = load()
     if lib is None:
         return None
-    if isinstance(data, memoryview):
-        data = bytes(data)
-    return int(lib.df_crc32c(data, len(data), seed))
+    ptr, n = _buf_arg(data)
+    return int(lib.df_crc32c(ptr, n, seed))
 
 
-def hash_bytes(algo: str, data: bytes | memoryview) -> str | None:
+def hash_bytes(algo: str, data: bytes | bytearray | memoryview) -> str | None:
     """Hex digest via native lib, or None to signal fallback."""
     lib = load()
     if lib is None:
         return None
-    if isinstance(data, memoryview):
-        data = bytes(data)
+    ptr, n = _buf_arg(data)
     out = ctypes.create_string_buffer(129)
-    rc = lib.df_hash(algo.encode(), data, len(data), out, len(out))
+    rc = lib.df_hash(algo.encode(), ptr, n, out, len(out))
     if rc != 0:
         return None
     return out.value.decode()
@@ -115,10 +130,9 @@ def piece_write(path: str, offset: int, data: bytes | memoryview
     lib = load()
     if lib is None or not getattr(lib, "_df_has_piece_io", False):
         return None
-    if isinstance(data, memoryview):
-        data = bytes(data)
+    ptr, n = _buf_arg(data)
     crc = ctypes.c_uint32(0)
-    rc = lib.df_piece_write(path.encode(), offset, data, len(data),
+    rc = lib.df_piece_write(path.encode(), offset, ptr, n,
                             ctypes.byref(crc))
     if rc < 0:
         raise OSError(-rc, os.strerror(-rc), path)

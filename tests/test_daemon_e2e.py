@@ -317,6 +317,50 @@ class TestRangedDownload:
                 pass
         asyncio.run(go())
 
+    def test_prefetch_whole_file_on_ranged_request(self, tmp_path):
+        """With download.prefetch_whole_file on, a ranged request warms the
+        WHOLE task in the background; a later range over a different span is
+        served from the local parent even with the origin gone (reference
+        ``client/daemon/peer/peertask_manager.go:262-287``)."""
+        data = os.urandom(300_000)
+
+        async def go():
+            origin, base = await start_origin({"f": data})
+            cfg = daemon_config(tmp_path, "pref")
+            cfg.download.prefetch_whole_file = True
+            daemon = Daemon(cfg)
+            await daemon.start()
+            ch = Channel(f"unix:{daemon.unix_sock}")
+            client = ServiceClient(ch, "df.daemon.Daemon")
+            try:
+                out1 = tmp_path / "p1.bin"
+                async for _ in client.unary_stream("Download", DownloadRequest(
+                        url=f"{base}/f", output=str(out1),
+                        url_meta=UrlMeta(range="bytes=0-999"))):
+                    pass
+                assert out1.read_bytes() == data[:1000]
+                # wait for the background whole-file task to land
+                from dragonfly2_tpu.common import ids as _ids
+                parent_id = _ids.parent_task_id(f"{base}/f")
+                for _ in range(200):
+                    ts = daemon.storage_mgr.find_completed_task(parent_id)
+                    if ts is not None:
+                        break
+                    await asyncio.sleep(0.05)
+                assert daemon.storage_mgr.find_completed_task(parent_id) \
+                    is not None, "prefetch never completed the whole file"
+                await origin.cleanup()  # different span must come from cache
+                out2 = tmp_path / "p2.bin"
+                async for _ in client.unary_stream("Download", DownloadRequest(
+                        url=f"{base}/f", output=str(out2),
+                        url_meta=UrlMeta(range="bytes=200000-299999"))):
+                    pass
+                assert out2.read_bytes() == data[200000:300000]
+            finally:
+                await ch.close()
+                await daemon.stop()
+        asyncio.run(go())
+
 
 class TestGCAbandoned:
     def test_abandoned_inflight_task_reclaimed(self, tmp_path):

@@ -254,3 +254,57 @@ class TestSchedulerE2E:
                 await seed.stop()
 
         asyncio.run(go())
+
+
+class TestRegisterTimeMeshing:
+    """Pieceless RUNNING siblings are valid candidates (the engine only
+    dispatches to announcers, and their sync streams are how a child hears
+    a sibling's first piece immediately) — but every offer keeps at least
+    one content-holder, so the swarm can't be scheduled seed-less."""
+
+    def _setup(self, n_siblings=6):
+        from dragonfly2_tpu.scheduler.scheduling import Scheduling
+
+        res = Resource()
+        task = Task("t" * 64, "http://o/f")
+        seed_host = res.store_host(Host(
+            id="hseed", ip="10.0.0.1", port=1, download_port=2,
+            type=HostType.SUPER_SEED))
+        seed = res.get_or_create_peer("seedpeer", task, seed_host)
+        seed.transit(PeerState.RUNNING)
+        seed.finished_pieces.add(0)   # the only content holder
+        sibs = []
+        for i in range(n_siblings):
+            h = res.store_host(Host(id=f"h{i}", ip=f"10.0.1.{i}", port=1,
+                                    download_port=2))
+            p = res.get_or_create_peer(f"sib{i}", task, h)
+            p.transit(PeerState.RUNNING)
+            sibs.append(p)
+        child_host = res.store_host(Host(id="hc", ip="10.0.2.1", port=1,
+                                         download_port=2))
+        child = res.get_or_create_peer("child", task, child_host)
+        child.transit(PeerState.RUNNING)
+        sched = Scheduling(SchedulerConfig(), Evaluator())
+        return sched, child, seed, sibs
+
+    def test_pieceless_running_siblings_are_candidates(self):
+        sched, child, seed, sibs = self._setup()
+        parents = sched.find_parents(child)
+        assert parents, "no parents offered"
+        ids = {p.id for p in parents}
+        assert ids & {s.id for s in sibs}, \
+            "register-time offer contains no pieceless siblings"
+
+    def test_offer_always_keeps_a_content_holder(self):
+        sched, child, seed, sibs = self._setup()
+        for _ in range(20):   # candidate pool is sampled randomly
+            parents = sched.find_parents(child)
+            assert any(p.has_content() for p in parents), \
+                "offer has no content holder (seed dropped)"
+
+    def test_failed_empty_peers_stay_excluded(self):
+        sched, child, seed, sibs = self._setup(n_siblings=2)
+        sibs[0].transit(PeerState.FAILED)
+        for _ in range(10):
+            parents = sched.find_parents(child)
+            assert sibs[0].id not in {p.id for p in parents}

@@ -67,13 +67,7 @@ class _Slot:
             # would let a fresh arrival's gate check win the race against
             # the woken waiter's resume — inverted fairness where the
             # longest-waiting request is the one that 503s
-            while srv._slot_waiters:
-                fut = srv._slot_waiters.popleft()
-                if not fut.done():   # timed-out waiters are cancelled
-                    fut.set_result(None)
-                    return
-            srv._active -= 1
-            _upload_active.set(srv._active)
+            srv._pass_on_slot()
 
 
 class _SlotFileResponse(web.FileResponse):
@@ -143,6 +137,20 @@ class UploadServer:
         self._transfer_ms_at = 0.0  # when the EWMA last saw a real transfer
         self._slot_waiters: deque = deque()
         self._runner: web.AppRunner | None = None
+
+    def _pass_on_slot(self) -> None:
+        """Give a freed (or orphaned) slot to the next LIVE waiter, else
+        return it to capacity. Cancelled futures (timed-out or disconnected
+        waiters) are skipped — setting a result on one would strand the
+        slot forever (the r04 leak: seed gate stuck at 5/6 after one
+        client disconnected while queued)."""
+        while self._slot_waiters:
+            fut = self._slot_waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                return
+        self._active -= 1
+        _upload_active.set(self._active)
 
     async def start(self) -> None:
         async def healthy(_r: web.Request) -> web.Response:
@@ -258,7 +266,20 @@ class UploadServer:
                 try:
                     await asyncio.wait_for(fut, remaining)
                 except asyncio.TimeoutError:
+                    if fut.done() and not fut.cancelled():
+                        # transfer landed exactly at the deadline: take it
+                        slot = _Slot(self, adopted=True)
+                        break
                     continue   # loop re-checks the deadline and 503s
+                except BaseException:
+                    # request died while queued (client disconnect -> task
+                    # cancel). A transfer may have landed on our future in
+                    # the same tick: re-home it, never strand it.
+                    if fut.done() and not fut.cancelled():
+                        self._pass_on_slot()
+                    else:
+                        fut.cancel()
+                    raise
                 # a releasing transfer handed us its slot (ownership
                 # transfer — _active already counts it)
                 slot = _Slot(self, adopted=True)

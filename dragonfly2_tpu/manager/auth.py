@@ -35,8 +35,11 @@ from aiohttp import web
 log = logging.getLogger("df.mgr.auth")
 
 SESSION_TTL_S = 7 * 24 * 3600.0
-# paths served without credentials (health, metrics, and signin itself)
+OAUTH_STATE_TTL_S = 600.0
+# paths served without credentials (health, metrics, and signin itself);
+# /oauth/* (signin redirect + provider callback) is public by prefix
 PUBLIC_PATHS = {"/healthy", "/metrics", "/api/v1/users/signin"}
+PUBLIC_PREFIXES = ("/oauth/",)
 
 
 def _b64(data: bytes) -> str:
@@ -114,10 +117,34 @@ class Authenticator:
             return True
         return action == "read"        # guest: read-only
 
+    # -- oauth sign-in state (CSRF guard on the authorize round-trip) ----
+
+    def mint_state(self, provider: str) -> str:
+        payload = json.dumps({"p": provider, "n": _b64(secrets.token_bytes(8)),
+                              "exp": time.time() + OAUTH_STATE_TTL_S})
+        body = _b64(payload.encode())
+        sig = _b64(hmac.new(self._secret, b"state:" + body.encode(),
+                            hashlib.sha256).digest())
+        return f"{body}.{sig}"
+
+    def verify_state(self, state: str, provider: str) -> bool:
+        body, _, sig = state.partition(".")
+        want = _b64(hmac.new(self._secret, b"state:" + body.encode(),
+                             hashlib.sha256).digest())
+        if not hmac.compare_digest(sig, want):
+            return False
+        try:
+            payload = json.loads(_unb64(body))
+        except (ValueError, json.JSONDecodeError):
+            return False
+        return (payload.get("p") == provider
+                and time.time() <= payload.get("exp", 0))
+
     def middleware(self):
         @web.middleware
         async def auth_middleware(request: web.Request, handler):
-            if request.path in PUBLIC_PATHS:
+            if (request.path in PUBLIC_PATHS
+                    or request.path.startswith(PUBLIC_PREFIXES)):
                 return await handler(request)
             user = self.authenticate(request)
             if user is None:
@@ -128,6 +155,79 @@ class Authenticator:
             request["user"] = user
             return await handler(request)
         return auth_middleware
+
+
+class OAuthFlow:
+    """Generic OAuth2 authorization-code sign-in.
+
+    Role parity: reference ``manager/models/oauth.go`` +
+    ``manager/handlers/oauth.go`` + ``manager/service/user.go`` oauth
+    signin — providers are DB rows (github/google are just two rows here),
+    the callback exchanges the code, reads the identity endpoint, and signs
+    the external identity in as a namespaced local user."""
+
+    def __init__(self, store, authenticator: Authenticator):
+        self.store = store
+        self.auth = authenticator
+
+    async def signin_url(self, name: str, redirect_uri: str) -> str | None:
+        import asyncio
+        p = await asyncio.to_thread(self.store.oauth, name)
+        if p is None:
+            return None
+        from urllib.parse import urlencode
+        q = {"response_type": "code", "client_id": p["client_id"],
+             "redirect_uri": redirect_uri,
+             "state": self.auth.mint_state(name)}
+        if p["scopes"]:
+            q["scope"] = p["scopes"]
+        sep = "&" if "?" in p["auth_url"] else "?"
+        return p["auth_url"] + sep + urlencode(q)
+
+    async def callback(self, name: str, code: str, state: str,
+                       redirect_uri: str) -> dict | None:
+        """code -> token -> identity -> local session; None = rejected."""
+        import asyncio
+
+        import aiohttp
+        p = await asyncio.to_thread(self.store.oauth, name)
+        if p is None or not self.auth.verify_state(state, name):
+            return None
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(p["token_url"], data={
+                        "grant_type": "authorization_code", "code": code,
+                        "client_id": p["client_id"],
+                        "client_secret": p["client_secret"],
+                        "redirect_uri": redirect_uri},
+                        headers={"Accept": "application/json"}) as resp:
+                    if resp.status != 200:
+                        return None
+                    tok = await resp.json(content_type=None)
+                access = tok.get("access_token")
+                if not access:
+                    return None
+                async with s.get(p["userinfo_url"], headers={
+                        "Authorization": f"Bearer {access}",
+                        "Accept": "application/json"}) as resp:
+                    if resp.status != 200:
+                        return None
+                    info = await resp.json(content_type=None)
+        except Exception as exc:  # noqa: BLE001 - provider is external
+            log.warning("oauth %s exchange failed: %s", name, exc)
+            return None
+        # STABLE identifiers first (sub/id): a mutable display name as the
+        # identity key would let anyone rename themselves into someone
+        # else's local account on providers without login/email claims
+        login = str(info.get("sub") or info.get("id") or info.get("login")
+                    or info.get("email") or "")
+        if not login:
+            return None
+        # scrypt on first sign-in + sqlite: off the event loop, like every
+        # other REST handler's store call
+        user = await asyncio.to_thread(self.store.get_or_create_oauth_user,
+                                       name, login)
+        return {"token": self.auth.mint_session(user), "user": user}
 
 
 def bootstrap_root(store, *, password_path: str = "") -> None:

@@ -57,6 +57,14 @@ class RestAPI:
         r.add_post("/api/v1/personal-access-tokens", self._create_pat)
         r.add_get("/api/v1/personal-access-tokens", self._list_pats)
         r.add_delete("/api/v1/personal-access-tokens/{id}", self._revoke_pat)
+        r.add_get("/api/v1/oauth", self._list_oauth)
+        r.add_post("/api/v1/oauth", self._create_oauth)
+        r.add_delete("/api/v1/oauth/{id}", self._delete_oauth)
+        if self.auth is not None:
+            from .auth import OAuthFlow
+            self._oauth_flow = OAuthFlow(self.store, self.auth)
+            r.add_get("/oauth/signin/{name}", self._oauth_signin)
+            r.add_get("/oauth/callback/{name}", self._oauth_callback)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -192,3 +200,56 @@ class RestAPI:
         await asyncio.to_thread(self.store.revoke_pat,
                                 int(request.match_info["id"]))
         return web.json_response({"ok": True})
+
+    # -- oauth (reference manager/handlers/oauth.go) --------------------
+
+    async def _list_oauth(self, _r: web.Request) -> web.Response:
+        return web.json_response(await asyncio.to_thread(self.store.oauths))
+
+    async def _create_oauth(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            oid = await asyncio.to_thread(
+                lambda: self.store.create_oauth(
+                    body["name"], client_id=body["client_id"],
+                    client_secret=body["client_secret"],
+                    auth_url=body["auth_url"], token_url=body["token_url"],
+                    userinfo_url=body["userinfo_url"],
+                    scopes=body.get("scopes", "")))
+        except KeyError as exc:
+            return web.json_response({"error": f"missing field {exc}"},
+                                     status=400)
+        except Exception as exc:  # noqa: BLE001 - e.g. duplicate name
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"id": oid}, status=201)
+
+    async def _delete_oauth(self, request: web.Request) -> web.Response:
+        ok = await asyncio.to_thread(self.store.delete_oauth,
+                                     int(request.match_info["id"]))
+        return web.json_response({"ok": ok},
+                                 status=200 if ok else 404)
+
+    async def _oauth_signin(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        redirect_uri = request.query.get(
+            "redirect_uri",
+            f"http://{request.host}/oauth/callback/{name}")
+        url = await self._oauth_flow.signin_url(name, redirect_uri)
+        if url is None:
+            return web.json_response({"error": "unknown provider"},
+                                     status=404)
+        raise web.HTTPFound(url)
+
+    async def _oauth_callback(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        code = request.query.get("code", "")
+        state = request.query.get("state", "")
+        redirect_uri = request.query.get(
+            "redirect_uri",
+            f"http://{request.host}/oauth/callback/{name}")
+        result = await self._oauth_flow.callback(name, code, state,
+                                                 redirect_uri)
+        if result is None:
+            return web.json_response({"error": "oauth signin rejected"},
+                                     status=401)
+        return web.json_response(result)

@@ -100,6 +100,17 @@ CREATE TABLE IF NOT EXISTS personal_access_tokens (
   expires_at REAL NOT NULL DEFAULT 0,
   created_at REAL
 );
+CREATE TABLE IF NOT EXISTS oauth_providers (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL UNIQUE,
+  client_id TEXT NOT NULL,
+  client_secret TEXT NOT NULL,
+  auth_url TEXT NOT NULL,
+  token_url TEXT NOT NULL,
+  userinfo_url TEXT NOT NULL,
+  scopes TEXT NOT NULL DEFAULT '',
+  created_at REAL
+);
 """
 
 
@@ -463,6 +474,50 @@ class Store:
         if expires and _now() > expires:
             return None
         return row
+
+    # -- oauth providers (reference ``manager/models/oauth.go``) ---------
+
+    def create_oauth(self, name: str, *, client_id: str, client_secret: str,
+                     auth_url: str, token_url: str, userinfo_url: str,
+                     scopes: str = "") -> int:
+        cur = self._exec(
+            "INSERT INTO oauth_providers(name, client_id, client_secret, "
+            "auth_url, token_url, userinfo_url, scopes, created_at) "
+            "VALUES(?,?,?,?,?,?,?,?)",
+            (name, client_id, client_secret, auth_url, token_url,
+             userinfo_url, scopes, _now()))
+        return cur.lastrowid
+
+    def oauth(self, name: str) -> dict | None:
+        rows = self._rows("SELECT * FROM oauth_providers WHERE name=?",
+                          (name,))
+        return dict(rows[0]) if rows else None
+
+    def oauths(self) -> list[dict]:
+        """Provider list WITHOUT client secrets (REST-exposed)."""
+        return [dict(r) for r in self._rows(
+            "SELECT id, name, client_id, auth_url, token_url, userinfo_url, "
+            "scopes, created_at FROM oauth_providers ORDER BY id")]
+
+    def delete_oauth(self, oauth_id: int) -> bool:
+        cur = self._exec("DELETE FROM oauth_providers WHERE id=?",
+                         (oauth_id,))
+        return cur.rowcount > 0
+
+    def get_or_create_oauth_user(self, provider: str, login: str) -> dict:
+        """The local user backing an external identity — created on first
+        sign-in with an unusable password and the guest role (an operator
+        promotes from there), namespaced so an attacker can't pre-register
+        a colliding local username."""
+        import secrets
+        name = f"{provider}:{login}"
+        rows = self._rows(
+            "SELECT id, name, role, created_at FROM users WHERE name=?",
+            (name,))
+        if rows:
+            return dict(rows[0])
+        uid = self.create_user(name, secrets.token_urlsafe(32))
+        return self.user(uid)
 
     def pats(self, user_id: int | None = None) -> list[dict]:
         sql = ("SELECT id, label, user_id, revoked, expires_at, created_at "

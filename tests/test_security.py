@@ -334,3 +334,115 @@ class TestFleetMTLS:
             finally:
                 await m.stop()
         asyncio.run(main())
+
+
+class TestOAuthSignin:
+    """OAuth2 authorization-code sign-in against a FAKE in-process provider
+    (reference manager/models/oauth.go + handlers oauth signin): signin
+    redirects to the provider with a signed state; the callback exchanges
+    the code, reads the identity, and mints a session that passes auth."""
+
+    def test_full_flow_and_state_rejection(self, tmp_path):
+        async def main():
+            import aiohttp
+            from aiohttp import web
+
+            # -- fake provider: /token and /userinfo
+            seen = {}
+
+            async def token(request: web.Request):
+                form = await request.post()
+                seen["code"] = form["code"]
+                seen["client_id"] = form["client_id"]
+                seen["client_secret"] = form["client_secret"]
+                if form["code"] != "good-code":
+                    return web.json_response({"error": "bad code"},
+                                             status=400)
+                return web.json_response({"access_token": "at-123"})
+
+            async def userinfo(request: web.Request):
+                assert request.headers["Authorization"] == "Bearer at-123"
+                return web.json_response({"login": "octocat"})
+
+            papp = web.Application()
+            papp.router.add_post("/token", token)
+            papp.router.add_get("/userinfo", userinfo)
+            prunner = web.AppRunner(papp, access_log=None)
+            await prunner.setup()
+            psite = web.TCPSite(prunner, "127.0.0.1", 0)
+            await psite.start()
+            from dragonfly2_tpu.common.aiohttp_util import resolve_port
+            pbase = f"http://127.0.0.1:{resolve_port(prunner)}"
+
+            m = await _mgr(tmp_path, auth_enabled=True)
+            try:
+                base = f"http://127.0.0.1:{m.rest.port}"
+                password = _root_password(tmp_path)
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "root",
+                                            "password": password}) as r:
+                        hdr = {"Authorization":
+                               f"Bearer {(await r.json())['token']}"}
+                    # register the provider (root write)
+                    async with s.post(f"{base}/api/v1/oauth", json={
+                            "name": "fakehub", "client_id": "cid",
+                            "client_secret": "csecret",
+                            "auth_url": f"{pbase}/authorize",
+                            "token_url": f"{pbase}/token",
+                            "userinfo_url": f"{pbase}/userinfo",
+                            "scopes": "read:user"}, headers=hdr) as r:
+                        assert r.status == 201
+                    # provider list never exposes the secret
+                    async with s.get(f"{base}/api/v1/oauth",
+                                     headers=hdr) as r:
+                        rows = await r.json()
+                        assert rows and "client_secret" not in rows[0]
+                    # signin: 302 to the provider with signed state
+                    async with s.get(f"{base}/oauth/signin/fakehub",
+                                     allow_redirects=False) as r:
+                        assert r.status == 302
+                        loc = r.headers["Location"]
+                        assert loc.startswith(f"{pbase}/authorize?")
+                        assert "client_id=cid" in loc
+                        from urllib.parse import parse_qs, urlsplit
+                        state = parse_qs(urlsplit(loc).query)["state"][0]
+                    # provider "redirects back": callback exchanges the code
+                    async with s.get(
+                            f"{base}/oauth/callback/fakehub",
+                            params={"code": "good-code",
+                                    "state": state}) as r:
+                        assert r.status == 200
+                        out = await r.json()
+                        assert out["user"]["name"] == "fakehub:octocat"
+                        otoken = out["token"]
+                    assert seen["client_secret"] == "csecret"
+                    # minted session authenticates (guest: read ok)
+                    async with s.get(f"{base}/api/v1/schedulers",
+                                     headers={"Authorization":
+                                              f"Bearer {otoken}"}) as r:
+                        assert r.status == 200
+                    # forged/expired state is rejected
+                    async with s.get(
+                            f"{base}/oauth/callback/fakehub",
+                            params={"code": "good-code",
+                                    "state": "bogus.sig"}) as r:
+                        assert r.status == 401
+                    # bad code -> provider refuses -> 401
+                    async with s.get(f"{base}/oauth/signin/fakehub",
+                                     allow_redirects=False) as r:
+                        loc = r.headers["Location"]
+                        from urllib.parse import parse_qs, urlsplit
+                        state2 = parse_qs(urlsplit(loc).query)["state"][0]
+                    async with s.get(
+                            f"{base}/oauth/callback/fakehub",
+                            params={"code": "evil", "state": state2}) as r:
+                        assert r.status == 401
+                    # unknown provider
+                    async with s.get(f"{base}/oauth/signin/nope",
+                                     allow_redirects=False) as r:
+                        assert r.status == 404
+            finally:
+                await m.stop()
+                await prunner.cleanup()
+        asyncio.run(main())

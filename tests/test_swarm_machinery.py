@@ -456,3 +456,53 @@ class TestUploadSlots:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-v"])
+
+
+class TestSlotQueueDisconnect:
+    def test_disconnected_waiter_does_not_strand_slot(self, tmp_path):
+        """A client that disconnects while queued for a slot must not
+        swallow the next freed slot (r04 leak: the seed's gate ran at
+        5/6 for the rest of its life after one queued client timed out)."""
+        import aiohttp
+
+        from dragonfly2_tpu.common.rate import TokenBucket
+        from dragonfly2_tpu.daemon.upload_server import UploadServer, _Slot
+        from dragonfly2_tpu.storage.manager import StorageConfig, StorageManager
+        from dragonfly2_tpu.storage.metadata import TaskMetadata
+
+        size = 64 << 10
+
+        async def main():
+            mgr = StorageManager(StorageConfig(data_dir=str(tmp_path)))
+            md = TaskMetadata(task_id="u" * 32, url="http://o/x",
+                              content_length=size, total_piece_count=1,
+                              piece_size=size)
+            ts = mgr.register_task(md)
+            ts.write_piece(0, 0, b"q" * size)
+            srv = UploadServer(mgr, host="127.0.0.1", concurrent_limit=1)
+            srv.limiter = TokenBucket(0)
+            await srv.start()
+            try:
+                url = (f"http://127.0.0.1:{srv.port}/download/"
+                       f"{'u' * 3}/{'u' * 32}")
+                rng = {"Range": f"bytes=0-{size - 1}"}
+                held = _Slot(srv)          # gate full (limit 1)
+                # client gives up while queued (well under SLOT_WAIT_S)
+                async with aiohttp.ClientSession(
+                        timeout=aiohttp.ClientTimeout(total=0.05)) as s:
+                    with pytest.raises(Exception):
+                        async with s.get(url, headers=rng) as r:
+                            await r.read()
+                await asyncio.sleep(0.05)  # let the cancelled handler unwind
+                held.release()             # must NOT hand off to the dead fut
+                await asyncio.sleep(0.05)
+                assert srv._active == 0, "slot stranded by dead waiter"
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url, headers=rng) as r:
+                        assert r.status == 206
+                        await r.read()
+                assert srv._active == 0
+            finally:
+                await srv.stop()
+
+        asyncio.run(main())

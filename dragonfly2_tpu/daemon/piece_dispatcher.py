@@ -57,6 +57,7 @@ EJECT_COOLDOWN_S = 4.0       # local ejection is a cooldown, not a divorce
 _EWMA_ALPHA = 0.3
 BUSY_BACKOFF_S = 0.04        # base 503 backoff (doubles per consecutive busy)
 BUSY_BACKOFF_MAX_S = 1.5     # cap on the exponential busy backoff
+ENDGAME_RACE_AGE_S = 0.5     # min in-flight age before racing a duplicate
 
 
 class ParentState:
@@ -137,13 +138,14 @@ class ParentState:
 
 
 class _PieceState:
-    __slots__ = ("info", "holders", "fetching", "first_seen")
+    __slots__ = ("info", "holders", "fetching", "first_seen", "dispatched_at")
 
     def __init__(self, info: PieceInfo):
         self.info = info
         self.holders: set[str] = set()   # parent peer ids that announced it
         self.fetching: set[str] = set()  # parents currently transferring it
         self.first_seen = time.monotonic()
+        self.dispatched_at = 0.0         # when the LATEST fetch started
 
     @property
     def inflight(self) -> bool:
@@ -390,8 +392,10 @@ class PieceDispatcher:
             if not usable(prev):
                 break
             group.insert(0, prev)
+        now = time.monotonic()
         for g in group:
             g.fetching.add(parent.peer_id)
+            g.dispatched_at = now
         parent.inflight += 1
         parent.attempts += len(group)
         if parent.is_seed:
@@ -416,26 +420,29 @@ class PieceDispatcher:
         peertask_conductor.go:1089)."""
         if not self.endgame or not self._pieces:
             return None
-        best: tuple[int, _PieceState, ParentState] | None = None
+        now = time.monotonic()
         for ps in self._pieces.values():
             if not ps.fetching:
                 continue   # normal path will take it
+            # ONE racer per piece, and only against a fetch that has been
+            # in flight a while: uncapped immediate racing turns every slow
+            # tail piece into a duplicate from every idle worker — bounded
+            # waste per piece is one aged duplicate
+            if (len(ps.fetching) >= 2
+                    or now - ps.dispatched_at < ENDGAME_RACE_AGE_S):
+                continue
             alts = [self.parents[h] for h in ps.holders - ps.fetching
                     if h in self.parents and not self.parents[h].ejected
                     and not self.parents[h].is_busy()]
             if not alts:
                 continue
             parent = min(alts, key=ParentState.rank)
-            key = len(ps.fetching)   # least-raced piece first
-            if best is None or key < best[0]:
-                best = (key, ps, parent)
-        if best is None:
-            return None
-        _, ps, parent = best
-        ps.fetching.add(parent.peer_id)
-        parent.inflight += 1
-        parent.attempts += 1
-        return Dispatch([ps.info], parent)
+            ps.fetching.add(parent.peer_id)
+            ps.dispatched_at = now
+            parent.inflight += 1
+            parent.attempts += 1
+            return Dispatch([ps.info], parent)
+        return None
 
     async def get(self, timeout: float | None = None) -> Dispatch | None:
         """Next (piece, parent) to fetch; None when closed or timed out."""
@@ -452,9 +459,10 @@ class PieceDispatcher:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None
-                # busy and cooldown windows expire on a clock, not on a
-                # notify: wake at the nearest expiry so a piece whose only
-                # holders hit 503 (or an eject cooldown) is retried promptly
+                # busy/cooldown/race-age windows expire on a clock, not on
+                # a notify: wake at the nearest expiry so a piece whose
+                # only holders hit 503 (or an eject cooldown, or an endgame
+                # race becoming age-eligible) is retried promptly
                 now = time.monotonic()
                 wake = None
                 for p in self.parents.values():
@@ -464,6 +472,13 @@ class PieceDispatcher:
                         if until > now:
                             dt = max(until - now, 0.02)
                             wake = dt if wake is None else min(wake, dt)
+                if self.endgame:
+                    for ps in self._pieces.values():
+                        if len(ps.fetching) == 1:
+                            until = ps.dispatched_at + ENDGAME_RACE_AGE_S
+                            if until > now:
+                                dt = max(until - now, 0.02)
+                                wake = dt if wake is None else min(wake, dt)
                 if wake is not None:
                     remaining = min(remaining or wake, wake)
                 try:

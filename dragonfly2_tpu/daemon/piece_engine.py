@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from typing import TYPE_CHECKING
 
@@ -155,6 +156,14 @@ class PieceEngine:
         self._need_back_source = False
         self._first_parent = asyncio.Event()
         self._last_ping = 0.0
+        # starvation-ping pacing: per-engine jittered base so a fan-out's
+        # children never ping in phase, exponential while pings produce no
+        # new announcements (a struggling swarm must not spend its one core
+        # on 100s of control messages/s — the r04 16-leecher convoy),
+        # reset to base on progress
+        self._ping_base = 0.1 * random.uniform(0.9, 1.5)
+        self._ping_interval = self._ping_base
+        self._announced_at_ping = -1
 
     def peer_client(self, addr: str) -> ServiceClient:
         return ServiceClient(self._channels.get(addr), DAEMON_SERVICE)
@@ -343,9 +352,16 @@ class PieceEngine:
         if not self.dispatcher.starving():
             return
         now = time.monotonic()
-        if now - self._last_ping < 0.1:
+        if now - self._last_ping < self._ping_interval:
             return
         self._last_ping = now
+        announced = sum(p.announced
+                        for p in self.dispatcher.parents.values())
+        if announced > self._announced_at_ping:
+            self._ping_interval = self._ping_base      # progress: re-arm
+        else:
+            self._ping_interval = min(self._ping_interval * 1.7, 1.2)
+        self._announced_at_ping = announced
         for sync in list(self._synchronizers.values()):
             await sync.ping()
         # resurrect dead sync streams for parents the scheduler still

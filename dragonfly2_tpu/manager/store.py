@@ -510,13 +510,21 @@ class Store:
         promotes from there), namespaced so an attacker can't pre-register
         a colliding local username."""
         import secrets
+        import sqlite3
         name = f"{provider}:{login}"
         rows = self._rows(
             "SELECT id, name, role, created_at FROM users WHERE name=?",
             (name,))
         if rows:
             return dict(rows[0])
-        uid = self.create_user(name, secrets.token_urlsafe(32))
+        try:
+            uid = self.create_user(name, secrets.token_urlsafe(32))
+        except sqlite3.IntegrityError:
+            # concurrent first sign-ins race the SELECT: the loser re-reads
+            rows = self._rows(
+                "SELECT id, name, role, created_at FROM users WHERE name=?",
+                (name,))
+            return dict(rows[0])
         return self.user(uid)
 
     def pats(self, user_id: int | None = None) -> list[dict]:

@@ -266,12 +266,18 @@ class TestDispatcher:
             d.endgame = True   # engine sets this when the task tail remains
             first = await d.get(timeout=0.5)
             assert first is not None
-            # piece 0 is in flight on one parent; endgame races the other
+            # a FRESH in-flight fetch is not raced (age gate: uncapped
+            # immediate racing was the r04 17x-overfetch spiral)
+            assert d._pick() is None
+            # once the fetch has been in flight past the age gate, endgame
+            # races ONE duplicate from the other holder
+            d._pieces[0].dispatched_at = time.monotonic() - 1.0
             dup = await d.get(timeout=0.5)
             assert dup is not None
             assert dup.piece.piece_num == 0
             assert dup.parent.peer_id != first.parent.peer_id
-            # no third racer exists -> nothing more to dispatch
+            # racer cap is 2: no third dispatch even after more aging
+            d._pieces[0].dispatched_at = time.monotonic() - 1.0
             assert d._pick() is None
             # first landing wins; the loser's late report is harmless
             await d.report(first, ok=True, cost_ms=5)
@@ -454,8 +460,6 @@ class TestUploadSlots:
         asyncio.run(main())
 
 
-if __name__ == "__main__":
-    pytest.main([__file__, "-v"])
 
 
 class TestSlotQueueDisconnect:
@@ -506,3 +510,7 @@ class TestSlotQueueDisconnect:
                 await srv.stop()
 
         asyncio.run(main())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

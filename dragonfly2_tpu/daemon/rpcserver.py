@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Any, AsyncIterator
 
 from ..common.errors import Code, DFError
@@ -187,8 +188,80 @@ class DaemonService:
     # -- local API -----------------------------------------------------
 
     async def download(self, request: DownloadRequest, context) -> AsyncIterator:
+        if request.recursive:
+            async for resp in self._download_recursive(request):
+                yield resp
+            return
         async for resp in self.ptm.start_file_task(request):
             yield resp
+
+    async def _download_recursive(self, request: DownloadRequest
+                                  ) -> AsyncIterator:
+        """BFS a directory-shaped origin: one file task per leaf, outputs
+        mirrored under ``request.output``, up to ``recursive_concurrency``
+        leaves in flight (reference ``client/dfget/dfget.go:317``
+        recursiveDownload; daemon-side recursion per ``rpcserver.go:404``).
+        Progress events from concurrent tasks interleave on the stream;
+        each file still emits its own done event."""
+        from ..source.client import walk
+
+        meta = request.url_meta
+        if meta is not None and (meta.digest or meta.range):
+            # a whole-tree digest/range can't apply to each file
+            from dataclasses import replace as _dc_replace
+            meta = _dc_replace(meta, digest="", range="")
+        header = dict(meta.header) if meta is not None and meta.header else None
+        sem = asyncio.Semaphore(max(1, request.recursive_concurrency))
+        out_q: asyncio.Queue = asyncio.Queue()
+        _DONE = object()
+
+        async def fetch(entry, rel: str) -> None:
+            async with sem:
+                sub = DownloadRequest(
+                    url=entry.url, output=os.path.join(request.output, rel),
+                    url_meta=meta, timeout_s=request.timeout_s,
+                    disable_back_source=request.disable_back_source,
+                    device_sink=request.device_sink,
+                    task_type=request.task_type,
+                    rate_limit_bps=request.rate_limit_bps,
+                    keep_original_offset=request.keep_original_offset)
+                async for resp in self.ptm.start_file_task(sub):
+                    await out_q.put(resp)
+
+        async def produce() -> None:
+            tasks: list[asyncio.Task] = []
+            try:
+                async for entry, rel in walk(
+                        request.url, timeout_s=request.timeout_s,
+                        header=header):
+                    tasks.append(asyncio.get_running_loop().create_task(
+                        fetch(entry, rel)))
+                results = await asyncio.gather(*tasks,
+                                               return_exceptions=True)
+                errs = [r for r in results if isinstance(r, BaseException)]
+                if errs:
+                    raise errs[0]
+            finally:
+                for t in tasks:
+                    if not t.done():
+                        t.cancel()
+                await out_q.put(_DONE)
+
+        producer = asyncio.get_running_loop().create_task(produce())
+        try:
+            while True:
+                item = await out_q.get()
+                if item is _DONE:
+                    break
+                yield item
+            await producer   # surface listing/fetch errors to the stream
+        finally:
+            if not producer.done():   # consumer died early (client gone)
+                producer.cancel()
+                try:
+                    await producer
+                except BaseException:  # noqa: BLE001 - already unwinding
+                    pass
 
     async def stat_task(self, request: StatTaskDaemonRequest, context) -> TaskStat:
         task_id = request.task_id or self.ptm._task_id(

@@ -43,6 +43,9 @@ class ManagerConfig:
     # listener would hand it to any on-path observer (open signing oracle).
     # Clients trust manager-ca/proxy-ca.crt (distributed out of band).
     grpc_tls: bool = False
+    # searcher plugin override (reference manager/searcher plugin slot):
+    # load df_plugin_searcher_default.py from this dir when set
+    plugin_dir: str = ""
 
 
 class Manager:
@@ -125,6 +128,13 @@ class Manager:
         return TLSOptions(cert_p, key_p)
 
     async def start(self) -> None:
+        if self.cfg.plugin_dir:
+            from .searcher import load_searcher_plugin
+            try:
+                load_searcher_plugin(self.cfg.plugin_dir)
+                log.info("searcher plugin loaded from %s", self.cfg.plugin_dir)
+            except Exception as exc:  # noqa: BLE001 - plugin is optional
+                log.warning("searcher plugin not loaded: %s", exc)
         # a default cluster always exists so self-registration lands somewhere
         self.store.default_scheduler_cluster()
         self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.grpc_port}",

@@ -91,6 +91,47 @@ async def download(req: SourceRequest) -> SourceResponse:
     return await client_for(req.url).download(req)
 
 
+async def walk(url: str, *, timeout_s: float = 0.0,
+               header: dict | None = None, max_depth: int = 64
+               ) -> AsyncIterator[tuple[ListEntry, str]]:
+    """BFS the listing under ``url``, yielding (entry, relative_path) for
+    every FILE (reference lister + ``recursiveDownload`` traversal,
+    ``client/dfget/dfget.go:317``). Origin credentials in ``header`` ride
+    every listing request. Directory symlink cycles are broken by realpath
+    identity for file:// and a depth cap for every scheme."""
+    import os
+    from collections import deque
+    from urllib.parse import urlparse
+
+    client = client_for(url)
+    base_path = urlparse(url).path.rstrip("/")
+
+    def ident(u: str) -> str:
+        p = urlparse(u)
+        if p.scheme in ("", "file"):
+            return "file://" + os.path.realpath(p.path)
+        return u
+
+    queue = deque([(url, 0)])
+    seen = {ident(url)}
+    while queue:
+        cur, depth = queue.popleft()
+        entries = await client.list(SourceRequest(
+            url=cur, header=dict(header or {}), timeout_s=timeout_s))
+        for e in entries:
+            if e.is_dir:
+                key = ident(e.url)
+                if key not in seen and depth + 1 <= max_depth:
+                    seen.add(key)
+                    queue.append((e.url, depth + 1))
+                continue
+            rel = urlparse(e.url).path
+            if base_path and rel.startswith(base_path):
+                rel = rel[len(base_path):]
+            rel = rel.lstrip("/") or e.name
+            yield e, rel
+
+
 async def close_clients() -> None:
     """Close every registered client's session bound to the CURRENT loop.
 

@@ -100,17 +100,42 @@ async def download_via_daemon(sock: str, args, *, progress=None) -> None:
 
 async def download_from_source(args, *, progress=None) -> None:
     """Direct origin fetch (no daemon): the reference's ``downloadFromSource``
-    fallback, with digest verification."""
+    fallback, with digest verification. ``--recursive`` BFS-mirrors the
+    listing client-side exactly like the reference's ``recursiveDownload``
+    (``client/dfget/dfget.go:317``)."""
     from ..source import SourceRequest, client_for
 
-    req = SourceRequest(url=args.url, timeout_s=args.timeout)
     client = client_for(args.url)
     try:
-        await _download_from_source_inner(client, req, args, progress)
+        if getattr(args, "recursive", False):
+            await _recursive_from_source(client, args, progress)
+        else:
+            req = SourceRequest(url=args.url, timeout_s=args.timeout)
+            await _download_from_source_inner(client, req, args, progress)
     finally:
         close = getattr(client, "close", None)
         if close is not None:
             await close()
+
+
+async def _recursive_from_source(client, args, progress) -> None:
+    import copy
+
+    from ..source import SourceRequest
+    from ..source.client import walk
+
+    meta = _meta(args)
+    header = dict(meta.header) if meta.header else None
+    async for e, rel in walk(args.url, timeout_s=args.timeout, header=header):
+        sub = copy.copy(args)
+        sub.url = e.url
+        sub.output = os.path.join(args.output, rel)
+        sub.digest = ""    # a whole-tree digest can't apply per file
+        sub.range_ = ""
+        await _download_from_source_inner(
+            client, SourceRequest(url=e.url, header=dict(header or {}),
+                                  timeout_s=args.timeout),
+            sub, progress)
 
 
 async def _download_from_source_inner(client, req, args, progress) -> None:

@@ -1,0 +1,102 @@
+"""Stress tool: concurrent download load with a latency histogram.
+
+Role parity: reference ``test/tools/stress/main.go`` — N workers hammer a
+URL (directly or through the daemon proxy) for a duration, then report
+request/error counts, throughput, and latency percentiles. One JSON line on
+stdout so harnesses can parse it.
+
+Usage:
+    python -m dragonfly2_tpu.tools.stress --url http://origin/blob \
+        [--proxy http://127.0.0.1:65001] [-c 16] [-d 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list (the reference's
+    histogram reports the same P50/P90/P95/P99 cut points)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+async def run_stress(url: str, *, proxy: str = "", concurrency: int = 8,
+                     duration_s: float = 10.0,
+                     connect_timeout_s: float = 10.0) -> dict:
+    import aiohttp
+
+    deadline = time.monotonic() + duration_s
+    latencies: list[float] = []
+    state = {"requests": 0, "errors": 0, "bytes": 0}
+
+    async def worker(session: aiohttp.ClientSession) -> None:
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            try:
+                async with session.get(url, proxy=proxy or None) as resp:
+                    got = 0
+                    async for chunk in resp.content.iter_chunked(1 << 20):
+                        got += len(chunk)
+                    if resp.status not in (200, 206):
+                        state["errors"] += 1
+                    else:
+                        state["bytes"] += got
+                        latencies.append(time.monotonic() - t0)
+            except Exception:  # noqa: BLE001 - counted, load goes on
+                state["errors"] += 1
+            state["requests"] += 1
+
+    # sock_read: a server that stalls mid-body (what a stress tool exists
+    # to expose) must count as an error, not hang the run past its deadline
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=connect_timeout_s,
+                                    sock_read=max(duration_s, 10.0))
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        t0 = time.monotonic()
+        await asyncio.gather(*(worker(session) for _ in range(concurrency)))
+        elapsed = time.monotonic() - t0
+
+    latencies.sort()
+    return {
+        "url": url,
+        "concurrency": concurrency,
+        "duration_s": round(elapsed, 2),
+        "requests": state["requests"],
+        "errors": state["errors"],
+        "bytes": state["bytes"],
+        "throughput_gbps": round(state["bytes"] / 1e9 / max(elapsed, 1e-9), 4),
+        "latency_ms": {
+            "p50": round(_percentile(latencies, 0.50) * 1000, 1),
+            "p90": round(_percentile(latencies, 0.90) * 1000, 1),
+            "p95": round(_percentile(latencies, 0.95) * 1000, 1),
+            "p99": round(_percentile(latencies, 0.99) * 1000, 1),
+        },
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dfstress", description="concurrent download load generator")
+    p.add_argument("--url", required=True)
+    p.add_argument("--proxy", default="",
+                   help="http proxy (the daemon's mirror), e.g. "
+                        "http://127.0.0.1:65001")
+    p.add_argument("-c", "--concurrency", type=int, default=8)
+    p.add_argument("-d", "--duration", type=float, default=10.0)
+    args = p.parse_args(argv)
+    result = asyncio.run(run_stress(
+        args.url, proxy=args.proxy, concurrency=args.concurrency,
+        duration_s=args.duration))
+    print(json.dumps(result))
+    return 1 if result["requests"] == result["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

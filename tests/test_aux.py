@@ -227,3 +227,65 @@ class TestProbeLoop:
                 await sched.stop()
 
         asyncio.run(go())
+
+
+class TestStressTool:
+    def test_stress_reports_histogram(self, tmp_path):
+        """Reference ``test/tools/stress`` parity: N workers, duration,
+        request/error counts, throughput, latency percentiles."""
+        import asyncio
+
+        from aiohttp import web
+
+        from dragonfly2_tpu.tools.stress import run_stress
+
+        async def go():
+            payload = b"z" * 100_000
+            calls = {"n": 0}
+
+            async def handle(request):
+                calls["n"] += 1
+                if calls["n"] % 5 == 0:
+                    return web.Response(status=500)
+                return web.Response(body=payload)
+
+            app = web.Application()
+            app.router.add_get("/blob", handle)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            from dragonfly2_tpu.common.aiohttp_util import resolve_port
+            url = f"http://127.0.0.1:{resolve_port(runner)}/blob"
+            try:
+                out = await run_stress(url, concurrency=4, duration_s=1.0)
+            finally:
+                await runner.cleanup()
+            assert out["requests"] > 10
+            assert 0 < out["errors"] < out["requests"]
+            assert out["bytes"] >= len(payload)
+            assert out["latency_ms"]["p50"] > 0
+            assert out["latency_ms"]["p99"] >= out["latency_ms"]["p50"]
+            assert out["throughput_gbps"] > 0
+        asyncio.run(go())
+
+
+class TestDfgetRecursiveFallback:
+    def test_source_fallback_mirrors_tree(self, tmp_path):
+        """--recursive on the direct-from-source path (no daemon) BFS-mirrors
+        the listing — the daemonless path must not regress to treating the
+        directory URL as a single file."""
+        from dragonfly2_tpu.tools import dfget
+
+        src = tmp_path / "tree"
+        (src / "deep").mkdir(parents=True)
+        (src / "one.bin").write_bytes(os.urandom(30_000))
+        (src / "deep" / "two.bin").write_bytes(os.urandom(10_000))
+        out = tmp_path / "mirror"
+        rc = dfget.main([f"file://{src}", "-O", str(out),
+                         "--recursive", "--no-daemon", "--quiet"])
+        assert rc == 0
+        assert (out / "one.bin").read_bytes() == \
+            (src / "one.bin").read_bytes()
+        assert (out / "deep" / "two.bin").read_bytes() == \
+            (src / "deep" / "two.bin").read_bytes()

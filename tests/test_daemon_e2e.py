@@ -472,3 +472,32 @@ class TestPieceGroupWorkQueue:
         requests, landed = self._run(40, 8 * 1024 * 1024, slow_first_group=True)
         assert sorted(num for num, _ in landed) == list(range(40))
         assert len(requests) == 10
+
+
+class TestRecursiveDownload:
+    def test_recursive_directory_via_daemon(self, tmp_path):
+        """--recursive mirrors a directory tree, one task per file
+        (reference ``client/dfget/dfget.go:317`` recursiveDownload)."""
+        src = tmp_path / "tree"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.bin").write_bytes(os.urandom(50_000))
+        (src / "b.txt").write_bytes(b"hello")
+        (src / "sub" / "c.bin").write_bytes(os.urandom(20_000))
+
+        async def go():
+            async def body(daemon, client):
+                out = tmp_path / "mirror"
+                dones = []
+                async for resp in client.unary_stream("Download", DownloadRequest(
+                        url=f"file://{src}", output=str(out),
+                        recursive=True)):
+                    if resp.done:
+                        dones.append(resp.output)
+                assert len(dones) == 3
+                assert (out / "a.bin").read_bytes() == \
+                    (src / "a.bin").read_bytes()
+                assert (out / "b.txt").read_bytes() == b"hello"
+                assert (out / "sub" / "c.bin").read_bytes() == \
+                    (src / "sub" / "c.bin").read_bytes()
+            await run_daemon_ctx(tmp_path, body)
+        asyncio.run(go())

@@ -180,3 +180,38 @@ class TestDurableJobs:
             finally:
                 await m.stop()
         asyncio.run(main())
+
+
+class TestSearcherPlugin:
+    def test_plugin_overrides_cluster_choice(self, tmp_path):
+        """A searcher-type plugin replaces the affinity scorer (reference
+        manager/searcher plugin slot)."""
+        import asyncio
+        import textwrap
+
+        from dragonfly2_tpu.idl.messages import GetSchedulersRequest
+        from dragonfly2_tpu.manager import searcher as s
+
+        plug_dir = tmp_path / "plugins"
+        plug_dir.mkdir()
+        (plug_dir / "df_plugin_searcher_default.py").write_text(
+            textwrap.dedent("""
+                class AlwaysSecond:
+                    def find_scheduler_cluster(self, clusters, req):
+                        return clusters[1]["id"] if len(clusters) > 1 else None
+
+                def dragonfly_plugin_init(option):
+                    return AlwaysSecond(), {"type": "searcher",
+                                            "name": "default"}
+            """))
+        clusters = [{"id": 1, "scopes": {}, "is_default": True},
+                    {"id": 2, "scopes": {}}]
+        req = GetSchedulersRequest(ip="10.0.0.1", hostname="h")
+        # built-in scorer prefers the default cluster
+        assert s.find_scheduler_cluster(clusters, req) == 1
+        s.load_searcher_plugin(str(plug_dir))
+        try:
+            assert s.find_scheduler_cluster(clusters, req) == 2
+        finally:
+            s._plugin_searcher = None
+        assert asyncio is not None

@@ -337,3 +337,31 @@ class TestORASSource:
                 await client.close()
                 await runner.cleanup()
         asyncio.run(main())
+
+
+class TestWalk:
+    def test_walk_breaks_symlink_cycles(self, tmp_path):
+        """A directory symlink pointing at an ancestor must not loop the
+        BFS forever (realpath identity breaks the cycle for file://)."""
+        import asyncio
+
+        from dragonfly2_tpu.source.client import walk
+
+        root = tmp_path / "tree"
+        (root / "sub").mkdir(parents=True)
+        (root / "a.bin").write_bytes(b"A" * 100)
+        (root / "sub" / "b.bin").write_bytes(b"B" * 50)
+        (root / "sub" / "loop").symlink_to(root)   # cycle
+
+        async def go():
+            rels = []
+            async for entry, rel in walk(f"file://{root}"):
+                rels.append(rel)
+                assert len(rels) < 50, "walk is looping"
+            return rels
+
+        rels = asyncio.run(go())
+        assert sorted(rels)[:2] == ["a.bin", "sub/b.bin"]
+        # the cycle may contribute each file at most once more via the
+        # symlinked alias, never unboundedly
+        assert len(rels) <= 4

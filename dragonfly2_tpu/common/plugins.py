@@ -12,8 +12,10 @@ the metadata. Python-shaped: a plugin is a module file
 The same contract checks apply (init symbol present, metadata echoes the
 requested type and name). Known types: ``evaluator`` (object with an
 ``evaluate(child, parent, total_piece_count)`` method, consumed by
-``scheduler.evaluator.make_evaluator``) and ``source`` (a source client
-registered for the schemes in ``meta["schemes"]``).
+``scheduler.evaluator.make_evaluator``), ``source`` (a source client
+registered for the schemes in ``meta["schemes"]``), and ``searcher``
+(object with ``find_scheduler_cluster(clusters, req)``, consumed by
+``manager.searcher.load_searcher_plugin``).
 """
 
 from __future__ import annotations

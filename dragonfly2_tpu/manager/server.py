@@ -129,12 +129,12 @@ class Manager:
 
     async def start(self) -> None:
         if self.cfg.plugin_dir:
+            # fail HARD like the scheduler's evaluator plugin slot: an
+            # operator who configured a plugin must not silently get the
+            # built-in scorer because of a typo in the plugin file
             from .searcher import load_searcher_plugin
-            try:
-                load_searcher_plugin(self.cfg.plugin_dir)
-                log.info("searcher plugin loaded from %s", self.cfg.plugin_dir)
-            except Exception as exc:  # noqa: BLE001 - plugin is optional
-                log.warning("searcher plugin not loaded: %s", exc)
+            load_searcher_plugin(self.cfg.plugin_dir)
+            log.info("searcher plugin loaded from %s", self.cfg.plugin_dir)
         # a default cluster always exists so self-registration lands somewhere
         self.store.default_scheduler_cluster()
         self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.grpc_port}",

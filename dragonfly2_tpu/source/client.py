@@ -8,11 +8,14 @@ store while bytes stream in.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Protocol
 
 from ..common.errors import Code, DFError
 from ..common.piece import Range
+
+log = logging.getLogger("df.source")
 
 
 @dataclass
@@ -121,14 +124,27 @@ async def walk(url: str, *, timeout_s: float = 0.0,
         for e in entries:
             if e.is_dir:
                 key = ident(e.url)
-                if key not in seen and depth + 1 <= max_depth:
-                    seen.add(key)
-                    queue.append((e.url, depth + 1))
+                if key in seen:
+                    continue
+                if depth + 1 > max_depth:
+                    log.warning("walk: skipping %s (deeper than max_depth"
+                                "=%d) — mirror will be incomplete",
+                                e.url, max_depth)
+                    continue
+                seen.add(key)
+                queue.append((e.url, depth + 1))
                 continue
             rel = urlparse(e.url).path
             if base_path and rel.startswith(base_path):
                 rel = rel[len(base_path):]
-            rel = rel.lstrip("/") or e.name
+            rel = os.path.normpath(rel.lstrip("/") or e.name)
+            if rel.startswith("..") or os.path.isabs(rel):
+                # origin-controlled names must not escape the output dir
+                # (object keys may legally contain '..'; a hostile lister
+                # could name its way into ~/.ssh with the daemon's
+                # privileges)
+                log.warning("walk: refusing traversal entry %r", e.url)
+                continue
             yield e, rel
 
 

@@ -89,6 +89,22 @@ async def download_via_daemon(sock: str, args, *, progress=None) -> None:
         req = DownloadRequest(url=args.url, output=os.path.abspath(args.output),
                               url_meta=_meta(args), timeout_s=args.timeout,
                               recursive=args.recursive)
+        if args.recursive:
+            # concurrent per-file events interleave on one stream with no
+            # file identity on progress frames — a single-file percentage
+            # renderer would garble them; report completed files instead
+            files = 0
+            total = 0
+            async for resp in client.unary_stream("Download", req):
+                if resp.done:
+                    files += 1
+                    total += resp.completed_length
+                    if not args.quiet:
+                        print(f"dfget: [{files}] {resp.output} "
+                              f"({format_bytes(resp.completed_length)})")
+            if not args.quiet:
+                print(f"dfget: {files} files, {format_bytes(total)} total")
+            return
         async for resp in client.unary_stream("Download", req):
             if progress and not resp.done:
                 progress(resp.completed_length, resp.content_length)

@@ -365,3 +365,44 @@ class TestWalk:
         # the cycle may contribute each file at most once more via the
         # symlinked alias, never unboundedly
         assert len(rels) <= 4
+
+    def test_walk_refuses_path_traversal_names(self, tmp_path):
+        """Origin-controlled names with '..' must not escape the mirror
+        root (object keys may legally contain dots; a hostile lister must
+        not write into ~/.ssh with the daemon's privileges)."""
+        import asyncio
+
+        from dragonfly2_tpu.source import ListEntry, register_client
+        from dragonfly2_tpu.source.client import walk
+
+        class EvilLister:
+            async def content_length(self, req):
+                return 10
+
+            async def supports_range(self, req):
+                return False
+
+            async def last_modified(self, req):
+                return ""
+
+            async def download(self, req):
+                raise AssertionError("not fetched")
+
+            async def list(self, req):
+                return [
+                    ListEntry(url="evil://b/a/../../../etc/cron.d/x",
+                              name="x", is_dir=False, content_length=10),
+                    ListEntry(url="evil://b/ok.bin", name="ok.bin",
+                              is_dir=False, content_length=10),
+                ]
+
+        register_client("evil", EvilLister())
+
+        async def go():
+            rels = []
+            async for _e, rel in walk("evil://b"):
+                rels.append(rel)
+            return rels
+
+        rels = asyncio.run(go())
+        assert rels == ["ok.bin"], rels

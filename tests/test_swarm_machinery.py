@@ -155,6 +155,36 @@ class TestDispatcher:
             assert st.consecutive_fails == 0
         asyncio.run(main())
 
+    def test_busy_honors_server_retry_hint(self):
+        async def main():
+            d = PieceDispatcher()
+            await d.add_parent("pa", "127.0.0.1:1")
+            await d.announce("pa", [info(0)])
+            got = await d.get(timeout=0.5)
+            t0 = time.monotonic()
+            await d.report_busy(got, retry_after_ms=400)
+            st = d.parents["pa"]
+            # hint (with jitter 0.8-1.5x) wins over the 40ms base backoff
+            assert st.busy_until - t0 >= 0.3
+            assert st.busy_until - t0 <= 0.7
+            # consecutive busies without a hint back off exponentially
+            got = None
+            st.busy_until = 0.0
+            got2 = await d.get(timeout=0.5)
+            await d.report_busy(got2)
+            first = st.busy_until - time.monotonic()
+            st.busy_until = 0.0
+            got3 = await d.get(timeout=0.5)
+            await d.report_busy(got3)
+            second = st.busy_until - time.monotonic()
+            assert second > first    # 2^(n-1) growth beats the jitter band
+            # success resets the streak
+            st.busy_until = 0.0
+            got4 = await d.get(timeout=0.5)
+            await d.report(got4, ok=True, cost_ms=5)
+            assert st.consecutive_busy == 0
+        asyncio.run(main())
+
     def test_cooldown_ejection_recovers(self):
         async def main():
             d = PieceDispatcher()

@@ -517,6 +517,26 @@ def fanout_wave(workdir: str, tag: str, n: int, sched_addr: str,
             p.p.wait(timeout=30)
         except subprocess.TimeoutExpired:
             p.kill()
+    # drop this wave's piece stores + replicas NOW: workdirs live in
+    # /dev/shm (RAM), and N waves x 16 leechers x 2 file-size copies
+    # accumulate tens of GB of tmpfs pages — which measurably slowed every
+    # later wave on the 1-vCPU bench VM (the r04 escalating-wave mystery:
+    # 13s -> 67s across identical waves, cured by this cleanup)
+    import shutil
+    dbg = os.environ.get("BENCH_DEBUG_DIR")
+    for i in range(n):
+        d = os.path.join(workdir, f"{tag}{i}")
+        if dbg:
+            # keep logs/ — the finally-block forensics copytree needs the
+            # per-daemon file logs; drop only the bulky payload dirs
+            for sub in ("data", "cache", "run"):
+                shutil.rmtree(os.path.join(d, sub), ignore_errors=True)
+            try:
+                os.unlink(os.path.join(d, "replica.bin"))
+            except OSError:
+                pass
+        else:
+            shutil.rmtree(d, ignore_errors=True)
     return result
 
 

@@ -60,6 +60,11 @@ class RestAPI:
         r.add_get("/api/v1/oauth", self._list_oauth)
         r.add_post("/api/v1/oauth", self._create_oauth)
         r.add_delete("/api/v1/oauth/{id}", self._delete_oauth)
+        r.add_get("/api/v1/seed-peer-clusters", self._list_sp_clusters)
+        r.add_post("/api/v1/seed-peer-clusters", self._create_sp_cluster)
+        r.add_patch("/api/v1/scheduler-clusters/{id}",
+                    self._update_sched_cluster)
+        r.add_get("/api/v1/users", self._list_users)
         if self.auth is not None:
             from .auth import OAuthFlow
             self._oauth_flow = OAuthFlow(self.store, self.auth)
@@ -200,6 +205,57 @@ class RestAPI:
         await asyncio.to_thread(self.store.revoke_pat,
                                 int(request.match_info["id"]))
         return web.json_response({"ok": True})
+
+    async def _list_sp_clusters(self, _r: web.Request) -> web.Response:
+        return web.json_response(
+            await asyncio.to_thread(self.store.seed_peer_clusters))
+
+    async def _create_sp_cluster(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            cid = await asyncio.to_thread(self.store.create_seed_peer_cluster,
+                                          body["name"])
+        except KeyError:
+            return web.json_response({"error": "missing field 'name'"},
+                                     status=400)
+        except Exception as exc:  # noqa: BLE001 - e.g. duplicate name
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"id": cid}, status=201)
+
+    async def _update_sched_cluster(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except Exception as exc:  # noqa: BLE001 - malformed input is a 400
+            return web.json_response({"error": str(exc)}, status=400)
+        cid = int(request.match_info["id"])
+        cfg = None
+        if body.get("config") is not None:
+            if not isinstance(body["config"], dict):
+                return web.json_response({"error": "config must be an object"},
+                                         status=400)
+            # PARTIAL update: merge over the stored config — rebuilding from
+            # dataclass defaults would silently reset every omitted tunable
+            current = await asyncio.to_thread(self.store.cluster_config, cid)
+            try:
+                cfg = dataclasses.replace(current, **body["config"])
+            except TypeError as exc:
+                return web.json_response({"error": str(exc)}, status=400)
+        if cfg is None and body.get("scopes") is None:
+            return web.json_response({"error": "nothing to update"},
+                                     status=400)
+        ok = await asyncio.to_thread(
+            lambda: self.store.update_scheduler_cluster(
+                cid, config=cfg, scopes=body.get("scopes")))
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def _list_users(self, request: web.Request) -> web.Response:
+        user = request.get("user")
+        if user is not None and user.get("role") != "root":
+            # usernames include oauth identities; only operators list them
+            return web.json_response({"error": "forbidden"}, status=403)
+        return web.json_response(await asyncio.to_thread(self.store.users))
 
     # -- oauth (reference manager/handlers/oauth.go) --------------------
 

@@ -184,6 +184,36 @@ class Store:
             " VALUES (?,?,?)", (name, _now(), _now()))
         return int(cur.lastrowid)
 
+    def seed_peer_clusters(self) -> list[dict]:
+        return [dict(r) for r in self._rows(
+            "SELECT * FROM seed_peer_clusters ORDER BY id")]
+
+    def update_scheduler_cluster(self, cluster_id: int, *,
+                                 config: ClusterConfig | None = None,
+                                 scopes: dict | None = None) -> bool:
+        """Partial update of a cluster's dynconfig payload (reference
+        UpdateSchedulerCluster handler); schedulers pick the new config up
+        on their next dynconfig refresh."""
+        sets, args = [], []
+        if config is not None:
+            sets.append("config=?")
+            args.append(json.dumps(dataclasses.asdict(config)))
+        if scopes is not None:
+            sets.append("scopes=?")
+            args.append(json.dumps(scopes))
+        if not sets:
+            return False
+        sets.append("updated_at=?")
+        args += [_now(), cluster_id]
+        cur = self._exec(
+            f"UPDATE scheduler_clusters SET {', '.join(sets)} WHERE id=?",
+            args)
+        return cur.rowcount > 0
+
+    def users(self) -> list[dict]:
+        return [dict(r) for r in self._rows(
+            "SELECT id, name, role, created_at FROM users ORDER BY id")]
+
     # -- scheduler instances ------------------------------------------
 
     def upsert_scheduler(self, *, hostname: str, ip: str, port: int,

@@ -138,3 +138,89 @@ class TestManagerE2E:
                 await manager.stop()
 
         asyncio.run(go())
+
+
+class TestRestCRUDExtras:
+    def test_sp_clusters_cluster_update_users(self, tmp_path):
+        """Seed-peer cluster CRUD, scheduler-cluster config PATCH (dynconfig
+        payload of record), and root-gated user listing."""
+        import aiohttp
+
+        from dragonfly2_tpu.manager.server import Manager, ManagerConfig
+
+        async def go():
+            m = Manager(ManagerConfig(listen_ip="127.0.0.1",
+                                      workdir=str(tmp_path),
+                                      auth_enabled=True))
+            await m.start()
+            try:
+                base = f"http://127.0.0.1:{m.rest.port}"
+                with open(tmp_path / "root.password") as f:
+                    pw = f.read().strip()
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "root",
+                                            "password": pw}) as r:
+                        hdr = {"Authorization":
+                               f"Bearer {(await r.json())['token']}"}
+                    # seed-peer clusters
+                    async with s.post(f"{base}/api/v1/seed-peer-clusters",
+                                      json={"name": "spc1"},
+                                      headers=hdr) as r:
+                        assert r.status == 201
+                    async with s.get(f"{base}/api/v1/seed-peer-clusters",
+                                     headers=hdr) as r:
+                        rows = await r.json()
+                        assert any(c["name"] == "spc1" for c in rows)
+                    # scheduler cluster config PATCH -> dynconfig changes
+                    async with s.get(f"{base}/api/v1/scheduler-clusters",
+                                     headers=hdr) as r:
+                        cid = (await r.json())[0]["id"]
+                    async with s.patch(
+                            f"{base}/api/v1/scheduler-clusters/{cid}",
+                            json={"config": {"candidate_parent_limit": 7}},
+                            headers=hdr) as r:
+                        assert r.status == 200
+                    cfg = m.store.cluster_config(cid)
+                    assert cfg.candidate_parent_limit == 7
+                    # PARTIAL: a second patch of a different field must not
+                    # reset the first back to its default
+                    async with s.patch(
+                            f"{base}/api/v1/scheduler-clusters/{cid}",
+                            json={"config": {"filter_parent_limit": 11}},
+                            headers=hdr) as r:
+                        assert r.status == 200
+                    cfg = m.store.cluster_config(cid)
+                    assert cfg.candidate_parent_limit == 7
+                    assert cfg.filter_parent_limit == 11
+                    # unknown field and empty body are 400s, not 500/404
+                    async with s.patch(
+                            f"{base}/api/v1/scheduler-clusters/{cid}",
+                            json={"config": {"bogus": 1}},
+                            headers=hdr) as r:
+                        assert r.status == 400
+                    async with s.patch(
+                            f"{base}/api/v1/scheduler-clusters/{cid}",
+                            json={}, headers=hdr) as r:
+                        assert r.status == 400
+                    # users: root sees the list; guests are refused
+                    async with s.post(f"{base}/api/v1/users",
+                                      json={"name": "eve", "password": "pw"},
+                                      headers=hdr) as r:
+                        assert r.status == 201
+                    async with s.get(f"{base}/api/v1/users",
+                                     headers=hdr) as r:
+                        assert r.status == 200
+                        assert {u["name"] for u in await r.json()} >= \
+                            {"root", "eve"}
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "eve",
+                                            "password": "pw"}) as r:
+                        ghdr = {"Authorization":
+                                f"Bearer {(await r.json())['token']}"}
+                    async with s.get(f"{base}/api/v1/users",
+                                     headers=ghdr) as r:
+                        assert r.status == 403
+            finally:
+                await m.stop()
+        asyncio.run(go())

@@ -84,6 +84,8 @@ class Tracer:
         self._otlp_endpoint = ""
         self._lock = threading.Lock()
         self._buffer: list[Span] = []
+        self._last_flush = time.monotonic()   # monotonic: NTP steps must
+        # not suppress (or force) the age-based flush
         self._atexit_registered = False
         self._export_q: "queue.Queue[list[Span] | None]" = queue.Queue(64)
         self._exporter: threading.Thread | None = None
@@ -138,8 +140,12 @@ class Tracer:
             if len(self._buffer) >= self.MAX_BUFFER:
                 self._buffer.pop(0)        # bounded: drop-oldest
             self._buffer.append(sp)
+            # size, notable-span, or AGE: a long-lived daemon emitting a
+            # trickle must not hold its spans in memory until shutdown
+            # (a live `tail -f traces.jsonl` is the point of the file)
             if (len(self._buffer) >= 64
-                    or sp.end_ns - sp.start_ns > 1_000_000_000):
+                    or sp.end_ns - sp.start_ns > 1_000_000_000
+                    or time.monotonic() - self._last_flush > 5.0):
                 self._flush_locked()
 
     def flush(self) -> None:
@@ -153,6 +159,7 @@ class Tracer:
 
     def _flush_locked(self) -> None:
         batch, self._buffer = self._buffer, []
+        self._last_flush = time.monotonic()
         if not batch:
             return
         if self._jsonl_file is not None:

@@ -26,6 +26,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", action="store_true", help="run as seed peer")
     p.add_argument("--scheduler", action="append", default=[],
                    help="scheduler address (repeatable)")
+    # monitor bootstrap (reference cmd/dependency InitMonitor --pprof-port /
+    # --jaeger): live /debug/{stacks,profile} on the upload port + tracing
+    p.add_argument("--debug-endpoints", action="store_true",
+                   help="serve /debug/stacks and /debug/profile")
+    p.add_argument("--tracing-jsonl", default="",
+                   help="enable tracing; spans to this JSONL path")
+    p.add_argument("--tracing-otlp", default="",
+                   help="enable tracing; spans to this OTLP endpoint")
     p.add_argument("--verbose", "-v", action="store_true")
     return p
 
@@ -58,6 +66,17 @@ def main(argv: list[str] | None = None) -> int:
         overrides["is_seed"] = True
     if args.scheduler:
         overrides.setdefault("scheduler", {})["addresses"] = args.scheduler
+    if args.debug_endpoints:
+        overrides.setdefault("upload", {})["debug_endpoints"] = True
+    if args.tracing_jsonl or args.tracing_otlp:
+        tr = overrides.setdefault("tracing", {})
+        tr["enabled"] = True
+        # only the flags actually passed: an empty value here would clobber
+        # the other exporter configured via file/env (leaf overwrite)
+        if args.tracing_jsonl:
+            tr["jsonl_path"] = args.tracing_jsonl
+        if args.tracing_otlp:
+            tr["otlp_endpoint"] = args.tracing_otlp
     cfg = load_config(DaemonConfig, args.config or None, overrides)
     asyncio.run(serve(cfg))
     return 0

@@ -89,6 +89,7 @@ class Tracer:
         self._atexit_registered = False
         self._export_q: "queue.Queue[list[Span] | None]" = queue.Queue(64)
         self._exporter: threading.Thread | None = None
+        self._flusher: threading.Thread | None = None
 
     def configure(self, *, service: str = "", jsonl_path: str = "",
                   otlp_endpoint: str = "",
@@ -111,6 +112,14 @@ class Tracer:
                 # for) rarely hit the 64-span flush threshold
                 atexit.register(self._shutdown_flush)
                 self._atexit_registered = True
+            if self.enabled and self._flusher is None:
+                # timer-driven flush: the finish()-time age check alone
+                # cannot drain a burst followed by silence — a live tail of
+                # the trace file would show nothing until the next span
+                self._flusher = threading.Thread(
+                    target=self._flush_loop, name="df-trace-flush",
+                    daemon=True)
+                self._flusher.start()
 
     def _sampled(self) -> bool:
         if self.sample_ratio >= 1.0:
@@ -151,6 +160,12 @@ class Tracer:
     def flush(self) -> None:
         with self._lock:
             self._flush_locked()
+
+    def _flush_loop(self) -> None:
+        while True:
+            time.sleep(5.0)
+            if self._buffer:
+                self.flush()
 
     def _shutdown_flush(self) -> None:
         self.flush()

@@ -124,10 +124,22 @@ class PieceManager:
         bench measured 0% ingest overlap that way)."""
         workers = min(self.cfg.back_source_parallelism, n)
         # one DMA unit per group: big enough that per-request origin overhead
-        # is noise, small enough that groups never span ingest shards
+        # is noise, small enough that groups never span ingest shards. The
+        # tail stretch (last ~2 rounds of the worker pool) halves the group
+        # size: with N groups ~= N workers every stream finishes together
+        # and the final ingest shards all ship after the last byte — smaller
+        # tail groups stagger the finishes so the tail DMA overlaps too.
         group_pieces = max(1, min(INGEST_DMA_UNIT_BYTES // piece_size,
                                   -(-n // workers)))
-        queue = collections.deque(range(0, n, group_pieces))
+        bounds: list[tuple[int, int]] = []
+        i = 0
+        while i < n:
+            size = group_pieces
+            if n - i <= 2 * workers * group_pieces and group_pieces > 1:
+                size = max(1, group_pieces // 2)
+            bounds.append((i, min(n, i + size)))
+            i += size
+        queue = collections.deque(bounds)
         base = req.range.start if req.range else 0
         content_len = req.range.length if req.range else total
 
@@ -166,8 +178,8 @@ class PieceManager:
 
         async def worker() -> None:
             while queue:
-                first = queue.popleft()
-                await group(first, min(n, first + group_pieces))
+                first, last = queue.popleft()
+                await group(first, last)
 
         results = await asyncio.gather(*(worker() for _ in range(workers)),
                                        return_exceptions=True)

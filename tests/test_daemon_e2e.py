@@ -455,23 +455,27 @@ class TestPieceGroupWorkQueue:
         asyncio.run(go())
         return requests, landed
 
-    def test_small_file_keeps_one_group_per_worker(self):
-        # group_pieces = min(32MiB // piece_size, ceil(n / workers)): with
-        # 64 × 64 KiB pieces the ceil(64/4)=16 bound wins -> exactly 4
-        # groups, same request count as the old static split
+    def test_small_file_splits_beyond_one_group_per_worker(self):
+        # group_pieces = min(32MiB // piece_size, ceil(n / workers)) = 16
+        # for 64 × 64 KiB pieces — and the tail-halving rule (everything
+        # within 2 pool-rounds of the end) splits those into 8 groups of 8,
+        # so coverage staggers instead of all four streams finishing at once
         requests, landed = self._run(64, 64 * 1024, slow_first_group=False)
         assert sorted(num for num, _ in landed) == list(range(64))
         assert sum(size for _, size in landed) == 64 * 64 * 1024
-        assert len(requests) == 4
+        assert len(requests) == 8
 
     def test_fast_workers_steal_groups_from_slow(self):
-        # piece_size 1 MiB, 40 pieces -> group_pieces = min(32, ceil(40/4))
-        # = 10 ... to get >workers groups use piece_size 8 MiB: group_pieces
-        # = min(4, 10) = 4 -> 10 groups over 4 workers; the slow worker
-        # (first group) must not strand the tail: others drain the queue
+        # piece_size 8 MiB, 40 pieces -> body groups of 4 pieces, tail
+        # (last 32 pieces = 2 pool-rounds) halved to 2: the slow worker
+        # (first group) must not strand the tail — others drain the queue
         requests, landed = self._run(40, 8 * 1024 * 1024, slow_first_group=True)
         assert sorted(num for num, _ in landed) == list(range(40))
-        assert len(requests) == 10
+        # dynamic claiming: strictly more groups than the 4 workers, and
+        # tail requests are SMALLER than body requests (stagger rule)
+        assert len(requests) > 4
+        sizes = [length for _, length in sorted(requests)]
+        assert sizes[-1] < sizes[0]
 
 
 class TestRecursiveDownload:

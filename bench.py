@@ -420,7 +420,9 @@ class Proc:
         line = self._read_line(timeout)
         return json.loads(line)
 
-    def wait_ready(self, timeout: float = 120.0) -> None:
+    def wait_ready(self, timeout: float = 240.0) -> None:
+        # generous: 16 fresh interpreters importing on one contended vCPU
+        # can legitimately take minutes to all come up
         line = self._read_line(timeout)
         assert line.strip() == "READY", f"unexpected: {line!r}"
 
@@ -496,32 +498,12 @@ def run_wave(procs: list[Proc]) -> tuple[float, list[float], float]:
     return max(r["elapsed"] for r in results), seed_fracs, cpu_util
 
 
-def fanout_wave(workdir: str, tag: str, n: int, sched_addr: str,
-                url: str, daemons: list["Proc"]
-                ) -> tuple[float, list[float], float]:
-    leechers = [Proc(["--role", "leecher",
-                      os.path.join(workdir, f"{tag}{i}"), f"{tag}leech{i}",
-                      sched_addr, url],
-                     stderr_path=os.environ.get("BENCH_DEBUG_DIR") and
-                     os.path.join(os.environ["BENCH_DEBUG_DIR"],
-                                  f"{tag}{i}.err"))
-                for i in range(n)]
-    daemons.extend(leechers)   # killed on any failure path
-    result = run_wave(leechers)
-    # reap this wave's processes BEFORE the caller starts the next one:
-    # 16 daemons' teardown (channel close, daemon.stop, interpreter exit)
-    # costs seconds of CPU that would otherwise bleed into the next timed
-    # wave on a core-bound host
-    for p in leechers:
-        try:
-            p.p.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            p.kill()
-    # drop this wave's piece stores + replicas NOW: workdirs live in
-    # /dev/shm (RAM), and N waves x 16 leechers x 2 file-size copies
-    # accumulate tens of GB of tmpfs pages — which measurably slowed every
-    # later wave on the 1-vCPU bench VM (the r04 escalating-wave mystery:
-    # 13s -> 67s across identical waves, cured by this cleanup)
+def _clean_wave_dirs(workdir: str, tag: str, n: int) -> None:
+    """Drop a wave's piece stores + replicas NOW: workdirs live in
+    /dev/shm (RAM), and N waves x 16 leechers x 2 file-size copies
+    accumulate tens of GB of tmpfs pages — which measurably slowed every
+    later wave on the 1-vCPU bench VM (the r04 escalating-wave mystery:
+    13s -> 67s across identical waves, cured by this cleanup)."""
     import shutil
     dbg = os.environ.get("BENCH_DEBUG_DIR")
     for i in range(n):
@@ -537,7 +519,53 @@ def fanout_wave(workdir: str, tag: str, n: int, sched_addr: str,
                 pass
         else:
             shutil.rmtree(d, ignore_errors=True)
-    return result
+
+
+def fanout_wave(workdir: str, tag: str, n: int, sched_addr: str,
+                url: str, daemons: list["Proc"], *,
+                origin_bytes_fn=None, _retry: bool = True
+                ) -> tuple[float, list[float], float, int]:
+    """Returns (max elapsed, seed fractions, cpu util, origin egress).
+
+    Egress is sampled INSIDE the wave (around the attempt that succeeded)
+    so an aborted first attempt's partial origin pulls don't inflate the
+    successful retry's egress-saved accounting."""
+    pre = origin_bytes_fn() if origin_bytes_fn else 0
+    leechers = [Proc(["--role", "leecher",
+                      os.path.join(workdir, f"{tag}{i}"), f"{tag}leech{i}",
+                      sched_addr, url],
+                     stderr_path=os.environ.get("BENCH_DEBUG_DIR") and
+                     os.path.join(os.environ["BENCH_DEBUG_DIR"],
+                                  f"{tag}{i}.err"))
+                for i in range(n)]
+    daemons.extend(leechers)   # killed on any failure path
+    try:
+        result = run_wave(leechers)
+    except (TimeoutError, RuntimeError) as exc:
+        # a straggler spawn on a contended host (16 interpreters on one
+        # vCPU) must not abort the whole bench — kill this wave's procs,
+        # free its tmpfs, and retry ONCE on a fresh tag + task
+        for p in leechers:
+            p.kill()
+        _clean_wave_dirs(workdir, tag, n)
+        if not _retry:
+            raise
+        log(f"wave {tag} spawn failed ({exc}); retrying once")
+        return fanout_wave(workdir, f"{tag}r", n, sched_addr,
+                           url + ".retry", daemons,
+                           origin_bytes_fn=origin_bytes_fn, _retry=False)
+    # reap this wave's processes BEFORE the caller starts the next one:
+    # 16 daemons' teardown (channel close, daemon.stop, interpreter exit)
+    # costs seconds of CPU that would otherwise bleed into the next timed
+    # wave on a core-bound host
+    for p in leechers:
+        try:
+            p.p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    _clean_wave_dirs(workdir, tag, n)
+    egress = (origin_bytes_fn() - pre) if origin_bytes_fn else 0
+    return (*result, egress)
 
 
 def _calibrate() -> float:
@@ -616,19 +644,17 @@ def main() -> None:
         half_runs = []
         n_runs = int(os.environ.get("BENCH_FANOUT_RUNS", "3"))
         for r in range(n_runs):
-            pre = origin_bytes()
-            half_s_r, _, half_cpu_r = fanout_wave(
+            half_s_r, _, half_cpu_r, half_egress = fanout_wave(
                 workdir, f"h{r}x", n_half, sched_addr,
-                f"{origin_base}/wave-half-{r}.bin", daemons)
-            half_egress = origin_bytes() - pre
+                f"{origin_base}/wave-half-{r}.bin", daemons,
+                origin_bytes_fn=origin_bytes)
             half_runs.append({"elapsed_s": half_s_r, "cpu": half_cpu_r})
             log(f"fan-out {n_half} leechers (half run {r}): {half_s_r:.2f}s "
                 f"(origin egress {half_egress / 1e6:.0f} MB)")
-            pre = origin_bytes()
-            fanout_s, seed_fracs, full_cpu = fanout_wave(
+            fanout_s, seed_fracs, full_cpu, p2p_egress = fanout_wave(
                 workdir, f"l{r}x", N_LEECHERS, sched_addr,
-                f"{origin_base}/wave-full-{r}.bin", daemons)
-            p2p_egress = origin_bytes() - pre
+                f"{origin_base}/wave-full-{r}.bin", daemons,
+                origin_bytes_fn=origin_bytes)
             runs.append({"elapsed_s": fanout_s, "egress": p2p_egress,
                          "seed_fracs": seed_fracs, "cpu": full_cpu})
             seed_active = "?"
@@ -662,12 +688,24 @@ def main() -> None:
             f"{fanout_s / half_s:.2f}x for 2x leechers; max seed-sourced "
             f"fraction {max_seed_frac:.0%}")
 
-        # TPU leg: measured in THIS process on the real chip
-        try:
-            tpu_stats = asyncio.run(tpu_ingest_bench(data_path, workdir))
-        except Exception as exc:  # noqa: BLE001 - no-accelerator hosts still bench the mesh
-            log(f"tpu ingest phase unavailable: {exc}")
-            tpu_stats = {}
+        # TPU leg: measured in THIS process on the real chip. Probe the
+        # backend bounded first — a wedged accelerator tunnel hangs every
+        # jax call indefinitely, and the mesh numbers above must still be
+        # reported
+        from dragonfly2_tpu.tpu.topology import probe_jax_devices
+
+        tpu_stats = {}
+        status, payload = probe_jax_devices(timeout_s=30.0)
+        if status == "timeout":
+            log("tpu ingest phase unavailable: accelerator runtime is not "
+                "answering")
+        elif status == "error":
+            log(f"tpu ingest phase unavailable: {payload}")
+        else:
+            try:
+                tpu_stats = asyncio.run(tpu_ingest_bench(data_path, workdir))
+            except Exception as exc:  # noqa: BLE001 - no-accelerator hosts still bench the mesh
+                log(f"tpu ingest phase unavailable: {exc}")
     finally:
         for p in daemons:
             p.kill()

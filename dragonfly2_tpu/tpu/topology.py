@@ -10,10 +10,51 @@ name + ICI chip coords + zone, and the scheduler computes a ``LinkType``
 from __future__ import annotations
 
 import functools
+import logging
 import os
 import socket
 
 from ..idl.messages import LinkType, TopologyInfo
+
+log = logging.getLogger("df.tpu.topology")
+
+
+def probe_jax_devices(timeout_s: float | None = None
+                      ) -> tuple[str, object]:
+    """TIME-BOUNDED jax device probe from a daemon thread.
+
+    jax backend init talks to the accelerator runtime (a tunnel, on some
+    deployments) and can hang indefinitely when it is wedged — and a
+    DISTRIBUTION daemon must come up and serve the CPU-side mesh even
+    while the accelerator runtime is sick (a wedged tunnel froze every
+    daemon of an r04 bench at construction for >120s). A daemon thread is
+    essential: an executor's non-daemon worker would block interpreter
+    exit via its atexit join.
+
+    Returns (status, payload):
+      ("ok", (tpu_chip_count, first_tpu_device | None, device_count))
+      ("error", exception)   — jax absent or backend init raised
+      ("timeout", None)      — runtime never answered
+    """
+    import threading
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("DF_TOPOLOGY_PROBE_TIMEOUT_S", "15"))
+    box: list = []
+
+    def _probe() -> None:
+        try:
+            import jax
+            devs = [d for d in jax.local_devices() if d.platform == "tpu"]
+            box.append(("ok", (len(devs), devs[0] if devs else None,
+                               jax.device_count())))
+        except BaseException as exc:  # noqa: BLE001 - reported to caller
+            box.append(("error", exc))
+
+    t = threading.Thread(target=_probe, name="df-topo-probe", daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    return box[0] if box else ("timeout", None)
 
 
 @functools.lru_cache(maxsize=1)
@@ -43,21 +84,20 @@ def detect() -> TopologyInfo:
         except ValueError:
             coords = None
     num_chips = 0
-    try:
-        import jax
-
-        devices = [d for d in jax.local_devices() if d.platform == "tpu"]
-        num_chips = len(devices)
-        if devices:
-            first = devices[0]
+    status, payload = probe_jax_devices()
+    if status == "timeout":
+        log.warning("accelerator runtime did not answer the topology probe;"
+                    " running topology-less (device sink unavailable)")
+    elif status == "ok":
+        num_chips, first, total = payload
+        if first is not None:
             if coords is None:   # explicit injection wins over detection
                 coords = tuple(getattr(first, "coords", ()) or ()) or None
             if not slice_name:
-                slice_name = f"{getattr(first, 'device_kind', 'tpu')}-{jax.device_count()}"
+                slice_name = f"{getattr(first, 'device_kind', 'tpu')}-{total}"
             if worker < 0:
                 worker = getattr(first, "process_index", 0)
-    except Exception:  # noqa: BLE001 - jax may be absent/misconfigured
-        pass
+    # status == "error": jax absent/misconfigured — silent, like always
     if not zone:
         zone = os.environ.get("DF_DEFAULT_ZONE", "local")
     return TopologyInfo(slice_name=slice_name, worker_index=worker,

@@ -188,3 +188,32 @@ class TestMesh:
         assert mesh.shape["data"] == n // 2
         with pytest.raises(ValueError):
             make_mesh({"data": 3}) if n % 3 else (_ for _ in ()).throw(ValueError())
+
+
+class TestJaxProbe:
+    def test_probe_ok_on_cpu_backend(self):
+        from dragonfly2_tpu.tpu.topology import probe_jax_devices
+        status, payload = probe_jax_devices(timeout_s=60)
+        assert status == "ok"
+        n_tpu, first, total = payload
+        assert total >= 1          # conftest pins the cpu backend
+        assert n_tpu == 0          # no tpu chips on the cpu backend
+
+    def test_probe_reports_error_not_timeout_when_jax_breaks(self, monkeypatch):
+        """Absent/broken jax must surface as 'error' (with the exception),
+        not masquerade as a hung runtime."""
+        import builtins
+
+        from dragonfly2_tpu.tpu import topology
+
+        real_import = builtins.__import__
+
+        def broken_import(name, *a, **kw):
+            if name == "jax":
+                raise ImportError("jax exploded (test)")
+            return real_import(name, *a, **kw)
+
+        monkeypatch.setattr(builtins, "__import__", broken_import)
+        status, payload = topology.probe_jax_devices(timeout_s=10)
+        assert status == "error"
+        assert "exploded" in str(payload)

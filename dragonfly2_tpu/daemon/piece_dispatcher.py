@@ -212,6 +212,13 @@ class PieceDispatcher:
         # gate because announcements are drip-fed — a child mid-swarm often
         # knows few undone pieces while hundreds remain
         self.endgame = False
+        # structural convoy accounting: cumulative seconds workers spent
+        # parked in get() with nothing dispatchable, bucketed by why. The
+        # bench reads this to separate "host CPU was the wall" from "the
+        # protocol starved its workers" (a wall-clock-only sublinearity
+        # number can't tell those apart on a saturated host).
+        self.wait_stats = {"no_piece_s": 0.0, "busy_s": 0.0,
+                           "seed_busy_s": 0.0, "other_s": 0.0}
 
     # ------------------------------------------------------------------
     # feeding: parents + announced pieces
@@ -444,6 +451,28 @@ class PieceDispatcher:
             return Dispatch([ps.info], parent)
         return None
 
+    def _wait_reason(self, now: float) -> str:
+        """Coarse bucket for why _pick returned None (caller holds _cond):
+        no announced pending piece at all, every usable holder backing off
+        busy (seed-only vs any), or other (locality deferral, in-flight
+        dedup, race-age windows)."""
+        if not self._pieces:
+            return "no_piece_s"
+        saw_busy, busy_all_seed = False, True
+        for ps in self._pieces.values():
+            if ps.inflight:
+                continue
+            for h in ps.holders:
+                p = self.parents.get(h)
+                if p is None or p.ejected:
+                    continue
+                if p.is_busy():
+                    saw_busy = True
+                    busy_all_seed = busy_all_seed and p.is_seed
+        if saw_busy:
+            return "seed_busy_s" if busy_all_seed else "busy_s"
+        return "other_s"
+
     async def get(self, timeout: float | None = None) -> Dispatch | None:
         """Next (piece, parent) to fetch; None when closed or timed out."""
         deadline = time.monotonic() + timeout if timeout else None
@@ -481,11 +510,15 @@ class PieceDispatcher:
                                 wake = dt if wake is None else min(wake, dt)
                 if wake is not None:
                     remaining = min(remaining or wake, wake)
+                reason = self._wait_reason(now)
+                t_wait = time.monotonic()
                 try:
                     await asyncio.wait_for(self._cond.wait(), remaining)
                 except asyncio.TimeoutError:
                     if deadline is not None and time.monotonic() >= deadline:
                         return None
+                finally:
+                    self.wait_stats[reason] += time.monotonic() - t_wait
 
     async def report_busy(self, d: Dispatch,
                           retry_after_ms: int = 0) -> None:

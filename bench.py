@@ -452,7 +452,6 @@ async def _train_during_ingest(daemon, base: str, workdir: str,
     state = {"params": params, "opt": opt_state}
 
     def steps_per_s(duration_s: float, stop: threading.Event | None = None,
-                    progress: dict | None = None,
                     stamps: list | None = None) -> tuple[float, int]:
         n = 0
         t0 = time.monotonic()
@@ -462,8 +461,6 @@ async def _train_during_ingest(daemon, base: str, workdir: str,
                 state["params"], state["opt"], batch)
             jax.block_until_ready(loss)
             n += 1
-            if progress is not None:
-                progress["n"] = n
             if stamps is not None:
                 stamps.append(time.monotonic())
         dt = time.monotonic() - t0
@@ -472,10 +469,9 @@ async def _train_during_ingest(daemon, base: str, workdir: str,
     base_sps, _ = steps_per_s(3.0)
 
     stop = threading.Event()
-    progress = {"n": 0}
     stamps: list[float] = []
     train_task = asyncio.create_task(
-        asyncio.to_thread(steps_per_s, 600.0, stop, progress, stamps))
+        asyncio.to_thread(steps_per_s, 600.0, stop, stamps))
     dma_active = 0.0
     streamed = 0
     windows: list[tuple[float, float]] = []
@@ -493,13 +489,16 @@ async def _train_during_ingest(daemon, base: str, workdir: str,
                 await asyncio.to_thread(ingest.result)
                 dma_active += sum(e - s for s, e in ingest.transfer_spans)
                 streamed += size
-            # window closes at last-DMA-done, BEFORE the bookkeeping
-            # (delete_task, loop checks) — the slowdown number must only
-            # average steps that ran against live ingest, not the gaps
-            windows.append((t_w0, time.monotonic()))
+                # window closes at last-DMA-done, BEFORE the bookkeeping
+                # (delete_task, loop checks) — the slowdown number must
+                # only average steps that ran against live ingest, not the
+                # gaps (and a failed sink task contributes no window)
+                windows.append((t_w0, time.monotonic()))
             if task_id is not None:
                 await daemon.ptm.delete_task(task_id)
-            if progress["n"] >= 15 or stop.is_set() or train_task.done():
+            in_window = sum(1 for t in stamps
+                            if any(s <= t <= e for s, e in windows))
+            if in_window >= 15 or stop.is_set() or train_task.done():
                 break
     finally:
         stop.set()

@@ -451,26 +451,27 @@ class PieceDispatcher:
             return Dispatch([ps.info], parent)
         return None
 
-    def _wait_reason(self, now: float) -> str:
+    def _wait_reason(self) -> str:
         """Coarse bucket for why _pick returned None (caller holds _cond):
         no announced pending piece at all, every usable holder backing off
         busy (seed-only vs any), or other (locality deferral, in-flight
-        dedup, race-age windows)."""
+        dedup, race-age windows). Classifies parents once (not per piece)
+        and short-circuits on the first busy non-seed: this runs on every
+        worker wake, which a 503 storm drives at the 0.02s wake floor."""
         if not self._pieces:
             return "no_piece_s"
-        saw_busy, busy_all_seed = False, True
-        for ps in self._pieces.values():
-            if ps.inflight:
-                continue
-            for h in ps.holders:
-                p = self.parents.get(h)
-                if p is None or p.ejected:
+        busy_ids, busy_seed_ids = set(), set()
+        for pid, p in self.parents.items():
+            if not p.ejected and p.is_busy():
+                (busy_seed_ids if p.is_seed else busy_ids).add(pid)
+        if busy_ids or busy_seed_ids:
+            for ps in self._pieces.values():
+                if ps.inflight:
                     continue
-                if p.is_busy():
-                    saw_busy = True
-                    busy_all_seed = busy_all_seed and p.is_seed
-        if saw_busy:
-            return "seed_busy_s" if busy_all_seed else "busy_s"
+                if ps.holders & busy_ids:
+                    return "busy_s"
+                if ps.holders & busy_seed_ids:
+                    return "seed_busy_s"
         return "other_s"
 
     async def get(self, timeout: float | None = None) -> Dispatch | None:
@@ -510,7 +511,7 @@ class PieceDispatcher:
                                 wake = dt if wake is None else min(wake, dt)
                 if wake is not None:
                     remaining = min(remaining or wake, wake)
-                reason = self._wait_reason(now)
+                reason = self._wait_reason()
                 t_wait = time.monotonic()
                 try:
                     await asyncio.wait_for(self._cond.wait(), remaining)

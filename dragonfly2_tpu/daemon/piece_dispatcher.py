@@ -165,6 +165,12 @@ LOCALITY_GRACE_S = 0.15
 # one (503 backoff is 40ms; DCN costs the whole transfer at ~1/10th the
 # bandwidth) — bounded so a stuck local holder can't starve the piece
 BUSY_LOCAL_WAIT_S = 1.0
+# a BUSY peer holder is worth a short wait before spending SEED egress:
+# seed/origin-side bandwidth is the scarce fleet resource (BASELINE
+# "% egress saved"), and a freshly idle seed otherwise becomes a magnet
+# the moment sibling upload slots saturate (chaos e2e: one survivor took
+# half its pieces from a just-restarted seed while busy peers held them)
+BUSY_PEER_SEED_WAIT_S = 0.6
 ENDGAME_PIECES = 2   # remaining-piece count at which duplicate racing is allowed
 # (kept tiny: each duplicate is a full extra transfer — on CPU-bound hosts
 # racing the whole tail measurably SLOWS the wave; this is stall insurance
@@ -219,6 +225,7 @@ class PieceDispatcher:
         # number can't tell those apart on a saturated host).
         self.wait_stats = {"no_piece_s": 0.0, "busy_s": 0.0,
                            "seed_busy_s": 0.0, "other_s": 0.0}
+        self._seed_hold_expiry: float | None = None   # see _pick seed grace
 
     # ------------------------------------------------------------------
     # feeding: parents + announced pieces
@@ -307,6 +314,7 @@ class PieceDispatcher:
         now = time.monotonic()
         candidates = []
         deferred = []
+        self._seed_hold_expiry = None   # earliest held-piece re-admission
         # locality deferral only exists where locality does: a swarm with
         # no same-slice parents at all (no topology, e.g. plain clusters)
         # must not tax every fresh piece with the grace wait
@@ -334,6 +342,20 @@ class PieceDispatcher:
             if (any_local and not local_free and not self.ordered
                     and age < wait):
                 deferred.append((ps, holders))   # see LOCALITY_GRACE_S
+            elif (not self.ordered
+                  and all(h.is_seed for h in holders)
+                  and any(not h.is_seed for h in all_states)
+                  and age < BUSY_PEER_SEED_WAIT_S):
+                # only FREE holder is a seed but a busy peer holds it: hold
+                # the piece back (a REAL wait, not a fallback bias — see
+                # BUSY_PEER_SEED_WAIT_S). The worker's wake scan covers
+                # both the peer's busy expiry and this piece's age-bound
+                # re-admission (_seed_hold_expiry), so nothing can stall.
+                expiry = ps.first_seen + BUSY_PEER_SEED_WAIT_S
+                if (self._seed_hold_expiry is None
+                        or expiry < self._seed_hold_expiry):
+                    self._seed_hold_expiry = expiry
+                continue
             else:
                 candidates.append((ps, holders))
         if not candidates:
@@ -509,6 +531,10 @@ class PieceDispatcher:
                             if until > now:
                                 dt = max(until - now, 0.02)
                                 wake = dt if wake is None else min(wake, dt)
+                held = getattr(self, "_seed_hold_expiry", None)
+                if held is not None and held > now:
+                    dt = max(held - now, 0.02)
+                    wake = dt if wake is None else min(wake, dt)
                 if wake is not None:
                     remaining = min(remaining or wake, wake)
                 reason = self._wait_reason()

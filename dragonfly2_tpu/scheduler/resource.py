@@ -137,6 +137,11 @@ class Peer:
         self.blocked_parents: dict[str, float] = {}   # parent id -> expiry
         self.last_offer_ids: set[str] = set()     # parents last pushed to peer
         self.packet_sink = None                   # set by the report stream
+        # report stream broke while the peer was mid-download: very likely
+        # a dead process. Not a removal — completion can land via a late
+        # unary report, and a live peer re-opens a stream (both clear it) —
+        # but offers and coverage must stop counting the peer meanwhile.
+        self.stream_gone = False
         self.created_at = time.time()
         self.updated_at = self.created_at
 
@@ -207,6 +212,11 @@ class Task:
         self.back_source_peers: set[str] = set()  # peers holding an origin slot
         self.seed_triggered = False
         self.seed_job = None                     # asyncio.Task of the trigger
+        self.seed_retries = 0                    # re-triggers after failure
+        self.seed_next_retry_at = 0.0            # monotonic backoff gate
+        self.url_meta = None                     # first register's UrlMeta:
+        # kept so a seed RE-trigger (seed daemon died mid-injection) can
+        # replay the original request headers/tag against the origin
         self.created_at = time.time()
         self.updated_at = self.created_at
 
@@ -305,6 +315,33 @@ class Task:
 
     def has_available_peer(self) -> bool:
         return any(p.has_content() for p in self.peers.values())
+
+    def has_live_available_peer(self) -> bool:
+        """has_available_peer minus peers whose report stream died
+        mid-download (their content is unreachable until they return)."""
+        return any(p.has_content()
+                   and not (p.stream_gone and not p.is_done())
+                   for p in self.peers.values())
+
+    def swarm_can_complete(self) -> bool:
+        """Whether the union of live peers' finished pieces covers every
+        piece of the task. False means some content exists NOWHERE in the
+        swarm (e.g. the seed died mid-injection and took the tail pieces
+        with it) — no amount of peer-to-peer scheduling can finish, and
+        the scheduler must re-source (seed re-trigger / back-source).
+        Unknown totals count as coverable: there is nothing to prove yet.
+        """
+        if self.total_piece_count <= 0:
+            return True
+        covered: set[int] = set()
+        for p in self.peers.values():
+            if p.state in (PeerState.FAILED, PeerState.LEAVING) \
+                    or (p.stream_gone and not p.is_done()):
+                continue
+            covered |= p.finished_pieces
+            if len(covered) >= self.total_piece_count:
+                return True
+        return False
 
     def touch(self) -> None:
         self.updated_at = time.time()

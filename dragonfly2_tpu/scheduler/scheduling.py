@@ -51,6 +51,12 @@ class Scheduling:
                 continue
             if parent.id == child.id:
                 continue
+            if parent.stream_gone and not parent.is_done():
+                # mid-download peer whose report stream died: almost
+                # certainly a dead process — offering it strands children
+                # on a parent that will never answer (chaos e2e)
+                self._trace(child, parent, "stream-gone")
+                continue
             if child.is_blocked(parent.id):
                 self._trace(child, parent, "blocklist")
                 continue

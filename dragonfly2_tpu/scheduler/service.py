@@ -45,6 +45,14 @@ _piece_reports = REGISTRY.counter("df_sched_piece_report_total",
 
 SCHEDULE_RETRY_INTERVAL_S = 0.25
 SCHEDULE_PATIENCE_S = 10.0
+# re-fires of a broken seed trigger per task (seed daemon death/restart);
+# each retry is one ObtainSeeds RPC, so the cap bounds origin pressure from
+# a permanently-down seed fleet while letting a restarted seed resume.
+# Exponential backoff between fires (1,2,4,...s capped) makes the budget
+# span a realistic daemon restart (~tens of seconds: process re-exec +
+# imports + topology probe) instead of burning out in 2.5s of refresh ticks
+SEED_RETRIGGER_LIMIT = 6
+SEED_RETRIGGER_BACKOFF_CAP_S = 30.0
 
 
 class SchedulerService:
@@ -86,14 +94,11 @@ class SchedulerService:
             peer.transit(PeerState.RUNNING)
 
         # first peer of an unseeded task: fire the seed trigger
+        if task.url_meta is None:
+            task.url_meta = req.url_meta
         if (not task.seed_triggered and self.seed_client.available()
                 and not task.has_available_peer()):
-            task.seed_triggered = True
-            t = asyncio.get_running_loop().create_task(
-                self.seed_client.trigger(task, req.url_meta))
-            task.seed_job = t
-            self._seed_tasks.add(t)
-            t.add_done_callback(self._seed_tasks.discard)
+            self._fire_seed_trigger(task, req.url_meta)
 
         scope = task.size_scope()
         result = RegisterResult(task_id=task.id, size_scope=SizeScope.NORMAL,
@@ -143,6 +148,7 @@ class SchedulerService:
                           f"unknown peer {first.src_peer_id[-12:]}")
         sink: asyncio.Queue[PeerPacket | None] = asyncio.Queue()
         peer.packet_sink = sink
+        peer.stream_gone = False      # live again: a fresh report stream
 
         async def consume() -> None:
             try:
@@ -177,6 +183,18 @@ class SchedulerService:
                                  return_exceptions=True)
             if peer.packet_sink is sink:
                 peer.packet_sink = None
+                if not peer.is_done():
+                    # the report stream died with the peer mid-download
+                    # (process kill, node loss): a dead peer must stop
+                    # being offered as a parent NOW — the chaos e2e showed
+                    # survivors stuck with killed victims in their sticky
+                    # offer, leaning on the seed for everything the
+                    # victims "held". Not a removal: the daemon's final
+                    # unary report (or a live peer's fresh stream) still
+                    # finds the peer and clears the mark.
+                    peer.stream_gone = True
+                    log.info("peer %s report stream gone mid-task",
+                             peer.id[-12:])
 
     REFRESH_INTERVAL_S = 0.5
 
@@ -191,6 +209,7 @@ class SchedulerService:
             await asyncio.sleep(self.REFRESH_INTERVAL_S)
             if peer.is_done() or peer.state == PeerState.BACK_SOURCE:
                 return
+            self._maybe_retrigger_seed(peer.task)
             await self._refresh_parents(peer)
 
     async def _schedule_with_patience(self, peer: Peer,
@@ -212,6 +231,7 @@ class SchedulerService:
                 sink.put_nowait(self.scheduling.build_packet(peer, parents))
                 return
             now = asyncio.get_running_loop().time()
+            self._maybe_retrigger_seed(peer.task)
             seed_pending = (peer.task.seed_job is not None
                             and not peer.task.seed_job.done())
             # feeders = content is coming even though no parent is legal
@@ -228,6 +248,56 @@ class SchedulerService:
                     sink.put_nowait(packet)
                 return
             await asyncio.sleep(SCHEDULE_RETRY_INTERVAL_S)
+
+    def _fire_seed_trigger(self, task, url_meta) -> None:
+        """Start (or restart) the seed ObtainSeeds job for a task and track
+        it; shared by first-register, preheat, and the mid-task re-trigger."""
+        task.seed_triggered = True
+        t = asyncio.get_running_loop().create_task(
+            self.seed_client.trigger(task, url_meta))
+        task.seed_job = t
+        self._seed_tasks.add(t)
+        t.add_done_callback(self._seed_tasks.discard)
+
+    def _maybe_retrigger_seed(self, task) -> None:
+        """The seed daemon can die MID-INJECTION (process kill, node loss):
+        its trigger stream breaks and the pieces it never announced exist
+        nowhere, so every waiting peer starves no matter how the remaining
+        swarm is scheduled — and a disable_back_source fleet has forbidden
+        the origin fallback. When the swarm provably cannot complete and no
+        trigger is in flight, re-fire it (bounded): a restarted seed
+        reloads its piece store and resumes serving within one RPC.
+        Checked from each peer's refresh loop and the patience loop."""
+        seed_pending = task.seed_job is not None and not task.seed_job.done()
+        now = asyncio.get_running_loop().time()
+        if (seed_pending or not task.seed_triggered
+                or not self.seed_client.available()
+                or task.seed_retries >= SEED_RETRIGGER_LIMIT
+                or now < task.seed_next_retry_at):
+            return
+        # cheap gate first: a coverage gap can only open when a peer died
+        # or failed, or nobody (live) holds anything — skip the
+        # O(peers x pieces) union on healthy 0.5s refresh ticks
+        suspect = any(p.stream_gone or p.state in (PeerState.FAILED,
+                                                   PeerState.LEAVING)
+                      for p in task.peers.values())
+        if not suspect and task.has_live_available_peer():
+            return
+        if task.total_piece_count > 0:
+            gap = not task.swarm_can_complete()
+        else:
+            # seed died before announcing content info: nothing provable
+            # about coverage — re-seed only if no LIVE peer holds anything
+            gap = not task.has_live_available_peer()
+        if not gap:
+            return
+        task.seed_retries += 1
+        task.seed_next_retry_at = now + min(2.0 ** task.seed_retries,
+                                            SEED_RETRIGGER_BACKOFF_CAP_S)
+        log.warning("task %s has an uncoverable piece gap and no live seed "
+                    "job; re-trigger %d/%d", task.id[:12], task.seed_retries,
+                    SEED_RETRIGGER_LIMIT)
+        self._fire_seed_trigger(task, task.url_meta)
 
     def _rule_back_source(self, peer: Peer) -> PeerPacket | None:
         task = peer.task
@@ -413,12 +483,7 @@ class SchedulerService:
         # must not poison the task until GC)
         if not task.seed_triggered or (seed_done
                                        and not task.has_available_peer()):
-            task.seed_triggered = True
-            t = asyncio.get_running_loop().create_task(
-                self.seed_client.trigger(task, meta))
-            task.seed_job = t
-            self._seed_tasks.add(t)
-            t.add_done_callback(self._seed_tasks.discard)
+            self._fire_seed_trigger(task, meta)
         if req.wait and task.seed_job is not None:
             await asyncio.shield(task.seed_job)
         if task.has_available_peer():

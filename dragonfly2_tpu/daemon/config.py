@@ -29,6 +29,11 @@ class SecurityConfig:
     issue_token_path: str = ""        # or a file holding it
     ca_cert: str = ""                 # fleet CA path (manager proxy-ca.crt)
     cert_validity_s: int = 7 * 24 * 3600
+    # TLS rollout policy for the peer RPC port (reference pkg/rpc/mux.go +
+    # credential.go): "force" = TLS only; "default"/"prefer" = plaintext
+    # AND TLS accepted on the one port so a live fleet can upgrade without
+    # a flag day ("prefer" flags plaintext peers in logs/metrics)
+    tls_policy: str = "force"
     # NOTE scope: with security enabled, BOTH peer planes are mTLS — the
     # gRPC sync streams and the HTTPS piece uploads (client certs required
     # on each). The renewal loop refreshes the issued material at 2/3

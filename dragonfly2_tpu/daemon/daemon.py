@@ -240,7 +240,8 @@ class Daemon:
         # the issued leaf, dial other peers trusting the fleet CA
         # peer-facing TCP server: bind the listen address, advertise host_ip
         self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.rpc_port}",
-                             tls=self._rpc_tls)
+                             tls=self._rpc_tls,
+                             tls_policy=self.cfg.security.tls_policy)
         for sdef in build_service(svc):
             self.rpc.register(sdef)
         await self.rpc.start()

@@ -1,0 +1,139 @@
+"""TLS/plaintext mux: both protocols on ONE listen port, with a rollout
+policy.
+
+Role parity: reference ``pkg/rpc/mux.go`` (cmux splitting TLS from h2c on
+one listener) + ``pkg/rpc/credential.go`` (default/prefer/force policies).
+Without this, turning mTLS on across a fleet is a flag day: every peer's
+client and server must flip together or half the mesh goes dark. With it,
+servers accept both during the rollout and ``force`` retires plaintext —
+for NEW connections only, so nothing in flight is dropped.
+
+Design: the public port is a tiny asyncio front listener that peeks the
+first byte of each connection — 0x16 is a TLS record's handshake type;
+gRPC's h2c preface starts with 'P' (PRI * HTTP/2.0) — and splices bytes to
+one of two backend listeners of the SAME grpc.aio server (grpc-python
+cannot share one listener between credentials; the reference's Go cmux
+hands off accepted conns in-process, ours costs one local hop). The
+backends are UNIX SOCKETS in a 0700 directory, not loopback TCP: a
+loopback port would let any on-host process skip the mux — and its policy
+and the TLS client-cert check — entirely. A same-uid process can still
+reach the sockets, but a same-uid process can also read the TLS keys, so
+no boundary is weakened.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import tempfile
+
+from ..common.metrics import REGISTRY
+
+log = logging.getLogger("df.rpc.mux")
+
+_conns = REGISTRY.counter("df_rpc_mux_conns_total",
+                          "mux accepted connections", ("kind",))
+
+POLICIES = ("default", "prefer", "force")
+TLS_HANDSHAKE_BYTE = 0x16
+
+
+class MuxListener:
+    """Front listener splicing TLS vs plaintext to two backend sockets.
+
+    ``policy`` is mutable at runtime (the rollout knob):
+      default — serve both, no judgement
+      prefer  — serve both; count + log plaintext as deprecated
+      force   — refuse NEW plaintext connections (existing ones live on)
+    """
+
+    def __init__(self, listen_ip: str, port: int, *,
+                 plain_sock: str, tls_sock: str,
+                 policy: str = "default"):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown mux policy {policy!r}")
+        self.listen_ip = listen_ip
+        self.port = port
+        self.plain_sock = plain_sock
+        self.tls_sock = tls_sock
+        self.policy = policy
+        self._server: asyncio.Server | None = None
+        self._warned_plain = False
+
+    @staticmethod
+    def backend_sockets() -> tuple[str, str]:
+        """(plain, tls) unix socket paths in a fresh 0700 directory."""
+        d = tempfile.mkdtemp(prefix="dfmux-")
+        os.chmod(d, 0o700)
+        return os.path.join(d, "plain.sock"), os.path.join(d, "tls.sock")
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.listen_ip, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("mux on :%d -> %s / %s (policy=%s)",
+                 self.port, self.plain_sock, self.tls_sock, self.policy)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            first = await asyncio.wait_for(reader.read(1), timeout=30.0)
+        except (asyncio.TimeoutError, ConnectionError):
+            writer.close()
+            return
+        if not first:
+            writer.close()
+            return
+        is_tls = first[0] == TLS_HANDSHAKE_BYTE
+        if not is_tls:
+            if self.policy == "force":
+                _conns.labels("plain_refused").inc()
+                log.warning("refusing plaintext connection (policy=force)")
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except OSError:
+                    pass
+                return
+            if self.policy == "prefer" and not self._warned_plain:
+                self._warned_plain = True
+                log.warning("plaintext peer connected (policy=prefer): "
+                            "schedule its TLS upgrade")
+        _conns.labels("tls" if is_tls else "plain").inc()
+        backend = self.tls_sock if is_tls else self.plain_sock
+        try:
+            up_r, up_w = await asyncio.open_unix_connection(backend)
+        except OSError:
+            writer.close()
+            return
+        up_w.write(first)
+
+        async def pump(src: asyncio.StreamReader,
+                       dst: asyncio.StreamWriter) -> None:
+            try:
+                while True:
+                    data = await src.read(64 * 1024)
+                    if not data:
+                        break
+                    dst.write(data)
+                    await dst.drain()
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                try:
+                    dst.close()
+                except OSError:
+                    pass
+
+        await asyncio.gather(pump(reader, up_w), pump(up_r, writer))
+        for w in (writer, up_w):
+            try:
+                await w.wait_closed()
+            except OSError:
+                pass

@@ -137,14 +137,24 @@ class RPCServer:
     ``address`` may be "ip:port", "unix:/path", or "ip:0" (ephemeral —
     resolved port available as ``.port`` after ``start``). ``tls`` secures
     the listener (TLSOptions above).
+
+    ``tls_policy`` (only meaningful with ``tls``; reference
+    ``pkg/rpc/mux.go`` + ``credential.go``):
+      "force"   — TLS only on the port (the prior behavior; default)
+      "default" — plaintext AND TLS accepted on ONE port (rollout mode)
+      "prefer"  — both accepted; plaintext flagged deprecated in logs +
+                  metrics. Flip ``.mux.policy`` to "force" at runtime to
+                  retire plaintext for new connections without a restart.
     """
 
     def __init__(self, address: str, *, options: list | None = None,
-                 tls: TLSOptions | None = None):
+                 tls: TLSOptions | None = None, tls_policy: str = "force"):
         self.address = address
         self.port: int | None = None
         self.health = _Health()
         self.tls = tls
+        self.tls_policy = tls_policy
+        self.mux = None                     # MuxListener when muxing
         self._server = grpc.aio.server(options=options or [
             ("grpc.max_send_message_length", 64 * 1024 * 1024),
             ("grpc.max_receive_message_length", 64 * 1024 * 1024),
@@ -158,17 +168,40 @@ class RPCServer:
 
     async def start(self) -> None:
         self._server.add_generic_rpc_handlers(tuple(d.build() for d in self._defs))
-        if self.tls is not None:
+        muxing = (self.tls is not None and self.tls_policy != "force"
+                  and not self.address.startswith("unix:"))
+        if muxing:
+            # both credentials on ONE public port: grpc-python cannot share
+            # a listener between credential sets, so the mux front peeks
+            # each connection and splices it to the matching unix-socket
+            # backend (0700 dir — a loopback TCP backend would let on-host
+            # processes bypass the policy and client-cert check; rpc/mux.py)
+            from .mux import MuxListener
+            plain_sock, tls_sock = MuxListener.backend_sockets()
+            self._server.add_insecure_port(f"unix:{plain_sock}")
+            self._server.add_secure_port(f"unix:{tls_sock}",
+                                         self.tls.server_credentials())
+            ip, _, port_s = self.address.rpartition(":")
+            self.mux = MuxListener(ip or "127.0.0.1", int(port_s or 0),
+                                   plain_sock=plain_sock, tls_sock=tls_sock,
+                                   policy=self.tls_policy)
+        elif self.tls is not None:
             port = self._server.add_secure_port(
                 self.address, self.tls.server_credentials())
         else:
             port = self._server.add_insecure_port(self.address)
-        if not self.address.startswith("unix:"):
-            self.port = port
         await self._server.start()
-        log.info("rpc server on %s (port=%s, tls=%s): %s", self.address,
-                 self.port, self.tls is not None,
+        if self.mux is not None:
+            await self.mux.start()
+            self.port = self.mux.port
+        elif not self.address.startswith("unix:"):
+            self.port = port
+        log.info("rpc server on %s (port=%s, tls=%s, policy=%s): %s",
+                 self.address, self.port, self.tls is not None,
+                 self.tls_policy if self.tls is not None else "-",
                  ",".join(d.name for d in self._defs))
 
     async def stop(self, grace: float = 1.0) -> None:
+        if self.mux is not None:
+            await self.mux.stop()
         await self._server.stop(grace)

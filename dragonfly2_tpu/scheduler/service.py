@@ -476,6 +476,8 @@ class SchedulerService:
         if not self.seed_client.available():
             raise DFError(Code.SCHED_FORBIDDEN, "no seed peers to preheat into")
         task = self.resource.get_or_create_task(task_id, req.url)
+        if task.url_meta is None:
+            task.url_meta = meta      # a seed RE-trigger replays these
         if task.state == TaskState.PENDING:
             task.transit(TaskState.RUNNING)
         seed_done = task.seed_job is not None and task.seed_job.done()

@@ -19,11 +19,6 @@ import time
 
 import pytest
 
-# daemons in this test never need jax; cut the per-boot topology probe
-# from 15s to 2s so the seed RESTART lands inside the wave (the config
-# loader knows this var is not a config override — common/config.py)
-os.environ["DF_TOPOLOGY_PROBE_TIMEOUT_S"] = "2"
-
 import bench
 from test_churn import start_daemon, teardown
 
@@ -36,7 +31,11 @@ N_KILLED = 2
 SIZE = 96 << 20
 
 
-def test_chaos_wave_survives_leecher_and_seed_death(tmp_path):
+def test_chaos_wave_survives_leecher_and_seed_death(tmp_path, monkeypatch):
+    # daemons in this test never need jax; cut the per-boot topology probe
+    # from 15s to 2s so the seed RESTART lands inside the wave. Test-scoped
+    # (monkeypatch reverts): the subprocesses inherit it via os.environ.
+    monkeypatch.setenv("DF_TOPOLOGY_PROBE_TIMEOUT_S", "2")
     blob = os.urandom(SIZE)
     data = tmp_path / "blob.bin"
     data.write_bytes(blob)

@@ -47,6 +47,9 @@ class PeerTaskConductor:
         self.peer_id = peer_id
         self.url = url
         self.url_meta = url_meta or UrlMeta()
+        # scheduler may refine this at register (application-table lookup);
+        # storage GC eviction ordering reads the refined value
+        self.resolved_priority = int(self.url_meta.priority)
         self.storage_mgr = storage_mgr
         self.piece_mgr = piece_mgr
         self.scheduler = scheduler
@@ -173,7 +176,8 @@ class PeerTaskConductor:
             task_id=self.task_id, task_type=self.task_type, url=self.url,
             tag=self.url_meta.tag, application=self.url_meta.application,
             content_length=effective_len, total_piece_count=self.total_pieces,
-            piece_size=self.piece_size, digest=self.url_meta.digest)
+            piece_size=self.piece_size, digest=self.url_meta.digest,
+            priority=self.resolved_priority)
         self.storage = self.storage_mgr.register_task(md)
         if (self.device_sink_factory is not None and effective_len > 0
                 and self.device_ingest is None):

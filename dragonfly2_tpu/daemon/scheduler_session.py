@@ -190,6 +190,7 @@ class SchedulerConnector:
                 task_id=conductor.task_id, peer_id=conductor.peer_id,
                 peer_host=self.host),
             timeout=self.register_timeout_s)
+        conductor.resolved_priority = int(result.resolved_priority)
         session = PeerSession(client, result, conductor)
         await session.open_report_stream()
         return session

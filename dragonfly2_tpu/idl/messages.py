@@ -202,6 +202,10 @@ class RegisterResult:
     single_piece: SinglePiece | None = None  # SMALL
     content_length: int = -1
     piece_size: int = 0
+    # the scheduler's resolved priority (explicit > application table >
+    # default) echoed back so the daemon's storage GC can order eviction
+    # by it even when the request itself carried no explicit priority
+    resolved_priority: Priority = Priority.LEVEL0
 
 
 @message
@@ -625,6 +629,23 @@ class CertificateRequest:
 class CertificateResponse:
     cert_pem: bytes = b""
     ca_cert_pem: bytes = b""
+
+
+@message
+class ApplicationEntry:
+    """One manager-registered application with its download priority
+    (reference ``manager/models/application.go:24`` Priority JSONMap —
+    the scheduler's CalculatePriority consults this when a request
+    carries no explicit priority)."""
+
+    name: str = ""
+    url: str = ""
+    priority: Priority = Priority.LEVEL0
+
+
+@message
+class ListApplicationsResponse:
+    applications: list[ApplicationEntry] | None = None
 
 
 @message

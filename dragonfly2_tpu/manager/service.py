@@ -9,6 +9,7 @@ self-registration RPCs schedulers/seed peers call on boot.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 from typing import AsyncIterator
 
@@ -64,6 +65,29 @@ class ManagerService:
             lambda: self.store.seed_peers(
                 cluster_id=req.cluster_id or None, only_active=True))
         return GetSeedPeersResponse(seed_peers=peers)
+
+    async def list_applications(self, req, context):
+        """Applications + priorities for scheduler dynconfig (reference
+        manager/rpcserver ListApplications consumed by
+        ``Peer.CalculatePriority``). Priority persists as a JSON map
+        (``{"value": N}``, reference JSONMap shape)."""
+        from ..idl.messages import (ApplicationEntry,
+                                    ListApplicationsResponse, Priority)
+        rows = await asyncio.to_thread(self.store.applications)
+        out = []
+        for r in rows:
+            # one malformed row must not fail the whole table: parse and
+            # clamp per entry, default LEVEL0
+            try:
+                prio = int(json.loads(r.get("priority") or "{}")
+                           .get("value", 0))
+            except (ValueError, TypeError, AttributeError):
+                prio = 0
+            prio = min(max(prio, int(Priority.LEVEL0)), int(Priority.LEVEL6))
+            out.append(ApplicationEntry(
+                name=r["name"], url=r.get("url", "") or "",
+                priority=Priority(prio)))
+        return ListApplicationsResponse(applications=out)
 
     async def register_scheduler(self, req: RegisterSchedulerRequest,
                                  context) -> Empty:
@@ -171,6 +195,7 @@ def build_service(svc: ManagerService) -> ServiceDef:
     d = ServiceDef(MANAGER_SERVICE)
     d.unary_unary("GetSchedulers", svc.get_schedulers)
     d.unary_unary("GetSeedPeers", svc.get_seed_peers)
+    d.unary_unary("ListApplications", svc.list_applications)
     d.unary_unary("RegisterScheduler", svc.register_scheduler)
     d.unary_unary("RegisterSeedPeer", svc.register_seed_peer)
     d.stream_unary("KeepAlive", svc.keep_alive)

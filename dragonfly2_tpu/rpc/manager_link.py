@@ -71,6 +71,10 @@ class ManagerLink:
         return await self._unary(
             "GetSeedPeers", GetSeedPeersRequest(cluster_id=cluster_id))
 
+    async def list_applications(self):
+        from ..idl.messages import Empty
+        return await self._unary("ListApplications", Empty())
+
     async def create_model(self, req) -> None:
         await self._unary("CreateModel", req, timeout=60.0)
 

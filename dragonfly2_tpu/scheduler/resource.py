@@ -137,6 +137,10 @@ class Peer:
         self.blocked_parents: dict[str, float] = {}   # parent id -> expiry
         self.last_offer_ids: set[str] = set()     # parents last pushed to peer
         self.packet_sink = None                   # set by the report stream
+        # resolved download priority (idl.Priority numeric: 0 = highest).
+        # Set at register: explicit request value, else the manager-fed
+        # application table, else LEVEL0 (reference Peer.CalculatePriority)
+        self.priority = 0
         # report stream broke while the peer was mid-download: very likely
         # a dead process. Not a removal — completion can land via a late
         # unary report, and a live peer re-opens a stream (both clear it) —

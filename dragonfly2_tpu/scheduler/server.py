@@ -7,6 +7,7 @@ manager stage; job queues ride the manager's queue, not Redis).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 
 from ..common.gc import GC, GCTask
@@ -141,8 +142,32 @@ class Scheduler:
                     self.service.seed_client = self.seed_client
         except Exception as exc:  # noqa: BLE001 - manager optional at boot
             log.warning("manager attach failed (%s); running standalone", exc)
+            return
+        # applications are OPTIONAL (an older manager may lack the verb):
+        # a failed first fetch must neither mislabel the attach as failed
+        # nor disable refresh — the loop keeps retrying and recovers when
+        # the manager catches up
+        self._app_refresh = asyncio.get_running_loop().create_task(
+            self._app_refresh_loop())
+
+    async def _refresh_applications(self) -> None:
+        """Pull the application priority table into the service (reference
+        dynconfig.GetApplications feeding Peer.CalculatePriority)."""
+        resp = await self.manager.list_applications()
+        self.service.applications = {
+            e.name: int(e.priority) for e in (resp.applications or [])}
+
+    async def _app_refresh_loop(self) -> None:
+        while True:
+            try:
+                await self._refresh_applications()
+            except Exception as exc:  # noqa: BLE001 - manager flaky is fine
+                log.debug("application refresh failed: %s", exc)
+            await asyncio.sleep(self.cfg.keepalive_interval_s * 6)
 
     async def stop(self) -> None:
+        if getattr(self, "_app_refresh", None) is not None:
+            self._app_refresh.cancel()
         if self.announcer is not None:
             await self.announcer.stop()
         if self.service.records is not None:

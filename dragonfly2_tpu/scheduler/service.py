@@ -21,7 +21,7 @@ from ..common.errors import Code, DFError
 from ..common.metrics import REGISTRY
 from ..idl.messages import (AnnounceHostRequest, Empty, LeaveHostRequest,
                             LeavePeerRequest, PeerPacket, PeerResult,
-                            PieceResult, RegisterPeerTaskRequest,
+                            PieceResult, Priority, RegisterPeerTaskRequest,
                             RegisterResult, SinglePiece, SizeScope,
                             StatTaskRequest, SyncProbesResponse, TaskStat,
                             ProbeTarget)
@@ -66,6 +66,10 @@ class SchedulerService:
         self.topo = topo
         self.records = records          # download-record sink (trainer dataset)
         self._seed_tasks: set[asyncio.Task] = set()
+        # application name -> Priority numeric, fed from the manager's
+        # applications table (reference dynconfig.GetApplications); consulted
+        # when a request carries no explicit priority
+        self.applications: dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # RegisterPeerTask
@@ -88,8 +92,16 @@ class SchedulerService:
             task.transit(TaskState.RUNNING)
         elif task.state == TaskState.PENDING:
             task.transit(TaskState.RUNNING)
+        resolved_priority = self._resolve_priority(req.url_meta)
+        if resolved_priority == int(Priority.LEVEL1):
+            # reference service_v2.go: LEVEL1 = download forbidden. Checked
+            # BEFORE peer creation: a forbidden client retrying in a loop
+            # must not grow a PENDING peer per attempt until the 24h TTL
+            raise DFError(Code.SCHED_FORBIDDEN,
+                          "download forbidden by priority (LEVEL1)")
         host = self.resource.store_host(req.peer_host)
         peer = self.resource.get_or_create_peer(req.peer_id, task, host)
+        peer.priority = resolved_priority
         if peer.state == PeerState.PENDING:
             peer.transit(PeerState.RUNNING)
 
@@ -103,7 +115,8 @@ class SchedulerService:
         scope = task.size_scope()
         result = RegisterResult(task_id=task.id, size_scope=SizeScope.NORMAL,
                                 content_length=task.content_length,
-                                piece_size=task.piece_size)
+                                piece_size=task.piece_size,
+                                resolved_priority=Priority(resolved_priority))
         if scope == SizeScope.EMPTY:
             result.size_scope = SizeScope.EMPTY
         elif scope == SizeScope.TINY:
@@ -212,10 +225,30 @@ class SchedulerService:
             self._maybe_retrigger_seed(peer.task)
             await self._refresh_parents(peer)
 
+    def _resolve_priority(self, url_meta) -> int:
+        """Reference ``Peer.CalculatePriority``: an explicit request value
+        wins; LEVEL0 (the unset default) falls through to the manager's
+        application table; unknown applications resolve LEVEL0 (= the best
+        service class, like the reference's LEVEL6/LEVEL0 switch arm)."""
+        if url_meta is not None and int(url_meta.priority) != int(Priority.LEVEL0):
+            return int(url_meta.priority)
+        if url_meta is not None and url_meta.application:
+            prio = self.applications.get(url_meta.application)
+            if prio is not None:
+                return int(prio)
+        return int(Priority.LEVEL0)
+
     async def _schedule_with_patience(self, peer: Peer,
                                       sink: asyncio.Queue) -> None:
         """Initial scheduling loop: try now, retry while a seed is coming,
-        rule back-source when patience ends."""
+        rule back-source when patience ends. LEVEL2 peers skip the P2P
+        wait entirely (reference: 'Peer is first to download
+        back-to-source')."""
+        if peer.priority == int(Priority.LEVEL2):
+            packet = self._rule_back_source(peer)
+            if packet is not None:
+                sink.put_nowait(packet)
+            return
         deadline = (asyncio.get_running_loop().time() + SCHEDULE_PATIENCE_S)
         while True:
             if peer.is_done() or peer.state == PeerState.BACK_SOURCE:
@@ -299,10 +332,43 @@ class SchedulerService:
                     SEED_RETRIGGER_LIMIT)
         self._fire_seed_trigger(task, task.url_meta)
 
+    def _back_source_class_load(self, priority: int) -> int:
+        """Active back-source peers that COUNT against a requester of this
+        priority: equal-or-higher-priority holders only. Lower-priority
+        (numerically greater) holders are invisible, so a LEVEL0 request
+        is admitted even when LEVEL6 traffic has filled the budget — the
+        admission-side form of slot preemption (origin pulls cannot be
+        revoked mid-flight). Computed on demand: rulings are per-peer
+        events, not hot-path."""
+        import time as _time
+        n = 0
+        stale_after = _time.time() - 300.0
+        for task in self.resource.tasks.values():
+            for pid in task.back_source_peers:
+                p = task.peers.get(pid)
+                if p is None or p.state != PeerState.BACK_SOURCE \
+                        or p.priority > priority:
+                    continue
+                # crashed holders must not wedge the cluster budget for
+                # the 24h peer TTL: a dead process is stream_gone within
+                # one RPC, and a live back-source peer touches on every
+                # piece report — silent for 5 min means gone
+                if p.stream_gone or p.updated_at < stale_after:
+                    continue
+                n += 1
+        return n
+
     def _rule_back_source(self, peer: Peer) -> PeerPacket | None:
         task = peer.task
         if len(task.back_source_peers) >= self.cfg.back_source_concurrent:
             _schedules.labels("busy").inc()
+            return PeerPacket(task_id=task.id, src_peer_id=peer.id,
+                              code=int(Code.SCHED_TASK_STATUS_ERROR))
+        if self._back_source_class_load(peer.priority) >= \
+                self.cfg.back_source_total:
+            _schedules.labels("busy_global").inc()
+            log.info("back-source budget full for priority %d (peer %s)",
+                     peer.priority, peer.id[-12:])
             return PeerPacket(task_id=task.id, src_peer_id=peer.id,
                               code=int(Code.SCHED_TASK_STATUS_ERROR))
         try:

@@ -195,7 +195,9 @@ class StorageManager:
         used, cap = self._usage()
         if cap and used / cap > self.cfg.disk_gc_high_ratio:
             target = int(cap * self.cfg.disk_gc_low_ratio)
-            candidates.sort(key=lambda t: t.md.access_time)
+            # eviction order: lowest download priority first (numeric
+            # DESC — LEVEL6 before LEVEL0), then oldest access
+            candidates.sort(key=lambda t: (-t.md.priority, t.md.access_time))
             for ts in candidates:
                 if used <= target:
                     break

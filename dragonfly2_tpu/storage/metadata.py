@@ -56,6 +56,10 @@ class TaskMetadata:
     range_length: int = -1
     access_time: float = field(default_factory=time.time)
     create_time: float = field(default_factory=time.time)
+    # idl.Priority numeric (0 = highest): disk GC evicts low-priority
+    # content first (reference storage GC orders eviction by application
+    # priority before recency)
+    priority: int = 0
 
     @property
     def stored_bytes(self) -> int:

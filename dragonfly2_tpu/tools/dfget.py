@@ -33,6 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--digest", default="", help="expected digest algo:hex")
     p.add_argument("--tag", default="", help="task isolation tag")
     p.add_argument("--application", default="")
+    p.add_argument("--priority", type=int, default=0, choices=range(7),
+                   help="download priority LEVEL0 (highest) .. LEVEL6; "
+                   "0 also means 'resolve via the application table'")
     p.add_argument("--header", action="append", default=[],
                    help="extra origin header K:V (repeatable)")
     p.add_argument("--filter", action="append", default=[],
@@ -54,9 +57,11 @@ def _meta(args) -> UrlMeta:
     for h in args.header:
         k, _, v = h.partition(":")
         header[k.strip()] = v.strip()
+    from ..idl.messages import Priority
     return UrlMeta(digest=args.digest, tag=args.tag, range=args.range_,
                    application=args.application, header=header or None,
-                   filtered_query_params=args.filter or None)
+                   filtered_query_params=args.filter or None,
+                   priority=Priority(args.priority))
 
 
 async def _daemon_alive(sock: str) -> bool:

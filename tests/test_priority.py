@@ -1,0 +1,170 @@
+"""Priority plumbing: manager applications -> scheduler arbitration -> GC.
+
+VERDICT r04 missing #3 / next #7. Reference:
+``manager/models/application.go:24`` (priority per application),
+``scheduler/resource/peer.go:486 CalculatePriority`` (explicit > application
+> default), ``scheduler/service/service_v2.go:1318`` (LEVEL1 forbidden,
+LEVEL2 straight to origin), and priority-ordered storage eviction.
+Our arbitration is admission-side: the scheduler-wide back-source budget is
+counted per priority class, so a LEVEL0 request is admitted while LEVEL6
+holders have the budget "full" — the implementable form of a LEVEL0 task
+preempting a LEVEL6 task's back-source slot (origin pulls cannot be revoked
+mid-flight).
+"""
+
+import asyncio
+
+import pytest
+
+from dragonfly2_tpu.common.errors import Code, DFError
+from dragonfly2_tpu.idl.messages import (Host, HostType, Priority,
+                                         RegisterPeerTaskRequest, UrlMeta)
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.evaluator import Evaluator
+from dragonfly2_tpu.scheduler.resource import PeerState, Resource
+from dragonfly2_tpu.scheduler.scheduling import Scheduling
+from dragonfly2_tpu.scheduler.seed_client import SeedPeerClient
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.scheduler.topology_store import TopologyStore
+
+
+def _service(**cfg_kw) -> SchedulerService:
+    cfg = SchedulerConfig(**cfg_kw)
+    res = Resource()
+    svc = SchedulerService(cfg, res, Scheduling(cfg, Evaluator()),
+                           SeedPeerClient(res, []), TopologyStore())
+    return svc
+
+
+def _register(svc, task_no: int, peer_no: int, meta: UrlMeta):
+    req = RegisterPeerTaskRequest(
+        task_id=f"{task_no:064d}", url=f"http://o/f{task_no}",
+        peer_id=f"peer-{task_no}-{peer_no}", url_meta=meta,
+        peer_host=Host(id=f"h{task_no}-{peer_no}", ip="127.0.0.1",
+                       port=1, download_port=2, type=HostType.NORMAL))
+    return req
+
+
+class TestResolution:
+    def test_explicit_beats_application_beats_default(self):
+        svc = _service()
+        svc.applications = {"batch": 6, "critical": 0}
+        # explicit value wins
+        assert svc._resolve_priority(UrlMeta(
+            priority=Priority.LEVEL2, application="batch")) == 2
+        # LEVEL0 (unset) falls through to the application table
+        assert svc._resolve_priority(UrlMeta(application="batch")) == 6
+        # unknown application -> LEVEL0 (best class, reference behavior)
+        assert svc._resolve_priority(UrlMeta(application="nope")) == 0
+        assert svc._resolve_priority(UrlMeta()) == 0
+
+
+class TestBackSourceArbitration:
+    def test_level0_preempts_level6_back_source_budget(self):
+        async def main():
+            svc = _service(back_source_total=1, back_source_concurrent=4)
+            svc.applications = {"batch": 6, "critical": 0}
+
+            # LEVEL6 task's peer takes the one global slot
+            a = await svc.register_peer_task(
+                _register(svc, 1, 1, UrlMeta(application="batch")), None)
+            peer_a = svc.resource.find_peer(a.task_id, "peer-1-1")
+            assert peer_a.priority == 6
+            # resolved priority is echoed to the daemon (storage GC reads it)
+            assert int(a.resolved_priority) == 6
+            pkt = svc._rule_back_source(peer_a)
+            assert pkt.code == int(Code.SCHED_NEED_BACK_SOURCE)
+            assert peer_a.state == PeerState.BACK_SOURCE
+
+            # another LEVEL6 task: budget full for its class -> busy
+            b = await svc.register_peer_task(
+                _register(svc, 2, 1, UrlMeta(application="batch")), None)
+            peer_b = svc.resource.find_peer(b.task_id, "peer-2-1")
+            pkt = svc._rule_back_source(peer_b)
+            assert pkt.code == int(Code.SCHED_TASK_STATUS_ERROR)
+            assert peer_b.state != PeerState.BACK_SOURCE
+
+            # LEVEL0 task: the LEVEL6 holder does not count against it —
+            # admitted despite the "full" budget (slot preemption)
+            c = await svc.register_peer_task(
+                _register(svc, 3, 1, UrlMeta(application="critical")), None)
+            peer_c = svc.resource.find_peer(c.task_id, "peer-3-1")
+            assert peer_c.priority == 0
+            pkt = svc._rule_back_source(peer_c)
+            assert pkt.code == int(Code.SCHED_NEED_BACK_SOURCE)
+            assert peer_c.state == PeerState.BACK_SOURCE
+
+        asyncio.run(main())
+
+    def test_level1_register_forbidden(self):
+        async def main():
+            svc = _service()
+            with pytest.raises(DFError) as exc:
+                await svc.register_peer_task(
+                    _register(svc, 4, 1,
+                              UrlMeta(priority=Priority.LEVEL1)), None)
+            assert exc.value.code == Code.SCHED_FORBIDDEN
+
+        asyncio.run(main())
+
+    def test_level2_skips_p2p_patience(self):
+        async def main():
+            svc = _service()
+            await svc.register_peer_task(
+                _register(svc, 5, 1, UrlMeta(priority=Priority.LEVEL2)),
+                None)
+            peer = svc.resource.find_peer(f"{5:064d}", "peer-5-1")
+            sink: asyncio.Queue = asyncio.Queue()
+            peer.packet_sink = sink
+            await asyncio.wait_for(
+                svc._schedule_with_patience(peer, sink), timeout=1.0)
+            pkt = sink.get_nowait()
+            assert pkt.code == int(Code.SCHED_NEED_BACK_SOURCE)
+
+        asyncio.run(main())
+
+
+class TestManagerFeed:
+    def test_applications_rpc_roundtrip(self, tmp_path):
+        async def main():
+            from dragonfly2_tpu.manager.service import ManagerService
+            from dragonfly2_tpu.manager.store import Store
+
+            store = Store(str(tmp_path / "m.db"))
+            store.upsert_application("batch", url="http://batch",
+                                     priority={"value": 6})
+            store.upsert_application("critical", priority={"value": 0})
+            svc = ManagerService(store)
+            resp = await svc.list_applications(None, None)
+            table = {e.name: int(e.priority) for e in resp.applications}
+            assert table == {"batch": 6, "critical": 0}
+
+        asyncio.run(main())
+
+
+class TestGCOrdering:
+    def test_low_priority_evicted_first(self, tmp_path):
+        from dragonfly2_tpu.storage.manager import StorageConfig, StorageManager
+        from dragonfly2_tpu.storage.metadata import TaskMetadata
+
+        mgr = StorageManager(StorageConfig(
+            data_dir=str(tmp_path), capacity_bytes=3_000_000,
+            disk_gc_high_ratio=0.5, disk_gc_low_ratio=0.4,
+            task_ttl_s=3600))
+        payload = b"z" * 1_000_000
+        for i, prio in enumerate([0, 6]):
+            md = TaskMetadata(task_id=f"{i:064x}", url=f"http://o/{i}",
+                              content_length=len(payload),
+                              total_piece_count=1, piece_size=len(payload),
+                              priority=prio)
+            ts = mgr.register_task(md)
+            ts.write_piece(0, 0, payload)
+            ts.mark_done(success=True)
+        assert mgr.try_gc() >= 1
+        kept = [ts.md.priority for ts in mgr.tasks()]
+        assert 0 in kept and 6 not in kept, \
+            f"GC must evict the LEVEL6 task first, kept priorities {kept}"
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

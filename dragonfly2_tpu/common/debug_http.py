@@ -1,0 +1,104 @@
+"""pprof-analog debug surface ANY service can serve.
+
+Role parity: reference ``cmd/dependency/dependency.go:95-117`` gives every
+service (daemon, scheduler, manager, trainer) a net/pprof listener. Here:
+
+- ``/debug/stacks``  — every thread's stack + every asyncio task (the
+  goroutine-dump analog; first question in any hang investigation)
+- ``/debug/profile`` — cProfile the event-loop thread for ?seconds=N
+  (the pprof 'profile' analog)
+- ``/metrics``       — the process's Prometheus registry
+
+The daemon embeds these routes in its upload server; the scheduler,
+manager, and trainer launchers serve them on a dedicated ``--debug-port``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from aiohttp import web
+
+from .metrics import REGISTRY
+
+log = logging.getLogger("df.debug")
+
+
+async def debug_stacks(_r: web.Request) -> web.Response:
+    """Every thread's stack + every asyncio task."""
+    import io
+    import sys
+    import threading
+    import traceback
+
+    buf = io.StringIO()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        buf.write(f"--- thread {names.get(tid, tid)} ---\n")
+        traceback.print_stack(frame, file=buf)
+    buf.write("--- asyncio tasks ---\n")
+    for task in asyncio.all_tasks():
+        buf.write(f"{task.get_name()}: {task.get_coro()}\n")
+        for entry in task.get_stack(limit=4):
+            buf.write(f"  {entry.f_code.co_filename}:{entry.f_lineno} "
+                      f"{entry.f_code.co_name}\n")
+    return web.Response(text=buf.getvalue())
+
+
+_profile_lock = asyncio.Lock()
+
+
+async def debug_profile(request: web.Request) -> web.Response:
+    """cProfile the event-loop thread for ?seconds=N (default 5, max 60).
+    Serialized: two concurrent profilers on one thread corrupt each
+    other."""
+    import cProfile
+    import io
+    import pstats
+
+    try:
+        seconds = min(max(float(request.query.get("seconds", "5")), 0.0),
+                      60.0)
+    except ValueError:
+        return web.Response(status=400, text="seconds must be a number")
+    if _profile_lock.locked():
+        return web.Response(status=409, text="a profile is already running")
+    async with _profile_lock:
+        prof = cProfile.Profile()
+        try:
+            prof.enable()
+            await asyncio.sleep(seconds)
+        finally:
+            prof.disable()
+        out = io.StringIO()
+        pstats.Stats(prof, stream=out).sort_stats(
+            "cumulative").print_stats(60)
+        return web.Response(text=out.getvalue())
+
+
+async def _metrics(_r: web.Request) -> web.Response:
+    return web.Response(text=REGISTRY.expose(),
+                        content_type="text/plain")
+
+
+def add_debug_routes(router) -> None:
+    router.add_get("/debug/stacks", debug_stacks)
+    router.add_get("/debug/profile", debug_profile)
+
+
+async def start_debug_server(host: str, port: int):
+    """Serve /debug/{stacks,profile} + /metrics; returns (runner, port).
+    ``port`` 0 binds ephemeral. Bind failures raise — a requested debug
+    surface that silently isn't there wastes the hang investigation it
+    exists for."""
+    app = web.Application()
+    add_debug_routes(app.router)
+    app.router.add_get("/metrics", _metrics)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    bound = site._server.sockets[0].getsockname()[1]
+    log.info("debug endpoints on %s:%d", host, bound)
+    return runner, bound

@@ -190,7 +190,11 @@ class SchedulerConnector:
                 task_id=conductor.task_id, peer_id=conductor.peer_id,
                 peer_host=self.host),
             timeout=self.register_timeout_s)
-        conductor.resolved_priority = int(result.resolved_priority)
+        # adopt the scheduler's application-table resolution only when it
+        # actually resolved something: an older scheduler echoes the
+        # LEVEL0 default, which must not clobber an explicit local value
+        if int(result.resolved_priority) != 0:
+            conductor.resolved_priority = int(result.resolved_priority)
         session = PeerSession(client, result, conductor)
         await session.open_report_stream()
         return session

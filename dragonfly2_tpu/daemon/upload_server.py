@@ -168,8 +168,8 @@ class UploadServer:
             # InitMonitor --pprof-port) — OFF by default: profiling slows
             # every Python call on the loop thread, and this port is
             # reachable by any mesh peer
-            app.router.add_get("/debug/stacks", _debug_stacks)
-            app.router.add_get("/debug/profile", _debug_profile)
+            from ..common.debug_http import add_debug_routes
+            add_debug_routes(app.router)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         ssl_ctx = None
@@ -317,57 +317,3 @@ class UploadServer:
             raise
 
 
-async def _debug_stacks(_r: web.Request) -> web.Response:
-    """Every thread's stack + every asyncio task (the first question in any
-    hang investigation; reference serves net/pprof goroutine dumps)."""
-    import faulthandler
-    import io
-    import traceback
-
-    buf = io.StringIO()
-    import sys
-    frames = sys._current_frames()
-    import threading as _threading
-    names = {t.ident: t.name for t in _threading.enumerate()}
-    for tid, frame in frames.items():
-        buf.write(f"--- thread {names.get(tid, tid)} ---\n")
-        traceback.print_stack(frame, file=buf)
-    buf.write("--- asyncio tasks ---\n")
-    for task in asyncio.all_tasks():
-        buf.write(f"{task.get_name()}: {task.get_coro()}\n")
-        for entry in task.get_stack(limit=4):
-            buf.write(f"  {entry.f_code.co_filename}:{entry.f_lineno} "
-                      f"{entry.f_code.co_name}\n")
-    assert faulthandler  # imported for parity with CLI use
-    return web.Response(text=buf.getvalue())
-
-
-_profile_lock = asyncio.Lock()
-
-
-async def _debug_profile(request: web.Request) -> web.Response:
-    """cProfile the event-loop thread for ?seconds=N (default 5, max 60) —
-    the pprof 'profile' endpoint analog. Serialized: two concurrent
-    profilers on one thread corrupt each other."""
-    import cProfile
-    import io
-    import pstats
-
-    try:
-        seconds = min(max(float(request.query.get("seconds", "5")), 0.0),
-                      60.0)
-    except ValueError:
-        return web.Response(status=400, text="seconds must be a number")
-    if _profile_lock.locked():
-        return web.Response(status=409, text="a profile is already running")
-    async with _profile_lock:
-        prof = cProfile.Profile()
-        try:
-            prof.enable()
-            await asyncio.sleep(seconds)
-        finally:
-            prof.disable()
-        out = io.StringIO()
-        pstats.Stats(prof, stream=out).sort_stats(
-            "cumulative").print_stats(60)
-        return web.Response(text=out.getvalue())

@@ -1,0 +1,63 @@
+"""Listener helpers: port-range and vsock listen.
+
+Role parity: reference ``pkg/rpc/server_listen.go`` (``ListenWithPortRange``
+— first free port in [start, end] wins, used where fleets pin service ports
+to firewall-approved ranges) and ``pkg/rpc/vsock.go`` (AF_VSOCK listeners
+for VM-isolated deployments, e.g. firecracker guests talking to a host
+daemon without a NIC).
+
+gRPC-python cannot bind AF_VSOCK itself; vsock deployments put the
+``rpc.mux.MuxListener`` front (or any asyncio server) on the vsock and let
+it splice to the server's unix-socket backends.
+"""
+
+from __future__ import annotations
+
+import socket
+
+VSOCK_CID_ANY = -1
+
+
+def parse_port_spec(spec: str) -> tuple[int, int]:
+    """"8000" -> (8000, 8000); "8000-8010" -> (8000, 8010); "0" -> (0, 0)."""
+    start, _, end = spec.partition("-")
+    lo = int(start)
+    hi = int(end) if end else lo
+    if hi < lo:
+        raise ValueError(f"port range end < start: {spec!r}")
+    return lo, hi
+
+
+def bind_port_in_range(ip: str, start: int, end: int) -> socket.socket:
+    """First bindable TCP port in [start, end] (reference
+    ``ListenWithPortRange``); start == 0 binds ephemeral. Returns the BOUND
+    listening socket — the mux front serves it directly
+    (``MuxListener(sock=...)``, see RPCServer's muxing branch) so no other
+    process can steal the port between probe and use. Plain grpc listeners
+    instead scan the range with per-port binds
+    (``RPCServer._add_port_ranged`` — grpc cannot adopt a bound socket)."""
+    last_exc: OSError | None = None
+    for port in range(start, end + 1):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind((ip, port))
+            s.listen(128)
+            return s
+        except OSError as exc:
+            s.close()
+            last_exc = exc
+    raise OSError(f"no free port in {ip}:{start}-{end}") from last_exc
+
+
+def vsock_listener(port: int, cid: int = VSOCK_CID_ANY) -> socket.socket:
+    """Bound AF_VSOCK listening socket (reference ``pkg/rpc/vsock.go``).
+    Raises OSError where the kernel lacks vsock support — callers surface
+    that as a configuration error, not a silent TCP fallback."""
+    if not hasattr(socket, "AF_VSOCK"):
+        raise OSError("AF_VSOCK not supported on this platform")
+    cid = socket.VMADDR_CID_ANY if cid == VSOCK_CID_ANY else cid
+    s = socket.socket(socket.AF_VSOCK, socket.SOCK_STREAM)
+    s.bind((cid, port))
+    s.listen(128)
+    return s

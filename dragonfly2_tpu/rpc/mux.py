@@ -50,7 +50,12 @@ class MuxListener:
 
     def __init__(self, listen_ip: str, port: int, *,
                  plain_sock: str, tls_sock: str,
-                 policy: str = "default"):
+                 policy: str = "default", sock=None):
+        """``sock``: an already-BOUND listening socket the front should
+        serve instead of binding listen_ip:port itself — how a port-RANGE
+        spec (``rpc.listen.bind_port_in_range``) or an AF_VSOCK listener
+        (``rpc.listen.vsock_listener``, VM-isolated deployments) fronts a
+        grpc server that cannot bind those itself."""
         if policy not in POLICIES:
             raise ValueError(f"unknown mux policy {policy!r}")
         self.listen_ip = listen_ip
@@ -58,6 +63,7 @@ class MuxListener:
         self.plain_sock = plain_sock
         self.tls_sock = tls_sock
         self.policy = policy
+        self._sock = sock
         self._server: asyncio.Server | None = None
         self._warned_plain = False
 
@@ -69,11 +75,17 @@ class MuxListener:
         return os.path.join(d, "plain.sock"), os.path.join(d, "tls.sock")
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(
-            self._handle, self.listen_ip, self.port)
-        self.port = self._server.sockets[0].getsockname()[1]
-        log.info("mux on :%d -> %s / %s (policy=%s)",
-                 self.port, self.plain_sock, self.tls_sock, self.policy)
+        if self._sock is not None:
+            self._server = await asyncio.start_server(
+                self._handle, sock=self._sock)
+        else:
+            self._server = await asyncio.start_server(
+                self._handle, self.listen_ip, self.port)
+        name = self._server.sockets[0].getsockname()
+        self.port = name[1] if isinstance(name, tuple) and len(name) > 1 \
+            else self.port
+        log.info("mux on %s -> %s / %s (policy=%s)",
+                 name, self.plain_sock, self.tls_sock, self.policy)
 
     async def stop(self) -> None:
         if self._server is not None:

@@ -158,6 +158,11 @@ class RPCServer:
         self._server = grpc.aio.server(options=options or [
             ("grpc.max_send_message_length", 64 * 1024 * 1024),
             ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            # grpc defaults SO_REUSEPORT on: two servers handed the same
+            # port RANGE would both silently bind its first port and the
+            # kernel would load-balance RPCs between the wrong processes —
+            # binds must fail loudly so the range scan advances
+            ("grpc.so_reuseport", 0),
         ])
         health_def = ServiceDef("df.health.Health")
         health_def.unary_unary("Check", self.health.check)
@@ -181,15 +186,23 @@ class RPCServer:
             self._server.add_insecure_port(f"unix:{plain_sock}")
             self._server.add_secure_port(f"unix:{tls_sock}",
                                          self.tls.server_credentials())
+            from .listen import bind_port_in_range, parse_port_spec
             ip, _, port_s = self.address.rpartition(":")
-            self.mux = MuxListener(ip or "127.0.0.1", int(port_s or 0),
+            lo, hi = parse_port_spec(port_s or "0")
+            front_sock = None
+            if hi > lo:
+                # port-range spec: bind here (race-free) and hand the
+                # bound socket to the mux front
+                front_sock = bind_port_in_range(ip or "127.0.0.1", lo, hi)
+            self.mux = MuxListener(ip or "127.0.0.1", lo,
                                    plain_sock=plain_sock, tls_sock=tls_sock,
-                                   policy=self.tls_policy)
+                                   policy=self.tls_policy, sock=front_sock)
         elif self.tls is not None:
-            port = self._server.add_secure_port(
-                self.address, self.tls.server_credentials())
+            port = self._add_port_ranged(
+                lambda addr: self._server.add_secure_port(
+                    addr, self.tls.server_credentials()))
         else:
-            port = self._server.add_insecure_port(self.address)
+            port = self._add_port_ranged(self._server.add_insecure_port)
         await self._server.start()
         if self.mux is not None:
             await self.mux.start()
@@ -200,6 +213,26 @@ class RPCServer:
                  self.address, self.port, self.tls is not None,
                  self.tls_policy if self.tls is not None else "-",
                  ",".join(d.name for d in self._defs))
+
+    def _add_port_ranged(self, add_port) -> int:
+        """Bind ``address``, supporting an "ip:START-END" port range
+        (reference ``pkg/rpc/server_listen.go`` ListenWithPortRange): the
+        first port grpc can bind wins. grpc-python cannot adopt a pre-bound
+        socket, so the probe IS the bind — no steal window."""
+        if self.address.startswith("unix:") or "-" not in \
+                self.address.rsplit(":", 1)[-1]:
+            return add_port(self.address)
+        from .listen import parse_port_spec
+        ip, _, spec = self.address.rpartition(":")
+        lo, hi = parse_port_spec(spec)
+        for p in range(lo, hi + 1):
+            try:
+                port = add_port(f"{ip}:{p}")
+            except RuntimeError:
+                continue   # grpc >= 1.60 raises on a taken port
+            if port:
+                return port
+        raise OSError(f"no free port in {self.address}")
 
     async def stop(self, grace: float = 1.0) -> None:
         if self.mux is not None:

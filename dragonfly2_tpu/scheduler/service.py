@@ -105,10 +105,13 @@ class SchedulerService:
         if peer.state == PeerState.PENDING:
             peer.transit(PeerState.RUNNING)
 
-        # first peer of an unseeded task: fire the seed trigger
+        # first peer of an unseeded task: fire the seed trigger. LEVEL2
+        # peers are about to be ruled straight to origin — triggering the
+        # seed too would pull the content from origin TWICE
         if task.url_meta is None:
             task.url_meta = req.url_meta
         if (not task.seed_triggered and self.seed_client.available()
+                and resolved_priority != int(Priority.LEVEL2)
                 and not task.has_available_peer()):
             self._fire_seed_trigger(task, req.url_meta)
 

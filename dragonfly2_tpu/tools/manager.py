@@ -28,19 +28,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable REST auth/RBAC (bootstraps a root user)")
     p.add_argument("--issue-certs", action="store_true",
                    help="enable fleet certificate issuance")
+    p.add_argument("--debug-port", type=int, default=0,
+                   help="serve /debug/{stacks,profile} + /metrics "
+                   "(pprof analog, reference cmd/dependency InitMonitor);"
+                   " 0 off, -1 ephemeral")
     p.add_argument("--verbose", "-v", action="store_true")
     return p
 
 
-async def serve(cfg: ManagerConfig) -> None:
+async def serve(cfg: ManagerConfig, debug_port: int = 0) -> None:
     mgr = Manager(cfg)
     await mgr.start()
+    debug_runner = None
+    if debug_port:
+        from ..common.debug_http import start_debug_server
+        debug_runner, dbg_port = await start_debug_server(
+            "127.0.0.1", max(debug_port, 0))
+        print(f"debug on :{dbg_port}", flush=True)
     print(f"manager up: grpc={mgr.address} rest=:{mgr.rest.port}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if debug_runner is not None:
+        await debug_runner.cleanup()
     await mgr.stop()
 
 
@@ -63,7 +75,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.issue_certs:
         overrides["issue_certs"] = True
     cfg = load_config(ManagerConfig, args.config or None, overrides)
-    asyncio.run(serve(cfg))
+    asyncio.run(serve(cfg, debug_port=args.debug_port))
     return 0
 
 

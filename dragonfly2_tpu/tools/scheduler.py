@@ -33,19 +33,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="span export path (tracing off when empty)")
     p.add_argument("--tracing-otlp", default="",
                    help="OTLP/HTTP collector endpoint")
+    p.add_argument("--debug-port", type=int, default=0,
+                   help="serve /debug/{stacks,profile} + /metrics "
+                   "(pprof analog, reference cmd/dependency InitMonitor);"
+                   " 0 off, -1 ephemeral")
     p.add_argument("--verbose", "-v", action="store_true")
     return p
 
 
-async def serve(cfg: SchedulerConfig) -> None:
+async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
     sched = Scheduler(cfg)
     await sched.start()
+    debug_runner = None
+    if debug_port:
+        from ..common.debug_http import start_debug_server
+        debug_runner, dbg_port = await start_debug_server(
+            "127.0.0.1", max(debug_port, 0))
+        print(f"debug on :{dbg_port}", flush=True)
     print(f"scheduler up: {sched.address}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if debug_runner is not None:
+        await debug_runner.cleanup()
     await sched.stop()
 
 
@@ -72,7 +84,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.tracing_otlp:
         overrides["tracing_otlp"] = args.tracing_otlp
     cfg = load_config(SchedulerConfig, args.config or None, overrides)
-    asyncio.run(serve(cfg))
+    asyncio.run(serve(cfg, debug_port=args.debug_port))
     return 0
 
 

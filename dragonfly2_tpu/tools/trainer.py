@@ -24,19 +24,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-dir", default="")
     p.add_argument("--manager", action="append", default=[],
                    help="manager address (repeatable)")
+    p.add_argument("--debug-port", type=int, default=0,
+                   help="serve /debug/{stacks,profile} + /metrics "
+                   "(pprof analog, reference cmd/dependency InitMonitor);"
+                   " 0 off, -1 ephemeral")
     p.add_argument("--verbose", "-v", action="store_true")
     return p
 
 
-async def serve(cfg: TrainerConfig) -> None:
+async def serve(cfg: TrainerConfig, debug_port: int = 0) -> None:
     trainer = Trainer(cfg)
     await trainer.start()
+    debug_runner = None
+    if debug_port:
+        from ..common.debug_http import start_debug_server
+        debug_runner, dbg_port = await start_debug_server(
+            "127.0.0.1", max(debug_port, 0))
+        print(f"debug on :{dbg_port}", flush=True)
     print(f"trainer up: {trainer.address}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if debug_runner is not None:
+        await debug_runner.cleanup()
     await trainer.stop()
 
 
@@ -53,7 +65,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.manager:
         overrides["manager_addresses"] = args.manager
     cfg = load_config(TrainerConfig, args.config or None, overrides)
-    asyncio.run(serve(cfg))
+    asyncio.run(serve(cfg, debug_port=args.debug_port))
     return 0
 
 

@@ -160,3 +160,65 @@ class TestHashRing:
         assert pool.addresses() == {"127.0.0.1:1", "127.0.0.1:2"}
         pool.update(["127.0.0.1:2", "127.0.0.1:3"])
         assert pool.addresses() == {"127.0.0.1:2", "127.0.0.1:3"}
+
+
+class TestListeners:
+    def test_port_range_listen(self):
+        """reference pkg/rpc/server_listen.go ListenWithPortRange: the
+        server binds the first free port in the configured range."""
+        async def main():
+            from test_launchers import free_port
+            from dragonfly2_tpu.idl.messages import Empty
+            from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+            from dragonfly2_tpu.rpc.server import RPCServer, ServiceDef
+
+            base = free_port()
+            # occupy the first port of the range so the server must move on
+            import socket as _socket
+            blocker = _socket.socket()
+            blocker.bind(("127.0.0.1", base))
+            blocker.listen(1)
+            try:
+                async def ping(req, ctx):
+                    return Empty()
+
+                svc = ServiceDef("df.test.Ping")
+                svc.unary_unary("Ping", ping)
+                srv = RPCServer(f"127.0.0.1:{base}-{base + 20}")
+                srv.register(svc)
+                await srv.start()
+                try:
+                    assert base < srv.port <= base + 20
+                    ch = Channel(f"127.0.0.1:{srv.port}")
+                    out = await ServiceClient(ch, "df.test.Ping").unary(
+                        "Ping", Empty(), timeout=10)
+                    assert isinstance(out, Empty)
+                    await ch.close()
+                finally:
+                    await srv.stop()
+            finally:
+                blocker.close()
+
+        asyncio.run(main())
+
+    def test_vsock_helper_contract(self):
+        """vsock listen helper binds AF_VSOCK or raises OSError (never a
+        silent TCP fallback); parse_port_spec handles singles + ranges."""
+        import pytest as _pytest
+
+        from dragonfly2_tpu.rpc.listen import (bind_port_in_range,
+                                               parse_port_spec,
+                                               vsock_listener)
+
+        assert parse_port_spec("8000") == (8000, 8000)
+        assert parse_port_spec("8000-8010") == (8000, 8010)
+        with _pytest.raises(ValueError):
+            parse_port_spec("9-8")
+        s = bind_port_in_range("127.0.0.1", 0, 0)
+        assert s.getsockname()[1] > 0
+        s.close()
+        try:
+            v = vsock_listener(1234)
+            v.close()
+        except OSError:
+            pass   # sandbox kernels commonly lack /dev/vsock

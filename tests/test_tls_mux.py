@@ -126,6 +126,37 @@ class TestMuxRollout:
 
         asyncio.run(main())
 
+    def test_mux_with_port_range_spec(self, tmp_path):
+        """A port-RANGE address composes with the mux: the front binds the
+        first free port in the range (pre-bound socket handoff)."""
+        async def main():
+            from test_launchers import free_port
+
+            cert, key, ca = _material(tmp_path)
+            base = free_port()
+
+            async def ping(req, ctx):
+                return Empty()
+
+            svc = ServiceDef("df.test.Ping")
+            svc.unary_unary("Ping", ping)
+            srv = RPCServer(f"127.0.0.1:{base}-{base + 10}",
+                            tls=TLSOptions(cert, key), tls_policy="default")
+            srv.register(svc)
+            await srv.start()
+            try:
+                assert base <= srv.port <= base + 10
+                for ch in (Channel(f"127.0.0.1:{srv.port}"),
+                           Channel(f"127.0.0.1:{srv.port}", tls_ca=ca)):
+                    out = await ServiceClient(ch, "df.test.Ping").unary(
+                        "Ping", Empty(), timeout=10)
+                    assert isinstance(out, Empty)
+                    await ch.close()
+            finally:
+                await srv.stop()
+
+        asyncio.run(main())
+
     def test_unknown_policy_rejected(self, tmp_path):
         async def main():
             with pytest.raises(ValueError):

@@ -87,6 +87,24 @@ def add_debug_routes(router) -> None:
     router.add_get("/debug/profile", debug_profile)
 
 
+def add_debug_arg(parser) -> None:
+    """The shared --debug-port flag for service launchers."""
+    parser.add_argument("--debug-port", type=int, default=0,
+                        help="serve /debug/{stacks,profile} + /metrics "
+                        "(pprof analog, reference cmd/dependency "
+                        "InitMonitor); 0 off, -1 ephemeral")
+
+
+async def maybe_start_debug(debug_port: int):
+    """Launcher wiring: start (and announce) the debug server when the
+    flag is set; returns the runner (or None) for cleanup at shutdown."""
+    if not debug_port:
+        return None
+    runner, port = await start_debug_server("127.0.0.1", max(debug_port, 0))
+    print(f"debug on :{port}", flush=True)
+    return runner
+
+
 async def start_debug_server(host: str, port: int):
     """Serve /debug/{stacks,profile} + /metrics; returns (runner, port).
     ``port`` 0 binds ephemeral. Bind failures raise — a requested debug

@@ -15,6 +15,7 @@ import socket
 from typing import Any
 
 from ..common.dfpath import DFPath
+from ..common.errors import Code, DFError
 from ..common.gc import GC, GCTask
 from ..idl.messages import DeviceSink, Host, HostType
 from ..storage.manager import StorageConfig, StorageManager
@@ -100,6 +101,16 @@ class Daemon:
         """Returns a factory(content_length) -> DeviceIngest honoring the
         request's sink spec."""
         def factory(content_length: int):
+            if topology.runtime_wedged():
+                # the boot-time probe thread is still parked inside jax
+                # init holding its locks (see topology.runtime_wedged):
+                # a bare jax call here would hang the EVENT LOOP, not
+                # just this task — refuse and let the caller fall back
+                # to disk-only
+                raise DFError(
+                    Code.UNAVAILABLE,
+                    "accelerator runtime never answered the topology "
+                    "probe; device sink disabled for this process")
             import jax
 
             from ..tpu.hbm_sink import DeviceIngest

@@ -32,6 +32,8 @@ import time
 
 from aiohttp import web
 
+from ..common.errors import Code, DFError
+
 log = logging.getLogger("df.mgr.auth")
 
 SESSION_TTL_S = 7 * 24 * 3600.0
@@ -120,8 +122,18 @@ class Authenticator:
     # -- oauth sign-in state (CSRF guard on the authorize round-trip) ----
 
     def mint_state(self, provider: str) -> str:
-        payload = json.dumps({"p": provider, "n": _b64(secrets.token_bytes(8)),
-                              "exp": time.time() + OAUTH_STATE_TTL_S})
+        nonce = _b64(secrets.token_bytes(8))
+        exp = time.time() + OAUTH_STATE_TTL_S
+        payload = json.dumps({"p": provider, "n": nonce, "exp": exp})
+        # server-side nonce: states are SINGLE-USE (a signed state alone
+        # was replayable for its whole TTL by anyone who observed it —
+        # the signin endpoint is public, so minting costs an attacker
+        # nothing; consumption is what proves this exact round-trip).
+        # DB-backed: survives restart, shared across replicas, and the
+        # table is capped against unauthenticated mint floods.
+        if not self.store.save_oauth_nonce(nonce, exp):
+            raise DFError(Code.RESOURCE_EXHAUSTED,
+                          "too many pending oauth sign-ins")
         body = _b64(payload.encode())
         sig = _b64(hmac.new(self._secret, b"state:" + body.encode(),
                             hashlib.sha256).digest())
@@ -137,8 +149,12 @@ class Authenticator:
             payload = json.loads(_unb64(body))
         except (ValueError, json.JSONDecodeError):
             return False
-        return (payload.get("p") == provider
-                and time.time() <= payload.get("exp", 0))
+        if (payload.get("p") != provider
+                or time.time() > payload.get("exp", 0)):
+            # provider/expiry checked BEFORE consumption: a mismatched
+            # callback must not burn a still-valid state
+            return False
+        return self.store.consume_oauth_nonce(payload.get("n", ""))
 
     def middleware(self):
         @web.middleware

@@ -16,6 +16,7 @@ import logging
 from aiohttp import web
 
 from ..common.aiohttp_util import resolve_port
+from ..common.errors import DFError
 from ..common.metrics import REGISTRY
 from ..idl.messages import ClusterConfig, UrlMeta
 from .jobs import JobRunner
@@ -238,8 +239,45 @@ class RestAPI:
             # PARTIAL update: merge over the stored config — rebuilding from
             # dataclass defaults would silently reset every omitted tunable
             current = await asyncio.to_thread(self.store.cluster_config, cid)
+            # validate each VALUE against the stored field's type (replace
+            # only checks key names): a wrong-typed value would persist
+            # fine here and blow up later inside every scheduler's
+            # dynconfig refresh
+            coerced = {}
+            for k, v in body["config"].items():
+                if not hasattr(current, k):
+                    return web.json_response(
+                        {"error": f"unknown config key {k!r}"}, status=400)
+                target = type(getattr(current, k))
+                bad = web.json_response(
+                    {"error": f"{k} must be {target.__name__}"}, status=400)
+                if target is bool:
+                    if not isinstance(v, bool):
+                        return bad
+                    coerced[k] = v
+                elif target is int:
+                    # bool is an int subclass and float coercion would
+                    # silently truncate — both are type errors here; a
+                    # NUMERIC string coerces (what clients actually send)
+                    if isinstance(v, (bool, float)):
+                        return bad
+                    try:
+                        coerced[k] = int(v)
+                    except (TypeError, ValueError):
+                        return bad
+                elif target is float:
+                    if isinstance(v, bool):
+                        return bad
+                    try:
+                        coerced[k] = float(v)
+                    except (TypeError, ValueError):
+                        return bad
+                elif isinstance(v, target):
+                    coerced[k] = v
+                else:
+                    return bad
             try:
-                cfg = dataclasses.replace(current, **body["config"])
+                cfg = dataclasses.replace(current, **coerced)
             except TypeError as exc:
                 return web.json_response({"error": str(exc)}, status=400)
         if cfg is None and body.get("scopes") is None:
@@ -290,7 +328,11 @@ class RestAPI:
         redirect_uri = request.query.get(
             "redirect_uri",
             f"http://{request.host}/oauth/callback/{name}")
-        url = await self._oauth_flow.signin_url(name, redirect_uri)
+        try:
+            url = await self._oauth_flow.signin_url(name, redirect_uri)
+        except DFError as exc:
+            # state-table cap under a mint flood: answer 429, don't 500
+            return web.json_response({"error": exc.message}, status=429)
         if url is None:
             return web.json_response({"error": "unknown provider"},
                                      status=404)

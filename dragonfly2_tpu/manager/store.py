@@ -91,6 +91,10 @@ CREATE TABLE IF NOT EXISTS users (
   role TEXT NOT NULL DEFAULT 'guest',
   created_at REAL
 );
+CREATE TABLE IF NOT EXISTS oauth_states (
+  nonce TEXT PRIMARY KEY,
+  expires_at REAL NOT NULL
+);
 CREATE TABLE IF NOT EXISTS personal_access_tokens (
   id INTEGER PRIMARY KEY AUTOINCREMENT,
   token_hash TEXT NOT NULL UNIQUE,
@@ -504,6 +508,31 @@ class Store:
         if expires and _now() > expires:
             return None
         return row
+
+    # -- oauth sign-in states (single-use, DB-backed so they survive a
+    # manager restart and work across replicas sharing the DB) -----------
+
+    OAUTH_STATE_CAP = 10_000
+
+    def save_oauth_nonce(self, nonce: str, expires_at: float) -> bool:
+        """False when the active-state cap is hit: /signin is public, so
+        an unauthenticated mint flood must saturate a bounded table, not
+        the manager's memory/disk."""
+        self._exec("DELETE FROM oauth_states WHERE expires_at < ?",
+                   (_now(),))
+        n = self._rows("SELECT COUNT(*) AS n FROM oauth_states")[0]["n"]
+        if n >= self.OAUTH_STATE_CAP:
+            return False
+        self._exec("INSERT OR REPLACE INTO oauth_states(nonce, expires_at)"
+                   " VALUES (?,?)", (nonce, expires_at))
+        return True
+
+    def consume_oauth_nonce(self, nonce: str) -> bool:
+        """Atomically consume: True exactly once per saved nonce."""
+        cur = self._exec(
+            "DELETE FROM oauth_states WHERE nonce=? AND expires_at >= ?",
+            (nonce, _now()))
+        return cur.rowcount > 0
 
     # -- oauth providers (reference ``manager/models/oauth.go``) ---------
 

@@ -135,10 +135,19 @@ async def walk(url: str, *, timeout_s: float = 0.0,
                 queue.append((e.url, depth + 1))
                 continue
             rel = urlparse(e.url).path
-            if base_path and rel.startswith(base_path):
-                rel = rel[len(base_path):]
+            # strip base_path only at a SEGMENT boundary: an entry under
+            # /data2/f listed from base /data must stay "data2/f", not
+            # become "2/f"
+            if base_path:
+                base = base_path.rstrip("/")
+                if rel == base:
+                    rel = ""
+                elif rel.startswith(base + "/"):
+                    rel = rel[len(base):]
             rel = os.path.normpath(rel.lstrip("/") or e.name)
-            if rel.startswith("..") or os.path.isabs(rel):
+            # traversal check by path SEGMENT: "../x" escapes, a file
+            # legitimately named "..config" does not
+            if rel.split(os.sep, 1)[0] == ".." or os.path.isabs(rel):
                 # origin-controlled names must not escape the output dir
                 # (object keys may legally contain '..'; a hostile lister
                 # could name its way into ~/.ssh with the daemon's

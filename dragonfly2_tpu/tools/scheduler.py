@@ -33,10 +33,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="span export path (tracing off when empty)")
     p.add_argument("--tracing-otlp", default="",
                    help="OTLP/HTTP collector endpoint")
-    p.add_argument("--debug-port", type=int, default=0,
-                   help="serve /debug/{stacks,profile} + /metrics "
-                   "(pprof analog, reference cmd/dependency InitMonitor);"
-                   " 0 off, -1 ephemeral")
+    from ..common.debug_http import add_debug_arg
+    add_debug_arg(p)
     p.add_argument("--verbose", "-v", action="store_true")
     return p
 
@@ -44,12 +42,8 @@ def build_parser() -> argparse.ArgumentParser:
 async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
     sched = Scheduler(cfg)
     await sched.start()
-    debug_runner = None
-    if debug_port:
-        from ..common.debug_http import start_debug_server
-        debug_runner, dbg_port = await start_debug_server(
-            "127.0.0.1", max(debug_port, 0))
-        print(f"debug on :{dbg_port}", flush=True)
+    from ..common.debug_http import maybe_start_debug
+    debug_runner = await maybe_start_debug(debug_port)
     print(f"scheduler up: {sched.address}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -24,10 +24,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-dir", default="")
     p.add_argument("--manager", action="append", default=[],
                    help="manager address (repeatable)")
-    p.add_argument("--debug-port", type=int, default=0,
-                   help="serve /debug/{stacks,profile} + /metrics "
-                   "(pprof analog, reference cmd/dependency InitMonitor);"
-                   " 0 off, -1 ephemeral")
+    from ..common.debug_http import add_debug_arg
+    add_debug_arg(p)
     p.add_argument("--verbose", "-v", action="store_true")
     return p
 
@@ -35,12 +33,8 @@ def build_parser() -> argparse.ArgumentParser:
 async def serve(cfg: TrainerConfig, debug_port: int = 0) -> None:
     trainer = Trainer(cfg)
     await trainer.start()
-    debug_runner = None
-    if debug_port:
-        from ..common.debug_http import start_debug_server
-        debug_runner, dbg_port = await start_debug_server(
-            "127.0.0.1", max(debug_port, 0))
-        print(f"debug on :{dbg_port}", flush=True)
+    from ..common.debug_http import maybe_start_debug
+    debug_runner = await maybe_start_debug(debug_port)
     print(f"trainer up: {trainer.address}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -54,7 +54,24 @@ def probe_jax_devices(timeout_s: float | None = None
     t = threading.Thread(target=_probe, name="df-topo-probe", daemon=True)
     t.start()
     t.join(timeout=timeout_s)
-    return box[0] if box else ("timeout", None)
+    result = box[0] if box else ("timeout", None)
+    global _last_probe_timed_out
+    _last_probe_timed_out = result[0] == "timeout"
+    return result
+
+
+_last_probe_timed_out = False
+
+
+def runtime_wedged() -> bool:
+    """THE CONTRACT for a wedged accelerator runtime: when the probe timed
+    out, its thread is parked INSIDE jax backend init holding jax's init
+    locks — any later jax call from any thread of this process can block
+    forever behind it. A topology-less process must therefore never touch
+    jax again for its lifetime; every optional jax entry point (the
+    daemon's device-sink factory, bench phases) checks this instead of
+    finding out by hanging the event loop."""
+    return _last_probe_timed_out
 
 
 @functools.lru_cache(maxsize=1)

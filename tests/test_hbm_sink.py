@@ -253,6 +253,34 @@ class TestJaxProbe:
         assert total >= 1          # conftest pins the cpu backend
         assert n_tpu == 0          # no tpu chips on the cpu backend
 
+    def test_wedged_runtime_disables_device_sink(self, monkeypatch, tmp_path):
+        """The wedged-runtime CONTRACT (VERDICT r04 weak #5): after a
+        timed-out probe the process must never touch jax again — the
+        daemon's device-sink factory refuses instead of hanging the event
+        loop behind the probe thread's jax init locks. The conductor
+        catches the refusal and continues to disk."""
+        from dragonfly2_tpu.common.errors import Code, DFError
+        from dragonfly2_tpu.daemon.config import DaemonConfig, StorageSection
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DeviceSink
+
+        monkeypatch.setattr(topology, "_last_probe_timed_out", True)
+        assert topology.runtime_wedged()
+        daemon = Daemon(DaemonConfig(workdir=str(tmp_path),
+                                     host_ip="127.0.0.1", hostname="w",
+                                     storage=StorageSection(
+                                         gc_interval_s=3600)))
+        factory = daemon.device_sink_builder(DeviceSink(enabled=True))
+        with pytest.raises(DFError) as exc:
+            factory(1 << 20)
+        assert exc.value.code == Code.UNAVAILABLE
+        # a later successful probe clears the contract: construction works
+        monkeypatch.setattr(topology, "_last_probe_timed_out", False)
+        assert not topology.runtime_wedged()
+        ingest = factory(1 << 20)
+        assert ingest is not None
+        ingest.close()
+
     def test_probe_reports_error_not_timeout_when_jax_breaks(self, monkeypatch):
         """Absent/broken jax must surface as 'error' (with the exception),
         not masquerade as a hung runtime."""

@@ -193,6 +193,20 @@ class TestRestCRUDExtras:
                     cfg = m.store.cluster_config(cid)
                     assert cfg.candidate_parent_limit == 7
                     assert cfg.filter_parent_limit == 11
+                    # wrong-typed values: numeric strings coerce, junk 400s
+                    # (a bad value must fail HERE, not later inside every
+                    # scheduler's dynconfig refresh)
+                    async with s.patch(
+                            f"{base}/api/v1/scheduler-clusters/{cid}",
+                            json={"config": {"filter_parent_limit": "10"}},
+                            headers=hdr) as r:
+                        assert r.status == 200
+                    assert m.store.cluster_config(cid).filter_parent_limit == 10
+                    async with s.patch(
+                            f"{base}/api/v1/scheduler-clusters/{cid}",
+                            json={"config": {"filter_parent_limit": "lots"}},
+                            headers=hdr) as r:
+                        assert r.status == 400
                     # unknown field and empty body are 400s, not 500/404
                     async with s.patch(
                             f"{base}/api/v1/scheduler-clusters/{cid}",

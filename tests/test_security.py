@@ -336,6 +336,24 @@ class TestFleetMTLS:
         asyncio.run(main())
 
 
+class TestOAuthState:
+    def test_states_are_single_use(self, tmp_path):
+        """A signed state is consumable exactly once: anyone replaying an
+        observed state within its TTL gets refused (the signin endpoint is
+        public, so the HMAC alone proves nothing about THIS round-trip)."""
+        from dragonfly2_tpu.manager.auth import Authenticator
+        from dragonfly2_tpu.manager.store import Store
+
+        auth = Authenticator(Store(":memory:"))
+        state = auth.mint_state("fakehub")
+        assert auth.verify_state(state, "fakehub")
+        assert not auth.verify_state(state, "fakehub")   # replay refused
+        # a wrong-provider callback must not burn a still-valid state
+        other = auth.mint_state("fakehub")
+        assert not auth.verify_state(other, "evilhub")
+        assert auth.verify_state(other, "fakehub")
+
+
 class TestOAuthSignin:
     """OAuth2 authorization-code sign-in against a FAKE in-process provider
     (reference manager/models/oauth.go + handlers oauth signin): signin

@@ -366,6 +366,46 @@ class TestWalk:
         # symlinked alias, never unboundedly
         assert len(rels) <= 4
 
+    def test_walk_rel_paths_respect_segment_boundaries(self, tmp_path):
+        """Stripping the base path must stop at a '/' boundary (an entry
+        under /data2 listed from base /data is 'data2/f', not '2/f'), and
+        a file merely NAMED '..config' is a legitimate mirror entry —
+        only '..' as a path segment is traversal."""
+        import asyncio
+
+        from dragonfly2_tpu.source import ListEntry, register_client
+        from dragonfly2_tpu.source.client import walk
+
+        class Lister:
+            async def content_length(self, req):
+                return 10
+
+            async def supports_range(self, req):
+                return False
+
+            async def last_modified(self, req):
+                return ""
+
+            async def download(self, req):
+                raise AssertionError("not fetched")
+
+            async def list(self, req):
+                return [
+                    ListEntry(url="seg://h/data2/f", name="f",
+                              is_dir=False, content_length=10),
+                    ListEntry(url="seg://h/data/..config", name="..config",
+                              is_dir=False, content_length=10),
+                    ListEntry(url="seg://h/data/ok.bin", name="ok.bin",
+                              is_dir=False, content_length=10),
+                ]
+
+        register_client("seg", Lister())
+
+        async def go():
+            return sorted([rel async for _e, rel in walk("seg://h/data")])
+
+        assert asyncio.run(go()) == ["..config", "data2/f", "ok.bin"]
+
     def test_walk_refuses_path_traversal_names(self, tmp_path):
         """Origin-controlled names with '..' must not escape the mirror
         root (object keys may legally contain dots; a hostile lister must

@@ -710,6 +710,9 @@ def role_tpu(data_path: str, workdir: str) -> None:
     ``BENCH_TPU_FORCE_CPU=1`` pins the phase at the CPU backend (the
     numbers stay honest — ``device_platform`` labels them): useful for
     exercising the phase when the accelerator tunnel is down."""
+    # this child exists to DETECT RECOVERY: the host wedge marker must not
+    # short-circuit its probe into a stale 'still down' answer
+    os.environ["DF_TOPOLOGY_WEDGE_CACHE"] = "0"
     if os.environ.get("BENCH_TPU_FORCE_CPU"):
         import jax
         jax.config.update("jax_platforms", "cpu")

@@ -101,16 +101,17 @@ class Daemon:
         """Returns a factory(content_length) -> DeviceIngest honoring the
         request's sink spec."""
         def factory(content_length: int):
-            if topology.runtime_wedged():
-                # the boot-time probe thread is still parked inside jax
-                # init holding its locks (see topology.runtime_wedged):
-                # a bare jax call here would hang the EVENT LOOP, not
-                # just this task — refuse and let the caller fall back
-                # to disk-only
+            if not topology.ensure_runtime_alive():
+                # permanently poisoned (our own probe thread is parked in
+                # jax init holding its locks), host-marked wedged, or a
+                # fresh bounded probe just timed out: a bare jax call here
+                # would hang the EVENT LOOP, not just this task — refuse
+                # and let the caller fall back to disk-only. A recovered
+                # runtime is re-admitted by the bounded probe.
                 raise DFError(
                     Code.UNAVAILABLE,
-                    "accelerator runtime never answered the topology "
-                    "probe; device sink disabled for this process")
+                    "accelerator runtime is not answering; device sink "
+                    "unavailable")
             import jax
 
             from ..tpu.hbm_sink import DeviceIngest

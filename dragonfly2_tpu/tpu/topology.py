@@ -13,10 +13,28 @@ import functools
 import logging
 import os
 import socket
+import time
 
 from ..idl.messages import LinkType, TopologyInfo
 
 log = logging.getLogger("df.tpu.topology")
+
+
+def _wedge_cache_path() -> str:
+    """Host-global marker keyed by the env that steers jax's platform
+    choice (processes pinned differently can see different runtimes) and
+    by uid (shared /dev/shm)."""
+    import hashlib
+    import tempfile
+
+    key = hashlib.sha256(
+        f"{os.environ.get('JAX_PLATFORMS', '')}\x00"
+        f"{os.environ.get('XLA_FLAGS', '')}".encode()).hexdigest()[:16]
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    return os.path.join(base, f"df-accel-wedged-{os.getuid()}-{key}")
+
+
+WEDGE_CACHE_TTL_S = 60.0
 
 
 def probe_jax_devices(timeout_s: float | None = None
@@ -31,6 +49,13 @@ def probe_jax_devices(timeout_s: float | None = None
     essential: an executor's non-daemon worker would block interpreter
     exit via its atexit join.
 
+    A TIMED-OUT probe is cached host-globally for ``WEDGE_CACHE_TTL_S``
+    (``DF_TOPOLOGY_WEDGE_CACHE=0`` disables): a wedged runtime is a host
+    condition, and without the cache every process of a 16-daemon fleet
+    boot (or a restart storm on a sick host) serially re-pays the full
+    probe timeout — 15s x N of pure wall. A successful probe deletes the
+    marker, so a recovered tunnel is re-noticed within one TTL.
+
     Returns (status, payload):
       ("ok", (tpu_chip_count, first_tpu_device | None, device_count))
       ("error", exception)   — jax absent or backend init raised
@@ -38,8 +63,20 @@ def probe_jax_devices(timeout_s: float | None = None
     """
     import threading
 
+    global _last_probe_timed_out
     if timeout_s is None:
         timeout_s = float(os.environ.get("DF_TOPOLOGY_PROBE_TIMEOUT_S", "15"))
+    cache_on = os.environ.get("DF_TOPOLOGY_WEDGE_CACHE", "1") != "0"
+    cache = _wedge_cache_path()
+    if cache_on:
+        try:
+            if time.time() - os.stat(cache).st_mtime < WEDGE_CACHE_TTL_S:
+                _last_probe_timed_out = True
+                log.info("accelerator runtime marked wedged by a recent "
+                         "probe on this host; skipping (%s)", cache)
+                return ("timeout", None)
+        except OSError:
+            pass
     box: list = []
 
     def _probe() -> None:
@@ -55,23 +92,66 @@ def probe_jax_devices(timeout_s: float | None = None
     t.start()
     t.join(timeout=timeout_s)
     result = box[0] if box else ("timeout", None)
-    global _last_probe_timed_out
     _last_probe_timed_out = result[0] == "timeout"
+    if result[0] == "timeout":
+        # an ACTUAL thread of this process is now parked in jax init —
+        # permanent poison, unlike a cache-hit (see runtime_wedged)
+        global _local_probe_hung
+        _local_probe_hung = True
+    if cache_on:
+        try:
+            if result[0] == "timeout":
+                with open(cache, "w"):
+                    pass
+            elif result[0] == "ok":
+                try:
+                    os.unlink(cache)
+                except FileNotFoundError:
+                    pass
+        except OSError:
+            pass   # cache is best-effort
     return result
 
 
 _last_probe_timed_out = False
+_local_probe_hung = False      # THIS process parked a thread in jax init
 
 
 def runtime_wedged() -> bool:
-    """THE CONTRACT for a wedged accelerator runtime: when the probe timed
-    out, its thread is parked INSIDE jax backend init holding jax's init
-    locks — any later jax call from any thread of this process can block
-    forever behind it. A topology-less process must therefore never touch
-    jax again for its lifetime; every optional jax entry point (the
-    daemon's device-sink factory, bench phases) checks this instead of
-    finding out by hanging the event loop."""
-    return _last_probe_timed_out
+    """THE CONTRACT for a wedged accelerator runtime, two strengths:
+
+    - ``_local_probe_hung``: THIS process's probe thread is parked INSIDE
+      jax backend init holding jax's init locks — any later jax call from
+      any thread of this process can block forever behind it. Permanent
+      for the process lifetime.
+    - a FRESH host wedge marker (another process's probe timed out within
+      the TTL): this process has no parked thread, but the runtime was
+      recently observed dead — touching jax now would hang anew. SOFT:
+      clears when the marker expires or a successful probe deletes it.
+
+    Every optional jax entry point (the daemon's device-sink factory,
+    bench phases) checks this instead of finding out by hanging the event
+    loop. After a soft wedge clears, callers re-probe bounded
+    (``ensure_runtime_alive``) before trusting jax."""
+    if _local_probe_hung:
+        return True
+    try:
+        return (time.time() - os.stat(_wedge_cache_path()).st_mtime
+                < WEDGE_CACHE_TTL_S)
+    except OSError:
+        return False
+
+
+def ensure_runtime_alive(timeout_s: float = 2.0) -> bool:
+    """Safe-to-touch-jax check for lazy entry points (device sink): False
+    when this process is permanently poisoned or the host marker is
+    fresh; otherwise one SHORT bounded probe decides (a timeout rewrites
+    the marker, so the next call within the TTL refuses instantly instead
+    of blocking again)."""
+    if _local_probe_hung:
+        return False
+    status, _ = probe_jax_devices(timeout_s=timeout_s)
+    return status == "ok"
 
 
 @functools.lru_cache(maxsize=1)

@@ -264,7 +264,7 @@ class TestJaxProbe:
         from dragonfly2_tpu.daemon.daemon import Daemon
         from dragonfly2_tpu.idl.messages import DeviceSink
 
-        monkeypatch.setattr(topology, "_last_probe_timed_out", True)
+        monkeypatch.setattr(topology, "_local_probe_hung", True)
         assert topology.runtime_wedged()
         daemon = Daemon(DaemonConfig(workdir=str(tmp_path),
                                      host_ip="127.0.0.1", hostname="w",
@@ -274,12 +274,51 @@ class TestJaxProbe:
         with pytest.raises(DFError) as exc:
             factory(1 << 20)
         assert exc.value.code == Code.UNAVAILABLE
-        # a later successful probe clears the contract: construction works
-        monkeypatch.setattr(topology, "_last_probe_timed_out", False)
-        assert not topology.runtime_wedged()
+        # once the poison is gone, ensure_runtime_alive's bounded probe
+        # re-admits the (healthy cpu-backend) runtime: construction works
+        monkeypatch.setattr(topology, "_local_probe_hung", False)
         ingest = factory(1 << 20)
         assert ingest is not None
         ingest.close()
+
+    def test_wedge_cache_prevents_repeat_probe_stalls(self, monkeypatch,
+                                                      tmp_path):
+        """A timed-out probe marks the host so sibling processes (a fleet
+        boot, a restart storm) skip their own full-timeout probe; a later
+        successful probe clears the marker."""
+        import builtins
+        import os
+        import time
+
+        # private marker path for this test (a bogus XLA_FLAGS key would
+        # abort jax's first backend init when run in isolation)
+        cache = str(tmp_path / "wedge-marker")
+        monkeypatch.setattr(topology, "_wedge_cache_path", lambda: cache)
+        monkeypatch.setattr(topology, "_local_probe_hung", False)
+
+        real_import = builtins.__import__
+
+        def hanging_import(name, *a, **kw):
+            if name == "jax":
+                time.sleep(20)
+            return real_import(name, *a, **kw)
+
+        monkeypatch.setattr(builtins, "__import__", hanging_import)
+        status, _ = topology.probe_jax_devices(timeout_s=0.3)
+        assert status == "timeout"
+        assert os.path.exists(cache), "timeout must write the wedge marker"
+        # marker fresh: the next probe answers instantly without touching
+        # jax at all (import hook restored -> a real probe would succeed)
+        monkeypatch.setattr(builtins, "__import__", real_import)
+        t0 = time.monotonic()
+        status, _ = topology.probe_jax_devices(timeout_s=30)
+        assert status == "timeout"
+        assert time.monotonic() - t0 < 1.0, "cached wedge must be instant"
+        assert topology.runtime_wedged()
+        os.unlink(cache)
+        status, _ = topology.probe_jax_devices(timeout_s=60)
+        assert status == "ok"
+        assert not os.path.exists(cache), "success must clear the marker"
 
     def test_probe_reports_error_not_timeout_when_jax_breaks(self, monkeypatch):
         """Absent/broken jax must surface as 'error' (with the exception),

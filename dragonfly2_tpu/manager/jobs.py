@@ -27,16 +27,25 @@ class JobRunner:
         self._running: set[asyncio.Task] = set()
 
     async def submit_preheat(self, *, url: str, url_meta: UrlMeta | None = None,
-                             cluster_id: int | None = None) -> int:
+                             cluster_id: int | None = None,
+                             type_: str = "file",
+                             platform: str = "") -> int:
+        """``type_`` "file" preheats one URL; "image" treats ``url`` as an
+        OCI manifest reference (``.../v2/<name>/manifests/<ref>``),
+        resolves it (manifest lists filtered by ``platform`` "os/arch"),
+        and preheats every config+layer blob (reference
+        ``manager/job/preheat.go`` getImageLayers)."""
         import dataclasses
         job_id = await asyncio.to_thread(
             self.store.create_job, "preheat",
             {"url": url, "cluster_id": cluster_id,
+             "type": type_, "platform": platform,
              # persisted so a crash-resume preheats the SAME task id
              # (UrlMeta participates in the task id)
              "url_meta": dataclasses.asdict(url_meta) if url_meta else None})
         t = asyncio.get_running_loop().create_task(
-            self._run_preheat(job_id, url, url_meta, cluster_id))
+            self._run_preheat(job_id, url, url_meta, cluster_id,
+                              type_=type_, platform=platform))
         self._running.add(t)
         t.add_done_callback(self._running.discard)
         return job_id
@@ -77,15 +86,153 @@ class JobRunner:
 
     async def _run_preheat(self, job_id: int, url: str,
                            url_meta: UrlMeta | None,
-                           cluster_id: int | None) -> None:
+                           cluster_id: int | None, *,
+                           type_: str = "file",
+                           platform: str = "") -> None:
+        urls = [url]
+        blob_meta = url_meta
+        if type_ == "image":
+            try:
+                urls, auth = await self._resolve_image_layers(url, url_meta,
+                                                              platform)
+            except Exception as exc:  # noqa: BLE001 - job outcome, not crash
+                await asyncio.to_thread(
+                    self.store.update_job, job_id, state="failed",
+                    result={"error": f"image resolution failed: {exc}"})
+                return
+            if not urls:
+                await asyncio.to_thread(
+                    self.store.update_job, job_id, state="failed",
+                    result={"error": "image has no matching platform "
+                                     "manifests/layers"})
+                return
+            if auth:
+                # the SEEDS fetch the blobs: hand them the registry token
+                # the resolution negotiated (reference parseLayers sets the
+                # Authorization header on each layer's PreheatRequest).
+                # Headers do not participate in the task id.
+                import dataclasses
+                base_meta = blob_meta or UrlMeta()
+                blob_meta = dataclasses.replace(
+                    base_meta, header={**(base_meta.header or {}), **auth})
+
         async def call(client, addr):
-            resp = await client.unary(
-                "Preheat", PreheatRequest(url=url, url_meta=url_meta,
-                                          wait=True), timeout=600.0)
-            return ({"state": resp.state, "task_id": resp.task_id},
-                    resp.state == "succeeded")
+            # blobs are independent: overlap them (bounded) so the job
+            # resolves at the slowest blob, not the sum of all of them
+            sem = asyncio.Semaphore(8)
+
+            async def one(u: str) -> dict:
+                async with sem:
+                    resp = await client.unary(
+                        "Preheat", PreheatRequest(url=u, url_meta=blob_meta,
+                                                  wait=True), timeout=600.0)
+                return {"url": u, "state": resp.state,
+                        "task_id": resp.task_id}
+
+            states = list(await asyncio.gather(*[one(u) for u in urls]))
+            good = all(s["state"] == "succeeded" for s in states)
+            if type_ == "image":
+                return ({"state": "succeeded" if good else "failed",
+                         "blobs": states}, good)
+            return (states[0], good)
 
         await self._fan_out(job_id, cluster_id, "preheat", call)
+
+    # -- OCI image resolution (reference manager/job/preheat.go) ---------
+
+    @staticmethod
+    def _parse_bearer_challenge(header: str) -> dict:
+        import re
+        return dict(re.findall(r'(\w+)="([^"]*)"', header))
+
+    async def _registry_get(self, session, url: str, headers: dict,
+                            auth: dict) -> tuple[int, dict, bytes]:
+        """GET with the standard registry token dance: on 401 with a
+        Bearer challenge, fetch a token from the advertised realm and
+        retry once (reference newImageAuthClient). A won token lands in
+        ``auth`` (mutated) so later requests — and the seeds' blob
+        fetches — reuse it."""
+        async with session.get(url, headers={**headers, **auth}) as resp:
+            if resp.status != 401:
+                return resp.status, dict(resp.headers), await resp.read()
+            challenge = resp.headers.get("WWW-Authenticate", "")
+        ch = self._parse_bearer_challenge(challenge)
+        realm = ch.get("realm")
+        if not challenge.lower().startswith("bearer") or not realm:
+            return 401, {}, b""
+        params = {k: v for k, v in ch.items()
+                  if k in ("service", "scope") and v}
+        async with session.get(realm, params=params) as tresp:
+            if tresp.status != 200:
+                return 401, {}, b""
+            tok = (await tresp.json()).get("token") or ""
+        auth["Authorization"] = f"Bearer {tok}"
+        async with session.get(url, headers={**headers, **auth}) as resp:
+            return resp.status, dict(resp.headers), await resp.read()
+
+    async def _resolve_image_layers(self, url: str,
+                                    url_meta: UrlMeta | None,
+                                    platform: str
+                                    ) -> tuple[list[str], dict]:
+        """Manifest reference -> (every config+layer blob URL, the auth
+        header the token dance won, for the seeds' blob fetches),
+        following one level of manifest list/index (filtered by
+        ``platform`` "os/arch" when given, like reference
+        filterManifests)."""
+        import json as _json
+
+        import aiohttp
+
+        base, _, _ref = url.rpartition("/manifests/")
+        if not base:
+            raise ValueError(f"not a manifest reference: {url}")
+        LIST_TYPES = (
+            "application/vnd.docker.distribution.manifest.list.v2+json",
+            "application/vnd.oci.image.index.v1+json")
+        MANIFEST_TYPES = (
+            "application/vnd.docker.distribution.manifest.v2+json",
+            "application/vnd.oci.image.manifest.v1+json")
+        headers = dict((url_meta.header or {}) if url_meta else {})
+        headers["Accept"] = ", ".join((*LIST_TYPES, *MANIFEST_TYPES))
+        want_os = want_arch = ""
+        if platform:
+            want_os, _, want_arch = platform.partition("/")
+        blobs: list[str] = []
+        auth: dict = {}
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60.0)) as session:
+            status, hdrs, body = await self._registry_get(session, url,
+                                                          headers, auth)
+            if status != 200:
+                raise ValueError(f"manifest fetch {status} for {url}")
+            doc = _json.loads(body)
+            ctype = hdrs.get("Content-Type", doc.get("mediaType", ""))
+            manifests = [doc]
+            if ctype in LIST_TYPES or "manifests" in doc:
+                manifests = []
+                for entry in doc.get("manifests", []):
+                    p = entry.get("platform") or {}
+                    if platform and (p.get("os") != want_os
+                                     or p.get("architecture") != want_arch):
+                        continue
+                    status, _h, mbody = await self._registry_get(
+                        session, f"{base}/manifests/{entry['digest']}",
+                        headers, auth)
+                    if status != 200:
+                        raise ValueError(
+                            f"sub-manifest fetch {status} for "
+                            f"{entry['digest']}")
+                    manifests.append(_json.loads(mbody))
+            for m in manifests:
+                cfg = (m.get("config") or {}).get("digest")
+                if cfg:
+                    blobs.append(f"{base}/blobs/{cfg}")
+                for layer in m.get("layers", []):
+                    if layer.get("digest"):
+                        blobs.append(f"{base}/blobs/{layer['digest']}")
+        # dedup preserving order (shared layers across platforms)
+        seen: set[str] = set()
+        return ([b for b in blobs if not (b in seen or seen.add(b))], auth)
 
     async def submit_sync_peers(self, *,
                                 cluster_id: int | None = None) -> int:
@@ -142,7 +289,9 @@ class JobRunner:
                         if args.get("url_meta") else None)
                 t = asyncio.get_running_loop().create_task(
                     self._run_preheat(job["id"], args["url"], meta,
-                                      args.get("cluster_id")))
+                                      args.get("cluster_id"),
+                                      type_=args.get("type", "file"),
+                                      platform=args.get("platform", "")))
             elif job["type"] == "sync_peers":
                 t = asyncio.get_running_loop().create_task(
                     self._run_sync_peers(job["id"],

@@ -132,11 +132,17 @@ class RestAPI:
             if not args.get("url"):
                 return web.json_response(
                     {"error": "preheat requires args.url"}, status=400)
+            if args.get("type", "file") not in ("file", "image"):
+                return web.json_response(
+                    {"error": "preheat args.type must be file|image"},
+                    status=400)
             meta = UrlMeta(**args.get("url_meta", {})) \
                 if args.get("url_meta") else None
             job_id = await self.jobs.submit_preheat(
                 url=args["url"], url_meta=meta,
-                cluster_id=args.get("cluster_id"))
+                cluster_id=args.get("cluster_id"),
+                type_=args.get("type", "file"),
+                platform=args.get("platform", ""))
         elif body.get("type") == "sync_peers":
             job_id = await self.jobs.submit_sync_peers(
                 cluster_id=args.get("cluster_id"))

@@ -63,7 +63,6 @@ def probe_jax_devices(timeout_s: float | None = None
     """
     import threading
 
-    global _last_probe_timed_out
     if timeout_s is None:
         timeout_s = float(os.environ.get("DF_TOPOLOGY_PROBE_TIMEOUT_S", "15"))
     cache_on = os.environ.get("DF_TOPOLOGY_WEDGE_CACHE", "1") != "0"
@@ -71,7 +70,6 @@ def probe_jax_devices(timeout_s: float | None = None
     if cache_on:
         try:
             if time.time() - os.stat(cache).st_mtime < WEDGE_CACHE_TTL_S:
-                _last_probe_timed_out = True
                 log.info("accelerator runtime marked wedged by a recent "
                          "probe on this host; skipping (%s)", cache)
                 return ("timeout", None)
@@ -92,29 +90,32 @@ def probe_jax_devices(timeout_s: float | None = None
     t.start()
     t.join(timeout=timeout_s)
     result = box[0] if box else ("timeout", None)
-    _last_probe_timed_out = result[0] == "timeout"
+    global _local_probe_hung, _runtime_ok
     if result[0] == "timeout":
         # an ACTUAL thread of this process is now parked in jax init —
         # permanent poison, unlike a cache-hit (see runtime_wedged)
-        global _local_probe_hung
         _local_probe_hung = True
-    if cache_on:
-        try:
-            if result[0] == "timeout":
+        if cache_on:
+            try:
                 with open(cache, "w"):
                     pass
-            elif result[0] == "ok":
-                try:
-                    os.unlink(cache)
-                except FileNotFoundError:
-                    pass
+            except OSError:
+                pass   # cache is best-effort
+    elif result[0] == "ok":
+        _runtime_ok = True
+        # deleting a stale wedge marker is ALWAYS right — even for a
+        # process that reads with the cache disabled (the bench's
+        # recovery detector must broadcast the recovery it just proved)
+        try:
+            os.unlink(cache)
         except OSError:
-            pass   # cache is best-effort
+            pass
     return result
 
 
-_last_probe_timed_out = False
 _local_probe_hung = False      # THIS process parked a thread in jax init
+_runtime_ok = False            # a probe in THIS process saw jax answer
+_reprobe_inflight = False      # background re-verification running
 
 
 def runtime_wedged() -> bool:
@@ -128,13 +129,19 @@ def runtime_wedged() -> bool:
       the TTL): this process has no parked thread, but the runtime was
       recently observed dead — touching jax now would hang anew. SOFT:
       clears when the marker expires or a successful probe deletes it.
+      Not consulted when ``DF_TOPOLOGY_WEDGE_CACHE=0`` (a process that
+      deliberately re-probes must trust its own result, not a stale
+      marker).
 
     Every optional jax entry point (the daemon's device-sink factory,
     bench phases) checks this instead of finding out by hanging the event
-    loop. After a soft wedge clears, callers re-probe bounded
-    (``ensure_runtime_alive``) before trusting jax."""
+    loop."""
     if _local_probe_hung:
         return True
+    if _runtime_ok:
+        return False
+    if os.environ.get("DF_TOPOLOGY_WEDGE_CACHE", "1") == "0":
+        return False
     try:
         return (time.time() - os.stat(_wedge_cache_path()).st_mtime
                 < WEDGE_CACHE_TTL_S)
@@ -142,16 +149,39 @@ def runtime_wedged() -> bool:
         return False
 
 
-def ensure_runtime_alive(timeout_s: float = 2.0) -> bool:
-    """Safe-to-touch-jax check for lazy entry points (device sink): False
-    when this process is permanently poisoned or the host marker is
-    fresh; otherwise one SHORT bounded probe decides (a timeout rewrites
-    the marker, so the next call within the TTL refuses instantly instead
-    of blocking again)."""
+def ensure_runtime_alive() -> bool:
+    """NON-BLOCKING safe-to-touch-jax check for event-loop entry points
+    (device sink). O(1): returns True only when a probe in THIS process
+    has seen the backend answer. When the verdict is unknown (this
+    process booted off a cache-hit and never probed) and the host marker
+    has lapsed, a full-timeout background probe is kicked off and False
+    is returned — the CURRENT request degrades (disk-only), the NEXT one
+    after a successful probe gets the sink. Never joins a probe thread on
+    the caller's thread: a 'bounded' 2s join here would still freeze the
+    daemon's entire event loop when the runtime is sick, and would
+    poison healthy-but-slow (>2s init) backends."""
+    global _reprobe_inflight
     if _local_probe_hung:
         return False
-    status, _ = probe_jax_devices(timeout_s=timeout_s)
-    return status == "ok"
+    if _runtime_ok:
+        return True
+    if runtime_wedged():
+        return False
+    if not _reprobe_inflight:
+        import threading
+
+        _reprobe_inflight = True
+
+        def _reprobe() -> None:
+            global _reprobe_inflight
+            try:
+                probe_jax_devices()
+            finally:
+                _reprobe_inflight = False
+
+        threading.Thread(target=_reprobe, name="df-topo-reprobe",
+                         daemon=True).start()
+    return False
 
 
 @functools.lru_cache(maxsize=1)

@@ -139,6 +139,152 @@ class TestManagerE2E:
 
         asyncio.run(go())
 
+    def test_image_preheat_resolves_layers_with_token_auth(self, tmp_path):
+        """Reference ``test/e2e/manager/preheat.go`` "preheat image": a
+        REST preheat job of type=image against a token-auth OCI registry
+        resolves the manifest LIST, filters by platform, and warms every
+        config+layer blob of the selected arch into the seed — the seeds'
+        blob fetches ride the token the manager's dance negotiated."""
+        import hashlib
+        import json as _json
+
+        layers = {
+            "amd-l1": os.urandom(1 << 20),
+            "amd-l2": os.urandom(1 << 20),
+            "arm-l1": os.urandom(1 << 20),
+        }
+        cfg_blob = _json.dumps({"arch": "amd64"}).encode()
+
+        def dg(b: bytes) -> str:
+            return "sha256:" + hashlib.sha256(b).hexdigest()
+
+        blobs = {dg(b): b for b in (*layers.values(), cfg_blob)}
+        man_amd = _json.dumps({
+            "schemaVersion": 2,
+            "config": {"digest": dg(cfg_blob), "size": len(cfg_blob)},
+            "layers": [{"digest": dg(layers["amd-l1"])},
+                       {"digest": dg(layers["amd-l2"])}]}).encode()
+        man_arm = _json.dumps({
+            "schemaVersion": 2,
+            "config": {"digest": dg(cfg_blob), "size": len(cfg_blob)},
+            "layers": [{"digest": dg(layers["arm-l1"])}]}).encode()
+        manifests = {dg(man_amd): man_amd, dg(man_arm): man_arm}
+        index = _json.dumps({
+            "schemaVersion": 2,
+            "mediaType":
+                "application/vnd.docker.distribution.manifest.list.v2+json",
+            "manifests": [
+                {"digest": dg(man_amd),
+                 "platform": {"os": "linux", "architecture": "amd64"}},
+                {"digest": dg(man_arm),
+                 "platform": {"os": "linux", "architecture": "arm64"}},
+            ]}).encode()
+
+        async def go():
+            from aiohttp import web
+
+            TOKEN = "Bearer reg-tok-42"
+            served_tokens = {"n": 0}
+
+            def authed(request) -> bool:
+                return request.headers.get("Authorization") == TOKEN
+
+            def challenge(request) -> web.Response:
+                realm = f"http://127.0.0.1:{request.url.port}/token"
+                return web.Response(status=401, headers={
+                    "WWW-Authenticate":
+                        f'Bearer realm="{realm}",service="reg.test",'
+                        f'scope="repository:img:pull"'})
+
+            async def token(request):
+                assert request.query.get("service") == "reg.test"
+                served_tokens["n"] += 1
+                return web.json_response({"token": "reg-tok-42"})
+
+            async def manifest(request):
+                if not authed(request):
+                    return challenge(request)
+                ref = request.match_info["ref"]
+                if ref == "v1":
+                    return web.Response(
+                        body=index,
+                        content_type="application/vnd.docker.distribution."
+                                     "manifest.list.v2+json")
+                body = manifests.get(ref)
+                if body is None:
+                    return web.Response(status=404)
+                return web.Response(
+                    body=body,
+                    content_type="application/vnd.docker.distribution."
+                                 "manifest.v2+json")
+
+            async def blob(request):
+                if not authed(request):
+                    return challenge(request)
+                data = blobs.get(request.match_info["digest"])
+                if data is None:
+                    return web.Response(status=404)
+                return web.Response(body=data)
+
+            app = web.Application()
+            app.router.add_get("/token", token)
+            app.router.add_get("/v2/img/manifests/{ref}", manifest)
+            app.router.add_get("/v2/img/blobs/{digest}", blob)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            base = f"http://127.0.0.1:{port}"
+
+            manager = Manager(ManagerConfig())
+            await manager.start()
+            seed_cfg = daemon_config(tmp_path, "seedIMG")
+            seed_cfg.is_seed = True
+            seed_cfg.manager_addresses = [manager.address]
+            seed = Daemon(seed_cfg)
+            await seed.start()
+            sched = Scheduler(SchedulerConfig(
+                manager_addresses=[manager.address]))
+            await sched.start()
+            try:
+                async with aiohttp.ClientSession() as http:
+                    async with http.post(
+                            f"http://127.0.0.1:{manager.rest.port}"
+                            f"/api/v1/jobs",
+                            json={"type": "preheat", "args": {
+                                "url": f"{base}/v2/img/manifests/v1",
+                                "type": "image",
+                                "platform": "linux/amd64"}}) as resp:
+                        assert resp.status == 201
+                        job_id = (await resp.json())["id"]
+                    for _ in range(200):
+                        async with http.get(
+                                f"http://127.0.0.1:{manager.rest.port}"
+                                f"/api/v1/jobs/{job_id}") as resp:
+                            job = await resp.json()
+                        if job["state"] in ("succeeded", "failed"):
+                            break
+                        await asyncio.sleep(0.1)
+                assert job["state"] == "succeeded", job
+                # exactly the amd64 config+layers were preheated into the
+                # seed's store; the arm64-only layer was not
+                stored = {ts.md.url.rsplit("/", 1)[-1]
+                          for ts in seed.ptm.storage_mgr.tasks()
+                          if ts.md.done}
+                assert dg(cfg_blob) in stored
+                assert dg(layers["amd-l1"]) in stored
+                assert dg(layers["amd-l2"]) in stored
+                assert dg(layers["arm-l1"]) not in stored
+                assert served_tokens["n"] >= 1, "token dance never ran"
+            finally:
+                await sched.stop()
+                await seed.stop()
+                await manager.stop()
+                await runner.cleanup()
+
+        asyncio.run(go())
+
 
 class TestRestCRUDExtras:
     def test_sp_clusters_cluster_update_users(self, tmp_path):

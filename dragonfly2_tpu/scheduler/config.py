@@ -60,11 +60,12 @@ class SchedulerConfig:
     retry_back_source_limit: int = RETRY_BACK_SOURCE_LIMIT
     back_source_concurrent: int = DEFAULT_BACK_SOURCE_CONCURRENT
     # scheduler-wide cap on concurrent back-source peers across ALL tasks
-    # (reference DefaultSchedulerBackToSourceCount): origin/WAN egress is a
-    # cluster resource, not a per-task one. Counted per priority CLASS —
-    # lower-priority holders don't block a higher-priority requester, which
-    # is how a LEVEL0 application preempts LEVEL6 traffic's origin slots.
-    back_source_total: int = 64
+    # (reference DefaultSchedulerBackToSourceCount = 200,
+    # scheduler/config/constants.go:63): origin/WAN egress is a cluster
+    # resource, not a per-task one. Counted per priority CLASS — lower-
+    # priority holders don't block a higher-priority requester, which is
+    # how a LEVEL0 application preempts LEVEL6 traffic's origin slots.
+    back_source_total: int = 200
     peer_ttl_s: float = PEER_TTL_S
     task_ttl_s: float = TASK_TTL_S
     host_ttl_s: float = HOST_TTL_S

@@ -17,6 +17,11 @@ class SchedulerConfig:
     register_timeout_s: float = 10.0
     schedule_timeout_s: float = 30.0       # max wait for a usable peer packet
     max_reschedule: int = 5                # reference RetryLimit
+    # manager-discovered scheduler set refresh cadence (reference daemon
+    # dynconfig refresh): 0 disables. A scheduler replaced — or one that
+    # registers AFTER this daemon booted — must reach daemons without a
+    # daemon restart.
+    refresh_interval_s: float = 30.0
 
 
 @dataclass

@@ -82,6 +82,7 @@ class Daemon:
         self.proxy_server: Any = None
         self.object_gateway: Any = None
         self.announcer: Any = None
+        self.prober: Any = None
         self.manager: Any = None
 
     # ------------------------------------------------------------------
@@ -288,15 +289,7 @@ class Daemon:
         self.gc.add(GCTask("storage", self.cfg.storage.gc_interval_s,
                            self.storage_mgr.try_gc))
         self.gc.start()
-        if self.scheduler is not None and hasattr(self.scheduler, "announce_host"):
-            from .announcer import Announcer
-            self.announcer = Announcer(self)
-            await self.announcer.start()
-        if (self.cfg.probe_enabled and self.scheduler is not None
-                and hasattr(self.scheduler, "sync_probes")):
-            from .networktopology import NetworkTopologyProber
-            self.prober = NetworkTopologyProber(self)
-            await self.prober.start()
+        await self._wire_scheduler_extras()
         # counted only after everything above succeeded, consumed exactly
         # once by stop(): a failed start() or a double stop() must neither
         # strand the count high (leak fix disabled) nor drive it to zero
@@ -335,14 +328,73 @@ class Daemon:
                     addrs, self.host_info(),
                     register_timeout_s=self.cfg.scheduler.register_timeout_s)
             else:
-                log.info("manager knows no active schedulers; back-source only")
+                log.info("manager knows no active schedulers; back-source "
+                         "only until the refresh loop finds one")
         except Exception as exc:  # noqa: BLE001 - manager optional
             log.warning("manager attach failed (%s); back-source only", exc)
+        if self.cfg.scheduler.refresh_interval_s > 0:
+            self._sched_refresh = asyncio.get_running_loop().create_task(
+                self._scheduler_refresh_loop())
+
+    async def _wire_scheduler_extras(self) -> None:
+        """Announcer + topology prober ride the scheduler connection; wired
+        at boot AND when the refresh loop adopts a late scheduler — a
+        healed daemon must announce itself and probe like one that booted
+        after the scheduler."""
+        if self.scheduler is None:
+            return
+        if self.announcer is None and hasattr(self.scheduler,
+                                              "announce_host"):
+            from .announcer import Announcer
+            self.announcer = Announcer(self)
+            await self.announcer.start()
+        if (self.prober is None and self.cfg.probe_enabled
+                and hasattr(self.scheduler, "sync_probes")):
+            from .networktopology import NetworkTopologyProber
+            self.prober = NetworkTopologyProber(self)
+            await self.prober.start()
+
+    async def _scheduler_refresh_loop(self) -> None:
+        """Track the manager's scheduler set (reference daemon dynconfig
+        refresh): a replaced scheduler reaches the ring, and a daemon that
+        booted before ANY scheduler registered heals out of back-source-
+        only the moment one appears. An empty/failed fetch keeps the last
+        known set — a manager blip must not strand live schedulers."""
+        from ..idl.messages import GetSchedulersRequest
+
+        while True:
+            await asyncio.sleep(self.cfg.scheduler.refresh_interval_s)
+            try:
+                resp = await self.manager.get_schedulers(GetSchedulersRequest(
+                    hostname=self.hostname, ip=self.host_ip,
+                    topology=self.topology))
+                addrs = [f"{s.ip}:{s.port}"
+                         for s in (resp.schedulers or [])]
+                if not addrs:
+                    continue
+                if self.scheduler is None:
+                    self.scheduler = SchedulerConnector(
+                        addrs, self.host_info(),
+                        register_timeout_s=self.cfg.scheduler
+                        .register_timeout_s)
+                    if self.ptm is not None:
+                        self.ptm.scheduler = self.scheduler
+                    await self._wire_scheduler_extras()
+                    log.info("schedulers appeared: %s", addrs)
+                elif set(addrs) != set(self.scheduler.addresses):
+                    log.info("scheduler set changed: %s -> %s",
+                             self.scheduler.addresses, addrs)
+                    self.scheduler.update_addresses(addrs)
+            except Exception as exc:  # noqa: BLE001 - manager flaky is fine
+                log.debug("scheduler refresh failed: %s", exc)
 
     async def stop(self) -> None:
         renewal = getattr(self, "_cert_renewal", None)
         if renewal is not None:
             renewal.cancel()
+        refresh = getattr(self, "_sched_refresh", None)
+        if refresh is not None:
+            refresh.cancel()
         if self.cfg.tracing.enabled:
             from ..common import tracing
             tracing.TRACER.flush()

@@ -165,6 +165,32 @@ class SchedulerConnector:
         self.register_timeout_s = register_timeout_s
         self._ring = HashRing(self.addresses)
         self._channels: dict[str, Channel] = {}
+        self._close_tasks: set = set()   # strong refs: the loop only
+        # weak-refs tasks, and a GC'd close task leaks its channel
+
+    def update_addresses(self, addresses: list[str]) -> None:
+        """Adopt a refreshed scheduler set (manager dynconfig): new
+        addresses join the consistent-hash ring; removed ones leave it
+        and their channels CLOSE — a scheduler the manager dropped is
+        gone or being retired, and sessions riding it take the
+        conductor's normal reschedule ladder (stream-loss recovery is
+        already first-class, see tests/test_churn.py). New tasks hash
+        onto the new ring immediately."""
+        want = set(addresses)
+        have = set(self.addresses)
+        if want == have:
+            return
+        import asyncio
+        for addr in want - have:
+            self._ring.add(addr)
+        for addr in have - want:
+            self._ring.remove(addr)
+            ch = self._channels.pop(addr, None)
+            if ch is not None:
+                t = asyncio.get_running_loop().create_task(ch.close())
+                self._close_tasks.add(t)
+                t.add_done_callback(self._close_tasks.discard)
+        self.addresses = list(addresses)
 
     def _client(self, task_id: str) -> ServiceClient:
         # consistent-hash the task onto one scheduler address so all peers of

@@ -20,10 +20,11 @@ a background thread; the (synchronous) training thread iterates::
 While step i consumes shard i, shards i+1..i+depth ride the P2P mesh and
 DMA into device memory on the HBM sink's transfer thread — the same
 overlap the bench measures as ``train_step_slowdown_pct``. Each yielded
-array is the shard's raw bytes as a uint8 jax.Array (one per device, or
-a global sharded array when ``sharding`` is given); decoding stays with
-the caller (WebDataset/TFRecord framing is format-specific and cheap
-next to the transfer).
+item is the shard's raw bytes as per-device uint8 arrays (the HBM sink's
+result); decoding stays with the caller (WebDataset/TFRecord framing is
+format-specific and cheap next to the transfer). For a single global
+sharded ``jax.Array``, use ``tpu.hbm_sink.DeviceIngest`` with a
+``sharding`` directly.
 """
 
 from __future__ import annotations
@@ -128,14 +129,29 @@ class ShardPrefetcher:
 
     async def astream(self):
         """Async iterator over device arrays, ``depth`` shards in flight,
-        strictly in input order."""
+        strictly in input order. Duplicate URLs (sampling with
+        replacement) are serialized: concurrent fetches of one URL would
+        share a conductor and harvest the same consumed (donated) sink."""
         pending: list[asyncio.Task] = []
+        last_for_url: dict[str, asyncio.Task] = {}
         idx = 0
+
+        def spawn(url: str) -> asyncio.Task:
+            prev = last_for_url.get(url)
+
+            async def run():
+                if prev is not None and not prev.done():
+                    await asyncio.wait({prev})
+                return await self._fetch(url)
+
+            t = asyncio.create_task(run())
+            last_for_url[url] = t
+            return t
+
         try:
             while pending or idx < len(self.urls):
                 while idx < len(self.urls) and len(pending) < self.depth:
-                    pending.append(asyncio.create_task(
-                        self._fetch(self.urls[idx])))
+                    pending.append(spawn(self.urls[idx]))
                     idx += 1
                 head = pending.pop(0)
                 try:

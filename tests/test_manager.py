@@ -139,6 +139,43 @@ class TestManagerE2E:
 
         asyncio.run(go())
 
+    def test_late_scheduler_heals_daemon_out_of_back_source_only(
+            self, tmp_path):
+        """A daemon that boots before ANY scheduler registered (rollout
+        ordering, scheduler crash window) must adopt one via the manager
+        refresh loop — without a daemon restart (reference daemon
+        dynconfig refresh)."""
+        async def go():
+            manager = Manager(ManagerConfig())
+            await manager.start()
+            leech_cfg = daemon_config(tmp_path, "earlyD")
+            leech_cfg.manager_addresses = [manager.address]
+            leech_cfg.scheduler.refresh_interval_s = 0.2
+            daemon = Daemon(leech_cfg)
+            await daemon.start()
+            sched = None
+            try:
+                assert daemon.scheduler is None   # nothing to discover yet
+                sched = Scheduler(SchedulerConfig(
+                    manager_addresses=[manager.address]))
+                await sched.start()
+                for _ in range(100):
+                    if daemon.scheduler is not None:
+                        break
+                    await asyncio.sleep(0.1)
+                assert daemon.scheduler is not None, \
+                    "refresh loop never adopted the late scheduler"
+                assert daemon.ptm.scheduler is daemon.scheduler
+                assert f"127.0.0.1:{sched.rpc.port}" in \
+                    daemon.scheduler.addresses
+            finally:
+                if sched is not None:
+                    await sched.stop()
+                await daemon.stop()
+                await manager.stop()
+
+        asyncio.run(go())
+
     def test_image_preheat_resolves_layers_with_token_auth(self, tmp_path):
         """Reference ``test/e2e/manager/preheat.go`` "preheat image": a
         REST preheat job of type=image against a token-auth OCI registry

@@ -222,3 +222,23 @@ class TestListeners:
             v.close()
         except OSError:
             pass   # sandbox kernels commonly lack /dev/vsock
+
+    def test_scheduler_connector_adopts_refreshed_set(self):
+        """Manager-driven scheduler replacement reaches the consistent-hash
+        ring without a daemon restart (reference daemon dynconfig)."""
+        async def main():
+            from dragonfly2_tpu.daemon.scheduler_session import (
+                SchedulerConnector)
+            from dragonfly2_tpu.idl.messages import Host
+
+            host = Host(id="h", ip="127.0.0.1", port=1, download_port=2)
+            conn = SchedulerConnector(["10.0.0.1:80", "10.0.0.2:80"], host)
+            picks_before = {conn._ring.pick(f"t{i}") for i in range(50)}
+            assert picks_before == {"10.0.0.1:80", "10.0.0.2:80"}
+            conn.update_addresses(["10.0.0.2:80", "10.0.0.3:80"])
+            picks_after = {conn._ring.pick(f"t{i}") for i in range(50)}
+            assert picks_after == {"10.0.0.2:80", "10.0.0.3:80"}
+            assert set(conn.addresses) == {"10.0.0.2:80", "10.0.0.3:80"}
+            conn.update_addresses(["10.0.0.2:80", "10.0.0.3:80"])  # no-op
+
+        asyncio.run(main())

@@ -183,6 +183,28 @@ class TestShardPrefetcher:
             stop.set()
             t.join(timeout=30)
 
+    def test_duplicate_urls_serialize_not_corrupt(self, tmp_path):
+        """Sampling with replacement: the same URL twice with depth=2 must
+        yield two valid copies, never a shared consumed sink."""
+        async def main():
+            origin, base, hits = await _origin()
+            daemon = Daemon(DaemonConfig(
+                workdir=str(tmp_path / "d"), host_ip="127.0.0.1",
+                hostname="pf5", storage=StorageSection(gc_interval_s=3600)))
+            await daemon.start()
+            try:
+                url = f"{base}/shard-0.tar"
+                pf = ShardPrefetcher(daemon, [url, url], depth=2)
+                out = [_reassemble(a) async for a in pf.astream()]
+                assert len(out) == 2
+                for got in out:
+                    assert got[:len(SHARDS[0])] == SHARDS[0]
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(main())
+
     def test_sync_without_loop_raises(self, tmp_path):
         pf = ShardPrefetcher(None, [])
         with pytest.raises(RuntimeError):

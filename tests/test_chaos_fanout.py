@@ -36,6 +36,28 @@ def test_chaos_wave_survives_leecher_and_seed_death(tmp_path, monkeypatch):
     # from 15s to 2s so the seed RESTART lands inside the wave. Test-scoped
     # (monkeypatch reverts): the subprocesses inherit it via os.environ.
     monkeypatch.setenv("DF_TOPOLOGY_PROBE_TIMEOUT_S", "2")
+    # ONE documented retry: the 1-vCPU host's 2-3x drift (see
+    # bench calib) occasionally lands the kill windows badly — a chaos
+    # scenario is rerun once from scratch before declaring failure; the
+    # assertions themselves are identical on both attempts.
+    try:
+        _run_chaos_once(tmp_path / "try1")
+    except AssertionError as exc:
+        import shutil
+        import warnings
+
+        # warning (not print): a retried-pass must stay VISIBLE in normal
+        # CI output, or a regression raising the flake rate hides until
+        # it fails twice in a row
+        warnings.warn(f"chaos attempt 1 failed ({exc}); retrying once")
+        # drop attempt 1's ~1.7 GB (blob + piece stores + replicas) so the
+        # retry can't ENOSPC the host for an unrelated reason
+        shutil.rmtree(tmp_path / "try1", ignore_errors=True)
+        _run_chaos_once(tmp_path / "try2")
+
+
+def _run_chaos_once(tmp_path):
+    tmp_path.mkdir(parents=True, exist_ok=True)
     blob = os.urandom(SIZE)
     data = tmp_path / "blob.bin"
     data.write_bytes(blob)

@@ -822,6 +822,54 @@ def _calibrate() -> float:
     return round(len(buf) / 1e9 / (time.monotonic() - t0), 3)
 
 
+def _calibrate_mp(workers: int = 4) -> float:
+    """Aggregate GB/s of ``workers`` parallel sha256 processes. The
+    single-thread calib stays flat while co-tenant load slows saturated
+    multi-process waves 2x (r5: full waves 12s -> 27s at constant
+    single-thread calib) — THIS probe captures the contention those waves
+    actually run under, so cross-run wave comparisons can be normalized."""
+    import concurrent.futures
+
+    # workers sleep until a SHARED epoch then hash for a fixed window:
+    # without the barrier, spawn skew (interpreter startup is seconds on
+    # this host) lets windows land disjoint and the "contended" sum
+    # approaches N x single-thread. Each worker reports when its window
+    # actually opened so late spawns can be excluded from the sum. Any
+    # failure degrades to 0.0 — this probe must never cost the run its
+    # one JSON output line.
+    lead = 15.0
+    start_at = time.time() + lead
+    code = ("import hashlib,sys,time\n"
+            "time.sleep(max(0.0, float(sys.argv[1]) - time.time()))\n"
+            "opened = time.time()\n"
+            "buf = b'\\xa5' * (8 << 20)\n"
+            "n, t0 = 0, time.monotonic()\n"
+            "while time.monotonic() - t0 < 1.5:\n"
+            "    hashlib.sha256(buf).hexdigest(); n += 1\n"
+            "print(opened, n * (8 << 20) / (time.monotonic() - t0))")
+
+    def one(_i: int) -> tuple[float, float]:
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code, str(start_at)],
+                capture_output=True, text=True, timeout=lead + 120)
+            opened, rate = out.stdout.split()
+            return float(opened), float(rate)
+        except (subprocess.SubprocessError, ValueError, OSError):
+            return float("inf"), 0.0
+
+    try:
+        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+            results = list(pool.map(one, range(workers)))
+    except Exception:  # noqa: BLE001 - diagnostic probe only
+        return 0.0
+    on_time = [rate for opened, rate in results
+               if opened <= start_at + 1.0]
+    if len(on_time) < 2:
+        return 0.0       # windows didn't overlap: no contention measured
+    return round(sum(on_time) / 1e9, 3)
+
+
 def main() -> None:
     ensure_native()
     workdir = tempfile.mkdtemp(prefix="dfbench-", dir=base_tmp())
@@ -1016,6 +1064,7 @@ def main() -> None:
         "sublinearity_2x": round(fanout_s / half_s, 3),
         "host_cpus": os.cpu_count(),
         "calib_sha256_gbps": _calibrate(),
+        "calib_mp_gbps": _calibrate_mp(),
         "wave_cpu_util": {"half": round(half_cpu, 3),
                           "full": round(full_cpu, 3)},
         "fanout_runs_s": [round(r["elapsed_s"], 2) for r in runs],

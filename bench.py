@@ -828,41 +828,47 @@ def _calibrate_mp(workers: int = 4) -> float:
     multi-process waves 2x (r5: full waves 12s -> 27s at constant
     single-thread calib) — THIS probe captures the contention those waves
     actually run under, so cross-run wave comparisons can be normalized."""
-    import concurrent.futures
-
-    # workers sleep until a SHARED epoch then hash for a fixed window:
-    # without the barrier, spawn skew (interpreter startup is seconds on
-    # this host) lets windows land disjoint and the "contended" sum
-    # approaches N x single-thread. Each worker reports when its window
-    # actually opened so late spawns can be excluded from the sum. Any
-    # failure degrades to 0.0 — this probe must never cost the run its
-    # one JSON output line.
-    lead = 15.0
-    start_at = time.time() + lead
+    # readiness handshake then a SHARED start epoch: without the barrier,
+    # spawn skew (interpreter startup is seconds on this host) lets the
+    # windows land disjoint and the "contended" sum approaches N x
+    # single-thread. Each worker reports when its window actually opened
+    # so stragglers can be excluded from the sum. Any failure degrades to
+    # 0.0 — this probe must never cost the run its one JSON output line.
     code = ("import hashlib,sys,time\n"
-            "time.sleep(max(0.0, float(sys.argv[1]) - time.time()))\n"
+            "print('ready', flush=True)\n"
+            "start = float(sys.stdin.readline())\n"
+            "time.sleep(max(0.0, start - time.time()))\n"
             "opened = time.time()\n"
             "buf = b'\\xa5' * (8 << 20)\n"
             "n, t0 = 0, time.monotonic()\n"
             "while time.monotonic() - t0 < 1.5:\n"
             "    hashlib.sha256(buf).hexdigest(); n += 1\n"
             "print(opened, n * (8 << 20) / (time.monotonic() - t0))")
-
-    def one(_i: int) -> tuple[float, float]:
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", code, str(start_at)],
-                capture_output=True, text=True, timeout=lead + 120)
-            opened, rate = out.stdout.split()
-            return float(opened), float(rate)
-        except (subprocess.SubprocessError, ValueError, OSError):
-            return float("inf"), 0.0
-
+    procs = []
     try:
-        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
-            results = list(pool.map(one, range(workers)))
+        for _ in range(workers):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", code], stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True))
+        for p in procs:
+            if p.stdout.readline().strip() != "ready":
+                raise RuntimeError("calib worker failed to start")
+        start_at = time.time() + 0.5
+        for p in procs:
+            p.stdin.write(f"{start_at}\n")
+            p.stdin.flush()
+        results = []
+        for p in procs:
+            opened, rate = p.stdout.readline().split()
+            results.append((float(opened), float(rate)))
+            p.wait(timeout=30)
     except Exception:  # noqa: BLE001 - diagnostic probe only
         return 0.0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     on_time = [rate for opened, rate in results
                if opened <= start_at + 1.0]
     if len(on_time) < 2:

@@ -34,11 +34,18 @@ class SecurityConfig:
     issue_token_path: str = ""        # or a file holding it
     ca_cert: str = ""                 # fleet CA path (manager proxy-ca.crt)
     cert_validity_s: int = 7 * 24 * 3600
-    # TLS rollout policy for the peer RPC port (reference pkg/rpc/mux.go +
-    # credential.go): "force" = TLS only; "default"/"prefer" = plaintext
-    # AND TLS accepted on the one port so a live fleet can upgrade without
-    # a flag day ("prefer" flags plaintext peers in logs/metrics)
+    # TLS rollout policy for BOTH peer planes — the gRPC port and the
+    # HTTPS piece-upload port (reference pkg/rpc/mux.go + credential.go):
+    # "force" = TLS only; "default"/"prefer" = plaintext AND TLS accepted
+    # on the one port so a live fleet can upgrade without a flag day
+    # ("prefer" flags plaintext peers in logs/metrics)
     tls_policy: str = "force"
+
+    def validate(self) -> None:
+        if self.tls_policy not in ("default", "prefer", "force"):
+            raise ValueError(
+                f"security.tls_policy must be default|prefer|force, "
+                f"got {self.tls_policy!r}")
     # NOTE scope: with security enabled, BOTH peer planes are mTLS — the
     # gRPC sync streams and the HTTPS piece uploads (client certs required
     # on each). The renewal loop refreshes the issued material at 2/3

@@ -208,6 +208,9 @@ class Daemon:
         if self._peer_tls_cert:
             self.upload_server.tls = (self._peer_tls_cert,
                                       self._peer_tls_key, self._peer_tls_ca)
+            # rollout knob applies to BOTH planes; must be set before
+            # upload_server.start() decides whether to front a mux
+            self.upload_server.tls_policy = self.cfg.security.tls_policy
         if self.cfg.download.source_ca or self.cfg.download.source_insecure:
             # the source client is a process singleton: remember the prior
             # trust setting so stop() restores it (co-resident daemons in

@@ -129,6 +129,8 @@ class UploadServer:
         self.host = host
         self.port = port
         self.tls: tuple[str, str, str] | None = None   # (cert, key, ca)
+        self.tls_policy = "force"      # see rpc/mux.py POLICIES
+        self.mux = None                # MuxListener when rollout-muxing
         self.limiter = TokenBucket(rate_limit_bps or 0)
         self.concurrent_limit = concurrent_limit or self.DEFAULT_CONCURRENT_LIMIT
         self.debug_endpoints = debug_endpoints
@@ -184,16 +186,38 @@ class UploadServer:
             ssl_ctx.load_cert_chain(cert, key)
             ssl_ctx.load_verify_locations(cafile=ca)
             ssl_ctx.verify_mode = _ssl.CERT_REQUIRED
-        site = web.TCPSite(self._runner, self.host, self.port,
-                           ssl_context=ssl_ctx)
-        await site.start()
-        self.port = resolve_port(self._runner)
-        log.info("upload server on %s:%d (tls=%s)", self.host, self.port,
-                 self.tls is not None)
+        if ssl_ctx is not None and self.tls_policy != "force":
+            # TLS rollout on the DATA plane too (same contract as the rpc
+            # mux, rpc/mux.py): one public port serves plaintext AND mTLS
+            # via a peeking front over unix-socket backends, so the piece
+            # plane upgrades without a fleet flag day. Flip .mux.policy to
+            # "force" at runtime to retire plaintext for new connections.
+            from ..rpc.mux import MuxListener
+            plain_sock, tls_sock = MuxListener.backend_sockets()
+            await web.UnixSite(self._runner, plain_sock).start()
+            await web.UnixSite(self._runner, tls_sock,
+                               ssl_context=ssl_ctx).start()
+            self.mux = MuxListener(self.host, self.port,
+                                   plain_sock=plain_sock, tls_sock=tls_sock,
+                                   policy=self.tls_policy)
+            await self.mux.start()
+            self.port = self.mux.port
+        else:
+            site = web.TCPSite(self._runner, self.host, self.port,
+                               ssl_context=ssl_ctx)
+            await site.start()
+            self.port = resolve_port(self._runner)
+        log.info("upload server on %s:%d (tls=%s, policy=%s)", self.host,
+                 self.port, self.tls is not None,
+                 self.tls_policy if self.tls is not None else "-")
 
     async def stop(self) -> None:
+        if self.mux is not None:
+            await self.mux.stop()
         if self._runner:
             await self._runner.cleanup()
+        if self.mux is not None:
+            self.mux.cleanup_backend_files()
 
     async def _traced(self, request: web.Request) -> web.StreamResponse:
         """Server half of the piece-request trace: the child's traceparent

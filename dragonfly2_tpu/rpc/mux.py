@@ -92,6 +92,20 @@ class MuxListener:
             self._server.close()
             await self._server.wait_closed()
 
+    def cleanup_backend_files(self) -> None:
+        """Best-effort removal of the backend unix sockets and their
+        tempdir — call AFTER the backend servers have shut down, or every
+        restart leaks one dfmux-* directory."""
+        for path in (self.plain_sock, self.tls_sock):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        try:
+            os.rmdir(os.path.dirname(self.plain_sock))
+        except OSError:
+            pass
+
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         try:

@@ -153,6 +153,12 @@ class RPCServer:
         self.port: int | None = None
         self.health = _Health()
         self.tls = tls
+        if tls is not None:
+            from .mux import POLICIES
+            if tls_policy not in POLICIES:
+                # fail BEFORE start() creates backend sockets — a typo'd
+                # policy must not orphan a dfmux-* dir mid-startup
+                raise ValueError(f"unknown tls_policy {tls_policy!r}")
         self.tls_policy = tls_policy
         self.mux = None                     # MuxListener when muxing
         self._server = grpc.aio.server(options=options or [
@@ -238,3 +244,5 @@ class RPCServer:
         if self.mux is not None:
             await self.mux.stop()
         await self._server.stop(grace)
+        if self.mux is not None:
+            self.mux.cleanup_backend_files()

@@ -9,6 +9,7 @@ connections are refused and TLS clients connect throughout.
 """
 
 import asyncio
+import os
 
 import pytest
 
@@ -18,11 +19,12 @@ from dragonfly2_tpu.rpc.client import Channel, ServiceClient
 from dragonfly2_tpu.rpc.server import RPCServer, ServiceDef, TLSOptions
 
 
-def _material(tmp_path):
-    """(cert_path, key_path, ca_path) for a 127.0.0.1 server leaf."""
+def _material(tmp_path, name: str = "srv"):
+    """(cert_path, key_path, ca_path): a fresh 127.0.0.1 leaf named
+    ``name`` from the issuer rooted at tmp_path (same CA per tmp_path)."""
     issuer = CertIssuer(str(tmp_path / "ca"))
     cert_pem, key_pem, _exp = issuer._mint("127.0.0.1")
-    cert_p, key_p = tmp_path / "srv.crt", tmp_path / "srv.key"
+    cert_p, key_p = tmp_path / f"{name}.crt", tmp_path / f"{name}.key"
     cert_p.write_bytes(cert_pem)
     key_p.write_bytes(key_pem)
     return str(cert_p), str(key_p), issuer.ca_cert_path
@@ -152,6 +154,82 @@ class TestMuxRollout:
                         "Ping", Empty(), timeout=10)
                     assert isinstance(out, Empty)
                     await ch.close()
+            finally:
+                await srv.stop()
+
+        asyncio.run(main())
+
+    def test_upload_data_plane_muxes_plain_and_mtls(self, tmp_path):
+        """The PIECE plane rolls out the same way (our data plane is
+        HTTPS, not gRPC, so the reference's mux story must cover it too):
+        one upload port serves plaintext HTTP and mTLS HTTPS during
+        rollout; force-flip refuses new plaintext while mTLS (client cert
+        REQUIRED) keeps serving."""
+        async def main():
+            import aiohttp
+
+            from dragonfly2_tpu.storage.manager import (StorageConfig,
+                                                        StorageManager)
+            from dragonfly2_tpu.storage.metadata import TaskMetadata
+            from dragonfly2_tpu.daemon.upload_server import UploadServer
+
+            # one stored piece to serve
+            mgr = StorageManager(StorageConfig(data_dir=str(tmp_path / "s"),
+                                               task_ttl_s=3600))
+            payload = os.urandom(256 * 1024)
+            md = TaskMetadata(task_id="a" * 64, url="http://o/x",
+                              content_length=len(payload),
+                              total_piece_count=1, piece_size=len(payload))
+            ts = mgr.register_task(md)
+            ts.write_piece(0, 0, payload)
+            ts.mark_done(success=True)
+
+            cert, key, ca = _material(tmp_path)
+            # DISTINCT client leaf from the SAME issuer (the server
+            # REQUIRES a fleet-CA-signed client cert: mTLS is mutual)
+            ccert, ckey, _ = _material(tmp_path, name="client")
+            srv = UploadServer(mgr, host="127.0.0.1")
+            srv.tls = (cert, key, ca)
+            srv.tls_policy = "default"
+            await srv.start()
+            try:
+                url_path = f"/download/{'a' * 3}/{'a' * 64}"
+                rng_hdr = {"Range": f"bytes=0-{len(payload) - 1}"}
+                plain_url = f"http://127.0.0.1:{srv.port}{url_path}"
+                tls_url = f"https://127.0.0.1:{srv.port}{url_path}"
+
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(plain_url, params={"peerId": "p1"},
+                                     headers=rng_hdr) as resp:
+                        assert resp.status == 206
+                        assert await resp.read() == payload
+
+                import ssl as _ssl
+                ctx = _ssl.create_default_context(cafile=ca)
+                ctx.check_hostname = False
+                ctx.load_cert_chain(ccert, ckey)
+                async with aiohttp.ClientSession(
+                        connector=aiohttp.TCPConnector(ssl=ctx)) as s:
+                    async with s.get(tls_url, params={"peerId": "p2"},
+                                     headers=rng_hdr) as resp:
+                        assert resp.status == 206
+                        assert await resp.read() == payload
+
+                srv.mux.policy = "force"
+                async with aiohttp.ClientSession(
+                        connector=aiohttp.TCPConnector(
+                            force_close=True)) as s:
+                    with pytest.raises(Exception):
+                        async with s.get(plain_url, params={"peerId": "p3"},
+                                         headers=rng_hdr,
+                                         timeout=aiohttp.ClientTimeout(
+                                             total=5)) as resp:
+                            await resp.read()
+                async with aiohttp.ClientSession(
+                        connector=aiohttp.TCPConnector(ssl=ctx)) as s:
+                    async with s.get(tls_url, params={"peerId": "p4"},
+                                     headers=rng_hdr) as resp:
+                        assert resp.status == 206
             finally:
                 await srv.stop()
 

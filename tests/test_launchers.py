@@ -39,7 +39,10 @@ def spawn(mod: str, *args: str) -> subprocess.Popen:
         cwd=REPO)
 
 
-def wait_line(proc: subprocess.Popen, needle: str, timeout: float = 60.0) -> str:
+def wait_line(proc: subprocess.Popen, needle: str, timeout: float = 150.0) -> str:
+    # generous: a co-tenant-loaded 1-vCPU host stretches interpreter boot
+    # to tens of seconds, and a transient timeout here reds the whole
+    # suite under the driver's -x gate
     deadline = time.monotonic() + timeout
     lines = []
     while time.monotonic() < deadline:
@@ -56,7 +59,7 @@ def wait_line(proc: subprocess.Popen, needle: str, timeout: float = 60.0) -> str
     raise TimeoutError(f"{needle!r} not seen; got: {''.join(lines)[-2000:]}")
 
 
-def wait_http(url: str, timeout: float = 30.0) -> None:
+def wait_http(url: str, timeout: float = 90.0) -> None:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         try:
@@ -177,9 +180,9 @@ def test_debug_endpoints_on_every_service(tmp_path):
                 ("trainer", ["--data-dir", str(tmp_path / "records")])):
             p = spawn(mod, "--debug-port", "-1", *extra)
             procs.append(p)
-            line = wait_line(p, "debug on :", timeout=60)
+            line = wait_line(p, "debug on :", timeout=150)
             port = int(line.rsplit(":", 1)[1])
-            wait_line(p, f"{mod} up:", timeout=60)
+            wait_line(p, f"{mod} up:", timeout=150)
             stacks = urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/debug/stacks", timeout=10).read()
             assert b"asyncio tasks" in stacks, mod

@@ -40,12 +40,21 @@ def spawn(mod: str, *args: str) -> subprocess.Popen:
 
 
 def wait_line(proc: subprocess.Popen, needle: str, timeout: float = 150.0) -> str:
-    # generous: a co-tenant-loaded 1-vCPU host stretches interpreter boot
-    # to tens of seconds, and a transient timeout here reds the whole
-    # suite under the driver's -x gate
+    # generous deadline (a co-tenant-loaded 1-vCPU host stretches
+    # interpreter boot to tens of seconds; a transient timeout here reds
+    # the whole suite under the driver's -x gate) — and select() BEFORE
+    # readline(), or a service that wedges with its pipe open would block
+    # readline forever and the deadline would never be enforced
+    import select
     deadline = time.monotonic() + timeout
     lines = []
     while time.monotonic() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if not ready:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"process died: {''.join(lines)[-2000:]}")
+            continue
         line = proc.stdout.readline()
         if not line:
             if proc.poll() is not None:
@@ -57,17 +66,6 @@ def wait_line(proc: subprocess.Popen, needle: str, timeout: float = 150.0) -> st
         if needle in line:
             return line
     raise TimeoutError(f"{needle!r} not seen; got: {''.join(lines)[-2000:]}")
-
-
-def wait_http(url: str, timeout: float = 90.0) -> None:
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            urllib.request.urlopen(url, timeout=2)
-            return
-        except Exception:
-            time.sleep(0.2)
-    raise TimeoutError(f"{url} not up")
 
 
 def test_full_stack_from_clis(tmp_path):

@@ -168,7 +168,10 @@ def test_fakepod_ici_fanout_and_ml_loop(tmp_path):
                 stderr=subprocess.PIPE, text=True)))
         for name, out, p in pulls:
             try:
-                rc = p.wait(timeout=180)
+                # 300s: 7 concurrent dfget pulls on a co-tenant-loaded
+                # 1-vCPU host have hit 180 under doubled load; headroom
+                # is free when healthy
+                rc = p.wait(timeout=300)
             except subprocess.TimeoutExpired:
                 p.kill()
                 pytest.fail(f"{name}: dfget hung")

@@ -40,32 +40,47 @@ def spawn(mod: str, *args: str) -> subprocess.Popen:
 
 
 def wait_line(proc: subprocess.Popen, needle: str, timeout: float = 150.0) -> str:
-    # generous deadline (a co-tenant-loaded 1-vCPU host stretches
-    # interpreter boot to tens of seconds; a transient timeout here reds
-    # the whole suite under the driver's -x gate) — and select() BEFORE
-    # readline(), or a service that wedges with its pipe open would block
-    # readline forever and the deadline would never be enforced
-    import select
+    # Generous deadline: a co-tenant-loaded 1-vCPU host stretches
+    # interpreter boot to tens of seconds, and a transient timeout here
+    # reds the whole suite under the driver's -x gate. The deadline must
+    # hold even when the service wedges with its pipe open — but NOT via
+    # select()-before-readline(): the stdout is a BUFFERED text stream,
+    # so a boot burst drains many lines into Python's buffer, the OS pipe
+    # goes empty, and select never fires again while the wanted line sits
+    # in the buffer (this exact bug hung the fakepod e2e). A reader
+    # thread doing blocking readlines into a queue is buffering-immune;
+    # it is reused across wait_line calls on the same process and dies
+    # with it.
+    import queue as _queue
+    import threading
+
+    q = getattr(proc, "_wl_queue", None)
+    if q is None:
+        q = _queue.Queue()
+        proc._wl_queue = q
+
+        def _pump() -> None:
+            for ln in proc.stdout:
+                q.put(ln)
+            q.put(None)          # EOF sentinel
+
+        threading.Thread(target=_pump, daemon=True).start()
     deadline = time.monotonic() + timeout
-    lines = []
-    while time.monotonic() < deadline:
-        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
-        if not ready:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"process died: {''.join(lines)[-2000:]}")
+    lines: list[str] = []
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"{needle!r} not seen; got: {''.join(lines)[-2000:]}")
+        try:
+            line = q.get(timeout=min(remaining, 0.5))
+        except _queue.Empty:
             continue
-        line = proc.stdout.readline()
-        if not line:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"process died: {''.join(lines)[-2000:]}")
-            time.sleep(0.05)
-            continue
+        if line is None:
+            raise RuntimeError(f"process died: {''.join(lines)[-2000:]}")
         lines.append(line)
         if needle in line:
             return line
-    raise TimeoutError(f"{needle!r} not seen; got: {''.join(lines)[-2000:]}")
 
 
 def test_full_stack_from_clis(tmp_path):

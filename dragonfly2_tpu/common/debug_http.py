@@ -95,17 +95,20 @@ def add_debug_arg(parser) -> None:
                         "InitMonitor); 0 off, -1 ephemeral")
 
 
-async def maybe_start_debug(debug_port: int):
+async def maybe_start_debug(debug_port: int, extra_routes=None):
     """Launcher wiring: start (and announce) the debug server when the
-    flag is set; returns the runner (or None) for cleanup at shutdown."""
+    flag is set; returns the runner (or None) for cleanup at shutdown.
+    ``extra_routes``: callable(router) adding service-specific surfaces
+    (the scheduler mounts /debug/cluster this way)."""
     if not debug_port:
         return None
-    runner, port = await start_debug_server("127.0.0.1", max(debug_port, 0))
+    runner, port = await start_debug_server("127.0.0.1", max(debug_port, 0),
+                                            extra_routes=extra_routes)
     print(f"debug on :{port}", flush=True)
     return runner
 
 
-async def start_debug_server(host: str, port: int):
+async def start_debug_server(host: str, port: int, extra_routes=None):
     """Serve /debug/{stacks,profile} + /metrics; returns (runner, port).
     ``port`` 0 binds ephemeral. Bind failures raise — a requested debug
     surface that silently isn't there wastes the hang investigation it
@@ -113,6 +116,8 @@ async def start_debug_server(host: str, port: int):
     app = web.Application()
     add_debug_routes(app.router)
     app.router.add_get("/metrics", _metrics)
+    if extra_routes is not None:
+        extra_routes(app.router)
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
     site = web.TCPSite(runner, host, port)

@@ -168,6 +168,9 @@ class Tracer:
                 self.flush()
 
     def _shutdown_flush(self) -> None:
+        """Final flush + export drain; the atexit target AND the explicit
+        launcher-shutdown path (module-level ``shutdown``) — one logic
+        home so the two exits cannot drift."""
         self.flush()
         if self._otlp_endpoint:
             self.drain_exports()
@@ -253,6 +256,14 @@ class Tracer:
 
 TRACER = Tracer()
 configure = TRACER.configure
+
+
+def shutdown() -> None:
+    """Launcher tail: flush the span buffer and give queued OTLP batches a
+    bounded window to leave, deterministically BEFORE the launcher's own
+    process-exit path (the atexit registration covers interpreter exit,
+    but only once configure() ran; launchers call this unconditionally)."""
+    TRACER._shutdown_flush()
 
 
 _NOOP = Span(name="noop", ctx=SpanContext("0" * 32, "0" * 16,

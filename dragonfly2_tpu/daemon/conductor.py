@@ -26,6 +26,7 @@ from ..idl.messages import TaskType, UrlMeta
 from ..storage.manager import StorageManager
 from ..storage.metadata import TaskMetadata
 from ..storage.store import TaskStorage
+from . import flight_recorder as fr
 
 log = logging.getLogger("df.core.conductor")
 
@@ -42,7 +43,8 @@ class PeerTaskConductor:
                  task_type: TaskType = TaskType.STANDARD,
                  device_sink_factory: Any = None,
                  ordered: bool = False,
-                 trace: Any = None):
+                 trace: Any = None,
+                 flight: Any = None):
         self.task_id = task_id
         self.peer_id = peer_id
         self.url = url
@@ -59,6 +61,7 @@ class PeerTaskConductor:
         self.device_sink_factory = device_sink_factory
         self.ordered = ordered       # stream consumers want low pieces first
         self.trace = trace
+        self.flight = flight         # TaskFlight journal (None = disabled)
 
         self.state = self.PENDING
         self.fail_code = Code.OK
@@ -113,6 +116,8 @@ class PeerTaskConductor:
             used_p2p = False
             if self.scheduler is not None:
                 self._session = await self._register()
+                if self.flight is not None and self._session is not None:
+                    self.flight.event(fr.REGISTERED)
                 if self._session is not None and self._p2p_engine is not None:
                     used_p2p = await self._p2p_engine.pull(self, self._session)
             if not used_p2p:
@@ -189,9 +194,18 @@ class PeerTaskConductor:
 
     async def on_piece_from_source(self, num: int, offset: int, data: bytes,
                                    cost_ms: int) -> None:
+        # timestamp taken BEFORE landing (wire_done must precede the
+        # hbm_done _land_piece emits), recorded only AFTER the piece
+        # verified and landed (a digest-failed or duplicate piece must not
+        # count as delivered bytes in the summary); back-source pieces
+        # skip the dispatcher stages, so the duration back-dates the start
+        t_wire = self.flight.now_ms() if self.flight is not None else 0.0
         if not await self._land_piece(num, offset, data, cost_ms, source=""):
             return
         self.traffic_source += len(data)
+        if self.flight is not None:
+            self.flight.event(fr.WIRE_DONE, num, fr.ORIGIN, len(data),
+                              dur_ms=cost_ms, t_ms=t_wire)
         if self._session is not None:
             # a back-source peer announces its pieces so the scheduler can
             # make it a parent — this is where origin egress gets saved
@@ -208,7 +222,9 @@ class PeerTaskConductor:
 
     async def on_piece_from_peer(self, num: int, offset: int, data: bytes,
                                  cost_ms: int, parent_id: str,
-                                 piece_digest: str = "") -> None:
+                                 piece_digest: str = "") -> bool:
+        """Returns True when this call landed the piece (the flight
+        recorder and traffic stats count only landed pieces)."""
         # the P2P downloader verified data against piece_digest already
         landed = await self._land_piece(num, offset, data, cost_ms,
                                         source=parent_id,
@@ -218,6 +234,7 @@ class PeerTaskConductor:
             # endgame-raced duplicates are dropped at landing and must not
             # inflate the traffic accounting (egress-saved stats)
             self.traffic_p2p += len(data)
+        return landed
 
     async def _land_piece(self, num: int, offset: int, data: bytes,
                           cost_ms: int, source: str,
@@ -251,6 +268,8 @@ class PeerTaskConductor:
             # executor and serialize ingest with storage writes.
             try:
                 self.device_ingest.write(offset, data)
+                if self.flight is not None:
+                    self.flight.event(fr.HBM_DONE, num, nbytes=len(data))
             except Exception:
                 self.log.exception("device ingest write failed; disabling sink")
                 self.device_ingest.close()
@@ -320,7 +339,21 @@ class PeerTaskConductor:
                 self.log.exception("device sink flush failed")
                 self.device_ingest.close()
                 self.device_ingest = None
+        if self.device_ingest is not None:
+            # inside the peertask span context: the HBM landing joins the
+            # task's trace (schedule decision -> piece fetch -> HBM)
+            from ..common import tracing
+            spans = list(self.device_ingest.transfer_spans)
+            with tracing.span("hbm.ingest",
+                              task_id=self.task_id[:16]) as hsp:
+                hsp.set(transfers=len(spans),
+                        done_fraction=self.device_ingest.done_fraction(),
+                        dma_ms=round(sum(b - a for a, b in spans) * 1e3, 3))
+            if self.flight is not None:
+                self.flight.hbm_spans(spans)
         self.state = self.SUCCESS
+        if self.flight is not None:
+            self.flight.finish(self.SUCCESS)
         self._publish({"type": "done", "success": True,
                        "completed": self.completed_length,
                        "total": self.content_length})
@@ -337,6 +370,8 @@ class PeerTaskConductor:
         self.state = self.FAILED
         self.fail_code = code
         self.fail_message = message
+        if self.flight is not None:
+            self.flight.finish(self.FAILED)
         if self.device_ingest is not None:
             self.device_ingest.close()
             self.device_ingest = None

@@ -63,6 +63,18 @@ class TracingConfig:
 
 
 @dataclass
+class FlightConfig:
+    """Download flight recorder (daemon/flight_recorder.py): per-task
+    piece-lifecycle journal behind GET /debug/flight on the upload port.
+    On by default — recording is one deque append per piece event and
+    memory is ring-capped; disabling removes even that."""
+
+    enabled: bool = True
+    max_tasks: int = 64               # flights kept (drop-oldest)
+    max_events: int = 4096            # events per flight (ring)
+
+
+@dataclass
 class DownloadConfig:
     piece_parallelism: int = 4             # piece download workers per task
     back_source_parallelism: int = 4       # concurrent origin range streams
@@ -148,6 +160,7 @@ class DaemonConfig:
     upload: UploadConfig = field(default_factory=UploadConfig)
     storage: StorageSection = field(default_factory=StorageSection)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    flight: FlightConfig = field(default_factory=FlightConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
     proxy: ProxyConfig = field(default_factory=ProxyConfig)
     object_storage: ObjectStorageConfig = field(default_factory=ObjectStorageConfig)

@@ -66,12 +66,16 @@ class Daemon:
         self.shaper = TrafficShaper(
             total_rate_bps=cfg.download.total_rate_limit_bps,
             kind=cfg.download.traffic_shaper_kind)
+        from .flight_recorder import FlightRecorder
+        self.flight_recorder = FlightRecorder(
+            enabled=cfg.flight.enabled, max_tasks=cfg.flight.max_tasks,
+            max_events=cfg.flight.max_events)
         self.upload_server = UploadServer(
             self.storage_mgr, port=cfg.upload.port,
             rate_limit_bps=cfg.upload.rate_limit_bps,
             debug_endpoints=cfg.upload.debug_endpoints,
             concurrent_limit=cfg.upload.concurrent_limit,
-            host=cfg.listen_ip)
+            host=cfg.listen_ip, flight_recorder=self.flight_recorder)
         self._scheduler_factory = scheduler_factory
         self._p2p_engine_factory = p2p_engine_factory
         self.scheduler: Any = None
@@ -249,7 +253,8 @@ class Daemon:
             p2p_engine_factory=engine_factory,
             device_sink_builder=self.device_sink_builder,
             is_seed=self.cfg.is_seed, shaper=self.shaper,
-            prefetch_whole_file=self.cfg.download.prefetch_whole_file)
+            prefetch_whole_file=self.cfg.download.prefetch_whole_file,
+            flight_recorder=self.flight_recorder)
         svc = DaemonService(self.ptm,
                             upload_addr=f"{self.host_ip}:{self.upload_server.port}")
         # fleet mTLS: enroll with the manager, serve the peer RPC port with

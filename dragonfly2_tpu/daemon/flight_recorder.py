@@ -1,0 +1,302 @@
+"""Download flight recorder: a ring-buffered per-task event journal.
+
+Role parity: none in the reference — this is the TPU-native observability
+plane PAPER §1 calls for. Scheduling quality depends on knowing, per piece,
+where time went: queueing on the parent, the wire transfer, or the HBM
+device transfer. The recorder captures every piece's lifecycle
+
+    scheduled -> dispatched -> first_byte -> wire_done -> hbm_done
+
+with parent peer id, source (p2p vs back-to-source), and byte counts, and
+can summarize a finished task (slowest-piece attribution, per-parent
+throughput, tail-latency breakdown, back-to-source ratio).
+
+Overhead contract (bench-critical — every piece of a v5p fan-out crosses
+this path):
+  * recording one event is a single ``deque.append`` of a tuple — O(1),
+    no allocation beyond the tuple, no locks (asyncio single-threaded);
+  * per-task event count is ring-capped (``max_events``, drop-oldest);
+  * the recorder keeps at most ``max_tasks`` flights (drop-oldest);
+  * while disabled, ``begin()`` returns None and callers hold a None —
+    the hot path then never even enters this module.
+
+Exposure: ``GET /debug/flight`` (+ ``/<task_id>``) on the daemon upload
+server (upload_server.py), a compact summary attached to the terminal
+``PeerResult`` (scheduler_session.py) feeding the scheduler's cluster view
+and the trainer's record stream, and the ``dfdiag`` CLI waterfall.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+
+# piece lifecycle stages (strings, interned by the parser — kept short
+# because every event tuple carries one)
+SCHEDULED = "scheduled"      # dispatcher handed the piece to a worker
+DISPATCHED = "dispatched"    # HTTP GET to the parent is about to fire
+FIRST_BYTE = "first_byte"    # first body chunk arrived (per request)
+WIRE_DONE = "wire_done"      # piece bytes fully on the wire, verified
+HBM_DONE = "hbm_done"        # piece staged for the device sink
+# task-level stages
+REGISTERED = "registered"    # scheduler register returned
+HBM_SHARD = "hbm_shard"      # one device DMA completed (piece = shard idx)
+DONE = "done"                # task reached a terminal state
+
+ORIGIN = ""                  # parent id of a back-to-source fetch
+
+
+class TaskFlight:
+    """One task's event journal. Events are ``(t_ms, stage, piece, parent,
+    bytes, dur_ms)`` tuples relative to the flight's start."""
+
+    __slots__ = ("task_id", "peer_id", "started_at", "_m0", "events",
+                 "state", "url")
+
+    def __init__(self, task_id: str, peer_id: str, *, url: str = "",
+                 max_events: int = 4096):
+        self.task_id = task_id
+        self.peer_id = peer_id
+        self.url = url
+        self.started_at = time.time()
+        self._m0 = time.monotonic()
+        self.events: deque = deque(maxlen=max_events)
+        self.state = "running"
+
+    # -- recording (hot path) ------------------------------------------
+
+    def now_ms(self) -> float:
+        return (time.monotonic() - self._m0) * 1000.0
+
+    def event(self, stage: str, piece: int = -1, parent: str = ORIGIN,
+              nbytes: int = 0, dur_ms: float = 0.0,
+              t_ms: float | None = None) -> None:
+        """``t_ms``: explicit timestamp (from now_ms()) for events whose
+        moment precedes their recording — a wire_done journaled only once
+        the piece verified and landed."""
+        self.events.append(
+            (self.now_ms() if t_ms is None else t_ms, stage, piece,
+             parent, nbytes, dur_ms))
+
+    def finish(self, state: str) -> None:
+        self.state = state
+        self.event(DONE)
+
+    def hbm_spans(self, spans: list) -> None:
+        """Adopt a DeviceIngest's completed transfer spans ((monotonic
+        start, end) pairs) as shard-level events on this flight's clock."""
+        for idx, (t0, t1) in enumerate(spans):
+            self.events.append(((t0 - self._m0) * 1000.0, HBM_SHARD, idx,
+                                ORIGIN, 0, (t1 - t0) * 1000.0))
+
+    # -- consumption ---------------------------------------------------
+
+    def timeline(self) -> dict:
+        return {
+            "task_id": self.task_id, "peer_id": self.peer_id,
+            "url": self.url, "started_at": self.started_at,
+            "state": self.state,
+            "events": [{"t_ms": round(t, 3), "stage": stage, "piece": piece,
+                        "parent": parent, "bytes": nbytes,
+                        "dur_ms": round(dur, 3)}
+                       for t, stage, piece, parent, nbytes, dur in
+                       self.events],
+        }
+
+    def summarize(self) -> dict:
+        """Machine-readable attribution: per-piece stage breakdown,
+        per-parent throughput, slowest piece + its dominant stage, tail
+        latencies, back-to-source ratio."""
+        pieces: dict[int, dict] = {}
+        parents: dict[str, dict] = {}
+        hbm_dma_ms = 0.0
+        for t, stage, piece, parent, nbytes, dur in self.events:
+            if stage == HBM_SHARD:
+                hbm_dma_ms += dur
+                continue
+            if piece < 0:
+                continue
+            p = pieces.setdefault(piece, {})
+            if stage == WIRE_DONE:
+                p[WIRE_DONE] = t
+                p["bytes"] = nbytes
+                p["parent"] = parent
+                p["wire_dur"] = dur
+            elif stage == HBM_DONE:
+                p[HBM_DONE] = t
+            else:
+                # pre-wire stages keyed by parent: endgame racers journal
+                # their own attempts, and only the entries of the parent
+                # that actually delivered (the WIRE_DONE one) are read at
+                # row-build time — a loser can never rewrite the winner's
+                # stage history, whichever order their events landed
+                p.setdefault(stage, {})[parent] = t
+        piece_rows = []
+        for num in sorted(pieces):
+            p = pieces[num]
+            wire_end = p.get(WIRE_DONE)
+            if wire_end is None:
+                continue
+            winner = p.get("parent", ORIGIN)
+            # pieces that skipped the dispatcher (back-source) carry their
+            # measured duration on the wire_done event: back-date the start
+            sched = (p.get(SCHEDULED) or {}).get(winner)
+            if sched is None:
+                sched = wire_end - p.get("wire_dur", 0.0)
+            disp = (p.get(DISPATCHED) or {}).get(winner, sched)
+            first = (p.get(FIRST_BYTE) or {}).get(winner)
+            if first is None:
+                # grouped-span members get no first_byte of their own:
+                # back-date from the per-piece duration so wire_ms is this
+                # piece's transfer share, not the whole span window
+                first = max(disp, wire_end - p.get("wire_dur", 0.0))
+            hbm = p.get(HBM_DONE, wire_end)
+            stages = {
+                "queue_ms": max(disp - sched, 0.0),
+                "ttfb_ms": max(first - disp, 0.0),
+                "wire_ms": max(wire_end - first, 0.0),
+                "hbm_ms": max(hbm - wire_end, 0.0),
+            }
+            total = wire_end - sched + stages["hbm_ms"]
+            parent = winner
+            row = {"piece": num, "parent": parent,
+                   "source": "origin" if parent == ORIGIN else "p2p",
+                   "bytes": p.get("bytes", 0),
+                   "start_ms": round(sched, 3),
+                   "total_ms": round(total, 3),
+                   **{k: round(v, 3) for k, v in stages.items()}}
+            piece_rows.append(row)
+            # accrued from the DEDUPED piece table, not per event (endgame
+            # duplicates must not inflate a parent), and from wire time
+            # only — folding ttfb in would divide a span-serving parent's
+            # throughput by its group size and flag it as a straggler
+            pp = parents.setdefault(
+                parent, {"bytes": 0, "pieces": 0, "wire_ms": 0.0})
+            pp["bytes"] += row["bytes"]
+            pp["pieces"] += 1
+            pp["wire_ms"] += stages["wire_ms"]
+        for pp in parents.values():
+            ms = pp["wire_ms"]
+            pp["wire_ms"] = round(ms, 3)
+            pp["throughput_bps"] = (
+                round(pp["bytes"] / (ms / 1000.0)) if ms > 0 else 0)
+        totals = sorted(r["total_ms"] for r in piece_rows)
+        slowest = max(piece_rows, key=lambda r: r["total_ms"],
+                      default=None)
+        summary = {
+            "task_id": self.task_id, "peer_id": self.peer_id,
+            "state": self.state,
+            "pieces": len(piece_rows),
+            "bytes_p2p": sum(r["bytes"] for r in piece_rows
+                             if r["source"] == "p2p"),
+            "bytes_source": sum(r["bytes"] for r in piece_rows
+                                if r["source"] == "origin"),
+            "per_parent": parents,
+            "tail_ms": {"p50": _pctl(totals, 0.50),
+                        "p90": _pctl(totals, 0.90),
+                        "p99": _pctl(totals, 0.99)},
+            "hbm_dma_ms": round(hbm_dma_ms, 3),
+            "piece_rows": piece_rows,
+        }
+        total_bytes = summary["bytes_p2p"] + summary["bytes_source"]
+        summary["back_to_source_ratio"] = (
+            round(summary["bytes_source"] / total_bytes, 4)
+            if total_bytes else 0.0)
+        if slowest is not None:
+            stage = max(("queue_ms", "ttfb_ms", "wire_ms", "hbm_ms"),
+                        key=lambda k: slowest[k])
+            summary["slowest_piece"] = {
+                "piece": slowest["piece"], "parent": slowest["parent"],
+                "total_ms": slowest["total_ms"],
+                "dominant_stage": stage.removesuffix("_ms"),
+                "dominant_ms": slowest[stage]}
+        return summary
+
+    def compact_summary(self, *, max_parents: int = 8) -> dict:
+        """The wire form attached to the terminal PeerResult: the summary
+        minus per-piece rows, parents capped to the heaviest few (a
+        1000-piece task must not ship a 1000-row report)."""
+        s = self.summarize()
+        del s["piece_rows"]
+        parents = sorted(s["per_parent"].items(),
+                         key=lambda kv: kv[1]["bytes"], reverse=True)
+        s["per_parent"] = dict(parents[:max_parents])
+        return s
+
+
+def _pctl(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return round(sorted_vals[idx], 3)
+
+
+class FlightRecorder:
+    """Daemon-wide registry of TaskFlights, ring-capped on task count."""
+
+    def __init__(self, *, enabled: bool = True, max_tasks: int = 64,
+                 max_events: int = 4096):
+        self.enabled = enabled
+        self.max_tasks = max_tasks
+        self.max_events = max_events
+        self._tasks: OrderedDict[str, TaskFlight] = OrderedDict()
+
+    def begin(self, task_id: str, peer_id: str,
+              url: str = "") -> TaskFlight | None:
+        """Open (or reopen) a flight; None while disabled so callers hold
+        a None and the hot path never calls back in."""
+        if not self.enabled:
+            return None
+        # the upload port is mesh-reachable and the flight surface is not
+        # auth-gated: strip the query string (presigned-URL credentials)
+        # before the URL becomes queryable debug state
+        flight = TaskFlight(task_id, peer_id, url=url.split("?", 1)[0],
+                            max_events=self.max_events)
+        self._tasks[task_id] = flight
+        self._tasks.move_to_end(task_id)
+        while len(self._tasks) > self.max_tasks:
+            self._tasks.popitem(last=False)
+        return flight
+
+    def get(self, task_id: str) -> TaskFlight | None:
+        return self._tasks.get(task_id)
+
+    def index(self) -> list[dict]:
+        return [{"task_id": f.task_id, "state": f.state,
+                 "started_at": f.started_at, "events": len(f.events)}
+                for f in self._tasks.values()]
+
+
+def add_flight_routes(router, recorder: FlightRecorder) -> None:
+    """``GET /debug/flight`` (index) and ``/debug/flight/{task_id}``
+    (?summary=1 for the attribution summary instead of the raw timeline).
+    Mounted on the daemon upload server next to /metrics — read-only and
+    cheap, so not gated behind the profiling flag."""
+    import json
+
+    from aiohttp import web
+
+    async def flight_index(_r: web.Request) -> web.Response:
+        return web.json_response({"enabled": recorder.enabled,
+                                  "tasks": recorder.index()})
+
+    async def flight_one(request: web.Request) -> web.Response:
+        task_id = request.match_info["task_id"]
+        flight = recorder.get(task_id)
+        if flight is None:
+            # prefix match: operators paste truncated ids from logs
+            matches = [f for tid, f in recorder._tasks.items()
+                       if tid.startswith(task_id)]
+            if len(matches) != 1:
+                raise web.HTTPNotFound(
+                    text=json.dumps({"error": f"no flight for {task_id}"}),
+                    content_type="application/json")
+            flight = matches[0]
+        if request.query.get("summary"):
+            return web.json_response(flight.summarize())
+        body = flight.timeline()
+        body["summary"] = flight.summarize()
+        return web.json_response(body)
+
+    router.add_get("/debug/flight", flight_index)
+    router.add_get("/debug/flight/{task_id}", flight_one)

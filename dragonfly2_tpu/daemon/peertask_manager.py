@@ -29,7 +29,8 @@ class PeerTaskManager:
                  hostname: str, host_ip: str, scheduler: Any = None,
                  p2p_engine_factory: Any = None,
                  device_sink_builder: Any = None, is_seed: bool = False,
-                 shaper: Any = None, prefetch_whole_file: bool = False):
+                 shaper: Any = None, prefetch_whole_file: bool = False,
+                 flight_recorder: Any = None):
         self.storage_mgr = storage_mgr
         self.piece_mgr = piece_mgr
         self.hostname = hostname
@@ -40,6 +41,7 @@ class PeerTaskManager:
         self.is_seed = is_seed
         self.shaper = shaper
         self.prefetch_whole_file = prefetch_whole_file
+        self.flight_recorder = flight_recorder
         self._conductors: dict[str, PeerTaskConductor] = {}
         self._prefetching: set[str] = set()
         # strong refs: the loop only weak-refs tasks, and a GC'd prefetch
@@ -74,14 +76,18 @@ class PeerTaskManager:
                     if engine is not None:
                         engine.dispatcher.ordered = True
                 return conductor
+            peer_id = ids.peer_id(self.hostname, self.host_ip,
+                                  seed=self.is_seed)
+            flight = (self.flight_recorder.begin(task_id, peer_id, url=url)
+                      if self.flight_recorder is not None else None)
             conductor = PeerTaskConductor(
-                task_id=task_id,
-                peer_id=ids.peer_id(self.hostname, self.host_ip, seed=self.is_seed),
+                task_id=task_id, peer_id=peer_id,
                 url=url, url_meta=meta, storage_mgr=self.storage_mgr,
                 piece_mgr=self.piece_mgr, scheduler=self.scheduler,
                 content_range=content_range,
                 disable_back_source=disable_back_source, task_type=task_type,
-                device_sink_factory=device_sink_factory, ordered=ordered)
+                device_sink_factory=device_sink_factory, ordered=ordered,
+                flight=flight)
             if self.p2p_engine_factory is not None:
                 conductor.set_p2p_engine(self.p2p_engine_factory())
             if self.shaper is not None:

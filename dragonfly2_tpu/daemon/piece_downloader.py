@@ -61,16 +61,21 @@ class PieceDownloader:
             await self._session.close()
 
     @staticmethod
-    async def _read_body(resp, size: int, hasher, what: str) -> bytearray:
+    async def _read_body(resp, size: int, hasher, what: str,
+                         on_first=None) -> bytearray:
         """Stream the body into ONE preallocated buffer, folding each
         cache-hot chunk into the digest as it arrives. Replaces
         ``resp.read()``: no chunk-list join copy, and no second cold
         traversal of a 4-16 MiB piece just to hash it — per-byte CPU is
-        the fan-out ceiling on core-bound hosts."""
+        the fan-out ceiling on core-bound hosts. ``on_first`` fires once
+        when the first body chunk lands (flight-recorder ttfb)."""
         buf = bytearray(size)
         mv = memoryview(buf)
         off = 0
         async for chunk in resp.content.iter_any():
+            if off == 0 and on_first is not None:
+                on_first()
+                on_first = None
             n = len(chunk)
             if off + n > size:
                 raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
@@ -85,7 +90,8 @@ class PieceDownloader:
         return buf
 
     async def download_piece(self, *, dst_addr: str, task_id: str,
-                             src_peer_id: str, piece: PieceInfo
+                             src_peer_id: str, piece: PieceInfo,
+                             on_first_byte=None,
                              ) -> tuple[bytearray, int]:
         """Fetch one piece from a parent. Returns (data, cost_ms).
 
@@ -126,7 +132,8 @@ class PieceDownloader:
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                         f"{what}: HTTP {resp.status}")
                 hasher = digestlib.Hasher(algo) if algo else None
-                data = await self._read_body(resp, size, hasher, what)
+                data = await self._read_body(resp, size, hasher, what,
+                                             on_first=on_first_byte)
         except DFError:
             raise
         except Exception as exc:  # noqa: BLE001 - network boundary
@@ -141,6 +148,7 @@ class PieceDownloader:
 
     async def download_span(self, *, dst_addr: str, task_id: str,
                             src_peer_id: str, pieces: list[PieceInfo],
+                            on_first_byte=None,
                             ) -> tuple[list[tuple[PieceInfo, memoryview]], int]:
         """Fetch CONTIGUOUS pieces in one ranged GET; split + verify each.
 
@@ -155,7 +163,8 @@ class PieceDownloader:
             p = pieces[0]
             data, cost = await self.download_piece(
                 dst_addr=dst_addr, task_id=task_id,
-                src_peer_id=src_peer_id, piece=p)
+                src_peer_id=src_peer_id, piece=p,
+                on_first_byte=on_first_byte)
             return [(p, memoryview(data))], cost
         url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start = pieces[0].range_start
@@ -183,7 +192,8 @@ class PieceDownloader:
                     raise DFError(
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                         f"{what}: HTTP {resp.status}")
-                data = await self._read_body(resp, size, None, what)
+                data = await self._read_body(resp, size, None, what,
+                                             on_first=on_first_byte)
         except DFError:
             raise
         except Exception as exc:  # noqa: BLE001 - network boundary

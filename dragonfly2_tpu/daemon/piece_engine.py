@@ -26,6 +26,7 @@ from ..common.metrics import REGISTRY
 from ..idl.messages import (PeerAddr, PeerPacket, PieceInfo, PieceResult,
                             PieceTaskRequest, SizeScope)
 from ..rpc.client import ChannelPool, ServiceClient
+from . import flight_recorder as fr
 from .piece_dispatcher import ENDGAME_PIECES, Dispatch, PieceDispatcher
 from .piece_downloader import PieceDownloader
 
@@ -203,19 +204,31 @@ class PieceEngine:
         else:
             conductor.set_content_info(info.range_size)
         t0 = int(time.time() * 1000)
+        flight = conductor.flight
+        on_first = None
+        if flight is not None:
+            flight.event(fr.DISPATCHED, info.piece_num, single.dst_peer_id)
+
+            def on_first(_num=info.piece_num, _pid=single.dst_peer_id):
+                flight.event(fr.FIRST_BYTE, _num, _pid)
         try:
             data, cost = await self.downloader.download_piece(
                 dst_addr=single.dst_addr, task_id=conductor.task_id,
-                src_peer_id=conductor.peer_id, piece=info)
+                src_peer_id=conductor.peer_id, piece=info,
+                on_first_byte=on_first)
         except DFError as exc:
             _p2p_pieces.labels("fail").inc()
             await session.report_piece(self._piece_result(
                 conductor, info, single.dst_peer_id, t0, ok=False,
                 code=exc.code))
             return False
-        await conductor.on_piece_from_peer(info.piece_num, info.range_start,
-                                           data, cost, single.dst_peer_id,
-                                           piece_digest=info.digest)
+        t_wire = flight.now_ms() if flight is not None else 0.0
+        placed = await conductor.on_piece_from_peer(
+            info.piece_num, info.range_start, data, cost,
+            single.dst_peer_id, piece_digest=info.digest)
+        if flight is not None and placed:
+            flight.event(fr.WIRE_DONE, info.piece_num, single.dst_peer_id,
+                         len(data), dur_ms=cost, t_ms=t_wire)
         _p2p_pieces.labels("ok").inc()
         await session.report_piece(self._piece_result(
             conductor, info, single.dst_peer_id, t0, ok=True, cost_ms=cost))
@@ -276,6 +289,14 @@ class PieceEngine:
             packet_task.cancel()
             for w in workers:
                 w.cancel()
+            # close the dispatcher BEFORE awaiting the workers: a cancel
+            # delivered in the same loop tick as a cond notify (the last
+            # piece's report) is swallowed by asyncio.wait_for (the 3.10
+            # lost-cancellation bug), and the unbounded gather below then
+            # waits forever on an undead worker. With the dispatcher
+            # closed, such a worker's next get() returns None and it exits
+            # via the closed path — every mesh e2e hung on this without it.
+            await self.dispatcher.close()
             await asyncio.gather(packet_task, *workers, return_exceptions=True)
 
     async def _wait_parent_change(self) -> None:
@@ -391,9 +412,22 @@ class PieceEngine:
                 fresh.start()
 
     async def _download_one(self, conductor, session, d: Dispatch) -> None:
+        flight = conductor.flight
+        if flight is not None:
+            # worker pickup: queue_ms then measures the rate-limiter wait;
+            # parent-side queueing lands in ttfb_ms (dispatched->first_byte)
+            for info in d.pieces:
+                flight.event(fr.SCHEDULED, info.piece_num, d.parent.peer_id)
         if conductor.rate_limiter is not None:
             await conductor.rate_limiter.acquire(d.size())
         t0 = int(time.time() * 1000)
+        on_first = None
+        if flight is not None:
+            for info in d.pieces:
+                flight.event(fr.DISPATCHED, info.piece_num, d.parent.peer_id)
+
+            def on_first(_num=d.piece.piece_num, _pid=d.parent.peer_id):
+                flight.event(fr.FIRST_BYTE, _num, _pid)
         from ..common import tracing
         try:
             with tracing.span("piece.download",
@@ -404,7 +438,8 @@ class PieceEngine:
                 psp.set(dst=d.parent.peer_id[-16:], link=int(d.parent.link))
                 landed, cost = await self.downloader.download_span(
                     dst_addr=d.parent.addr, task_id=conductor.task_id,
-                    src_peer_id=conductor.peer_id, pieces=d.pieces)
+                    src_peer_id=conductor.peer_id, pieces=d.pieces,
+                    on_first_byte=on_first)
         except DFError as exc:
             if exc.code == Code.CLIENT_PEER_BUSY:
                 # backpressure, not failure: requeue; no scheduler report
@@ -433,9 +468,16 @@ class PieceEngine:
             return
         per_piece_cost = max(1, cost // max(len(landed), 1))
         for info, data in landed:
-            await conductor.on_piece_from_peer(
+            # timestamp before the landing await, journaled only for
+            # pieces that actually land — an endgame duplicate must not
+            # overwrite the real deliverer's attribution
+            t_wire = flight.now_ms() if flight is not None else 0.0
+            placed = await conductor.on_piece_from_peer(
                 info.piece_num, info.range_start, data, per_piece_cost,
                 d.parent.peer_id, piece_digest=info.digest)
+            if flight is not None and placed:
+                flight.event(fr.WIRE_DONE, info.piece_num, d.parent.peer_id,
+                             len(data), dur_ms=per_piece_cost, t_ms=t_wire)
             _p2p_pieces.labels("ok").inc()
             await session.report_piece(self._piece_result(
                 conductor, info, d.parent.peer_id, t0, ok=True,

@@ -136,6 +136,7 @@ class PeerSession:
             self._stream.cancel()
         if conductor is not None and not self._peer_result_sent:
             self._peer_result_sent = True
+            flight = getattr(conductor, "flight", None)
             try:
                 await self.client.unary("ReportPeerResult", PeerResult(
                     task_id=self.task_id, peer_id=self.peer_id,
@@ -144,7 +145,10 @@ class PeerSession:
                     cost_ms=int(time.time() * 1000) - conductor.start_ms,
                     code=int(conductor.fail_code),
                     total_piece_count=conductor.total_pieces,
-                    content_length=conductor.content_length), timeout=5.0)
+                    content_length=conductor.content_length,
+                    flight_summary=(flight.compact_summary()
+                                    if flight is not None else None)),
+                    timeout=5.0)
             except Exception as exc:  # noqa: BLE001
                 log.debug("ReportPeerResult failed: %s", exc)
 

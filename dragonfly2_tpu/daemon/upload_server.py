@@ -124,8 +124,10 @@ class UploadServer:
 
     def __init__(self, storage_mgr: StorageManager, *, port: int = 0,
                  rate_limit_bps: int = 0, concurrent_limit: int = 0,
-                 host: str = "0.0.0.0", debug_endpoints: bool = False):
+                 host: str = "0.0.0.0", debug_endpoints: bool = False,
+                 flight_recorder=None):
         self.storage_mgr = storage_mgr
+        self.flight_recorder = flight_recorder
         self.host = host
         self.port = port
         self.tls: tuple[str, str, str] | None = None   # (cert, key, ca)
@@ -165,6 +167,11 @@ class UploadServer:
         app.router.add_get("/download/{prefix}/{task_id}", self._traced)
         app.router.add_get("/healthy", healthy)
         app.router.add_get("/metrics", metrics)
+        if self.flight_recorder is not None:
+            # read-only + ring-bounded, so served like /metrics rather
+            # than behind the profiling flag
+            from .flight_recorder import add_flight_routes
+            add_flight_routes(app.router, self.flight_recorder)
         if self.debug_endpoints:
             # pprof-equivalent debug surface (reference cmd/dependency
             # InitMonitor --pprof-port) — OFF by default: profiling slows

@@ -268,6 +268,11 @@ class PeerResult:
     code: int = 0
     total_piece_count: int = 0
     content_length: int = -1
+    # compact flight-recorder summary (daemon/flight_recorder.py
+    # ``compact_summary``): per-parent throughput, tail latencies,
+    # back-to-source ratio — feeds the scheduler's cluster view and the
+    # trainer's record stream; None when the recorder is disabled
+    flight_summary: dict | None = None
 
 
 @message

@@ -23,6 +23,16 @@ log = logging.getLogger("df.rpc.client")
 _RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
 
 
+def _trace_metadata():
+    """W3C traceparent as gRPC metadata when a span is current (same
+    contract as the piece HTTP path): one trace id then covers the
+    daemon's task span, the scheduler's decision, and the piece fetches.
+    Free when tracing is off — no current span means no metadata."""
+    from ..common import tracing
+    tp = tracing.traceparent()
+    return (("traceparent", tp),) if tp else None
+
+
 class RPCError(Exception):
     def __init__(self, code: grpc.StatusCode, message: str):
         super().__init__(f"{code.name}: {message}")
@@ -166,11 +176,12 @@ class ServiceClient:
 
     async def unary(self, method: str, request: Any, *, timeout: float | None = None) -> Any:
         attempt = 0
+        md = _trace_metadata()
         while True:
             attempt += 1
             try:
                 stub = self.channel._stub("unary_unary", self.service, method)
-                return await stub(request, timeout=timeout)
+                return await stub(request, timeout=timeout, metadata=md)
             except grpc.aio.AioRpcError as exc:
                 if exc.code() in _RETRYABLE and attempt < self.max_attempts:
                     delay = min(self.max_backoff,
@@ -185,19 +196,21 @@ class ServiceClient:
     def unary_stream(self, method: str, request: Any, *,
                      timeout: float | None = None) -> "_StreamIter":
         stub = self.channel._stub("unary_stream", self.service, method)
-        return _StreamIter(stub(request, timeout=timeout))
+        return _StreamIter(stub(request, timeout=timeout,
+                                metadata=_trace_metadata()))
 
     async def stream_unary(self, method: str, requests: AsyncIterator[Any], *,
                            timeout: float | None = None) -> Any:
         stub = self.channel._stub("stream_unary", self.service, method)
         try:
-            return await stub(requests, timeout=timeout)
+            return await stub(requests, timeout=timeout,
+                              metadata=_trace_metadata())
         except grpc.aio.AioRpcError as exc:
             raise _translate(exc) from None
 
     def stream_stream(self, method: str, *, timeout: float | None = None) -> "_BidiCall":
         stub = self.channel._stub("stream_stream", self.service, method)
-        return _BidiCall(stub(timeout=timeout))
+        return _BidiCall(stub(timeout=timeout, metadata=_trace_metadata()))
 
 
 class _StreamIter:

@@ -92,6 +92,22 @@ def rpc_error_interceptor():  # placeholder hook point for tracing interceptors
     return None
 
 
+def span_parent(context):
+    """Extract the caller's W3C traceparent from gRPC invocation metadata
+    (client half: rpc/client._trace_metadata). Returns a SpanContext to
+    pass as ``tracing.span(..., parent=...)`` or None."""
+    from ..common import tracing
+    try:
+        metadata = context.invocation_metadata() or ()
+    except Exception:  # noqa: BLE001 - fake contexts in tests
+        return None
+    for entry in metadata:
+        key, value = entry[0], entry[1]
+        if key == "traceparent":
+            return tracing.from_traceparent(value)
+    return None
+
+
 class _Health:
     """Minimal health service (role parity: ``pkg/rpc/health``)."""
 

@@ -1,0 +1,162 @@
+"""Cluster-wide download health view, fed by piece reports + flight
+summaries.
+
+Role parity: none in the reference — scheduler-side half of the flight
+recorder (daemon/flight_recorder.py). Every daemon already streams piece
+results up and attaches a compact flight summary to its terminal
+``PeerResult``; this module folds both into per-host aggregates the
+operator reads at ``GET /debug/cluster`` (served on the scheduler
+launcher's ``--debug-port``) and the trainer consumes via the records
+stream:
+
+  * per-peer/host throughput (bytes, pieces, mean piece cost),
+  * cluster back-to-source ratio (the egress the mesh failed to save),
+  * straggler parents — hosts whose mean served-piece cost sits far above
+    the cluster median (the "one slow host drags the fan-out" signal).
+
+All updates are O(1) per report; the snapshot walks the host table only
+when asked.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..common.metrics import REGISTRY
+
+_cluster_bytes = REGISTRY.counter(
+    "df_cluster_bytes_total",
+    "bytes reported downloaded cluster-wide", ("source",))
+_flights = REGISTRY.counter(
+    "df_cluster_flight_reports_total",
+    "flight summaries received from daemons")
+
+STRAGGLER_FACTOR = 3.0      # mean cost beyond this x median -> straggler
+MIN_STRAGGLER_PIECES = 4    # don't judge a parent on one slow piece
+
+
+class _HostAgg:
+    __slots__ = ("bytes_down_p2p", "bytes_down_source", "pieces_down",
+                 "pieces_served", "serve_cost_ms_sum", "fails",
+                 "flights", "last_seen", "last_flight")
+
+    def __init__(self) -> None:
+        self.bytes_down_p2p = 0
+        self.bytes_down_source = 0
+        self.pieces_down = 0
+        self.pieces_served = 0
+        self.serve_cost_ms_sum = 0.0
+        self.fails = 0
+        self.flights = 0
+        self.last_seen = time.time()
+        self.last_flight: dict | None = None
+
+    def mean_serve_ms(self) -> float:
+        return (self.serve_cost_ms_sum / self.pieces_served
+                if self.pieces_served else 0.0)
+
+
+class ClusterView:
+    def __init__(self) -> None:
+        self._hosts: dict[str, _HostAgg] = {}
+        self.started_at = time.time()
+
+    def _agg(self, host_id: str) -> _HostAgg:
+        agg = self._hosts.get(host_id)
+        if agg is None:
+            agg = self._hosts[host_id] = _HostAgg()
+        agg.last_seen = time.time()
+        return agg
+
+    # -- hooks called by SchedulerService (hot path: O(1)) -------------
+
+    def on_piece(self, peer, result) -> None:
+        agg = self._agg(peer.host.id)
+        if not result.success:
+            agg.fails += 1
+            return
+        info = result.piece_info
+        if info is None:
+            return
+        agg.pieces_down += 1
+        if result.dst_peer_id:
+            agg.bytes_down_p2p += info.range_size
+            _cluster_bytes.labels("p2p").inc(info.range_size)
+            parent = peer.task.peers.get(result.dst_peer_id)
+            if parent is not None:
+                pagg = self._agg(parent.host.id)
+                pagg.pieces_served += 1
+                pagg.serve_cost_ms_sum += info.download_cost_ms
+        else:
+            agg.bytes_down_source += info.range_size
+            _cluster_bytes.labels("source").inc(info.range_size)
+
+    def on_flight(self, peer, summary: dict) -> None:
+        agg = self._agg(peer.host.id)
+        agg.flights += 1
+        # keep only the latest per host (bounded by host count, not tasks)
+        agg.last_flight = {
+            k: summary.get(k) for k in
+            ("task_id", "state", "pieces", "bytes_p2p", "bytes_source",
+             "back_to_source_ratio", "tail_ms", "slowest_piece",
+             "hbm_dma_ms")}
+        _flights.inc()
+
+    # -- consumption ---------------------------------------------------
+
+    def stragglers(self) -> list[dict]:
+        """Serving hosts whose mean piece cost is far beyond the cluster
+        median — the parents a slow fan-out is waiting on."""
+        means = [(hid, a.mean_serve_ms(), a.pieces_served)
+                 for hid, a in self._hosts.items()
+                 if a.pieces_served >= MIN_STRAGGLER_PIECES]
+        if len(means) < 2:
+            return []
+        costs = sorted(m for _, m, _ in means)
+        # lower median: with two serving hosts the slow one must be judged
+        # against the fast one, not against itself
+        median = costs[(len(costs) - 1) // 2]
+        if median <= 0:
+            return []
+        return [{"host_id": hid, "mean_serve_ms": round(m, 3),
+                 "pieces_served": n,
+                 "slowdown": round(m / median, 2)}
+                for hid, m, n in means
+                if m > STRAGGLER_FACTOR * median]
+
+    def snapshot(self) -> dict:
+        p2p = sum(a.bytes_down_p2p for a in self._hosts.values())
+        src = sum(a.bytes_down_source for a in self._hosts.values())
+        hosts = {}
+        for hid, a in self._hosts.items():
+            hosts[hid] = {
+                "bytes_p2p": a.bytes_down_p2p,
+                "bytes_source": a.bytes_down_source,
+                "pieces_down": a.pieces_down,
+                "pieces_served": a.pieces_served,
+                "mean_serve_ms": round(a.mean_serve_ms(), 3),
+                "fails": a.fails,
+                "flights": a.flights,
+                "last_seen": a.last_seen,
+                "last_flight": a.last_flight,
+            }
+        return {
+            "since": self.started_at,
+            "hosts": hosts,
+            "bytes_p2p": p2p,
+            "bytes_source": src,
+            "back_to_source_ratio": (round(src / (p2p + src), 4)
+                                     if (p2p + src) else 0.0),
+            "stragglers": self.stragglers(),
+        }
+
+
+def add_cluster_routes(router, view: ClusterView) -> None:
+    """``GET /debug/cluster`` — mounted on the scheduler launcher's
+    --debug-port server next to /metrics."""
+    from aiohttp import web
+
+    async def cluster(_r: web.Request) -> web.Response:
+        return web.json_response(view.snapshot())
+
+    router.add_get("/debug/cluster", cluster)

@@ -103,12 +103,32 @@ class DownloadRecords:
             "report_fail_count": peer.report_fail_count,
             "created_at": time.time(),
         }
+        self._append_peer_row(row)
+
+    def on_flight(self, peer: Peer, summary: dict) -> None:
+        """Latency-attribution row per finished peer run, from the daemon's
+        flight recorder: where the time went (queue/wire/HBM), per-parent
+        throughput, tail latencies. The trainer learns from attribution
+        the piece rows alone cannot carry (a slow piece row does not say
+        WHY it was slow)."""
+        row = {
+            "kind": "flight",
+            "task_id": peer.task.id,
+            "peer_id": peer.id,
+            "host_id": peer.host.id,
+            "summary": summary,
+            "created_at": time.time(),
+        }
+        self._append_peer_row(row)
+
+    # -- internals -----------------------------------------------------
+
+    def _append_peer_row(self, row: dict) -> None:
+        """Ring-append a non-piece (peer/flight) row + buffer its line."""
         self._peer_rows.append(row)
         if len(self._peer_rows) > MAX_BUFFERED_ROWS:
             self._peer_rows = self._peer_rows[-MAX_BUFFERED_ROWS:]
         self._write(row)
-
-    # -- internals -----------------------------------------------------
 
     def _append(self, row: dict) -> None:
         self._rows.append(row)
@@ -195,7 +215,7 @@ class DownloadRecords:
         """Return drained rows after a failed upload (oldest first; the
         ring bound still applies)."""
         piece = [r for r in rows if r.get("kind") == "piece"]
-        peer = [r for r in rows if r.get("kind") == "peer"]
+        peer = [r for r in rows if r.get("kind") != "piece"]  # peer + flight
         self._rows = (piece + self._rows)[-MAX_BUFFERED_ROWS:]
         self._peer_rows = (peer + self._peer_rows)[-MAX_BUFFERED_ROWS:]
 

@@ -25,7 +25,8 @@ from ..idl.messages import (AnnounceHostRequest, Empty, LeaveHostRequest,
                             RegisterResult, SinglePiece, SizeScope,
                             StatTaskRequest, SyncProbesResponse, TaskStat,
                             ProbeTarget)
-from ..rpc.server import ServiceDef
+from ..rpc.server import ServiceDef, span_parent
+from .cluster_view import ClusterView
 from .config import SchedulerConfig
 from .resource import Peer, PeerState, Resource, TaskState
 from .scheduling import Scheduling
@@ -65,6 +66,7 @@ class SchedulerService:
         self.seed_client = seed_client
         self.topo = topo
         self.records = records          # download-record sink (trainer dataset)
+        self.cluster = ClusterView()    # pod-wide health (GET /debug/cluster)
         self._seed_tasks: set[asyncio.Task] = set()
         # application name -> Priority numeric, fed from the manager's
         # applications table (reference dynconfig.GetApplications); consulted
@@ -78,7 +80,11 @@ class SchedulerService:
     async def register_peer_task(self, req: RegisterPeerTaskRequest,
                                  context) -> RegisterResult:
         from ..common import tracing
-        with tracing.span("sched.register", task_id=req.task_id[:16],
+        # the daemon's traceparent rides the RPC metadata: the scheduling
+        # decision joins the task trace that also covers the piece fetches
+        # and the HBM landing
+        with tracing.span("sched.register", parent=span_parent(context),
+                          task_id=req.task_id[:16],
                           peer_id=req.peer_id[-16:]):
             return await self._register_peer_task(req, context)
 
@@ -182,11 +188,22 @@ class SchedulerService:
             self._schedule_with_patience(peer, sink))
         refresher = asyncio.get_running_loop().create_task(
             self._refresh_loop(peer))
+        # the daemon opened this stream inside its peertask span: mark the
+        # first offer (parents or back-source verdict) in that trace
+        from ..common import tracing
+        offer_parent = span_parent(context)
+        first_offer = True
         try:
             while True:
                 packet = await sink.get()
                 if packet is None:
                     break
+                if first_offer:
+                    first_offer = False
+                    with tracing.span("sched.offer", parent=offer_parent,
+                                      task_id=peer.task.id[:16],
+                                      code=packet.code):
+                        pass
                 yield packet
                 if packet.code == int(Code.SCHED_NEED_BACK_SOURCE):
                     # verdict delivered; the stream stays open for reports
@@ -393,6 +410,12 @@ class SchedulerService:
                                    result: PieceResult) -> None:
         peer.touch()
         task = peer.task
+        # endgame duplicate racers both report success for the same piece;
+        # the cluster view must count delivered bytes once
+        duplicate = (result.success and result.piece_info is not None
+                     and result.piece_info.piece_num in peer.finished_pieces)
+        if not duplicate:
+            self.cluster.on_piece(peer, result)
         if result.success:
             _piece_reports.labels("ok").inc()
             if result.piece_info is not None:
@@ -498,8 +521,12 @@ class SchedulerService:
         # piece-holder vertex — only the active-transfer edges go)
         task.set_parents(peer.id, [])
         peer.last_offer_ids = set()
+        if result.flight_summary:
+            self.cluster.on_flight(peer, result.flight_summary)
         if self.records is not None:
             self.records.on_peer(peer, result)
+            if result.flight_summary:
+                self.records.on_flight(peer, result.flight_summary)
         return Empty()
 
     # ------------------------------------------------------------------

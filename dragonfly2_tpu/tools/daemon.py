@@ -48,6 +48,8 @@ async def serve(cfg: DaemonConfig) -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     await daemon.stop()
+    from ..common import tracing
+    tracing.shutdown()   # don't drop the final span batch of a short run
 
 
 def main(argv: list[str] | None = None) -> int:

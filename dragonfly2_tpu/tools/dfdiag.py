@@ -1,0 +1,219 @@
+"""dfdiag: fetch a download's flight timeline and explain where time went.
+
+Reads the flight recorder's debug surface (daemon/flight_recorder.py) and
+renders an ASCII waterfall per piece plus a "why was this download slow"
+verdict; ``--cluster`` instead reads a scheduler's pod-wide health view.
+
+Usage:
+    python -m dragonfly2_tpu.tools.dfdiag --daemon 10.0.0.4:65002 <task_id>
+    python -m dragonfly2_tpu.tools.dfdiag --daemon 10.0.0.4:65002 --list
+    python -m dragonfly2_tpu.tools.dfdiag --file flight.json
+    python -m dragonfly2_tpu.tools.dfdiag --cluster --scheduler host:port
+
+Waterfall legend: ``.`` queue (rate-limiter wait), ``-`` ttfb (request +
+parent-side queueing), ``=`` wire transfer, ``#`` HBM staging.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+# (stage duration key, bar glyph, human name) — waterfall + verdict order
+STAGES = (
+    ("queue_ms", ".", "local queueing"),
+    ("ttfb_ms", "-", "parent queueing (time to first byte)"),
+    ("wire_ms", "=", "wire transfer"),
+    ("hbm_ms", "#", "HBM staging"),
+)
+
+
+def _get(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def fetch_flight(daemon: str, task_id: str) -> dict:
+    return _get(f"http://{daemon}/debug/flight/{task_id}")
+
+
+def fetch_index(daemon: str) -> dict:
+    return _get(f"http://{daemon}/debug/flight")
+
+
+def fetch_cluster(scheduler: str) -> dict:
+    return _get(f"http://{scheduler}/debug/cluster")
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def render_waterfall(summary: dict, *, width: int = 64) -> str:
+    """ASCII waterfall: one row per piece, bars proportional to wall time,
+    segmented by stage. Pure function over the /debug/flight summary (or a
+    saved copy) so it is testable offline."""
+    rows = summary.get("piece_rows") or []
+    if not rows:
+        return "(no completed pieces recorded)"
+    t_lo = min(r["start_ms"] for r in rows)
+    t_hi = max(r["start_ms"] + r["total_ms"] for r in rows)
+    span = max(t_hi - t_lo, 1e-9)
+    scale = width / span
+    out = [f"task {summary.get('task_id', '?')[:24]}  "
+           f"pieces={summary.get('pieces')}  "
+           f"p2p={_fmt_bytes(summary.get('bytes_p2p', 0))}  "
+           f"origin={_fmt_bytes(summary.get('bytes_source', 0))}  "
+           f"wall={span:.0f}ms",
+           f"{'piece':>6} {'parent':>10} |{'':<{width}}| total"]
+    for r in rows:
+        pad = int((r["start_ms"] - t_lo) * scale)
+        bar = ""
+        for key, glyph, _ in STAGES:
+            bar += glyph * int(round(r.get(key, 0.0) * scale))
+        # a piece too fast for one cell still deserves a mark
+        bar = (bar or "=")[:max(width - pad, 1)]
+        parent = r.get("parent") or "origin"
+        out.append(f"{r['piece']:>6} {parent[-10:]:>10} "
+                   f"|{' ' * pad}{bar:<{max(width - pad, 1)}}| "
+                   f"{r['total_ms']:.0f}ms")
+    legend = "  ".join(f"{glyph}={name.split(' (')[0]}"
+                       for _, glyph, name in STAGES)
+    out.append(f"legend: {legend}")
+    return "\n".join(out)
+
+
+def verdict(summary: dict) -> str:
+    """One-paragraph 'why was this download slow' attribution."""
+    rows = summary.get("piece_rows") or []
+    if not rows:
+        return "verdict: no completed pieces — nothing to attribute."
+    stage_totals = {key: sum(r.get(key, 0.0) for r in rows)
+                    for key, _, _ in STAGES}
+    grand = sum(stage_totals.values()) or 1e-9
+    key = max(stage_totals, key=stage_totals.get)
+    name = next(n for k, _, n in STAGES if k == key)
+    parts = [f"verdict: {100 * stage_totals[key] / grand:.0f}% of piece "
+             f"time went to {name}"]
+    slow = summary.get("slowest_piece")
+    if slow:
+        who = slow.get("parent") or "origin"
+        parts.append(f"slowest piece {slow['piece']} took "
+                     f"{slow['total_ms']:.0f}ms, dominated by "
+                     f"{slow['dominant_stage']} "
+                     f"({slow['dominant_ms']:.0f}ms) from {who[-12:]}")
+    ratio = summary.get("back_to_source_ratio", 0.0)
+    if ratio > 0.5:
+        parts.append(f"{100 * ratio:.0f}% of bytes came from origin — the "
+                     "mesh barely helped (no parents, or parents too slow)")
+    elif ratio > 0:
+        parts.append(f"back-to-source ratio {ratio:.2f}")
+    per_parent = summary.get("per_parent") or {}
+    rates = {p: v.get("throughput_bps", 0)
+             for p, v in per_parent.items() if v.get("throughput_bps")}
+    if len(rates) > 1:
+        worst = min(rates, key=rates.get)
+        best = max(rates, key=rates.get)
+        if rates[best] > 3 * rates[worst]:
+            parts.append(
+                f"parent {worst[-12:] or 'origin'} ran at "
+                f"{_fmt_bytes(rates[worst])}/s vs {_fmt_bytes(rates[best])}/s"
+                f" from {best[-12:] or 'origin'} — a straggler parent")
+    tail = summary.get("tail_ms") or {}
+    if tail:
+        parts.append(f"piece latency p50/p90/p99 = {tail.get('p50')}/"
+                     f"{tail.get('p90')}/{tail.get('p99')}ms")
+    return ";\n  ".join(parts) + "."
+
+
+def render_cluster(snapshot: dict) -> str:
+    """Tabular view of the scheduler's pod-wide health snapshot."""
+    out = [f"cluster: p2p={_fmt_bytes(snapshot.get('bytes_p2p', 0))}  "
+           f"origin={_fmt_bytes(snapshot.get('bytes_source', 0))}  "
+           f"back-to-source={snapshot.get('back_to_source_ratio', 0.0):.2%}"]
+    hosts = snapshot.get("hosts") or {}
+    if hosts:
+        out.append(f"{'host':<28} {'pieces':>7} {'served':>7} "
+                   f"{'serve-ms':>9} {'fails':>6} {'flights':>8}")
+        for hid, h in sorted(hosts.items()):
+            out.append(f"{hid[-28:]:<28} {h['pieces_down']:>7} "
+                       f"{h['pieces_served']:>7} {h['mean_serve_ms']:>9.1f} "
+                       f"{h['fails']:>6} {h['flights']:>8}")
+    stragglers = snapshot.get("stragglers") or []
+    for s in stragglers:
+        out.append(f"STRAGGLER {s['host_id'][-28:]}: mean serve "
+                   f"{s['mean_serve_ms']:.0f}ms — {s['slowdown']}x the "
+                   f"cluster median over {s['pieces_served']} pieces")
+    if not stragglers:
+        out.append("no straggler parents")
+    return "\n".join(out)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dfdiag", description="flight-recorder waterfall + verdict")
+    p.add_argument("task_id", nargs="?", default="",
+                   help="task id (prefix ok) to diagnose")
+    p.add_argument("--daemon", default="127.0.0.1:65002",
+                   help="daemon upload host:port serving /debug/flight")
+    p.add_argument("--scheduler", default="",
+                   help="scheduler debug host:port serving /debug/cluster")
+    p.add_argument("--file", default="",
+                   help="read a saved /debug/flight JSON instead of HTTP")
+    p.add_argument("--list", action="store_true",
+                   help="list recorded flights on the daemon")
+    p.add_argument("--cluster", action="store_true",
+                   help="show the scheduler's cluster health view")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of rendered text")
+    p.add_argument("--width", type=int, default=64, help="waterfall width")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.cluster:
+            if not args.scheduler:
+                # the daemon upload port serves /debug/flight, never
+                # /debug/cluster — a silent fallback would just 404
+                print("dfdiag: --cluster needs --scheduler host:port "
+                      "(the scheduler's --debug-port)", file=sys.stderr)
+                return 2
+            snap = fetch_cluster(args.scheduler)
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_cluster(snap))
+            return 0
+        if args.list:
+            idx = fetch_index(args.daemon)
+            print(json.dumps(idx, indent=2))
+            return 0
+        if args.file:
+            with open(args.file, encoding="utf-8") as f:
+                flight = json.load(f)
+        elif args.task_id:
+            flight = fetch_flight(args.daemon, args.task_id)
+        else:
+            print("dfdiag: need a task_id, --file, --list, or --cluster",
+                  file=sys.stderr)
+            return 2
+        summary = flight.get("summary") or flight
+        if args.json:
+            print(json.dumps(summary, indent=2))
+            return 0
+        print(render_waterfall(summary, width=args.width))
+        print(verdict(summary))
+        return 0
+    except OSError as exc:
+        print(f"dfdiag: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,8 @@ async def serve(cfg: ManagerConfig, debug_port: int = 0) -> None:
     if debug_runner is not None:
         await debug_runner.cleanup()
     await mgr.stop()
+    from ..common import tracing
+    tracing.shutdown()   # don't drop the final span batch of a short run
 
 
 def main(argv: list[str] | None = None) -> int:

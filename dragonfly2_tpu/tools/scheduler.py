@@ -43,7 +43,11 @@ async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
     sched = Scheduler(cfg)
     await sched.start()
     from ..common.debug_http import maybe_start_debug
-    debug_runner = await maybe_start_debug(debug_port)
+    from ..scheduler.cluster_view import add_cluster_routes
+    debug_runner = await maybe_start_debug(
+        debug_port,
+        extra_routes=lambda router: add_cluster_routes(
+            router, sched.service.cluster))
     print(f"scheduler up: {sched.address}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -53,6 +57,8 @@ async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
     if debug_runner is not None:
         await debug_runner.cleanup()
     await sched.stop()
+    from ..common import tracing
+    tracing.shutdown()   # don't drop the final span batch of a short run
 
 
 def main(argv: list[str] | None = None) -> int:

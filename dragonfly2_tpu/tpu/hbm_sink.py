@@ -35,7 +35,26 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..common.metrics import REGISTRY
+
 log = logging.getLogger("df.storage.hbm")
+
+# sink telemetry in the process registry (scraped at /metrics) instead of
+# instance-private fields only a result() caller could read: the DMA
+# overlap picture must survive the task and be visible to an operator
+# mid-download
+_hbm_transfer_s = REGISTRY.histogram(
+    "df_hbm_transfer_seconds", "device shard DMA duration",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0, 10.0))
+_hbm_transfers = REGISTRY.counter(
+    "df_hbm_transfers_total", "device shard transfers", ("result",))
+_hbm_bytes = REGISTRY.counter(
+    "df_hbm_staged_bytes_total", "bytes staged into the host buffer")
+_hbm_queue = REGISTRY.gauge(
+    "df_hbm_transfer_queue_depth", "shard transfers enqueued, not yet done")
+_hbm_done = REGISTRY.gauge(
+    "df_hbm_done_fraction", "coverage fraction of the most recent sink")
 
 
 class CoverageMap:
@@ -161,6 +180,8 @@ class DeviceIngest:
             raise ValueError(f"write beyond content: {end} > {self.content_length}")
         self.host[offset:end] = np.frombuffer(data, dtype=np.uint8)
         self._coverage.add(offset, end)
+        _hbm_bytes.inc(len(data))
+        _hbm_done.set(self.done_fraction())
         first = offset // self.shard_bytes
         last = (end - 1) // self.shard_bytes
         for shard in range(first, min(last + 1, self.n_shards)):
@@ -175,6 +196,9 @@ class DeviceIngest:
                 return
             self._shard_queued[shard] = True
             self._pending += 1
+            # delta, not set(): several sinks share the process gauge and
+            # one instance's private _pending must not clobber the others'
+            _hbm_queue.inc()
             self._idle.clear()
             # put stays under the lock (SimpleQueue.put never blocks): outside
             # it, a concurrent close() could slip its sentinel in first and
@@ -211,19 +235,24 @@ class DeviceIngest:
                 wait = getattr(arr, "block_until_ready", None)
                 if wait is not None:
                     wait()
+                t1 = time.monotonic()
                 with self._lock:
                     self._shard_arrays[shard] = arr
                     self._shard_sent[shard] = True
-                    self.transfer_spans.append((t0, time.monotonic()))
+                    self.transfer_spans.append((t0, t1))
+                _hbm_transfer_s.observe(t1 - t0)
+                _hbm_transfers.labels("ok").inc()
                 log.debug("shard %d/%d -> %s", shard, self.n_shards, device)
             except BaseException as exc:  # noqa: BLE001 - surfaced by result()
                 with self._lock:
                     if self._error is None:
                         self._error = exc
+                _hbm_transfers.labels("fail").inc()
                 log.exception("device transfer of shard %d failed", shard)
             finally:
                 with self._lock:
                     self._pending -= 1
+                    _hbm_queue.dec()
                     if self._pending == 0:
                         self._idle.set()
                     # self-terminate once every shard has shipped: a consumer

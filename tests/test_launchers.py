@@ -206,6 +206,13 @@ def test_debug_endpoints_on_every_service(tmp_path):
                 f"http://127.0.0.1:{port}/debug/profile?seconds=0.2",
                 timeout=10).read()
             assert b"cumulative" in prof, mod
+            if mod == "scheduler":
+                # the pod-wide observability view rides the same port
+                cluster = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/cluster",
+                    timeout=10).read())
+                assert cluster["hosts"] == {}
+                assert "back_to_source_ratio" in cluster
     finally:
         for p in procs:
             if p.poll() is None:

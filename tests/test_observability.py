@@ -1,0 +1,451 @@
+"""Observability plane: flight recorder, dfdiag, cluster view, metric
+hygiene, exposition strictness, and the end-to-end one-trace assertion
+(scheduler decision -> peer piece fetch -> HBM landing).
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from dragonfly2_tpu.common.metrics import Registry
+from dragonfly2_tpu.daemon import flight_recorder as fr
+from dragonfly2_tpu.daemon.flight_recorder import FlightRecorder, TaskFlight
+from dragonfly2_tpu.tools.dfdiag import (render_cluster, render_waterfall,
+                                         verdict)
+
+
+def synthetic_flight(*, max_events: int = 4096) -> TaskFlight:
+    """Deterministic flight: events injected straight into the ring so
+    stage durations are exact. Piece 0: fast p2p; piece 1: slow wire from
+    a straggler parent; piece 2: back-source."""
+    f = TaskFlight("t" * 64, "peer-x", max_events=max_events)
+    rows = [
+        # (t_ms, stage, piece, parent, bytes, dur_ms)
+        (0.0, fr.REGISTERED, -1, "", 0, 0.0),
+        (1.0, fr.SCHEDULED, 0, "parentA", 0, 0.0),
+        (2.0, fr.DISPATCHED, 0, "parentA", 0, 0.0),
+        (5.0, fr.FIRST_BYTE, 0, "parentA", 0, 0.0),
+        (15.0, fr.WIRE_DONE, 0, "parentA", 4 << 20, 13.0),
+        (16.0, fr.HBM_DONE, 0, "", 4 << 20, 0.0),
+        (1.0, fr.SCHEDULED, 1, "parentB", 0, 0.0),
+        (3.0, fr.DISPATCHED, 1, "parentB", 0, 0.0),
+        (10.0, fr.FIRST_BYTE, 1, "parentB", 0, 0.0),
+        (210.0, fr.WIRE_DONE, 1, "parentB", 4 << 20, 207.0),
+        (212.0, fr.HBM_DONE, 1, "", 4 << 20, 0.0),
+        (260.0, fr.WIRE_DONE, 2, "", 2 << 20, 40.0),
+        (261.0, fr.HBM_SHARD, 0, "", 0, 6.0),
+    ]
+    for row in rows:
+        f.events.append(row)
+    f.state = "success"
+    return f
+
+
+class TestFlightRecorder:
+    def test_summary_attribution(self):
+        s = synthetic_flight().summarize()
+        assert s["pieces"] == 3
+        assert s["bytes_p2p"] == 8 << 20
+        assert s["bytes_source"] == 2 << 20
+        rows = {r["piece"]: r for r in s["piece_rows"]}
+        # piece 0: queue 1ms, ttfb 3ms, wire 10ms, hbm 1ms
+        assert rows[0]["queue_ms"] == 1.0
+        assert rows[0]["ttfb_ms"] == 3.0
+        assert rows[0]["wire_ms"] == 10.0
+        assert rows[0]["hbm_ms"] == 1.0
+        # piece 1 is the slowest and its wire transfer dominates
+        slow = s["slowest_piece"]
+        assert slow["piece"] == 1
+        assert slow["dominant_stage"] == "wire"
+        assert slow["parent"] == "parentB"
+        # back-source piece back-dated from its recorded duration
+        assert rows[2]["wire_ms"] == 40.0
+        assert rows[2]["source"] == "origin"
+        assert s["back_to_source_ratio"] == pytest.approx(0.2)
+        assert s["hbm_dma_ms"] == 6.0
+        # per-parent throughput: parentB moved the same bytes far slower
+        pp = s["per_parent"]
+        assert pp["parentA"]["throughput_bps"] > \
+            pp["parentB"]["throughput_bps"]
+
+    def test_compact_summary_caps_parents(self):
+        f = TaskFlight("t" * 64, "p")
+        for i in range(20):
+            f.events.append((float(i), fr.WIRE_DONE, i, f"par{i:02d}",
+                             1024, 1.0))
+        c = f.compact_summary(max_parents=8)
+        assert len(c["per_parent"]) == 8
+        assert "piece_rows" not in c
+
+    def test_event_ring_bounded(self):
+        f = TaskFlight("t" * 64, "p", max_events=16)
+        for i in range(1000):
+            f.event(fr.WIRE_DONE, i, "a", 1)
+        assert len(f.events) == 16
+        # oldest dropped, newest kept
+        assert f.events[-1][2] == 999
+
+    def test_recorder_task_ring_and_disable(self):
+        rec = FlightRecorder(max_tasks=4)
+        for i in range(10):
+            rec.begin(f"task-{i}", "p")
+        assert len(rec.index()) == 4
+        assert rec.get("task-9") is not None
+        assert rec.get("task-0") is None
+        off = FlightRecorder(enabled=False)
+        assert off.begin("t", "p") is None
+        assert off.index() == []
+
+
+class TestDfdiag:
+    def test_waterfall_rows_and_legend(self):
+        s = synthetic_flight().summarize()
+        text = render_waterfall(s, width=40)
+        lines = text.splitlines()
+        # header + column row + one row per piece + legend
+        assert len(lines) == 2 + 3 + 1
+        assert "legend:" in lines[-1]
+        # the slow piece's bar is mostly wire glyphs
+        row1 = next(ln for ln in lines if ln.strip().startswith("1 "))
+        assert row1.count("=") > row1.count("-")
+        assert "211ms" in row1
+
+    def test_verdict_names_dominant_stage_and_straggler(self):
+        s = synthetic_flight().summarize()
+        v = verdict(s)
+        # wire dominates both overall and on the slowest piece
+        assert "wire transfer" in v
+        assert "slowest piece 1" in v
+        assert "straggler" in v
+        assert "p50/p90/p99" in v
+
+    def test_verdict_empty(self):
+        assert "nothing to attribute" in verdict({"piece_rows": []})
+
+
+class TestClusterView:
+    def _peer(self, res, task, peer_id, host_id):
+        from dragonfly2_tpu.idl.messages import Host
+        host = res.store_host(Host(id=host_id, ip="127.0.0.1", port=1,
+                                   download_port=2))
+        return res.get_or_create_peer(peer_id, task, host)
+
+    def _result(self, task_id, src, dst, size=1 << 20, cost_ms=10,
+                success=True):
+        from dragonfly2_tpu.idl.messages import PieceInfo, PieceResult
+        return PieceResult(task_id=task_id, src_peer_id=src, dst_peer_id=dst,
+                           success=success,
+                           piece_info=PieceInfo(piece_num=0, range_size=size,
+                                                download_cost_ms=cost_ms))
+
+    def test_aggregation_and_stragglers(self):
+        from dragonfly2_tpu.scheduler.cluster_view import ClusterView
+        from dragonfly2_tpu.scheduler.resource import Resource, Task
+        res = Resource()
+        task = Task("t" * 64, "u")
+        child = self._peer(res, task, "child", "h-child")
+        fast = self._peer(res, task, "fast", "h-fast")
+        slow = self._peer(res, task, "slow", "h-slow")
+        view = ClusterView()
+        for _ in range(8):
+            view.on_piece(child, self._result(task.id, "child", "fast",
+                                              cost_ms=10))
+            view.on_piece(child, self._result(task.id, "child", "slow",
+                                              cost_ms=500))
+        view.on_piece(child, self._result(task.id, "child", "",
+                                          size=2 << 20, cost_ms=50))
+        view.on_piece(child, self._result(task.id, "child", "fast",
+                                          success=False))
+        view.on_flight(child, {"task_id": task.id, "state": "success",
+                               "pieces": 17, "bytes_p2p": 16 << 20,
+                               "bytes_source": 2 << 20,
+                               "back_to_source_ratio": 0.11,
+                               "tail_ms": {"p50": 10}})
+        snap = view.snapshot()
+        assert snap["bytes_p2p"] == 16 << 20
+        assert snap["bytes_source"] == 2 << 20
+        assert snap["back_to_source_ratio"] == pytest.approx(2 / 18, abs=1e-3)
+        assert snap["hosts"]["h-child"]["fails"] == 1
+        assert snap["hosts"]["h-child"]["flights"] == 1
+        assert snap["hosts"]["h-child"]["last_flight"]["pieces"] == 17
+        assert snap["hosts"]["h-fast"]["pieces_served"] == 8
+        stragglers = {s["host_id"] for s in snap["stragglers"]}
+        assert stragglers == {"h-slow"}
+        # render path stays in sync with the snapshot schema
+        text = render_cluster(snap)
+        assert "STRAGGLER h-slow" in text
+        assert "back-to-source" in text
+
+    def test_too_few_hosts_no_straggler_verdict(self):
+        from dragonfly2_tpu.scheduler.cluster_view import ClusterView
+        from dragonfly2_tpu.scheduler.resource import Resource, Task
+        res = Resource()
+        task = Task("t" * 64, "u")
+        child = self._peer(res, task, "c", "hc")
+        self._peer(res, task, "p", "hp")
+        view = ClusterView()
+        for _ in range(6):
+            view.on_piece(child, self._result(task.id, "c", "p",
+                                              cost_ms=900))
+        assert view.stragglers() == []
+
+
+class TestExpositionStrictness:
+    """Registry.expose() exposition-format guarantees."""
+
+    def test_label_escaping(self):
+        r = Registry()
+        c = r.counter("df_esc_total", "escapes", ("path",))
+        c.labels('a"b\\c\nd').inc()
+        text = r.expose()
+        assert 'path="a\\"b\\\\c\\nd"' in text
+
+    def test_histogram_inf_bucket_and_consistency(self):
+        r = Registry()
+        h = r.histogram("df_lat_seconds", "lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = r.expose()
+        # +Inf bucket equals _count; buckets are cumulative
+        assert 'df_lat_seconds_bucket{le="0.1"} 1.0' in text
+        assert 'df_lat_seconds_bucket{le="1.0"} 2.0' in text
+        assert 'df_lat_seconds_bucket{le="+Inf"} 4.0' in text
+        assert "df_lat_seconds_count 4.0" in text
+        assert "df_lat_seconds_sum 55.55" in text
+
+    def test_histogram_labeled_inf_consistency(self):
+        r = Registry()
+        h = r.histogram("df_l2_seconds", "lat", ("op",), buckets=(1.0,))
+        h.labels("read").observe(0.5)
+        h.labels("read").observe(9.0)
+        text = r.expose()
+        assert 'df_l2_seconds_bucket{op="read",le="+Inf"} 2.0' in text
+        assert 'df_l2_seconds_count{op="read"} 2.0' in text
+
+    def test_duplicate_registration_type_errors(self):
+        r = Registry()
+        r.counter("df_dup_total", "x")
+        with pytest.raises(TypeError, match="already registered"):
+            r.gauge("df_dup_total", "x")
+        with pytest.raises(TypeError, match="re-registered with labels"):
+            r.counter("df_dup_total", "x", ("kind",))
+        # identical re-registration is the supported idempotent path
+        assert r.counter("df_dup_total", "x") is not None
+
+
+class TestMetricNamespaceLint:
+    def test_registry_hygiene_after_importing_all_services(self):
+        """Walk the process REGISTRY with every service imported: all
+        metrics df_-prefixed, none with empty help (the /metrics surface
+        must stay self-describing as it grows)."""
+        import importlib
+
+        for mod in (
+                "dragonfly2_tpu.daemon.daemon",
+                "dragonfly2_tpu.daemon.proxy",
+                "dragonfly2_tpu.daemon.objectstorage",
+                "dragonfly2_tpu.daemon.piece_dispatcher",
+                "dragonfly2_tpu.daemon.piece_engine",
+                "dragonfly2_tpu.daemon.upload_server",
+                "dragonfly2_tpu.rpc.mux",
+                "dragonfly2_tpu.scheduler.service",
+                "dragonfly2_tpu.scheduler.cluster_view",
+                "dragonfly2_tpu.manager.server",
+                "dragonfly2_tpu.trainer.server",
+                "dragonfly2_tpu.tpu.hbm_sink",
+        ):
+            importlib.import_module(mod)
+        from dragonfly2_tpu.common.metrics import REGISTRY
+        metrics = list(REGISTRY._metrics.values())
+        assert metrics, "no metrics registered?"
+        bad_prefix = [m.name for m in metrics
+                      if not m.name.startswith("df_")]
+        assert not bad_prefix, f"non-df_ metric names: {bad_prefix}"
+        empty_help = [m.name for m in metrics if not m.help.strip()]
+        assert not empty_help, f"metrics with empty help: {empty_help}"
+
+
+class TestFlightHTTP:
+    def test_debug_flight_endpoint_on_upload_server(self, tmp_path):
+        """A real multi-piece back-source download leaves a queryable
+        flight with a summary on /debug/flight/<task_id>."""
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest
+
+        async def go():
+            data = os.urandom((10 << 20) + 777)     # 3 pieces
+            origin, base = await start_origin({"f.bin": data})
+            daemon = Daemon(daemon_config(tmp_path, "flt"))
+            await daemon.start()
+            try:
+                async for _ in daemon.ptm.start_file_task(DownloadRequest(
+                        url=f"{base}/f.bin", output=str(tmp_path / "o"),
+                        timeout_s=60.0)):
+                    pass
+                task_id = next(iter(daemon.ptm._conductors))
+                import aiohttp
+                port = daemon.upload_server.port
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"http://127.0.0.1:{port}"
+                                     f"/debug/flight") as r:
+                        idx = await r.json()
+                        assert idx["enabled"]
+                        assert any(t["task_id"] == task_id
+                                   for t in idx["tasks"])
+                    # a task-id prefix resolves like a full id
+                    async with s.get(f"http://127.0.0.1:{port}"
+                                     f"/debug/flight/{task_id[:16]}") as r:
+                        assert r.status == 200
+                        flight = await r.json()
+                    async with s.get(f"http://127.0.0.1:{port}"
+                                     f"/debug/flight/nope-nope") as r:
+                        assert r.status == 404
+                assert flight["state"] == "success"
+                summary = flight["summary"]
+                assert summary["pieces"] == 3
+                assert summary["bytes_source"] == len(data)
+                assert summary["back_to_source_ratio"] == 1.0
+                text = render_waterfall(summary)
+                assert len([ln for ln in text.splitlines()
+                            if "ms" in ln and "|" in ln]) >= 3
+                assert "origin" in verdict(summary)
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+    def test_disabled_recorder_records_nothing(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest
+
+        async def go():
+            data = os.urandom(300_000)
+            origin, base = await start_origin({"x.bin": data})
+            cfg = daemon_config(tmp_path, "noflt")
+            cfg.flight.enabled = False
+            daemon = Daemon(cfg)
+            await daemon.start()
+            try:
+                async for _ in daemon.ptm.start_file_task(DownloadRequest(
+                        url=f"{base}/x.bin", output=str(tmp_path / "o"),
+                        timeout_s=60.0)):
+                    pass
+                # no journal object on the conductor: the hot path never
+                # paid for a single event
+                conductor = next(iter(daemon.ptm._conductors.values()))
+                assert conductor.flight is None
+                assert daemon.flight_recorder.index() == []
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+
+class TestOneTraceEndToEnd:
+    def test_trace_spans_sched_decision_fetch_and_hbm(self, tmp_path):
+        """ONE trace id covers the scheduler's register decision (joined
+        over gRPC metadata), the piece fetches (joined over the piece
+        HTTP header), and the HBM landing; and the flight summary rode
+        the terminal PeerResult into the scheduler's cluster view."""
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.common import tracing
+        from dragonfly2_tpu.daemon.config import (
+            SchedulerConfig as DaemonSchedCfg, TracingConfig)
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DeviceSink, DownloadRequest
+        from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+        from dragonfly2_tpu.scheduler.config import SeedPeerAddr
+
+        trace_path = str(tmp_path / "traces.jsonl")
+        old_tracer = tracing.TRACER
+        tracing.TRACER = tracing.Tracer()
+        tracing.configure = tracing.TRACER.configure
+
+        async def go():
+            data = os.urandom((10 << 20) + 777)     # 3 pieces
+            origin, base = await start_origin({"w.bin": data})
+            url = f"{base}/w.bin"
+            seed_cfg = daemon_config(tmp_path, "seed")
+            seed_cfg.is_seed = True
+            seed = Daemon(seed_cfg)
+            await seed.start()
+            sched = Scheduler(SchedulerConfig(
+                tracing_jsonl=trace_path,
+                seed_peers=[SeedPeerAddr(
+                    ip="127.0.0.1", rpc_port=seed.rpc.port,
+                    download_port=seed.upload_server.port)]))
+            await sched.start()
+            leech_cfg = daemon_config(tmp_path, "leech")
+            leech_cfg.scheduler = DaemonSchedCfg(
+                addresses=[sched.address], schedule_timeout_s=20.0)
+            leech_cfg.tracing = TracingConfig(enabled=True,
+                                              jsonl_path=trace_path)
+            leech = Daemon(leech_cfg)
+            await leech.start()
+            try:
+                async for _ in leech.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "out.bin"),
+                        disable_back_source=True, timeout_s=60.0,
+                        device_sink=DeviceSink(enabled=True))):
+                    pass
+                assert (tmp_path / "out.bin").read_bytes() == data
+                task_id = next(iter(leech.ptm._conductors))
+                conductor = leech.ptm.conductor(task_id)
+                assert conductor.traffic_p2p == len(data)
+                # flight summary reached the scheduler's cluster view on
+                # the terminal PeerResult (trails the client done event)
+                for _ in range(100):
+                    snap = sched.service.cluster.snapshot()
+                    host = snap["hosts"].get("leech-127.0.0.1")
+                    if host is not None and host["flights"] > 0:
+                        break
+                    await asyncio.sleep(0.05)
+                assert host is not None and host["flights"] == 1
+                assert host["last_flight"]["task_id"] == task_id
+                assert host["last_flight"]["state"] == "success"
+                assert snap["back_to_source_ratio"] == 0.0
+            finally:
+                tracing.TRACER.flush()
+                await leech.stop()
+                await sched.stop()
+                await seed.stop()
+                await origin.cleanup()
+
+        try:
+            asyncio.run(go())
+            rows = [json.loads(ln) for ln in open(trace_path)]
+            by_name: dict[str, list] = {}
+            for r in rows:
+                by_name.setdefault(r["name"], []).append(r)
+            for needed in ("peertask", "sched.register", "sched.offer",
+                           "piece.download", "upload.serve", "hbm.ingest"):
+                assert needed in by_name, (needed, sorted(by_name))
+            # the leecher's peertask trace id threads every layer
+            task_traces = {r["trace_id"] for r in by_name["peertask"]}
+            for name in ("sched.register", "sched.offer", "piece.download",
+                         "upload.serve", "hbm.ingest"):
+                joined = {r["trace_id"] for r in by_name[name]}
+                assert joined & task_traces, (name, joined, task_traces)
+        finally:
+            tracing.TRACER.flush()
+            tracing.TRACER = old_tracer
+            tracing.configure = old_tracer.configure
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -40,9 +40,22 @@ async def debug_stacks(_r: web.Request) -> web.Response:
     buf.write("--- asyncio tasks ---\n")
     for task in asyncio.all_tasks():
         buf.write(f"{task.get_name()}: {task.get_coro()}\n")
-        for entry in task.get_stack(limit=4):
-            buf.write(f"  {entry.f_code.co_filename}:{entry.f_lineno} "
-                      f"{entry.f_code.co_name}\n")
+        # walk the await chain by hand: Task.get_stack only reports the
+        # outermost coroutine frame, which hides WHERE a deep await is
+        # actually parked (the exact thing a hang diagnosis needs)
+        coro, depth = task.get_coro(), 0
+        while coro is not None and depth < 16:
+            frame = (getattr(coro, "cr_frame", None)
+                     or getattr(coro, "gi_frame", None))
+            if frame is not None:
+                buf.write(f"  {frame.f_code.co_filename}:{frame.f_lineno} "
+                          f"{frame.f_code.co_name}\n")
+            nxt = (getattr(coro, "cr_await", None)
+                   or getattr(coro, "gi_yieldfrom", None))
+            if nxt is None and frame is None:
+                break
+            coro = nxt
+            depth += 1
     return web.Response(text=buf.getvalue())
 
 

@@ -1,0 +1,298 @@
+"""Deterministic fault-injection plane: named sites, scripted faults.
+
+Role parity: none in the reference — Dragonfly2 tests its failure ladders
+with ad-hoc mocks per suite. At pod scale the retry/failover behaviour IS
+the product (a single stalled input shard stalls the whole training step),
+so this repo gives every layer a named injection site that tests and the
+stress tool can arm with deterministic scripts:
+
+    site            fired from
+    --------------  ----------------------------------------------------
+    rpc.unary       rpc/client.py ServiceClient.unary (before the stub)
+    rpc.stream.read rpc/client.py stream read halves
+    piece.wire      daemon/piece_downloader.py body read (inside the
+                    request's timeout window, so 'hang' trips the
+                    per-piece deadline exactly like a wedged parent)
+    source.fetch    source/client.py module-level download()
+    hbm.ingest      tpu/hbm_sink.py DeviceIngest.write (sync path)
+    sched.register  daemon/scheduler_session.py register, keyed by the
+                    scheduler address under attempt
+
+Script syntax (one clause per site, ';'-separated)::
+
+    site[@keysub]=kind[:arg]...
+    kind := fail | error | delay | hang | corrupt
+    arg  := n=<count|-1>        fire count, -1 = forever   (default 1)
+            code=<Code name|int>  DFError code raised      (default UNAVAILABLE)
+            after_ms=<ms>       retry_after_ms hint on the raised error
+            delay_s=<seconds>   sleep length for kind=delay
+            <float>             positional shorthand for delay_s
+            <int>               positional shorthand for n
+
+Examples::
+
+    sched.register@127.0.0.1:9000=fail:n=-1      # that scheduler is dead
+    source.fetch=error:code=SOURCE_ERROR:after_ms=400   # origin 503 once
+    piece.wire=hang:n=1                          # parent wedges mid-piece
+    piece.wire=corrupt:n=1                       # digest-mismatch once
+    rpc.unary=fail:n=2                           # fail twice, then succeed
+
+Overhead contract: every call site guards with ``if faultgate.ARMED:`` —
+one module-attribute load and a falsy test when disarmed; the module is
+never entered on the hot path of a production process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+
+from .errors import Code, DFError
+from .metrics import REGISTRY
+
+log = logging.getLogger("df.faultgate")
+
+# The site registry. Arming an unknown site is an error, and the tier-1
+# lint (tests/test_faults.py) asserts every name here is both fired
+# somewhere in the tree and documented in docs/RESILIENCE.md.
+SITES = frozenset({
+    "rpc.unary",
+    "rpc.stream.read",
+    "piece.wire",
+    "source.fetch",
+    "hbm.ingest",
+    "sched.register",
+})
+
+KINDS = frozenset({"fail", "error", "delay", "hang", "corrupt"})
+
+# fast-path flag: True iff at least one script is armed
+ARMED = False
+
+_injected = REGISTRY.counter("df_fault_injected_total",
+                             "faults injected by the faultgate plane",
+                             ("site", "kind"))
+
+
+class FaultScript:
+    """One armed fault at one site, optionally key-scoped."""
+
+    __slots__ = ("site", "kind", "key", "n", "code", "after_ms", "delay_s",
+                 "fired")
+
+    def __init__(self, site: str, kind: str, *, key: str = "", n: int = 1,
+                 code: Code = Code.UNAVAILABLE, after_ms: int = 0,
+                 delay_s: float = 0.5):
+        if site not in SITES:
+            raise ValueError(f"unknown faultgate site {site!r} "
+                             f"(known: {sorted(SITES)})")
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(known: {sorted(KINDS)})")
+        self.site = site
+        self.kind = kind
+        self.key = key
+        self.n = n              # remaining fires; -1 = forever
+        self.code = Code(code)
+        self.after_ms = int(after_ms)
+        self.delay_s = float(delay_s)
+        self.fired = 0
+
+    def matches(self, key: str) -> bool:
+        return self.n != 0 and (not self.key or self.key in key)
+
+    def consume(self) -> None:
+        self.fired += 1
+        if self.n > 0:
+            self.n -= 1
+
+    def describe(self) -> dict:
+        return {"site": self.site, "kind": self.kind, "key": self.key,
+                "remaining": self.n, "fired": self.fired,
+                "code": self.code.name, "after_ms": self.after_ms,
+                "delay_s": self.delay_s}
+
+
+_scripts: list[FaultScript] = []
+_lock = threading.Lock()   # hbm.ingest fires from the sink's caller thread
+
+
+def _recompute_armed() -> None:
+    global ARMED
+    ARMED = any(s.n != 0 for s in _scripts)
+
+
+def arm(site: str, kind: str, **kwargs) -> FaultScript:
+    """Arm one scripted fault; returns the script (live counters)."""
+    script = FaultScript(site, kind, **kwargs)
+    with _lock:
+        _scripts.append(script)
+        _recompute_armed()
+    log.info("faultgate armed: %s", script.describe())
+    return script
+
+
+def arm_script(text: str) -> list[FaultScript]:
+    """Arm from the textual syntax (see module docstring)."""
+    armed = []
+    for clause in text.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        head, _, spec = clause.partition("=")
+        if not spec:
+            raise ValueError(f"bad faultgate clause {clause!r} "
+                             "(want site[@key]=kind[:arg]...)")
+        site, _, key = head.partition("@")
+        parts = spec.split(":")
+        kind = parts[0].strip()
+        kwargs: dict = {"key": key.strip()}
+        for arg in parts[1:]:
+            arg = arg.strip()
+            if not arg:
+                continue
+            name, eq, value = arg.partition("=")
+            if not eq:
+                # positional: float -> delay_s, int -> n
+                if "." in name:
+                    kwargs["delay_s"] = float(name)
+                else:
+                    kwargs["n"] = int(name)
+                continue
+            if name == "n":
+                kwargs["n"] = int(value)
+            elif name == "code":
+                kwargs["code"] = (Code[value] if not value.lstrip("-").isdigit()
+                                  else Code(int(value)))
+            elif name == "after_ms":
+                kwargs["after_ms"] = int(value)
+            elif name == "delay_s":
+                kwargs["delay_s"] = float(value)
+            else:
+                raise ValueError(f"unknown faultgate arg {name!r} in {clause!r}")
+        armed.append(arm(site.strip(), kind, **kwargs))
+    return armed
+
+
+def reset() -> None:
+    """Disarm everything (tests call this in teardown)."""
+    with _lock:
+        _scripts.clear()
+        _recompute_armed()
+
+
+def status() -> dict:
+    with _lock:
+        return {"armed": ARMED, "scripts": [s.describe() for s in _scripts]}
+
+
+def _claim(site: str, key: str, *, kinds: frozenset | None = None
+           ) -> FaultScript | None:
+    """Find-and-consume the first matching armed script."""
+    with _lock:
+        for s in _scripts:
+            if s.site == site and s.matches(key) and (
+                    kinds is None or s.kind in kinds):
+                s.consume()
+                _recompute_armed()
+                return s
+    return None
+
+
+_RAISING = frozenset({"fail", "error"})
+_ASYNC_KINDS = frozenset({"fail", "error", "delay", "hang"})
+
+
+def _raise(script: FaultScript) -> None:
+    err = DFError(script.code,
+                  f"faultgate[{script.site}]: injected {script.kind}")
+    if script.after_ms:
+        err.retry_after_ms = script.after_ms
+    raise err
+
+
+async def fire(site: str, key: str = "") -> None:
+    """Fire at an async site. fail/error raise a DFError (error carries a
+    retry_after_ms hint), delay sleeps, hang parks until the caller's own
+    deadline cancels it. 'corrupt' scripts are not consumed here — they
+    belong to maybe_corrupt()."""
+    script = _claim(site, key, kinds=_ASYNC_KINDS)
+    if script is None:
+        return
+    _injected.labels(site, script.kind).inc()
+    log.info("faultgate fired: %s key=%r", script.describe(), key)
+    if script.kind in _RAISING:
+        _raise(script)
+    elif script.kind == "delay":
+        await asyncio.sleep(script.delay_s)
+    elif script.kind == "hang":
+        await asyncio.sleep(3600.0)   # parked; the site's deadline cancels us
+
+
+def fire_sync(site: str, key: str = "") -> None:
+    """Sync-path variant (hbm.ingest): fail/error raise; delay blocks the
+    calling thread; hang is treated as fail (a sync site cannot park
+    cancellably)."""
+    script = _claim(site, key, kinds=_ASYNC_KINDS)
+    if script is None:
+        return
+    _injected.labels(site, script.kind).inc()
+    log.info("faultgate fired (sync): %s key=%r", script.describe(), key)
+    if script.kind == "delay":
+        time.sleep(script.delay_s)
+        return
+    _raise(script)
+
+
+def corrupt(site: str, data: bytes, key: str = "") -> bytes:
+    """Consume one 'corrupt' script if armed for (site, key): flips a byte
+    so digest verification downstream fails deterministically. Returns the
+    (possibly corrupted) bytes."""
+    script = _claim(site, key, kinds=frozenset({"corrupt"}))
+    if script is None:
+        return data
+    _injected.labels(site, script.kind).inc()
+    log.info("faultgate corrupting %d bytes at %s key=%r", len(data), site,
+             key)
+    if not data:
+        return data
+    buf = bytearray(data)
+    buf[0] ^= 0xFF
+    return bytes(buf)
+
+
+def add_fault_routes(router) -> None:
+    """Debug control surface (mounted on the daemon upload server when
+    ``upload.debug_endpoints`` is on — arming faults mutates live behaviour
+    so it stays off the always-on surface):
+
+        GET    /debug/faults   -> {"armed": bool, "scripts": [...]}
+        POST   /debug/faults   -> body is a script string; arms it
+        DELETE /debug/faults   -> reset()
+    """
+    import json
+
+    from aiohttp import web
+
+    async def get_faults(_r: web.Request) -> web.Response:
+        return web.json_response(status())
+
+    async def post_faults(request: web.Request) -> web.Response:
+        text = (await request.text()).strip()
+        try:
+            armed = arm_script(text)
+        except (ValueError, KeyError) as exc:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": str(exc)}),
+                content_type="application/json")
+        return web.json_response({"armed": [s.describe() for s in armed]})
+
+    async def delete_faults(_r: web.Request) -> web.Response:
+        reset()
+        return web.json_response(status())
+
+    router.add_get("/debug/faults", get_faults)
+    router.add_post("/debug/faults", post_faults)
+    router.add_delete("/debug/faults", delete_faults)

@@ -119,11 +119,15 @@ class PeerTaskConductor:
                 if self.flight is not None and self._session is not None:
                     self.flight.event(fr.REGISTERED)
                 if self._session is not None and self._p2p_engine is not None:
+                    if self.flight is not None:
+                        self.flight.rung(fr.RUNG_P2P)
                     used_p2p = await self._p2p_engine.pull(self, self._session)
             if not used_p2p:
                 if self.disable_back_source:
                     raise DFError(Code.CLIENT_BACK_SOURCE_ERROR,
                                   "no P2P path and back-source disabled")
+                if self.flight is not None:
+                    self.flight.rung(fr.RUNG_BACK_SOURCE)
                 self.log.info("back-source: %s", self.url)
                 await self.piece_mgr.download_source(self)
             await self._finish_success()
@@ -371,6 +375,9 @@ class PeerTaskConductor:
         self.fail_code = code
         self.fail_message = message
         if self.flight is not None:
+            # ladder exhausted: the fail rung makes the terminal verdict
+            # part of the journal, not just the PeerResult code
+            self.flight.rung(fr.RUNG_FAIL)
             self.flight.finish(self.FAILED)
         if self.device_ingest is not None:
             self.device_ingest.close()

@@ -17,6 +17,12 @@ class SchedulerConfig:
     register_timeout_s: float = 10.0
     schedule_timeout_s: float = 30.0       # max wait for a usable peer packet
     max_reschedule: int = 5                # reference RetryLimit
+    # register failover ladder (docs/RESILIENCE.md): a dead hashed
+    # scheduler fails over to the next ring members before the task goes
+    # to origin, and the dead address is demoted for demote_s so later
+    # tasks skip it until a probe revives it
+    failover_n: int = 3                    # ring members tried per register
+    demote_s: float = 30.0                 # sticky demotion window
     # manager-discovered scheduler set refresh cadence (reference daemon
     # dynconfig refresh): 0 disables. A scheduler replaced — or one that
     # registers AFTER this daemon booted — must reach daemons without a

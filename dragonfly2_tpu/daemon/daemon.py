@@ -272,7 +272,9 @@ class Daemon:
         elif self.cfg.scheduler.addresses:
             self.scheduler = SchedulerConnector(
                 self.cfg.scheduler.addresses, self.host_info(),
-                register_timeout_s=self.cfg.scheduler.register_timeout_s)
+                register_timeout_s=self.cfg.scheduler.register_timeout_s,
+                failover_n=self.cfg.scheduler.failover_n,
+                demote_s=self.cfg.scheduler.demote_s)
         elif self.cfg.manager_addresses:
             await self._attach_manager()
         self.ptm.scheduler = self.scheduler
@@ -334,7 +336,9 @@ class Daemon:
             if addrs:
                 self.scheduler = SchedulerConnector(
                     addrs, self.host_info(),
-                    register_timeout_s=self.cfg.scheduler.register_timeout_s)
+                    register_timeout_s=self.cfg.scheduler.register_timeout_s,
+                    failover_n=self.cfg.scheduler.failover_n,
+                    demote_s=self.cfg.scheduler.demote_s)
             else:
                 log.info("manager knows no active schedulers; back-source "
                          "only until the refresh loop finds one")
@@ -384,7 +388,9 @@ class Daemon:
                     self.scheduler = SchedulerConnector(
                         addrs, self.host_info(),
                         register_timeout_s=self.cfg.scheduler
-                        .register_timeout_s)
+                        .register_timeout_s,
+                        failover_n=self.cfg.scheduler.failover_n,
+                        demote_s=self.cfg.scheduler.demote_s)
                     if self.ptm is not None:
                         self.ptm.scheduler = self.scheduler
                     await self._wire_scheduler_extras()

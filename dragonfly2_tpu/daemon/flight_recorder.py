@@ -42,6 +42,16 @@ HBM_DONE = "hbm_done"        # piece staged for the device sink
 REGISTERED = "registered"    # scheduler register returned
 HBM_SHARD = "hbm_shard"      # one device DMA completed (piece = shard idx)
 DONE = "done"                # task reached a terminal state
+RUNG = "rung"                # degradation-ladder transition (parent = rung)
+
+# the conductor's five-rung degradation ladder (docs/RESILIENCE.md): the
+# rung event's parent field names which rung the task just entered, so
+# dfdiag can show which rung ultimately served a slow task
+RUNG_P2P = "p2p"                      # scheduler gave parents; mesh pull
+RUNG_RESCHEDULE = "reschedule"        # parents died; waiting re-assignment
+RUNG_RING_FAILOVER = "ring_failover"  # hashed scheduler dead; next member
+RUNG_BACK_SOURCE = "back_source"      # fetching from origin
+RUNG_FAIL = "fail"                    # ladder exhausted; coded verdict
 
 ORIGIN = ""                  # parent id of a back-to-source fetch
 
@@ -51,7 +61,7 @@ class TaskFlight:
     bytes, dur_ms)`` tuples relative to the flight's start."""
 
     __slots__ = ("task_id", "peer_id", "started_at", "_m0", "events",
-                 "state", "url")
+                 "state", "url", "report_drops")
 
     def __init__(self, task_id: str, peer_id: str, *, url: str = "",
                  max_events: int = 4096):
@@ -62,6 +72,10 @@ class TaskFlight:
         self._m0 = time.monotonic()
         self.events: deque = deque(maxlen=max_events)
         self.state = "running"
+        # piece reports dropped because the scheduler stream's writer died
+        # (scheduler_session.report_piece) — a silent drop becomes a ghost
+        # peer on the scheduler, so the count rides the flight summary
+        self.report_drops = 0
 
     # -- recording (hot path) ------------------------------------------
 
@@ -81,6 +95,10 @@ class TaskFlight:
     def finish(self, state: str) -> None:
         self.state = state
         self.event(DONE)
+
+    def rung(self, name: str) -> None:
+        """Journal a degradation-ladder transition (RUNG_* constants)."""
+        self.event(RUNG, parent=name)
 
     def hbm_spans(self, spans: list) -> None:
         """Adopt a DeviceIngest's completed transfer spans ((monotonic
@@ -109,10 +127,17 @@ class TaskFlight:
         latencies, back-to-source ratio."""
         pieces: dict[int, dict] = {}
         parents: dict[str, dict] = {}
+        rungs: list[str] = []
         hbm_dma_ms = 0.0
         for t, stage, piece, parent, nbytes, dur in self.events:
             if stage == HBM_SHARD:
                 hbm_dma_ms += dur
+                continue
+            if stage == RUNG:
+                # dedupe consecutive repeats (reschedule can re-fire while
+                # the same outage is still in progress)
+                if not rungs or rungs[-1] != parent:
+                    rungs.append(parent)
                 continue
             if piece < 0:
                 continue
@@ -196,6 +221,12 @@ class TaskFlight:
                         "p90": _pctl(totals, 0.90),
                         "p99": _pctl(totals, 0.99)},
             "hbm_dma_ms": round(hbm_dma_ms, 3),
+            # the degradation-ladder trail and the rung the task ended on —
+            # dfdiag's verdict names it so "why did this go to origin"
+            # never needs log spelunking
+            "rungs": rungs,
+            "served_rung": rungs[-1] if rungs else "",
+            "report_drops": self.report_drops,
             "piece_rows": piece_rows,
         }
         total_bytes = summary["bytes_p2p"] + summary["bytes_source"]

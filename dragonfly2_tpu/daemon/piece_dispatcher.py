@@ -238,6 +238,8 @@ class PieceDispatcher:
         """Known parents keep their state. An ejected parent stays ejected
         unless ``resurrect`` (an explicit scheduler re-assignment) — piece
         announcements must NOT revive a parent the failure limit removed."""
+        if self._closed:     # teardown in progress: don't queue on a lock
+            return ParentState(peer_id, addr, is_seed=is_seed, link=link)
         async with self._cond:
             st = self.parents.get(peer_id)
             if st is None or (st.ejected and resurrect):
@@ -267,6 +269,8 @@ class PieceDispatcher:
                 and st.total_fails >= PARENT_FAIL_HARD_LIMIT)
 
     async def remove_parent(self, peer_id: str) -> None:
+        if self._closed:
+            return
         async with self._cond:
             st = self.parents.get(peer_id)
             if st is not None:
@@ -279,6 +283,8 @@ class PieceDispatcher:
 
     async def announce(self, parent_id: str, infos: list[PieceInfo]) -> None:
         """Parent reports it holds these pieces."""
+        if self._closed:
+            return
         async with self._cond:
             notify = False
             for info in infos:
@@ -299,8 +305,16 @@ class PieceDispatcher:
                 self._cond.notify_all()
 
     async def close(self) -> None:
+        # already-closed short-circuit BEFORE touching the lock: teardown
+        # calls close() more than once (engine finally + _teardown), and a
+        # worker cancelled inside cond.wait can leave the condition lock
+        # held by its orphaned waiter (3.10 wait_for+Condition hazard) —
+        # the second close must never queue on that lock
+        if self._closed:
+            return
+        self._closed = True       # visible immediately, even if the
+        # notify below has to wait for the lock
         async with self._cond:
-            self._closed = True
             self._cond.notify_all()
 
     # ------------------------------------------------------------------
@@ -496,11 +510,24 @@ class PieceDispatcher:
                     return "seed_busy_s"
         return "other_s"
 
+    async def _notified(self) -> None:
+        """One atomic acquire+wait: the lock scope and the cond.wait live
+        in a SINGLE coroutine, so when wait_for cancels it the unwind
+        releases the lock it re-acquired. The previous shape —
+        ``wait_for(self._cond.wait(), t)`` under the caller's ``async
+        with`` — split them across two tasks; a worker cancelled while
+        parked there orphaned the inner Condition.wait, which re-acquired
+        the condition lock in its finally and died HOLDING it. Every later
+        acquirer (close(), add_parent, the teardown gather) then queued on
+        the poisoned lock forever — the fake-pod silent hang."""
+        async with self._cond:
+            await self._cond.wait()
+
     async def get(self, timeout: float | None = None) -> Dispatch | None:
         """Next (piece, parent) to fetch; None when closed or timed out."""
         deadline = time.monotonic() + timeout if timeout else None
-        async with self._cond:
-            while True:
+        while True:
+            async with self._cond:
                 if self._closed:
                     return None
                 d = self._pick()
@@ -538,14 +565,21 @@ class PieceDispatcher:
                 if wake is not None:
                     remaining = min(remaining or wake, wake)
                 reason = self._wait_reason()
-                t_wait = time.monotonic()
-                try:
-                    await asyncio.wait_for(self._cond.wait(), remaining)
-                except asyncio.TimeoutError:
-                    if deadline is not None and time.monotonic() >= deadline:
-                        return None
-                finally:
-                    self.wait_stats[reason] += time.monotonic() - t_wait
+            # the wait runs OUTSIDE the pick's lock scope (see _notified):
+            # a notify landing in the released gap is missed, which costs
+            # at most one `remaining` pause — the loop re-picks after every
+            # wake, so correctness only needs the timeout
+            t_wait = time.monotonic()
+            try:
+                # 0.5s cap even for untimed callers: a notify landing in
+                # the released gap must cost a bounded re-pick, not a hang
+                await asyncio.wait_for(self._notified(),
+                                       0.5 if remaining is None else remaining)
+            except asyncio.TimeoutError:
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+            finally:
+                self.wait_stats[reason] += time.monotonic() - t_wait
 
     async def report_busy(self, d: Dispatch,
                           retry_after_ms: int = 0) -> None:
@@ -560,6 +594,8 @@ class PieceDispatcher:
         measured-transfer-time hint is used when present; otherwise the
         backoff doubles per consecutive busy. Jitter de-synchronizes the
         children so the slot race doesn't re-storm on expiry."""
+        if self._closed:
+            return
         async with self._cond:
             d.parent.inflight = max(0, d.parent.inflight - 1)
             d.parent.consecutive_busy += 1
@@ -583,6 +619,8 @@ class PieceDispatcher:
         """Outcome of one dispatch. ``completed`` narrows success to a
         subset of the group's piece nums (mid-group digest mismatch);
         ``cost_ms`` covers the whole transfer."""
+        if self._closed:
+            return
         async with self._cond:
             d.parent.inflight = max(0, d.parent.inflight - 1)
             done_nums = set(completed) if completed is not None else (

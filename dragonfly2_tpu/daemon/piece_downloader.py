@@ -12,13 +12,14 @@ one RTT and three per piece.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 
 import aiohttp
 
 from ..common import digest as digestlib
-from ..common import tracing
+from ..common import faultgate, tracing
 from ..common.errors import Code, DFError
 from ..idl.messages import PieceInfo
 
@@ -69,10 +70,18 @@ class PieceDownloader:
         traversal of a 4-16 MiB piece just to hash it — per-byte CPU is
         the fan-out ceiling on core-bound hosts. ``on_first`` fires once
         when the first body chunk lands (flight-recorder ttfb)."""
+        if faultgate.ARMED:
+            # inside the request's timeout window: a 'hang' script parks
+            # here until the per-piece deadline cancels the read, exactly
+            # like a parent that wedged mid-transfer; 'corrupt' flips a
+            # byte BEFORE hashing so digest verification trips downstream
+            await faultgate.fire("piece.wire", key=what)
         buf = bytearray(size)
         mv = memoryview(buf)
         off = 0
         async for chunk in resp.content.iter_any():
+            if off == 0 and faultgate.ARMED:
+                chunk = faultgate.corrupt("piece.wire", chunk, key=what)
             if off == 0 and on_first is not None:
                 on_first()
                 on_first = None
@@ -110,7 +119,8 @@ class PieceDownloader:
         if piece.digest:
             algo, want = digestlib.parse(piece.digest)
         t0 = time.monotonic()
-        try:
+
+        async def fetch():
             async with self._get_session().get(
                     url, headers=headers,
                     params={"peerId": src_peer_id}) as resp:
@@ -132,8 +142,20 @@ class PieceDownloader:
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                         f"{what}: HTTP {resp.status}")
                 hasher = digestlib.Hasher(algo) if algo else None
-                data = await self._read_body(resp, size, hasher, what,
+                body = await self._read_body(resp, size, hasher, what,
                                              on_first=on_first_byte)
+                return body, hasher
+
+        try:
+            # hard per-piece deadline OUTSIDE aiohttp: the session's total
+            # timeout only interrupts aiohttp's own awaits, so a parent (or
+            # an injected piece.wire hang) that wedges BETWEEN body reads
+            # would stall the worker forever without this
+            data, hasher = await asyncio.wait_for(fetch(), self.timeout_s)
+        except asyncio.TimeoutError:
+            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                          f"{what}: per-piece deadline "
+                          f"({self.timeout_s:.0f}s)") from None
         except DFError:
             raise
         except Exception as exc:  # noqa: BLE001 - network boundary
@@ -175,7 +197,8 @@ class PieceDownloader:
             headers["traceparent"] = tp
         what = f"parent {dst_addr} span @{start}+{size}"
         t0 = time.monotonic()
-        try:
+
+        async def fetch():
             async with self._get_session().get(
                     url, headers=headers,
                     params={"peerId": src_peer_id}) as resp:
@@ -192,8 +215,16 @@ class PieceDownloader:
                     raise DFError(
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                         f"{what}: HTTP {resp.status}")
-                data = await self._read_body(resp, size, None, what,
+                return await self._read_body(resp, size, None, what,
                                              on_first=on_first_byte)
+
+        try:
+            # same hard per-span deadline as download_piece (see there)
+            data = await asyncio.wait_for(fetch(), self.timeout_s)
+        except asyncio.TimeoutError:
+            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                          f"{what}: per-piece deadline "
+                          f"({self.timeout_s:.0f}s)") from None
         except DFError:
             raise
         except Exception as exc:  # noqa: BLE001 - network boundary

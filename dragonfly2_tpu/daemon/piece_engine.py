@@ -269,7 +269,10 @@ class PieceEngine:
                     <= ENDGAME_PIECES)
                 if not self.dispatcher.has_live_parent():
                     # parents gone: give the scheduler a grace period to
-                    # re-assign, then fall back to origin
+                    # re-assign, then fall back to origin — the reschedule
+                    # rung journals that this task is riding out an outage
+                    if conductor.flight is not None:
+                        conductor.flight.rung(fr.RUNG_RESCHEDULE)
                     try:
                         await asyncio.wait_for(
                             self._wait_parent_change(),
@@ -277,27 +280,49 @@ class PieceEngine:
                     except asyncio.TimeoutError:
                         log.info("parents exhausted; back-source for the rest")
                         return False
+                    if conductor.flight is not None:
+                        conductor.flight.rung(fr.RUNG_P2P)
                     continue
-                # progress tick: piece arrivals notify the conductor's cond
-                async with conductor._piece_cond:
-                    try:
-                        await asyncio.wait_for(conductor._piece_cond.wait(),
-                                               0.25)
-                    except asyncio.TimeoutError:
-                        pass
+                # progress tick: piece arrivals notify the conductor's cond.
+                # The acquire and the wait live in ONE wrapped coroutine so
+                # wait_for's cancellation unwinds them atomically — a bare
+                # wait_for(cond.wait(), t) splits them across tasks, and the
+                # orphaned waiter can die holding the condition lock (the
+                # same 3.10 hazard documented at the teardown below)
+                try:
+                    await asyncio.wait_for(self._piece_tick(conductor), 0.25)
+                except asyncio.TimeoutError:
+                    pass
         finally:
+            # close the dispatcher BEFORE cancelling the workers, not just
+            # before gathering them. Two distinct 3.10 asyncio hazards meet
+            # here:
+            #   * a cancel delivered in the same loop tick as a cond notify
+            #     (the last piece's report) is swallowed by asyncio.wait_for
+            #     (lost-cancellation), and the unbounded gather below then
+            #     waits forever on an undead worker — with the dispatcher
+            #     closed, such a worker's next get() returns None and it
+            #     exits via the closed path;
+            #   * cancelling a worker PARKED in get()'s wait_for(cond.wait)
+            #     orphans the inner Condition.wait task, which re-acquires
+            #     the condition lock in its finally and can die HOLDING it —
+            #     a close() issued after that cancel then queues on the
+            #     poisoned lock forever (the fake-pod silent-hang: conductor
+            #     stuck in dispatcher.close, zero log output). Closing first
+            #     lets close() take the lock while it is still healthy;
+            #     workers then wake via the notify and exit cleanly, and the
+            #     dispatcher's closed short-circuits keep any late caller
+            #     off the lock entirely.
+            await self.dispatcher.close()
             packet_task.cancel()
             for w in workers:
                 w.cancel()
-            # close the dispatcher BEFORE awaiting the workers: a cancel
-            # delivered in the same loop tick as a cond notify (the last
-            # piece's report) is swallowed by asyncio.wait_for (the 3.10
-            # lost-cancellation bug), and the unbounded gather below then
-            # waits forever on an undead worker. With the dispatcher
-            # closed, such a worker's next get() returns None and it exits
-            # via the closed path — every mesh e2e hung on this without it.
-            await self.dispatcher.close()
             await asyncio.gather(packet_task, *workers, return_exceptions=True)
+
+    @staticmethod
+    async def _piece_tick(conductor) -> None:
+        async with conductor._piece_cond:
+            await conductor._piece_cond.wait()
 
     async def _wait_parent_change(self) -> None:
         cond = self.dispatcher._cond

@@ -20,13 +20,36 @@ from ..common.errors import Code, DFError
 from ..common.piece import (INGEST_DMA_UNIT_BYTES, Range, compute_piece_size,
                             piece_count, piece_range)
 from ..common.rate import TokenBucket
+from ..common.retry import Retrier, RetryPolicy
 from ..source import SourceRequest, client_for
+from ..source import download as source_download
 from .config import DownloadConfig
 
 if TYPE_CHECKING:  # pragma: no cover
     from .conductor import PeerTaskConductor
 
 log = logging.getLogger("df.core.piece")
+
+# back-to-source fetch ladder: transient origin failures (5xx, transport)
+# retry under ONE policy, honoring the origin's Retry-After hint when it
+# sent one; NOT_FOUND/AUTH are verdicts, not weather, and fail immediately
+_SOURCE_RETRY = RetryPolicy(max_attempts=3, base_s=0.5, max_s=8.0,
+                            budget_s=60.0)
+
+
+def _transient_source(exc: BaseException) -> bool:
+    return (isinstance(exc, DFError)
+            and exc.code in (Code.SOURCE_ERROR, Code.UNAVAILABLE,
+                             Code.DEADLINE_EXCEEDED))
+
+
+async def _open_source(req: SourceRequest):
+    """Open an origin stream with the unified retry/backoff policy. Only
+    the OPEN retries here: pieces already landed from a stream that died
+    midway are deduped at landing, so callers that restart a whole group
+    stay correct without double-counting."""
+    return await Retrier(_SOURCE_RETRY).run(
+        lambda: source_download(req), retryable=_transient_source)
 
 
 class PieceManager:
@@ -70,7 +93,7 @@ class PieceManager:
                      if conductor.content_range is not None else total)
 
         if effective < 0:
-            await self._download_unknown_length(conductor, client, req)
+            await self._download_unknown_length(conductor, req)
             return
 
         piece_size = conductor.set_content_info(effective)
@@ -80,14 +103,14 @@ class PieceManager:
             await self._download_piece_groups(conductor, req, effective,
                                               piece_size, n)
         else:
-            await self._download_stream(conductor, client, req, piece_size,
+            await self._download_stream(conductor, req, piece_size,
                                         start_piece=0)
         conductor.on_source_complete(effective)
 
-    async def _download_stream(self, conductor, client, req: SourceRequest,
+    async def _download_stream(self, conductor, req: SourceRequest,
                                piece_size: int, start_piece: int) -> None:
         """One origin stream, cut into pieces as bytes arrive."""
-        resp = await client.download(req)
+        resp = await _open_source(req)
         num = start_piece
         buf = bytearray()
         rel = 0  # offsets are range-relative: the task stores just its range
@@ -149,8 +172,7 @@ class PieceManager:
             g_range = Range(base + g_off, g_end_off + g_end_len - g_off)
             sub = SourceRequest(url=req.url, header=dict(req.header),
                                range=g_range, timeout_s=req.timeout_s)
-            client = client_for(req.url)
-            resp = await client.download(sub)
+            resp = await _open_source(sub)
             num = first
             rel = g_off
             buf = bytearray()
@@ -187,12 +209,12 @@ class PieceManager:
         if errs:
             raise errs[0]
 
-    async def _download_unknown_length(self, conductor, client,
+    async def _download_unknown_length(self, conductor,
                                        req: SourceRequest) -> None:
         """Origin without Content-Length: stream until EOF, sizes learned at
         the end (reference ``downloadUnknownLengthSource``)."""
         piece_size = conductor.set_content_info(-1)
-        resp = await client.download(req)
+        resp = await _open_source(req)
         num = 0
         off = 0
         buf = bytearray()

@@ -15,10 +15,14 @@ import logging
 import time
 from typing import TYPE_CHECKING
 
+from ..common import faultgate
 from ..common.errors import Code, DFError
+from ..common.metrics import REGISTRY
+from ..common.retry import Retrier, RetryPolicy
 from ..idl.messages import (Host, PeerPacket, PeerResult, PieceResult,
                             RegisterPeerTaskRequest, RegisterResult)
-from ..rpc.client import Channel, ServiceClient
+from ..rpc.client import Channel, RPCError, ServiceClient
+from . import flight_recorder as fr
 
 if TYPE_CHECKING:  # pragma: no cover
     from .conductor import PeerTaskConductor
@@ -26,6 +30,20 @@ if TYPE_CHECKING:  # pragma: no cover
 log = logging.getLogger("df.flow.schedsess")
 
 SCHEDULER_SERVICE = "df.scheduler.Scheduler"
+
+_report_dropped = REGISTRY.counter(
+    "df_sched_report_dropped_total",
+    "piece results dropped because the scheduler report stream died")
+
+# terminal PeerResult / AnnounceHost: one retry with backoff before giving
+# up — a lost terminal report makes the scheduler hold a ghost peer until
+# GC, which is worth one more try but not worth stalling shutdown
+_REPORT_RETRY = RetryPolicy(max_attempts=2, base_s=0.3, max_s=1.0,
+                            budget_s=8.0)
+
+# register transport failures that mean "this scheduler, not this task":
+# the ladder moves to the next ring member instead of going to origin
+_FAILOVER_CODES = (Code.UNAVAILABLE, Code.DEADLINE_EXCEEDED)
 
 
 class PeerSession:
@@ -102,7 +120,14 @@ class PeerSession:
         if self._stream is None or self._closed:
             return
         if self._writer is not None and self._writer.done():
-            # writer died (scheduler went away): don't queue into the void
+            # writer died (scheduler went away): don't queue into the void —
+            # but COUNT it; silent drops leave the scheduler believing this
+            # peer never made progress (ghost-peer GC), and the count rides
+            # the flight summary so dfdiag surfaces it
+            _report_dropped.inc()
+            flight = getattr(self.conductor, "flight", None)
+            if flight is not None:
+                flight.report_drops += 1
             log.debug("report_piece dropped: writer gone")
             return
         self._out.put_nowait(result)
@@ -137,18 +162,30 @@ class PeerSession:
         if conductor is not None and not self._peer_result_sent:
             self._peer_result_sent = True
             flight = getattr(conductor, "flight", None)
+            result = PeerResult(
+                task_id=self.task_id, peer_id=self.peer_id,
+                url=conductor.url, success=success,
+                traffic=conductor.traffic_p2p,
+                cost_ms=int(time.time() * 1000) - conductor.start_ms,
+                code=int(conductor.fail_code),
+                total_piece_count=conductor.total_pieces,
+                content_length=conductor.content_length,
+                flight_summary=(flight.compact_summary()
+                                if flight is not None else None))
             try:
-                await self.client.unary("ReportPeerResult", PeerResult(
-                    task_id=self.task_id, peer_id=self.peer_id,
-                    url=conductor.url, success=success,
-                    traffic=conductor.traffic_p2p,
-                    cost_ms=int(time.time() * 1000) - conductor.start_ms,
-                    code=int(conductor.fail_code),
-                    total_piece_count=conductor.total_pieces,
-                    content_length=conductor.content_length,
-                    flight_summary=(flight.compact_summary()
-                                    if flight is not None else None)),
-                    timeout=5.0)
+                # retried once with backoff: losing the TERMINAL report
+                # leaves the scheduler holding a ghost peer until GC. The
+                # outer Retrier is the ONLY retry layer (max_attempts=1
+                # client) — stacking it on the default 3-attempt client
+                # would burn the whole budget inside attempt one on a
+                # black-holed scheduler and never actually re-send
+                once = ServiceClient(self.client.channel, SCHEDULER_SERVICE,
+                                     max_attempts=1)
+                await Retrier(_REPORT_RETRY).run(
+                    lambda: once.unary("ReportPeerResult", result,
+                                       timeout=5.0),
+                    retryable=lambda exc: not isinstance(exc, DFError)
+                    or exc.code in _FAILOVER_CODES)
             except Exception as exc:  # noqa: BLE001
                 log.debug("ReportPeerResult failed: %s", exc)
 
@@ -158,17 +195,27 @@ class SchedulerConnector:
 
     The conductor treats ``register`` raising SCHED_NEED_BACK_SOURCE /
     UNAVAILABLE / DEADLINE_EXCEEDED as "go to origin" (the reference's
-    fallback ladder at ``peertask_conductor.go:284``).
+    fallback ladder at ``peertask_conductor.go:284``) — but UNAVAILABLE is
+    now a LAST resort: a dead hashed scheduler first fails over to the
+    next ``failover_n`` ring members, and the dead address is stickily
+    demoted so subsequent tasks skip it until the ``demote_s`` window
+    expires (at which point the next task probes it and either revives it
+    or re-demotes). One dead scheduler must not send every task hashed to
+    it to origin while healthy ring members sit idle.
     """
 
     def __init__(self, addresses: list[str], host: Host, *,
-                 register_timeout_s: float = 10.0):
+                 register_timeout_s: float = 10.0, failover_n: int = 3,
+                 demote_s: float = 30.0):
         from ..rpc.balancer import HashRing
         self.addresses = list(addresses)
         self.host = host
         self.register_timeout_s = register_timeout_s
+        self.failover_n = max(1, failover_n)
+        self.demote_s = demote_s
         self._ring = HashRing(self.addresses)
         self._channels: dict[str, Channel] = {}
+        self._demoted: dict[str, float] = {}   # addr -> monotonic revive time
         self._close_tasks: set = set()   # strong refs: the loop only
         # weak-refs tasks, and a GC'd close task leaks its channel
 
@@ -189,6 +236,7 @@ class SchedulerConnector:
             self._ring.add(addr)
         for addr in have - want:
             self._ring.remove(addr)
+            self._demoted.pop(addr, None)
             ch = self._channels.pop(addr, None)
             if ch is not None:
                 t = asyncio.get_running_loop().create_task(ch.close())
@@ -196,44 +244,133 @@ class SchedulerConnector:
                 t.add_done_callback(self._close_tasks.discard)
         self.addresses = list(addresses)
 
-    def _client(self, task_id: str) -> ServiceClient:
-        # consistent-hash the task onto one scheduler address so all peers of
-        # a task converge on the same brain (reference pkg/balancer)
-        addr = self._ring.pick(task_id)
-        if addr is None:
-            raise DFError(Code.UNAVAILABLE, "no scheduler addresses")
+    # -- demotion (sticky failover memory) -----------------------------
+
+    def _alive(self, addr: str) -> bool:
+        until = self._demoted.get(addr)
+        if until is None:
+            return True
+        if time.monotonic() >= until:
+            # probe window: eligible again; the next register against it
+            # either revives it for real or re-demotes it
+            self._demoted.pop(addr, None)
+            return True
+        return False
+
+    def demote(self, addr: str) -> None:
+        self._demoted[addr] = time.monotonic() + self.demote_s
+        log.info("scheduler %s demoted for %.1fs", addr, self.demote_s)
+
+    def revive(self, addr: str) -> None:
+        if self._demoted.pop(addr, None) is not None:
+            log.info("scheduler %s revived", addr)
+
+    def demoted(self) -> set[str]:
+        return {a for a in list(self._demoted) if not self._alive(a)}
+
+    def _candidates(self, key: str) -> list[str]:
+        """Failover order for ``key``: the next-N distinct ring members
+        clockwise from the key's hash, live ones first; demoted addresses
+        stay listed LAST — with every candidate demoted, trying a dead
+        scheduler still beats silently going to origin."""
+        cands = self._ring.pick_n(key, self.failover_n)
+        live = [a for a in cands if self._alive(a)]
+        return live + [a for a in cands if a not in live]
+
+    def _client_at(self, addr: str, *, max_attempts: int = 3) -> ServiceClient:
         ch = self._channels.get(addr)
         if ch is None:
             ch = Channel(addr)
             self._channels[addr] = ch
-        return ServiceClient(ch, SCHEDULER_SERVICE)
+        return ServiceClient(ch, SCHEDULER_SERVICE,
+                             max_attempts=max_attempts)
+
+    def _client(self, task_id: str) -> ServiceClient:
+        # consistent-hash the task onto one scheduler address so all peers of
+        # a task converge on the same brain (reference pkg/balancer),
+        # skipping stickily-demoted members
+        cands = self._candidates(task_id)
+        if not cands:
+            raise DFError(Code.UNAVAILABLE, "no scheduler addresses")
+        return self._client_at(cands[0])
 
     def refresh_host(self, host: Host) -> None:
         self.host = host
 
     async def register(self, conductor: "PeerTaskConductor") -> PeerSession:
-        client = self._client(conductor.task_id)
-        result: RegisterResult = await client.unary(
-            "RegisterPeerTask",
-            RegisterPeerTaskRequest(
-                url=conductor.url, url_meta=conductor.url_meta,
-                task_id=conductor.task_id, peer_id=conductor.peer_id,
-                peer_host=self.host),
-            timeout=self.register_timeout_s)
-        # adopt the scheduler's application-table resolution only when it
-        # actually resolved something: an older scheduler echoes the
-        # LEVEL0 default, which must not clobber an explicit local value
-        if int(result.resolved_priority) != 0:
-            conductor.resolved_priority = int(result.resolved_priority)
-        session = PeerSession(client, result, conductor)
-        await session.open_report_stream()
-        return session
+        """Register around the ring: the hashed scheduler first, then the
+        next ring members (``failover_n`` total) before raising UNAVAILABLE
+        and sending the conductor to origin. Transport-dead members are
+        demoted; scheduler VERDICTS (NeedBackSource, Forbidden...) always
+        propagate from whichever member answered."""
+        cands = self._candidates(conductor.task_id)
+        if not cands:
+            raise DFError(Code.UNAVAILABLE, "no scheduler addresses")
+        flight = getattr(conductor, "flight", None)
+        request = RegisterPeerTaskRequest(
+            url=conductor.url, url_meta=conductor.url_meta,
+            task_id=conductor.task_id, peer_id=conductor.peer_id,
+            peer_host=self.host)
+        last_exc: BaseException | None = None
+        for i, addr in enumerate(cands):
+            # one attempt per member: in-place retries against a dead
+            # address only delay the healthy one clockwise of it
+            client = self._client_at(addr, max_attempts=1)
+            try:
+                if faultgate.ARMED:
+                    # bounded by the register timeout so a 'hang' script
+                    # walks the same deadline -> failover path a wedged
+                    # scheduler would (TimeoutError is caught below)
+                    await asyncio.wait_for(
+                        faultgate.fire("sched.register", key=addr),
+                        self.register_timeout_s)
+                result: RegisterResult = await client.unary(
+                    "RegisterPeerTask", request,
+                    timeout=self.register_timeout_s)
+            except DFError as exc:
+                if exc.code not in _FAILOVER_CODES:
+                    raise          # a verdict, not a dead scheduler
+                self.demote(addr)
+                last_exc = exc
+                log.warning("register on %s failed (%s); trying next ring "
+                            "member", addr, exc.code.name)
+                continue
+            except (RPCError, OSError, asyncio.TimeoutError) as exc:
+                self.demote(addr)
+                last_exc = exc
+                log.warning("register on %s failed (%s); trying next ring "
+                            "member", addr, exc)
+                continue
+            self.revive(addr)
+            if i > 0 and flight is not None:
+                flight.rung(fr.RUNG_RING_FAILOVER)
+            # adopt the scheduler's application-table resolution only when
+            # it actually resolved something: an older scheduler echoes the
+            # LEVEL0 default, which must not clobber an explicit local value
+            if int(result.resolved_priority) != 0:
+                conductor.resolved_priority = int(result.resolved_priority)
+            # the session keeps the default retrying client: its unaries
+            # (ReportPeerResult) talk to a member that just answered
+            session = PeerSession(self._client_at(addr), result, conductor)
+            await session.open_report_stream()
+            return session
+        raise DFError(
+            Code.UNAVAILABLE,
+            f"all {len(cands)} scheduler ring members unreachable "
+            f"(last: {last_exc})")
 
     async def announce_host(self, request) -> None:
         if not self.addresses:
             return
-        client = self._client(self.host.id)
-        await client.unary("AnnounceHost", request, timeout=5.0)
+        cands = self._candidates(self.host.id)
+        if not cands:
+            raise DFError(Code.UNAVAILABLE, "no scheduler addresses")
+        # single retry layer, same rationale as ReportPeerResult above
+        client = self._client_at(cands[0], max_attempts=1)
+        await Retrier(_REPORT_RETRY).run(
+            lambda: client.unary("AnnounceHost", request, timeout=5.0),
+            retryable=lambda exc: not isinstance(exc, DFError)
+            or exc.code in _FAILOVER_CODES)
 
     async def sync_probes(self):
         """Open the probe bidi stream (network-topology module drives it)."""
@@ -251,6 +388,13 @@ class SchedulerConnector:
             log.debug("LeaveHost failed: %s", exc)
 
     async def close(self) -> None:
+        # drain the channel-close tasks update_addresses spawned: left
+        # running they can outlive the loop and leak (or close) channels
+        # after teardown
+        if self._close_tasks:
+            await asyncio.gather(*list(self._close_tasks),
+                                 return_exceptions=True)
+            self._close_tasks.clear()
         for ch in self._channels.values():
             await ch.close()
         self._channels.clear()

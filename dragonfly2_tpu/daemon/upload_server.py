@@ -179,6 +179,11 @@ class UploadServer:
             # reachable by any mesh peer
             from ..common.debug_http import add_debug_routes
             add_debug_routes(app.router)
+            # fault-injection control plane (tools/stress.py --chaos):
+            # gated with the debug surface because arming scripts mutates
+            # live behaviour
+            from ..common.faultgate import add_fault_routes
+            add_fault_routes(app.router)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         ssl_ctx = None

@@ -9,18 +9,31 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import random
 from typing import Any, AsyncIterator
 
 import grpc
 import grpc.aio
 
+from ..common import faultgate
 from ..common.errors import Code, DFError
+from ..common.retry import Retrier, RetryPolicy
 from ..idl import dumps, loads
 
 log = logging.getLogger("df.rpc.client")
 
 _RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
+_RETRYABLE_DF = (Code.UNAVAILABLE, Code.DEADLINE_EXCEEDED)
+
+
+def _transient_rpc(exc: BaseException) -> bool:
+    """Unary retry classifier: transient transport failures, and injected
+    faultgate DFErrors with the same codes (so the fault plane exercises
+    the exact retry ladder real traffic takes)."""
+    if isinstance(exc, grpc.aio.AioRpcError):
+        return exc.code() in _RETRYABLE
+    if isinstance(exc, DFError):
+        return exc.code in _RETRYABLE_DF
+    return False
 
 
 def _trace_metadata():
@@ -173,25 +186,42 @@ class ServiceClient:
         self.max_attempts = max_attempts
         self.base_backoff = base_backoff
         self.max_backoff = max_backoff
+        self.retry_policy = RetryPolicy(max_attempts=max_attempts,
+                                        base_s=base_backoff,
+                                        max_s=max_backoff)
 
     async def unary(self, method: str, request: Any, *, timeout: float | None = None) -> Any:
-        attempt = 0
         md = _trace_metadata()
-        while True:
-            attempt += 1
-            try:
-                stub = self.channel._stub("unary_unary", self.service, method)
-                return await stub(request, timeout=timeout, metadata=md)
-            except grpc.aio.AioRpcError as exc:
-                if exc.code() in _RETRYABLE and attempt < self.max_attempts:
-                    delay = min(self.max_backoff,
-                                self.base_backoff * (2 ** (attempt - 1)))
-                    delay *= 0.5 + random.random()
-                    log.debug("retrying %s/%s after %s (%.2fs)",
-                              self.service, method, exc.code().name, delay)
-                    await asyncio.sleep(delay)
-                    continue
-                raise _translate(exc) from None
+        stub = self.channel._stub("unary_unary", self.service, method)
+        gate_key = f"{self.channel.address}/{self.service}/{method}"
+
+        async def call():
+            if faultgate.ARMED:
+                # the per-call deadline must bound the injected fault too:
+                # the grpc timeout below only covers the stub, so a 'hang'
+                # script fired before it would otherwise park for an hour
+                if timeout:
+                    try:
+                        await asyncio.wait_for(
+                            faultgate.fire("rpc.unary", key=gate_key),
+                            timeout)
+                    except asyncio.TimeoutError:
+                        raise DFError(Code.DEADLINE_EXCEEDED,
+                                      f"{gate_key}: deadline during "
+                                      "injected fault") from None
+                else:
+                    await faultgate.fire("rpc.unary", key=gate_key)
+            return await stub(request, timeout=timeout, metadata=md)
+
+        def on_retry(failures, exc, pause):
+            log.debug("retrying %s/%s after %s (%.2fs)",
+                      self.service, method, exc, pause)
+
+        try:
+            return await Retrier(self.retry_policy).run(
+                call, retryable=_transient_rpc, on_retry=on_retry)
+        except grpc.aio.AioRpcError as exc:
+            raise _translate(exc) from None
 
     def unary_stream(self, method: str, request: Any, *,
                      timeout: float | None = None) -> "_StreamIter":
@@ -234,6 +264,8 @@ class _StreamIter:
     async def read(self):
         """Like __anext__ but returns None at end of stream."""
         try:
+            if faultgate.ARMED:
+                await faultgate.fire("rpc.stream.read")
             msg = await self.call.read()
         except grpc.aio.AioRpcError as exc:
             raise _translate(exc) from None
@@ -259,6 +291,8 @@ class _BidiCall:
 
     async def read(self) -> Any | None:
         try:
+            if faultgate.ARMED:
+                await faultgate.fire("rpc.stream.read")
             msg = await self.call.read()
         except grpc.aio.AioRpcError as exc:
             raise _translate(exc) from None

@@ -12,6 +12,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Protocol
 
+from ..common import faultgate
 from ..common.errors import Code, DFError
 from ..common.piece import Range
 
@@ -91,6 +92,11 @@ async def supports_range(req: SourceRequest) -> bool:
 
 
 async def download(req: SourceRequest) -> SourceResponse:
+    if faultgate.ARMED:
+        # the back-to-source entry: an 'error' script with after_ms plays
+        # an origin 503+Retry-After; the piece manager's retry ladder must
+        # honor the hint (tests/test_faults.py)
+        await faultgate.fire("source.fetch", key=req.url)
     return await client_for(req.url).download(req)
 
 

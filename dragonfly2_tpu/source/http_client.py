@@ -23,12 +23,20 @@ def _timeout(req: SourceRequest) -> aiohttp.ClientTimeout:
     return aiohttp.ClientTimeout(total=None, sock_connect=30, sock_read=120)
 
 
-def _status_error(status: int, url: str) -> DFError:
+def _status_error(status: int, url: str, headers=None) -> DFError:
     if status == 404:
         return DFError(Code.SOURCE_NOT_FOUND, f"origin 404: {url}")
     if status in (401, 403):
         return DFError(Code.SOURCE_AUTH_ERROR, f"origin {status}: {url}")
-    return DFError(Code.SOURCE_ERROR, f"origin status {status}: {url}")
+    err = DFError(Code.SOURCE_ERROR, f"origin status {status}: {url}")
+    if headers is not None and status in (429, 503):
+        # surface the origin's own pacing hint so the back-source retry
+        # ladder (common/retry.py) waits what the origin asked for instead
+        # of its default backoff
+        value = str(headers.get("Retry-After", "")).strip()
+        if value.isdigit():
+            err.retry_after_ms = int(value) * 1000
+    return err
 
 
 class HTTPSourceClient:
@@ -99,7 +107,8 @@ class HTTPSourceClient:
                                    ssl=self._ssl,
                                    timeout=_timeout(req)) as resp:
                 if resp.status >= 400:
-                    raise _status_error(resp.status, req.url)
+                    raise _status_error(resp.status, req.url,
+                                        headers=resp.headers)
                 headers = dict(resp.headers)
                 cr = headers.get("Content-Range", "")
                 if "/" in cr:
@@ -139,8 +148,9 @@ class HTTPSourceClient:
             raise DFError(Code.SOURCE_ERROR, f"origin get failed: {exc}") from None
         if resp.status >= 400:
             status = resp.status
+            headers = dict(resp.headers)
             resp.close()
-            raise _status_error(status, req.url)
+            raise _status_error(status, req.url, headers=headers)
         if req.range is not None and resp.status != 206:
             resp.close()
             raise DFError(Code.SOURCE_RANGE_UNSUPPORTED,

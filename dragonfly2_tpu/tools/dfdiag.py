@@ -93,6 +93,11 @@ def verdict(summary: dict) -> str:
     """One-paragraph 'why was this download slow' attribution."""
     rows = summary.get("piece_rows") or []
     if not rows:
+        rungs = summary.get("rungs") or []
+        if rungs:
+            return ("verdict: no completed pieces — ladder ran "
+                    f"{' -> '.join(rungs)} and ended on "
+                    f"'{summary.get('served_rung', '')}'.")
         return "verdict: no completed pieces — nothing to attribute."
     stage_totals = {key: sum(r.get(key, 0.0) for r in rows)
                     for key, _, _ in STAGES}
@@ -129,6 +134,17 @@ def verdict(summary: dict) -> str:
     if tail:
         parts.append(f"piece latency p50/p90/p99 = {tail.get('p50')}/"
                      f"{tail.get('p90')}/{tail.get('p99')}ms")
+    rungs = summary.get("rungs") or []
+    if rungs:
+        # which degradation-ladder rung served this task, and the trail it
+        # took to get there (docs/RESILIENCE.md)
+        trail = (f" (ladder: {' -> '.join(rungs)})" if len(rungs) > 1 else "")
+        parts.append(f"served by rung '{summary.get('served_rung', '')}'"
+                     + trail)
+    drops = summary.get("report_drops", 0)
+    if drops:
+        parts.append(f"{drops} piece reports dropped on a dead scheduler "
+                     "stream — the scheduler undercounts this peer")
     return ";\n  ".join(parts) + "."
 
 

@@ -7,7 +7,16 @@ stdout so harnesses can parse it.
 
 Usage:
     python -m dragonfly2_tpu.tools.stress --url http://origin/blob \
-        [--proxy http://127.0.0.1:65001] [-c 16] [-d 10]
+        [--proxy http://127.0.0.1:65001] [-c 16] [-d 10] \
+        [--chaos 'piece.wire=delay:0.2:n=-1' \
+         --chaos-target http://127.0.0.1:UPLOAD_PORT]
+
+``--chaos`` arms a faultgate script (common/faultgate.py syntax; see
+docs/RESILIENCE.md) for the duration of the run and disarms it after.
+With ``--chaos-target`` the script is POSTed to that daemon's
+``/debug/faults`` surface (requires ``upload.debug_endpoints: true``), so
+a LIVE daemon takes the faults while this tool measures what its clients
+experience; without a target the script arms in this process only.
 """
 
 from __future__ import annotations
@@ -81,6 +90,49 @@ async def run_stress(url: str, *, proxy: str = "", concurrency: int = 8,
     }
 
 
+async def _run_with_chaos(args) -> dict:
+    """Arm the chaos script (remote daemon or in-process), run the load,
+    ALWAYS disarm — a stress run must not leave a live daemon wedged."""
+    import aiohttp
+
+    from ..common import faultgate
+
+    target = args.chaos_target.rstrip("/")
+    session = None
+    try:
+        if args.chaos and target:
+            session = aiohttp.ClientSession()
+            async with session.post(f"{target}/debug/faults",
+                                    data=args.chaos) as resp:
+                if resp.status != 200:
+                    raise SystemExit(
+                        f"chaos arm failed: HTTP {resp.status} "
+                        f"{await resp.text()} (is upload.debug_endpoints "
+                        f"on?)")
+        elif args.chaos:
+            # in-process arming only matters when fabric code runs in THIS
+            # process (run_stress issues plain HTTP GETs, which cross no
+            # faultgate site) — without a target the script is almost
+            # certainly meant for a daemon, so say so loudly
+            print("warning: --chaos without --chaos-target arms faults in "
+                  "this process only; a separate daemon is NOT affected "
+                  "(pass --chaos-target http://daemon:upload_port)",
+                  file=sys.stderr)
+            faultgate.arm_script(args.chaos)
+        return await run_stress(
+            args.url, proxy=args.proxy, concurrency=args.concurrency,
+            duration_s=args.duration)
+    finally:
+        if session is not None:
+            try:
+                async with session.delete(f"{target}/debug/faults"):
+                    pass
+            finally:
+                await session.close()
+        elif args.chaos:
+            faultgate.reset()
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="dfstress", description="concurrent download load generator")
@@ -90,10 +142,17 @@ def main(argv: list[str] | None = None) -> int:
                         "http://127.0.0.1:65001")
     p.add_argument("-c", "--concurrency", type=int, default=8)
     p.add_argument("-d", "--duration", type=float, default=10.0)
+    p.add_argument("--chaos", default="",
+                   help="faultgate script to arm for the run, e.g. "
+                        "'piece.wire=delay:0.2:n=-1' (docs/RESILIENCE.md)")
+    p.add_argument("--chaos-target", default="",
+                   help="daemon debug base URL (http://host:upload_port); "
+                        "the script is POSTed to /debug/faults there and "
+                        "disarmed after the run")
     args = p.parse_args(argv)
-    result = asyncio.run(run_stress(
-        args.url, proxy=args.proxy, concurrency=args.concurrency,
-        duration_s=args.duration))
+    result = asyncio.run(_run_with_chaos(args))
+    if args.chaos:
+        result["chaos"] = args.chaos
     print(json.dumps(result))
     return 1 if result["requests"] == result["errors"] else 0
 

@@ -35,6 +35,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..common import faultgate
 from ..common.metrics import REGISTRY
 
 log = logging.getLogger("df.storage.hbm")
@@ -175,6 +176,10 @@ class DeviceIngest:
     def write(self, offset: int, data: bytes | memoryview) -> None:
         """Land one verified piece; enqueues device transfers for any shard
         the piece completes. Returns as soon as the memcpy is done."""
+        if faultgate.ARMED:
+            # a raising script here exercises the conductor's sink-failure
+            # path: ingest disabled, download continues to disk
+            faultgate.fire_sync("hbm.ingest")
         end = offset + len(data)
         if end > self.content_length:
             raise ValueError(f"write beyond content: {end} > {self.content_length}")

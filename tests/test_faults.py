@@ -1,0 +1,729 @@
+"""Chaos suite: the fault-injection plane drives every rung of the
+degradation ladder end-to-end (docs/RESILIENCE.md), plus deterministic
+unit coverage for the unified retry policy and a tier-1 lint that keeps
+the faultgate site registry, the call sites, and the docs in sync.
+"""
+
+import asyncio
+import os
+import re
+import sys
+import time
+
+import pytest
+
+from dragonfly2_tpu.common import faultgate
+from dragonfly2_tpu.common.errors import Code, DFError
+from dragonfly2_tpu.common.retry import (Retrier, RetryPolicy, retry_after_s,
+                                         transient)
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faultgate.reset()
+    yield
+    faultgate.reset()
+
+
+# ----------------------------------------------------------------------
+# common/retry.py: jitter / budget / deadline math on a fake clock
+# ----------------------------------------------------------------------
+
+class FakeTime:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps: list[float] = []
+
+    def clock(self) -> float:
+        return self.t
+
+    async def sleep(self, s: float) -> None:
+        self.sleeps.append(s)
+        self.t += s
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestRetryPolicy:
+    def test_backoff_sequence_deterministic_midpoint_rng(self):
+        p = RetryPolicy(max_attempts=5, base_s=1.0, max_s=8.0,
+                        multiplier=2.0, jitter=0.5)
+        # rng=0.5 makes the jitter multiplier exactly 1.0
+        seq = [p.backoff_s(k, rng=lambda: 0.5) for k in (1, 2, 3, 4, 5)]
+        assert seq == [1.0, 2.0, 4.0, 8.0, 8.0]   # capped at max_s
+
+    def test_jitter_bounds(self):
+        p = RetryPolicy(base_s=1.0, jitter=0.5)
+        assert p.backoff_s(1, rng=lambda: 0.0) == pytest.approx(0.5)
+        assert p.backoff_s(1, rng=lambda: 1.0) == pytest.approx(1.5)
+
+    def test_retries_then_succeeds(self):
+        ft = FakeTime()
+        calls = {"n": 0}
+
+        async def fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise DFError(Code.UNAVAILABLE, "blip")
+            return "ok"
+
+        async def go():
+            r = Retrier(RetryPolicy(max_attempts=4, base_s=1.0, jitter=0.0),
+                        clock=ft.clock, sleep=ft.sleep)
+            return await r.run(fn)
+
+        assert run(go()) == "ok"
+        assert calls["n"] == 3
+        assert ft.sleeps == [1.0, 2.0]
+
+    def test_attempts_exhausted_raises_last(self):
+        ft = FakeTime()
+
+        async def fn():
+            raise DFError(Code.UNAVAILABLE, "down")
+
+        async def go():
+            r = Retrier(RetryPolicy(max_attempts=3, base_s=1.0, jitter=0.0),
+                        clock=ft.clock, sleep=ft.sleep)
+            await r.run(fn)
+
+        with pytest.raises(DFError, match="down"):
+            run(go())
+        assert ft.sleeps == [1.0, 2.0]
+
+    def test_budget_refuses_oversleep(self):
+        """A sleep that would overshoot the budget is NOT taken: fail fast
+        so the next ladder rung gets the remaining time."""
+        ft = FakeTime()
+        calls = {"n": 0}
+
+        async def fn():
+            calls["n"] += 1
+            raise DFError(Code.UNAVAILABLE, "down")
+
+        async def go():
+            r = Retrier(RetryPolicy(max_attempts=10, base_s=1.0,
+                                    multiplier=2.0, jitter=0.0,
+                                    budget_s=2.5),
+                        clock=ft.clock, sleep=ft.sleep)
+            await r.run(fn)
+
+        with pytest.raises(DFError):
+            run(go())
+        # slept 1.0 (elapsed 1.0), then 2.0 would make 3.0 > 2.5: stop
+        assert ft.sleeps == [1.0]
+        assert calls["n"] == 2
+
+    def test_per_run_deadline(self):
+        ft = FakeTime()
+        calls = {"n": 0}
+
+        async def fn():
+            calls["n"] += 1
+            raise DFError(Code.UNAVAILABLE, "down")
+
+        async def go():
+            r = Retrier(RetryPolicy(max_attempts=5, base_s=1.0, jitter=0.0),
+                        clock=ft.clock, sleep=ft.sleep)
+            await r.run(fn, deadline_s=0.5)
+
+        with pytest.raises(DFError):
+            run(go())
+        assert calls["n"] == 1 and ft.sleeps == []
+
+    def test_retry_after_hint_floors_backoff(self):
+        ft = FakeTime()
+        calls = {"n": 0}
+
+        async def fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                err = DFError(Code.SOURCE_ERROR, "503")
+                err.retry_after_ms = 1500
+                raise err
+            return "ok"
+
+        async def go():
+            r = Retrier(RetryPolicy(max_attempts=3, base_s=0.1, jitter=0.0),
+                        clock=ft.clock, sleep=ft.sleep)
+            return await r.run(fn, retryable=lambda _e: True)
+
+        assert run(go()) == "ok"
+        assert ft.sleeps == [1.5]     # hint floored the 0.1s backoff
+
+    def test_retry_after_s_sources(self):
+        err = DFError(Code.SOURCE_ERROR, "x")
+        assert retry_after_s(err) == 0.0
+        err.retry_after_ms = 250
+        assert retry_after_s(err) == pytest.approx(0.25)
+
+        class H(Exception):
+            headers = {"Retry-After": "3"}
+        assert retry_after_s(H()) == 3.0
+
+    def test_transient_default_classifier(self):
+        assert transient(DFError(Code.UNAVAILABLE, "x"))
+        assert transient(DFError(Code.DEADLINE_EXCEEDED, "x"))
+        assert transient(OSError("refused"))
+        assert not transient(DFError(Code.SOURCE_NOT_FOUND, "404"))
+        busy = DFError(Code.CLIENT_PEER_BUSY, "503")
+        busy.retry_after_ms = 100
+        assert transient(busy)
+
+    def test_non_retryable_raises_immediately(self):
+        ft = FakeTime()
+        calls = {"n": 0}
+
+        async def fn():
+            calls["n"] += 1
+            raise DFError(Code.SOURCE_NOT_FOUND, "404")
+
+        async def go():
+            await Retrier(RetryPolicy(max_attempts=5),
+                          clock=ft.clock, sleep=ft.sleep).run(fn)
+
+        with pytest.raises(DFError):
+            run(go())
+        assert calls["n"] == 1
+
+
+# ----------------------------------------------------------------------
+# common/faultgate.py: script parsing + fire semantics
+# ----------------------------------------------------------------------
+
+class TestFaultgate:
+    def test_unknown_site_and_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown faultgate site"):
+            faultgate.arm("nope.nope", "fail")
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faultgate.arm("rpc.unary", "explode")
+        with pytest.raises(ValueError, match="bad faultgate clause"):
+            faultgate.arm_script("rpc.unary")
+
+    def test_fail_n_then_succeed(self):
+        script = faultgate.arm_script("rpc.unary=fail:n=2")[0]
+        assert faultgate.ARMED
+
+        async def go():
+            for _ in range(2):
+                with pytest.raises(DFError) as ei:
+                    await faultgate.fire("rpc.unary", key="any")
+                assert ei.value.code == Code.UNAVAILABLE
+            await faultgate.fire("rpc.unary", key="any")   # exhausted: no-op
+
+        run(go())
+        assert script.fired == 2
+        assert not faultgate.ARMED    # nothing armed remains
+
+    def test_key_scoping(self):
+        faultgate.arm("sched.register", "fail", key="127.0.0.1:9000", n=-1)
+
+        async def go():
+            await faultgate.fire("sched.register", key="127.0.0.1:9001")
+            with pytest.raises(DFError):
+                await faultgate.fire("sched.register", key="127.0.0.1:9000")
+
+        run(go())
+
+    def test_error_carries_retry_hint(self):
+        faultgate.arm_script("source.fetch=error:code=SOURCE_ERROR:after_ms=400")
+
+        async def go():
+            with pytest.raises(DFError) as ei:
+                await faultgate.fire("source.fetch", key="http://x/y")
+            assert ei.value.code == Code.SOURCE_ERROR
+            assert ei.value.retry_after_ms == 400
+
+        run(go())
+
+    def test_corrupt_flips_then_passthrough(self):
+        faultgate.arm("piece.wire", "corrupt", n=1)
+        data = b"\x00\x01\x02"
+        flipped = faultgate.corrupt("piece.wire", data)
+        assert flipped != data and flipped[1:] == data[1:]
+        assert faultgate.corrupt("piece.wire", data) == data   # consumed
+
+    def test_fire_sync_raises(self):
+        faultgate.arm("hbm.ingest", "fail", code=Code.INTERNAL)
+        with pytest.raises(DFError) as ei:
+            faultgate.fire_sync("hbm.ingest")
+        assert ei.value.code == Code.INTERNAL
+
+    def test_reset_disarms(self):
+        faultgate.arm("rpc.unary", "fail")
+        assert faultgate.ARMED
+        faultgate.reset()
+        assert not faultgate.ARMED
+        assert faultgate.status() == {"armed": False, "scripts": []}
+
+
+class TestFaultgateLint:
+    """Tier-1 hygiene: every registered site is fired somewhere in the
+    tree, every fired name is registered, and every site is documented in
+    docs/RESILIENCE.md (mirrors the PR-1 metric-namespace lint)."""
+
+    def test_sites_fired_and_registered(self):
+        pat = re.compile(
+            r"faultgate\.(?:fire|fire_sync|corrupt)\(\s*[\"']([a-z.]+)[\"']")
+        fired: set[str] = set()
+        pkg = os.path.join(REPO, "dragonfly2_tpu")
+        for dirpath, _dirs, files in os.walk(pkg):
+            for name in files:
+                if not name.endswith(".py") or name == "faultgate.py":
+                    continue
+                with open(os.path.join(dirpath, name), encoding="utf-8") as f:
+                    fired.update(pat.findall(f.read()))
+        assert fired == set(faultgate.SITES), (
+            f"faultgate sites out of sync: fired-but-unregistered="
+            f"{fired - faultgate.SITES}, registered-but-never-fired="
+            f"{faultgate.SITES - fired}")
+
+    def test_sites_documented(self):
+        doc_path = os.path.join(REPO, "docs", "RESILIENCE.md")
+        with open(doc_path, encoding="utf-8") as f:
+            doc = f.read()
+        missing = [s for s in sorted(faultgate.SITES) if f"`{s}`" not in doc]
+        assert not missing, f"sites missing from docs/RESILIENCE.md: {missing}"
+
+    def test_rung_names_documented(self):
+        from dragonfly2_tpu.daemon import flight_recorder as fr
+        with open(os.path.join(REPO, "docs", "RESILIENCE.md"),
+                  encoding="utf-8") as f:
+            doc = f.read()
+        for rung in (fr.RUNG_P2P, fr.RUNG_RESCHEDULE, fr.RUNG_RING_FAILOVER,
+                     fr.RUNG_BACK_SOURCE, fr.RUNG_FAIL):
+            assert f"`{rung}`" in doc, rung
+
+
+# ----------------------------------------------------------------------
+# flight recorder: rung trail in the summary
+# ----------------------------------------------------------------------
+
+class TestRungJournal:
+    def test_rungs_and_served_rung(self):
+        from dragonfly2_tpu.daemon import flight_recorder as fr
+        f = fr.TaskFlight("t" * 64, "p")
+        f.rung(fr.RUNG_RING_FAILOVER)
+        f.rung(fr.RUNG_P2P)
+        f.rung(fr.RUNG_RESCHEDULE)
+        f.rung(fr.RUNG_RESCHEDULE)     # consecutive repeat deduped
+        f.rung(fr.RUNG_BACK_SOURCE)
+        f.report_drops = 3
+        s = f.summarize()
+        assert s["rungs"] == ["ring_failover", "p2p", "reschedule",
+                              "back_source"]
+        assert s["served_rung"] == "back_source"
+        assert s["report_drops"] == 3
+        c = f.compact_summary()
+        assert c["served_rung"] == "back_source"
+        assert c["report_drops"] == 3
+
+    def test_verdict_names_rung(self):
+        from dragonfly2_tpu.tools.dfdiag import verdict
+        v = verdict({"piece_rows": [], "rungs": ["p2p", "fail"],
+                     "served_rung": "fail"})
+        assert "p2p -> fail" in v
+
+
+# ----------------------------------------------------------------------
+# e2e chaos: the ladder under injected faults
+# ----------------------------------------------------------------------
+
+class TestSchedulerRingFailover:
+    def test_dead_hashed_scheduler_fails_over_and_completes_p2p(self, tmp_path):
+        """The first hashed scheduler is UNAVAILABLE forever; the task must
+        register on the next ring member, complete via the mesh with NO
+        back-to-source, show the ring_failover rung, and stickily demote
+        the dead address so the next task skips it entirely."""
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.common import ids
+        from dragonfly2_tpu.daemon.config import (
+            SchedulerConfig as DaemonSchedCfg)
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest
+        from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+        from dragonfly2_tpu.scheduler.config import SeedPeerAddr
+
+        async def go():
+            data = os.urandom((10 << 20) + 777)     # 3 pieces
+            origin, base = await start_origin({"w.bin": data, "x.bin": data})
+            seed_cfg = daemon_config(tmp_path, "seed")
+            seed_cfg.is_seed = True
+            seed = Daemon(seed_cfg)
+            await seed.start()
+            seed_peers = [SeedPeerAddr(ip="127.0.0.1",
+                                       rpc_port=seed.rpc.port,
+                                       download_port=seed.upload_server.port)]
+            scheds = [Scheduler(SchedulerConfig(seed_peers=seed_peers))
+                      for _ in range(2)]
+            for s in scheds:
+                await s.start()
+            leech_cfg = daemon_config(tmp_path, "leech")
+            leech_cfg.scheduler = DaemonSchedCfg(
+                addresses=[s.address for s in scheds],
+                schedule_timeout_s=20.0, demote_s=60.0)
+            leech = Daemon(leech_cfg)
+            await leech.start()
+            try:
+                url = f"{base}/w.bin"
+                task = ids.task_id(url)
+                dead = leech.scheduler._ring.pick(task)
+                assert dead is not None
+                script = faultgate.arm(
+                    "sched.register", "fail", key=dead, n=-1)
+
+                async for _ in leech.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "out.bin"),
+                        disable_back_source=True, timeout_s=60.0)):
+                    pass
+                assert (tmp_path / "out.bin").read_bytes() == data
+                conductor = leech.ptm.conductor(task)
+                assert conductor.state == conductor.SUCCESS
+                # no back-to-source: every byte rode the mesh
+                assert conductor.traffic_p2p == len(data)
+                assert conductor.traffic_source == 0
+                assert script.fired == 1
+                # the served rung is visible in the flight record
+                summary = leech.flight_recorder.get(task).summarize()
+                assert "ring_failover" in summary["rungs"]
+                assert summary["served_rung"] == "p2p"
+                # sticky demotion: the dead address is skipped by the NEXT
+                # task (no new fire against it), not probed per task
+                assert dead in leech.scheduler.demoted()
+                url2 = f"{base}/x.bin"
+                async for _ in leech.ptm.start_file_task(DownloadRequest(
+                        url=url2, output=str(tmp_path / "out2.bin"),
+                        disable_back_source=True, timeout_s=60.0)):
+                    pass
+                assert (tmp_path / "out2.bin").read_bytes() == data
+                assert script.fired == 1     # demoted address never retried
+            finally:
+                await leech.stop()
+                for s in scheds:
+                    await s.stop()
+                await seed.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+    def test_all_schedulers_down_backs_to_source(self, tmp_path):
+        """Every ring member UNAVAILABLE: register exhausts the failover
+        ladder, and the conductor serves the task from origin — with the
+        back_source rung journaled as the serving rung."""
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.common import ids
+        from dragonfly2_tpu.daemon.config import (
+            SchedulerConfig as DaemonSchedCfg)
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest
+
+        async def go():
+            data = os.urandom((4 << 20) + 5)
+            origin, base = await start_origin({"f.bin": data})
+            cfg = daemon_config(tmp_path, "solo")
+            # addresses exist but every register against them is injected
+            # dead BEFORE dialing, so no real scheduler is needed
+            cfg.scheduler = DaemonSchedCfg(
+                addresses=["127.0.0.1:9", "127.0.0.1:10"],
+                schedule_timeout_s=5.0)
+            cfg.probe_enabled = False
+            daemon = Daemon(cfg)
+            await daemon.start()
+            faultgate.arm("sched.register", "fail", n=-1)
+            try:
+                url = f"{base}/f.bin"
+                async for _ in daemon.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "o.bin"),
+                        timeout_s=60.0)):
+                    pass
+                assert (tmp_path / "o.bin").read_bytes() == data
+                task = ids.task_id(url)
+                conductor = daemon.ptm.conductor(task)
+                assert conductor.state == conductor.SUCCESS
+                assert conductor.traffic_source == len(data)
+                assert conductor.traffic_p2p == 0
+                summary = daemon.flight_recorder.get(task).summarize()
+                assert summary["served_rung"] == "back_source"
+                assert summary["rungs"] == ["back_source"]
+                # both ring members were tried and demoted
+                assert len(daemon.scheduler.demoted()) == 2
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+
+class TestRegisterHangBounded:
+    def test_hang_script_walks_deadline_failover(self):
+        """A 'hang' at sched.register must be bounded by the register
+        timeout and take the same demote-and-failover path as a wedged
+        scheduler — not park for an hour."""
+        from dragonfly2_tpu.daemon.scheduler_session import SchedulerConnector
+        from dragonfly2_tpu.idl.messages import Host, UrlMeta
+
+        class FakeConductor:
+            task_id = "t" * 64
+            peer_id = "p"
+            url = "http://x/y"
+            url_meta = UrlMeta()
+            flight = None
+
+        async def go():
+            conn = SchedulerConnector(
+                ["127.0.0.1:9", "127.0.0.1:10"], Host(id="h"),
+                register_timeout_s=0.3, failover_n=2)
+            faultgate.arm("sched.register", "hang", n=-1)
+            t0 = time.monotonic()
+            with pytest.raises(DFError) as ei:
+                await conn.register(FakeConductor())
+            elapsed = time.monotonic() - t0
+            assert ei.value.code == Code.UNAVAILABLE
+            # two candidates x 0.3s deadline, not 3600s
+            assert elapsed < 5.0
+            assert len(conn.demoted()) == 2
+            await conn.close()
+
+        run(go())
+
+
+class TestPieceWireChaos:
+    async def _p2p_pair(self, tmp_path, data, leech_tweak=None):
+        """Seed that owns the bytes + scripted-scheduler leech pulling
+        them P2P (origin torn down so the mesh is the only source)."""
+        from test_daemon_e2e import daemon_config
+        from test_p2p import (ScriptedScheduler, ScriptedSession,
+                              parent_addr, seed_daemon_with)
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import (PeerPacket, RegisterResult,
+                                                 SizeScope)
+
+        seed, origin, url, task_id, seed_peer = await seed_daemon_with(
+            tmp_path, data)
+        await origin.cleanup()           # bytes MUST come from the seed
+        leech_cfg = daemon_config(tmp_path, "leech")
+        if leech_tweak is not None:
+            leech_tweak(leech_cfg)
+        leecher = Daemon(leech_cfg)
+
+        def make_session(conductor):
+            packet = PeerPacket(task_id=conductor.task_id,
+                                src_peer_id=conductor.peer_id,
+                                main_peer=parent_addr(seed, seed_peer))
+            return ScriptedSession(RegisterResult(
+                task_id=conductor.task_id,
+                size_scope=SizeScope.NORMAL), [packet])
+
+        leecher._scheduler_factory = lambda d: ScriptedScheduler(make_session)
+        await leecher.start()
+        return seed, leecher, url, task_id
+
+    def test_parent_hang_trips_piece_deadline_then_recovers(self, tmp_path):
+        """A parent that wedges mid-piece: the injected hang parks the
+        body read until the per-piece deadline cancels it; the piece is
+        requeued and the task still completes from the mesh."""
+        from dragonfly2_tpu.idl.messages import DownloadRequest
+
+        data = os.urandom((9 << 20) + 333)
+
+        def tweak(cfg):
+            cfg.download.piece_timeout_s = 2.0
+
+        async def go():
+            seed, leecher, url, task_id = await self._p2p_pair(
+                tmp_path, data, leech_tweak=tweak)
+            script = faultgate.arm("piece.wire", "hang", n=1)
+            try:
+                t0 = time.monotonic()
+                async for _ in leecher.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "out.bin"),
+                        disable_back_source=True, timeout_s=60.0)):
+                    pass
+                elapsed = time.monotonic() - t0
+                assert (tmp_path / "out.bin").read_bytes() == data
+                conductor = leecher.ptm.conductor(task_id)
+                assert conductor.state == conductor.SUCCESS
+                assert conductor.traffic_p2p == len(data)
+                assert script.fired == 1
+                # the deadline had to fire before recovery
+                assert elapsed >= 2.0
+            finally:
+                await leecher.stop()
+                await seed.stop()
+
+        asyncio.run(go())
+
+    def test_digest_corruption_retried(self, tmp_path):
+        """One corrupted piece transfer: digest verification rejects it,
+        the dispatcher requeues, and the final bytes are intact."""
+        from dragonfly2_tpu.idl.messages import DownloadRequest
+
+        data = os.urandom((9 << 20) + 333)
+
+        async def go():
+            seed, leecher, url, task_id = await self._p2p_pair(
+                tmp_path, data)
+            script = faultgate.arm("piece.wire", "corrupt", n=1)
+            try:
+                async for _ in leecher.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "out.bin"),
+                        disable_back_source=True, timeout_s=60.0)):
+                    pass
+                assert (tmp_path / "out.bin").read_bytes() == data
+                conductor = leecher.ptm.conductor(task_id)
+                assert conductor.state == conductor.SUCCESS
+                assert script.fired == 1
+            finally:
+                await leecher.stop()
+                await seed.stop()
+
+        asyncio.run(go())
+
+
+class TestOriginRetryAfter:
+    def test_origin_503_retry_after_honored(self, tmp_path):
+        """Origin answers 503 with a Retry-After-style hint once: the
+        back-source ladder must wait at least the hinted delay, then
+        succeed."""
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.common import ids
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest
+
+        async def go():
+            data = os.urandom(300_000)
+            origin, base = await start_origin({"f.bin": data})
+            daemon = Daemon(daemon_config(tmp_path, "ra"))
+            await daemon.start()
+            script = faultgate.arm("source.fetch", "error",
+                                   code=Code.SOURCE_ERROR, after_ms=400, n=1)
+            try:
+                url = f"{base}/f.bin"
+                t0 = time.monotonic()
+                async for _ in daemon.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "o.bin"),
+                        timeout_s=60.0)):
+                    pass
+                elapsed = time.monotonic() - t0
+                assert (tmp_path / "o.bin").read_bytes() == data
+                assert script.fired == 1
+                assert elapsed >= 0.35, (
+                    f"Retry-After hint not honored: {elapsed:.3f}s")
+                conductor = daemon.ptm.conductor(ids.task_id(url))
+                assert conductor.state == conductor.SUCCESS
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+    def test_http_503_header_parsed_into_hint(self):
+        from dragonfly2_tpu.source.http_client import _status_error
+        err = _status_error(503, "http://x/y", headers={"Retry-After": "2"})
+        assert err.code == Code.SOURCE_ERROR
+        assert err.retry_after_ms == 2000
+        # 404 keeps its immediate-verdict code, no hint
+        err2 = _status_error(404, "http://x/y", headers={"Retry-After": "2"})
+        assert err2.code == Code.SOURCE_NOT_FOUND
+        assert not hasattr(err2, "retry_after_ms")
+
+
+class TestFaultControlPlane:
+    def test_debug_faults_endpoint_and_stress_chaos_arm(self, tmp_path):
+        """POST/GET/DELETE /debug/faults on the upload port (behind
+        upload.debug_endpoints), exercised the way tools/stress.py
+        --chaos-target drives it."""
+        import aiohttp
+
+        from test_daemon_e2e import daemon_config
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+
+        async def go():
+            cfg = daemon_config(tmp_path, "dbg")
+            cfg.upload.debug_endpoints = True
+            daemon = Daemon(cfg)
+            await daemon.start()
+            base = f"http://127.0.0.1:{daemon.upload_server.port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(f"{base}/debug/faults",
+                                      data="piece.wire=delay:0.1:n=2") as r:
+                        assert r.status == 200
+                    async with s.get(f"{base}/debug/faults") as r:
+                        st = await r.json()
+                    assert st["armed"]
+                    assert st["scripts"][0]["site"] == "piece.wire"
+                    assert st["scripts"][0]["remaining"] == 2
+                    # bad scripts are rejected, not half-armed
+                    async with s.post(f"{base}/debug/faults",
+                                      data="bogus.site=fail") as r:
+                        assert r.status == 400
+                    async with s.delete(f"{base}/debug/faults") as r:
+                        assert (await r.json()) == {"armed": False,
+                                                    "scripts": []}
+                assert not faultgate.ARMED
+            finally:
+                await daemon.stop()
+
+        asyncio.run(go())
+
+    def test_stress_in_process_chaos_always_disarms(self):
+        """--chaos without a target arms this process and disarms after
+        the run, even when the run errors."""
+        import argparse
+
+        from dragonfly2_tpu.tools.stress import _run_with_chaos
+
+        args = argparse.Namespace(
+            url="http://127.0.0.1:9/none", proxy="", concurrency=1,
+            duration_s=0.0, duration=0.1, chaos="rpc.unary=fail:n=-1",
+            chaos_target="")
+        result = asyncio.run(_run_with_chaos(args))
+        assert result["requests"] == result["errors"]   # origin is dead
+        assert not faultgate.ARMED                      # always disarmed
+
+
+class TestReportDropAccounting:
+    def test_dead_writer_drop_counted(self):
+        from dragonfly2_tpu.daemon import scheduler_session as ss
+        from dragonfly2_tpu.daemon.flight_recorder import TaskFlight
+        from dragonfly2_tpu.idl.messages import PieceResult, RegisterResult
+
+        class FakeConductor:
+            task_id = "t" * 64
+            peer_id = "p"
+            flight = TaskFlight("t" * 64, "p")
+
+        async def go():
+            session = ss.PeerSession(client=None,
+                                     result=RegisterResult(task_id="t" * 64),
+                                     conductor=FakeConductor())
+            session._stream = object()
+
+            async def dead():
+                return None
+            session._writer = asyncio.get_running_loop().create_task(dead())
+            await asyncio.sleep(0)      # let the writer finish
+            before = ss._report_dropped.value()
+            await session.report_piece(PieceResult(task_id="t" * 64,
+                                                   src_peer_id="p"))
+            assert ss._report_dropped.value() == before + 1
+            assert FakeConductor.flight.report_drops == 1
+            assert session._out.qsize() == 0
+
+        asyncio.run(go())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

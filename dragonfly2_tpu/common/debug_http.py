@@ -26,37 +26,11 @@ log = logging.getLogger("df.debug")
 
 
 async def debug_stacks(_r: web.Request) -> web.Response:
-    """Every thread's stack + every asyncio task."""
-    import io
-    import sys
-    import threading
-    import traceback
+    """Every thread's stack + every asyncio task's full await chain
+    (health.format_stacks — shared with the watchdog's auto-dumps)."""
+    from .health import format_stacks
 
-    buf = io.StringIO()
-    names = {t.ident: t.name for t in threading.enumerate()}
-    for tid, frame in sys._current_frames().items():
-        buf.write(f"--- thread {names.get(tid, tid)} ---\n")
-        traceback.print_stack(frame, file=buf)
-    buf.write("--- asyncio tasks ---\n")
-    for task in asyncio.all_tasks():
-        buf.write(f"{task.get_name()}: {task.get_coro()}\n")
-        # walk the await chain by hand: Task.get_stack only reports the
-        # outermost coroutine frame, which hides WHERE a deep await is
-        # actually parked (the exact thing a hang diagnosis needs)
-        coro, depth = task.get_coro(), 0
-        while coro is not None and depth < 16:
-            frame = (getattr(coro, "cr_frame", None)
-                     or getattr(coro, "gi_frame", None))
-            if frame is not None:
-                buf.write(f"  {frame.f_code.co_filename}:{frame.f_lineno} "
-                          f"{frame.f_code.co_name}\n")
-            nxt = (getattr(coro, "cr_await", None)
-                   or getattr(coro, "gi_yieldfrom", None))
-            if nxt is None and frame is None:
-                break
-            coro = nxt
-            depth += 1
-    return web.Response(text=buf.getvalue())
+    return web.Response(text=format_stacks())
 
 
 _profile_lock = asyncio.Lock()
@@ -128,6 +102,8 @@ async def start_debug_server(host: str, port: int, extra_routes=None):
     exists for."""
     app = web.Application()
     add_debug_routes(app.router)
+    from .health import add_health_routes
+    add_health_routes(app.router)
     app.router.add_get("/metrics", _metrics)
     if extra_routes is not None:
         extra_routes(app.router)

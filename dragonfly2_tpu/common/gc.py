@@ -9,10 +9,23 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable
 
+from .metrics import REGISTRY
+
 log = logging.getLogger("df.gc")
+
+_gc_last_run = REGISTRY.gauge(
+    "df_gc_last_run_timestamp_seconds",
+    "unix time a GC task last completed a sweep", ("task",))
+_gc_duration = REGISTRY.histogram(
+    "df_gc_run_duration_seconds", "wall time of each GC sweep", ("task",))
+_gc_reclaimed = REGISTRY.counter(
+    "df_gc_reclaimed_total", "items reclaimed by GC sweeps", ("task",))
+_gc_runs = REGISTRY.counter(
+    "df_gc_runs_total", "GC sweeps by outcome", ("task", "result"))
 
 
 @dataclass
@@ -35,10 +48,28 @@ class GC:
 
     async def run_one(self, task_id: str) -> int:
         task = self._tasks[task_id]
-        out = task.run()
-        if asyncio.iscoroutine(out):
-            out = await out
-        return int(out or 0)
+        t0 = time.monotonic()
+        try:
+            out = task.run()
+            if asyncio.iscoroutine(out):
+                out = await out
+        except asyncio.CancelledError:
+            raise            # shutdown catching a sweep mid-flight: not an
+            # error — counting it would pollute the alertable counter on
+            # every restart
+        except Exception:
+            _gc_runs.labels(task_id, "error").inc()
+            raise
+        n = int(out or 0)
+        # a sweep that found nothing still proves the runner is alive —
+        # the last-run timestamp is the liveness signal a dashboard alerts
+        # on (a wedged runner shows a frozen timestamp, not a zero count)
+        _gc_last_run.labels(task_id).set(time.time())
+        _gc_duration.labels(task_id).observe(time.monotonic() - t0)
+        _gc_runs.labels(task_id, "ok").inc()
+        if n:
+            _gc_reclaimed.labels(task_id).inc(n)
+        return n
 
     async def _loop(self, task: GCTask) -> None:
         while not self._stopped.is_set():

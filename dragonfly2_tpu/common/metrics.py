@@ -96,6 +96,14 @@ class _GaugeChild:
 
 _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# Size-shaped preset for piece/transfer byte histograms: the latency
+# default above tops out at 60 — useless for values in the MiB range.
+# Spans a 4 KiB ranged read to a 1 GiB whole-file span, log-spaced around
+# the 4-16 MiB piece sizes the fabric actually moves.
+BYTES_BUCKETS = (4096.0, 65536.0, 262144.0, float(1 << 20), float(4 << 20),
+                 float(8 << 20), float(16 << 20), float(64 << 20),
+                 float(256 << 20), float(1 << 30))
+
 
 class Histogram(_Metric):
     kind = "histogram"

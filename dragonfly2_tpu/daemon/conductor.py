@@ -358,6 +358,11 @@ class PeerTaskConductor:
         self.state = self.SUCCESS
         if self.flight is not None:
             self.flight.finish(self.SUCCESS)
+            # count this task's stage-budget breaches into
+            # df_slo_breach_total (once, here — summaries themselves only
+            # carry the annotation)
+            from ..common.health import PLANE
+            PLANE.slo.observe_summary(self.flight.summarize())
         self._publish({"type": "done", "success": True,
                        "completed": self.completed_length,
                        "total": self.content_length})
@@ -379,6 +384,8 @@ class PeerTaskConductor:
             # part of the journal, not just the PeerResult code
             self.flight.rung(fr.RUNG_FAIL)
             self.flight.finish(self.FAILED)
+            from ..common.health import PLANE
+            PLANE.slo.observe_summary(self.flight.summarize())
         if self.device_ingest is not None:
             self.device_ingest.close()
             self.device_ingest = None

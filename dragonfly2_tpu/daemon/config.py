@@ -81,6 +81,36 @@ class FlightConfig:
 
 
 @dataclass
+class HealthSection:
+    """Runtime health plane (common/health.py): event-loop lag sampler +
+    coroutine watchdog + per-stage SLO budgets behind GET /debug/health.
+    On by default — the monitor is one coroutine ticking at
+    ``sample_interval_s`` and sections are a dict insert per piece group."""
+
+    enabled: bool = True
+    sample_interval_s: float = 0.1     # lag sample / watchdog sweep period
+    stall_threshold_s: float = 1.0     # loop lag past this = stall event
+    dump_min_interval_s: float = 10.0  # stack-dump rate limit
+    # SLO budgets (ms) per download stage; <= 0 disables that budget
+    slo_schedule_ms: float = 1000.0
+    slo_first_byte_ms: float = 2000.0
+    slo_wire_ms: float = 5000.0
+    slo_hbm_ms: float = 1000.0
+
+    def to_plane(self):
+        from ..common.health import HealthConfig
+        return HealthConfig(
+            enabled=self.enabled,
+            sample_interval_s=self.sample_interval_s,
+            stall_threshold_s=self.stall_threshold_s,
+            dump_min_interval_s=self.dump_min_interval_s,
+            slo_schedule_ms=self.slo_schedule_ms,
+            slo_first_byte_ms=self.slo_first_byte_ms,
+            slo_wire_ms=self.slo_wire_ms,
+            slo_hbm_ms=self.slo_hbm_ms)
+
+
+@dataclass
 class DownloadConfig:
     piece_parallelism: int = 4             # piece download workers per task
     back_source_parallelism: int = 4       # concurrent origin range streams
@@ -167,6 +197,7 @@ class DaemonConfig:
     storage: StorageSection = field(default_factory=StorageSection)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     flight: FlightConfig = field(default_factory=FlightConfig)
+    health: HealthSection = field(default_factory=HealthSection)
     security: SecurityConfig = field(default_factory=SecurityConfig)
     proxy: ProxyConfig = field(default_factory=ProxyConfig)
     object_storage: ObjectStorageConfig = field(default_factory=ObjectStorageConfig)

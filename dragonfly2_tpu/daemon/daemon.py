@@ -88,6 +88,7 @@ class Daemon:
         self.announcer: Any = None
         self.prober: Any = None
         self.manager: Any = None
+        self.health: Any = None
 
     # ------------------------------------------------------------------
 
@@ -190,6 +191,13 @@ class Daemon:
     _active_in_process = 0   # daemons started but not yet stopped (this proc)
 
     async def start(self) -> None:
+        # health plane FIRST: the watchdog must already be sweeping when
+        # the earliest download section opens (refcounted process-wide,
+        # like the metrics REGISTRY — co-resident daemons share it)
+        from ..common import health
+        self.health = health.PLANE
+        self.health.acquire(self.cfg.health.to_plane())
+        self.health.attach_recorder(self.flight_recorder)
         if self.cfg.plugin_dir:
             from ..common.plugins import load_source_plugins
             load_source_plugins(self.cfg.plugin_dir)
@@ -453,3 +461,6 @@ class Daemon:
             if Daemon._active_in_process == 0:
                 from ..source.client import close_clients
                 await close_clients()
+        if getattr(self, "health", None) is not None:
+            self.health.release()
+            self.health = None

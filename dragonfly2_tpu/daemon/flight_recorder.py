@@ -61,7 +61,7 @@ class TaskFlight:
     bytes, dur_ms)`` tuples relative to the flight's start."""
 
     __slots__ = ("task_id", "peer_id", "started_at", "_m0", "events",
-                 "state", "url", "report_drops")
+                 "state", "url", "report_drops", "_sum_key", "_sum_cache")
 
     def __init__(self, task_id: str, peer_id: str, *, url: str = "",
                  max_events: int = 4096):
@@ -76,6 +76,8 @@ class TaskFlight:
         # (scheduler_session.report_piece) — a silent drop becomes a ghost
         # peer on the scheduler, so the count rides the flight summary
         self.report_drops = 0
+        self._sum_key: tuple | None = None   # summarize() memo (see there)
+        self._sum_cache: dict = {}
 
     # -- recording (hot path) ------------------------------------------
 
@@ -124,7 +126,20 @@ class TaskFlight:
     def summarize(self) -> dict:
         """Machine-readable attribution: per-piece stage breakdown,
         per-parent throughput, slowest piece + its dominant stage, tail
-        latencies, back-to-source ratio."""
+        latencies, back-to-source ratio.
+
+        Memoized on (event count, state): a finished task is summarized
+        at least twice back-to-back (SLO accounting at conductor finish,
+        then the compact PeerResult form), and the O(events) walk need
+        not run twice. Returns a shallow copy so consumers may del/replace
+        top-level keys (compact_summary does)."""
+        # last event rides the key: a ring at maxlen keeps a constant
+        # length while events churn, so length alone would serve a stale
+        # mid-flight summary from the HTTP surface
+        key = (len(self.events), self.state, self.report_drops,
+               self.events[-1] if self.events else None)
+        if key == self._sum_key:
+            return dict(self._sum_cache)
         pieces: dict[int, dict] = {}
         parents: dict[str, dict] = {}
         rungs: list[str] = []
@@ -233,6 +248,11 @@ class TaskFlight:
         summary["back_to_source_ratio"] = (
             round(summary["bytes_source"] / total_bytes, 4)
             if total_bytes else 0.0)
+        # per-stage SLO budget verdict rides every summary surface (HTTP,
+        # dfdiag, the compact PeerResult form) — pure annotation; the
+        # breach COUNTERS are incremented once per task by the conductor
+        from ..common.health import PLANE
+        PLANE.slo.annotate(summary)
         if slowest is not None:
             stage = max(("queue_ms", "ttfb_ms", "wire_ms", "hbm_ms"),
                         key=lambda k: slowest[k])
@@ -241,7 +261,8 @@ class TaskFlight:
                 "total_ms": slowest["total_ms"],
                 "dominant_stage": stage.removesuffix("_ms"),
                 "dominant_ms": slowest[stage]}
-        return summary
+        self._sum_key, self._sum_cache = key, summary
+        return dict(summary)
 
     def compact_summary(self, *, max_parents: int = 8) -> dict:
         """The wire form attached to the terminal PeerResult: the summary

@@ -21,8 +21,9 @@ import random
 import time
 from typing import TYPE_CHECKING
 
+from ..common import health
 from ..common.errors import Code, DFError
-from ..common.metrics import REGISTRY
+from ..common.metrics import BYTES_BUCKETS, REGISTRY
 from ..idl.messages import (PeerAddr, PeerPacket, PieceInfo, PieceResult,
                             PieceTaskRequest, SizeScope)
 from ..rpc.client import ChannelPool, ServiceClient
@@ -40,6 +41,9 @@ DAEMON_SERVICE = "df.daemon.Daemon"
 
 _p2p_pieces = REGISTRY.counter("df_p2p_piece_total",
                                "pieces fetched from peers", ("result",))
+_p2p_piece_bytes = REGISTRY.histogram(
+    "df_p2p_piece_bytes", "size of each piece landed from a peer",
+    buckets=BYTES_BUCKETS)
 
 
 class _Synchronizer:
@@ -212,10 +216,13 @@ class PieceEngine:
             def on_first(_num=info.piece_num, _pid=single.dst_peer_id):
                 flight.event(fr.FIRST_BYTE, _num, _pid)
         try:
-            data, cost = await self.downloader.download_piece(
-                dst_addr=single.dst_addr, task_id=conductor.task_id,
-                src_peer_id=conductor.peer_id, piece=info,
-                on_first_byte=on_first)
+            with health.PLANE.watchdog.section(
+                    "piece.wire", health.PLANE.slo.section_deadline_s(),
+                    stage="wire"):
+                data, cost = await self.downloader.download_piece(
+                    dst_addr=single.dst_addr, task_id=conductor.task_id,
+                    src_peer_id=conductor.peer_id, piece=info,
+                    on_first_byte=on_first)
         except DFError as exc:
             _p2p_pieces.labels("fail").inc()
             await session.report_piece(self._piece_result(
@@ -229,6 +236,8 @@ class PieceEngine:
         if flight is not None and placed:
             flight.event(fr.WIRE_DONE, info.piece_num, single.dst_peer_id,
                          len(data), dur_ms=cost, t_ms=t_wire)
+        if placed:
+            _p2p_piece_bytes.observe(len(data))
         _p2p_pieces.labels("ok").inc()
         await session.report_piece(self._piece_result(
             conductor, info, single.dst_peer_id, t0, ok=True, cost_ms=cost))
@@ -461,10 +470,19 @@ class PieceEngine:
                               parent=None,   # inherit the task span
                               ) as psp:
                 psp.set(dst=d.parent.peer_id[-16:], link=int(d.parent.link))
-                landed, cost = await self.downloader.download_span(
-                    dst_addr=d.parent.addr, task_id=conductor.task_id,
-                    src_peer_id=conductor.peer_id, pieces=d.pieces,
-                    on_first_byte=on_first)
+                # watchdog section: a parent that wedges mid-transfer
+                # self-reports (await-chain dump + SLO wire breach) well
+                # before the hard per-piece deadline cancels the read
+                # (no-op context while the plane is off); the deadline
+                # scales with the group so healthy spans don't trip it
+                with health.PLANE.watchdog.section(
+                        "piece.wire",
+                        health.PLANE.slo.section_deadline_s(len(d.pieces)),
+                        stage="wire"):
+                    landed, cost = await self.downloader.download_span(
+                        dst_addr=d.parent.addr, task_id=conductor.task_id,
+                        src_peer_id=conductor.peer_id, pieces=d.pieces,
+                        on_first_byte=on_first)
         except DFError as exc:
             if exc.code == Code.CLIENT_PEER_BUSY:
                 # backpressure, not failure: requeue; no scheduler report
@@ -503,6 +521,8 @@ class PieceEngine:
             if flight is not None and placed:
                 flight.event(fr.WIRE_DONE, info.piece_num, d.parent.peer_id,
                              len(data), dur_ms=per_piece_cost, t_ms=t_wire)
+            if placed:
+                _p2p_piece_bytes.observe(len(data))
             _p2p_pieces.labels("ok").inc()
             await session.report_piece(self._piece_result(
                 conductor, info, d.parent.peer_id, t0, ok=True,

@@ -66,9 +66,17 @@ class ProxyServer:
         """The CA file clients/containerd must trust when hijack is on."""
         return self._issuer.ca_cert_path if self._issuer else ""
 
+    # Listen backlog: asyncio's default is 100, and a container-runtime
+    # startup burst (hundreds of layer pulls dialing the proxy in one
+    # tick) overflows it — the kernel then RSTs queued connections and
+    # clients see "server disconnected" with zero server-side log
+    # (tests/test_concurrency.py::TestProxyConcurrency at 200+).
+    BACKLOG = 1024
+
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle_conn, self.daemon.cfg.listen_ip, self.port)
+            self._handle_conn, self.daemon.cfg.listen_ip, self.port,
+            backlog=self.BACKLOG)
         self.port = self._server.sockets[0].getsockname()[1]
         # upstream trust for relayed (non-P2P) fetches mirrors the source
         # client's: a private-CA registry must work for manifests/auth too,
@@ -88,7 +96,8 @@ class ProxyServer:
         if self.sni_port:
             self._sni_server = await asyncio.start_server(
                 self._handle_sni_conn, self.daemon.cfg.listen_ip,
-                max(self.sni_port, 0), ssl=self._sni_ssl_context())
+                max(self.sni_port, 0), ssl=self._sni_ssl_context(),
+                backlog=self.BACKLOG)
             self.sni_port = self._sni_server.sockets[0].getsockname()[1]
             log.info("SNI proxy on :%d", self.sni_port)
         log.info("proxy on :%d (mirror=%s, %d p2p rules, hijack=%s)",
@@ -315,7 +324,14 @@ class ProxyServer:
         head = "HTTP/1.1 200 OK\r\nContent-Type: application/octet-stream\r\n"
         sent_chunked = length < 0
         if sent_chunked:
-            head += "Transfer-Encoding: chunked\r\n\r\n"
+            # Connection: close on THIS branch too: the handler closes the
+            # socket after one response either way, and a chunked reply
+            # without the header let keep-alive clients pool the dead
+            # connection — the next request on it saw "server
+            # disconnected" with nothing in the proxy log (the early-joiner
+            # window before back-source returns content-length, caught by
+            # TestProxyConcurrency at 200+ clients)
+            head += "Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
         else:
             head += f"Content-Length: {length}\r\nConnection: close\r\n\r\n"
         writer.write(head.encode("latin1"))

@@ -13,12 +13,24 @@ import asyncio
 import logging
 import time
 
+from ..common.metrics import REGISTRY
 from ..common.rate import TokenBucket
 
 log = logging.getLogger("df.flow.shaper")
 
 SAMPLE_INTERVAL_S = 1.0
 MIN_SHARE_RATIO = 0.05     # no running task starves below 5% of total
+
+_shaper_rate = REGISTRY.gauge(
+    "df_shaper_rate_bps", "total download budget the shaper splits "
+    "(0 = unlimited, shaper idle)")
+_shaper_tasks = REGISTRY.gauge(
+    "df_shaper_tasks", "tasks currently registered with the shaper")
+_shaper_bytes = REGISTRY.counter(
+    "df_shaper_throttled_bytes_total",
+    "bytes recorded through shaper-governed tasks")
+_shaper_retunes = REGISTRY.counter(
+    "df_shaper_retunes_total", "per-task rate redistributions applied")
 
 
 class _TaskEntry:
@@ -59,17 +71,24 @@ class TrafficShaper:
         if entry is None:
             entry = _TaskEntry()
             self._tasks[task_id] = entry
+            _shaper_tasks.set(len(self._tasks))
             self._retune()
         return entry.bucket
 
     def unregister(self, task_id: str) -> None:
         if self._tasks.pop(task_id, None) is not None:
+            _shaper_tasks.set(len(self._tasks))
             self._retune()
 
     def record(self, task_id: str, nbytes: int) -> None:
         entry = self._tasks.get(task_id)
         if entry is not None:
             entry.consumed += nbytes
+            if self.total_rate_bps > 0:
+                # only governed traffic counts as throttled: with no
+                # budget the shaper is a pass-through and the byte is
+                # already counted by the transfer-path metrics
+                _shaper_bytes.inc(nbytes)
 
     # ------------------------------------------------------------------
 
@@ -79,8 +98,10 @@ class TrafficShaper:
             self._retune()
 
     def _retune(self) -> None:
+        _shaper_rate.set(self.total_rate_bps)
         if self.total_rate_bps <= 0 or not self._tasks:
             return
+        _shaper_retunes.inc()
         n = len(self._tasks)
         if self.kind == "plain":
             share = self.total_rate_bps / n

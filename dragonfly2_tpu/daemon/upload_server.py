@@ -17,7 +17,7 @@ from aiohttp import web
 from ..common import tracing
 from ..common.aiohttp_util import resolve_port
 from ..common.errors import DFError
-from ..common.metrics import REGISTRY
+from ..common.metrics import BYTES_BUCKETS, REGISTRY
 from ..common.piece import parse_http_range
 from ..common.rate import TokenBucket
 from ..storage.manager import StorageManager
@@ -30,6 +30,9 @@ _upload_reqs = REGISTRY.counter("df_upload_requests_total",
                                 "piece requests served", ("status",))
 _upload_active = REGISTRY.gauge("df_upload_active_transfers",
                                 "concurrency-gate slots currently held")
+_upload_piece_bytes = REGISTRY.histogram(
+    "df_upload_transfer_bytes", "size of each piece/span transfer served",
+    buckets=BYTES_BUCKETS)
 
 
 class _Slot:
@@ -172,6 +175,11 @@ class UploadServer:
             # than behind the profiling flag
             from .flight_recorder import add_flight_routes
             add_flight_routes(app.router, self.flight_recorder)
+        # runtime health snapshot (loop lag, watchdog, SLO breaches) —
+        # read-only like /debug/flight, so always on: a wedged daemon's
+        # health surface existing only behind a flag defeats its purpose
+        from ..common.health import add_health_routes
+        add_health_routes(app.router)
         if self.debug_endpoints:
             # pprof-equivalent debug surface (reference cmd/dependency
             # InitMonitor --pprof-port) — OFF by default: profiling slows
@@ -330,6 +338,7 @@ class UploadServer:
             if data_path is not None and total >= 0:
                 await self.limiter.acquire(rng.length)
                 _upload_bytes.inc(rng.length)
+                _upload_piece_bytes.observe(rng.length)
                 _upload_reqs.labels("206").inc()
                 return _SlotFileResponse(data_path(), slot)
             try:
@@ -340,6 +349,7 @@ class UploadServer:
                 raise web.HTTPNotFound(text=exc.message)
             await self.limiter.acquire(len(data))
             _upload_bytes.inc(len(data))
+            _upload_piece_bytes.observe(len(data))
             _upload_reqs.labels("206").inc()
             return _SlotResponse(
                 slot, status=206, body=data,

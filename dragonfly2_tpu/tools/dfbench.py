@@ -1,0 +1,365 @@
+"""dfbench: deterministic in-process fakepod benchmark + perf trajectory.
+
+``python -m dragonfly2_tpu.tools.dfbench --seed 7`` simulates a fan-out
+over a fakepod mesh (2 slices x N/2 hosts + a dedicated seed host, the
+same layout as tests/test_fakepod_ici.py) and writes ``BENCH_pr3.json``
+with aggregate throughput and p50/p95/p99 per-stage latencies — the
+regression gate every later PR compares against.
+
+Why a virtual-clock simulation instead of real daemons: the point of the
+harness is a *reproducible* schedule. The sim drives the REAL scheduler
+stack — ``Scheduling.find_parents`` over the real ``Resource``/``Peer``
+model and the real ``Evaluator`` locality/slot scoring, with upload-slot
+accounting riding ``Task.set_parents`` — plus the real flight-recorder
+``TaskFlight``/``summarize`` stage math and the health plane's SLO
+annotation, under a discrete-event clock seeded by ``--seed``. Two runs
+with the same seed produce byte-identical piece/parent schedules
+(``schedule_digest``), so a diff in the schedule IS a scheduling change,
+and stage latencies move only when the modeled costs (or the scheduler's
+decisions) move. Wall-clock noise from a loaded CI host never enters the
+numbers.
+
+What the latency model charges per piece (per link class ICI/DCN/WAN):
+a base RTT to first byte (inflated by the parent's concurrent transfers
+— upload-slot contention), wire time at the link bandwidth, and an
+HBM-ingest stage at DMA bandwidth; all jittered by the seeded RNG.
+
+Usage:
+    python -m dragonfly2_tpu.tools.dfbench --seed 7          # BENCH_pr3.json
+    python -m dragonfly2_tpu.tools.dfbench --smoke           # tiny, stdout
+    python -m dragonfly2_tpu.tools.dfbench --daemons 16 --pieces 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import heapq
+import json
+import random
+import sys
+
+from ..tpu.topology import LinkType, TopologyInfo, link_type
+
+# modeled link characteristics (bytes/s, ms) — a v5p-ish pod shape:
+# ICI wired bandwidth >> DCN >> cross-zone; the seed host sits outside
+# both slices so every child reaches it over DCN (symmetric, like the
+# fakepod e2e's dedicated seed VM)
+LINK_BW_BPS = {LinkType.LOCAL: 20e9, LinkType.ICI: 8e9,
+               LinkType.DCN: 1.5e9, LinkType.WAN: 0.3e9}
+LINK_RTT_MS = {LinkType.LOCAL: 0.05, LinkType.ICI: 0.3,
+               LinkType.DCN: 1.5, LinkType.WAN: 8.0}
+HBM_BW_BPS = 5e9                 # host-buffer -> device DMA
+TTFB_QUEUE_FACTOR = 0.35         # parent-side queueing per active transfer
+WIRE_SHARE_FACTOR = 0.15         # bandwidth dilution per active transfer
+REFRESH_EVERY = 8                # pieces landed between parent refreshes
+POLL_MS = 5.0                    # starved-worker re-poll (virtual)
+
+STAGES = ("schedule", "first_byte", "wire", "hbm", "total")
+_ROW_KEY = {"schedule": "queue_ms", "first_byte": "ttfb_ms",
+            "wire": "wire_ms", "hbm": "hbm_ms", "total": "total_ms"}
+
+# one percentile rule repo-wide: the bench's stage percentiles must stay
+# comparable with the flight summaries' tail_ms they sit next to
+from ..daemon.flight_recorder import _pctl  # noqa: E402
+
+
+class _Leecher:
+    __slots__ = ("peer", "flight", "done", "inflight", "parents",
+                 "schedule", "landed_at", "joined_ms", "done_ms",
+                 "since_refresh")
+
+    def __init__(self, peer, flight, joined_ms: float):
+        self.peer = peer
+        self.flight = flight
+        self.done: set[int] = set()
+        self.inflight: set[int] = set()
+        self.parents: list = []
+        self.schedule: list[list] = []     # [piece, parent_id] in order
+        self.landed_at: dict[int, float] = {}
+        self.joined_ms = joined_ms
+        self.done_ms = 0.0
+        self.since_refresh = 0
+
+
+def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
+              piece_size: int = 4 << 20, parallelism: int = 4) -> dict:
+    """Run one simulated fan-out; returns the result dict (pure function
+    of its arguments — no wall clock, no global state beyond the process
+    metrics registry the flight summaries touch)."""
+    from ..daemon import flight_recorder as fr
+    from ..daemon.flight_recorder import TaskFlight
+    from ..idl.messages import Host as HostMsg
+    from ..idl.messages import HostType
+    from ..scheduler.config import SchedulerConfig
+    from ..scheduler.evaluator import make_evaluator
+    from ..scheduler.resource import PeerState, Resource, Task
+    from ..scheduler.scheduling import Scheduling
+
+    rng = random.Random(seed)
+    # Scheduling.filter_candidates samples the pool via the GLOBAL
+    # random.shuffle (herd-avoidance) — pin it so the candidate order,
+    # and therefore the schedule, is a function of --seed alone
+    random.seed(seed)
+
+    res = Resource()
+    task = Task("bench" + "0" * 59, "bench://blob")
+    task.set_content_info(pieces * piece_size, piece_size, pieces)
+    sched = Scheduling(SchedulerConfig(), make_evaluator("default"))
+
+    def topo(slice_name: str, x: int, y: int) -> TopologyInfo:
+        return TopologyInfo(slice_name=slice_name, ici_coords=(x, y),
+                            zone="bench-zone")
+
+    def mk_peer(name: str, slice_name: str, x: int, y: int,
+                host_type: HostType = HostType.NORMAL, *,
+                register: bool = True):
+        host = res.store_host(HostMsg(
+            id=f"{name}-host", ip="10.0.0.1", port=1, download_port=2,
+            type=host_type, topology=topo(slice_name, x, y)))
+        if register:
+            return res.get_or_create_peer(f"{name}-peer", task, host)
+        # created now, registered (added to the task + DAG) at join time —
+        # registering the whole pod up front would hand the first offer
+        # edges to every future sibling and the cycle filter would then
+        # bar those siblings from ever serving (real daemons register
+        # when they join, so offers only ever name peers that exist)
+        from ..scheduler.resource import Peer
+        return Peer(f"{name}-peer", task, host)
+
+    # dedicated seed host OUTSIDE both slices, holding every piece
+    seed_peer = mk_peer("seedh", "slice-seed", 9, 9, HostType.SUPER_SEED)
+    seed_peer.transit(PeerState.RUNNING)
+    seed_peer.finished_pieces = set(range(pieces))
+    seed_peer.transit(PeerState.SUCCEEDED)
+
+    # leechers interleaved across 2 slices on a 2-column grid (fakepod
+    # layout), joining staggered so late children see a live mesh
+    leechers: list[_Leecher] = []
+    for i in range(daemons):
+        s = i % 2
+        idx = i // 2
+        peer = mk_peer(f"s{s}w{idx}", f"slice-{s}", idx % 2, idx // 2,
+                       register=False)
+        joined = i * 20.0 * rng.uniform(0.9, 1.1)
+        # ring sized to the run: the recorder's 4096 default would silently
+        # drop the earliest events past ~800 pieces and corrupt the numbers
+        flight = TaskFlight(task.id, peer.id, url="bench://blob",
+                            max_events=5 * pieces + 8)
+        flight.events.append((joined, fr.REGISTERED, -1, "", 0, 0.0))
+        leechers.append(_Leecher(peer, flight, joined))
+
+    by_peer_id = {lc.peer.id: lc for lc in leechers}
+    active: dict[str, int] = {}        # parent peer id -> live transfers
+
+    def refresh_parents(lc: _Leecher) -> None:
+        parents = sched.find_parents(lc.peer)
+        lc.parents = parents
+        lc.peer.last_offer_ids = {p.id for p in parents}
+        task.set_parents(lc.peer.id, [p.id for p in parents])
+
+    def holds(parent, piece: int, now: float) -> bool:
+        if parent is seed_peer:
+            return True
+        src = by_peer_id.get(parent.id)
+        if src is None:
+            return False
+        t = src.landed_at.get(piece)
+        return t is not None and t <= now
+
+    def pick(lc: _Leecher, now: float):
+        """(piece, parent) for the next fetch, or None while starved.
+        Lowest-numbered needed piece first; among holders, the least
+        loaded parent on the fastest link wins (the dispatcher's
+        load-aware locality preference, collapsed to a deterministic
+        rule)."""
+        for piece in range(pieces):
+            if piece in lc.done or piece in lc.inflight:
+                continue
+            holders = [p for p in lc.parents if holds(p, piece, now)]
+            if not holders:
+                continue
+            lt = {p.id: link_type(lc.peer.host.msg.topology,
+                                  p.host.msg.topology) for p in holders}
+            holders.sort(key=lambda p: (active.get(p.id, 0),
+                                        int(lt[p.id]), p.id))
+            return piece, holders[0]
+        return None
+
+    # discrete-event loop over (time_ms, seq, kind, ...):
+    #   ("worker", i)                — a worker of leecher i is free
+    #   ("land", i, piece, pid, tw) — a transfer's wire half finished
+    # Transfers hold their parent's ``active`` slot from dispatch until
+    # wire-done, so contention (ttfb inflation, bandwidth dilution)
+    # builds exactly when concurrent pulls overlap in virtual time.
+    events: list[tuple] = []
+    seq = 0
+
+    def push(t: float, *payload) -> None:
+        nonlocal seq
+        heapq.heappush(events, (t, seq, *payload))
+        seq += 1
+
+    for i, lc in enumerate(leechers):
+        for _ in range(parallelism):
+            push(lc.joined_ms, "worker", i)
+
+    finished = 0
+    while events and finished < len(leechers):
+        now, _s, kind, i, *rest = heapq.heappop(events)
+        lc = leechers[i]
+        if kind == "land":
+            piece, parent_id, t_wire = rest
+            lc.inflight.discard(piece)
+            lc.done.add(piece)
+            lc.landed_at[piece] = t_wire
+            lc.peer.finished_pieces.add(piece)
+            active[parent_id] = max(0, active.get(parent_id, 0) - 1)
+            lc.since_refresh += 1
+            if len(lc.done) >= pieces:
+                lc.flight.state = "success"
+                lc.peer.transit(PeerState.SUCCEEDED)
+                finished += 1
+            elif lc.since_refresh >= REFRESH_EVERY:
+                lc.since_refresh = 0
+                refresh_parents(lc)
+            continue
+        # worker event
+        if len(lc.done) + len(lc.inflight) >= pieces:
+            continue                     # nothing left for this worker
+        if lc.peer.id not in task.peers:
+            # join: register with the scheduler (exactly once — the first
+            # of this leecher's workers to wake does it) and take the
+            # initial offer
+            task.add_peer(lc.peer)
+            lc.peer.transit(PeerState.RUNNING)
+            refresh_parents(lc)
+        if not lc.parents:
+            refresh_parents(lc)
+        got = pick(lc, now)
+        if got is None:
+            # starved: refresh the offer (the scheduler's re-offer path)
+            # and re-poll — content lands in virtual time, not wall time
+            refresh_parents(lc)
+            push(now + POLL_MS, "worker", i)
+            continue
+        piece, parent = got
+        lc.inflight.add(piece)
+        lc.schedule.append([piece, parent.id])
+        lt = link_type(lc.peer.host.msg.topology, parent.host.msg.topology)
+        load = active.get(parent.id, 0)
+        active[parent.id] = load + 1
+        queue_ms = rng.uniform(0.1, 0.5)
+        ttfb_ms = (LINK_RTT_MS[lt] * (1.0 + TTFB_QUEUE_FACTOR * load)
+                   * rng.uniform(0.9, 1.3))
+        wire_ms = (piece_size / LINK_BW_BPS[lt] * 1000.0
+                   * (1.0 + WIRE_SHARE_FACTOR * load) * rng.uniform(0.9, 1.25))
+        hbm_ms = piece_size / HBM_BW_BPS * 1000.0 * rng.uniform(0.95, 1.15)
+        t_disp = now + queue_ms
+        t_first = t_disp + ttfb_ms
+        t_wire = t_first + wire_ms
+        t_hbm = t_wire + hbm_ms
+        ev = lc.flight.events.append
+        ev((now, fr.SCHEDULED, piece, parent.id, 0, 0.0))
+        ev((t_disp, fr.DISPATCHED, piece, parent.id, 0, 0.0))
+        ev((t_first, fr.FIRST_BYTE, piece, parent.id, 0, 0.0))
+        ev((t_wire, fr.WIRE_DONE, piece, parent.id, piece_size, wire_ms))
+        ev((t_hbm, fr.HBM_DONE, piece, "", piece_size, 0.0))
+        lc.done_ms = max(lc.done_ms, t_hbm)
+        push(t_wire, "land", i, piece, parent.id, t_wire)
+        push(t_hbm, "worker", i)         # worker busy through HBM staging
+
+    return _summarize(leechers, seed=seed, daemons=daemons, pieces=pieces,
+                      piece_size=piece_size, parallelism=parallelism)
+
+
+def _summarize(leechers, *, seed, daemons, pieces, piece_size,
+               parallelism) -> dict:
+    rows: list[dict] = []
+    per_daemon = {}
+    schedules = {}
+    seed_pieces = 0
+    total_pieces = 0
+    for lc in leechers:
+        summary = lc.flight.summarize()
+        rows.extend(summary["piece_rows"])
+        per_daemon[lc.peer.id] = {
+            "pieces": summary["pieces"],
+            "bytes": summary["bytes_p2p"] + summary["bytes_source"],
+            "joined_ms": round(lc.joined_ms, 3),
+            "done_ms": round(lc.done_ms, 3),
+            "tail_ms": summary["tail_ms"],
+            "slo_breaches": summary.get("slo_breaches", {}),
+        }
+        schedules[lc.peer.id] = lc.schedule
+        total_pieces += len(lc.schedule)
+        seed_pieces += sum(1 for _, p in lc.schedule
+                           if p.startswith("seedh"))
+    stage_latency = {}
+    for stage in STAGES:
+        vals = sorted(r[_ROW_KEY[stage]] for r in rows)
+        stage_latency[stage] = {"p50": _pctl(vals, 0.50),
+                                "p95": _pctl(vals, 0.95),
+                                "p99": _pctl(vals, 0.99)}
+    wall_ms = max((lc.done_ms for lc in leechers), default=0.0)
+    total_bytes = sum(d["bytes"] for d in per_daemon.values())
+    digest = hashlib.sha256(
+        json.dumps(schedules, sort_keys=True).encode()).hexdigest()
+    return {
+        "bench": "dfbench-fakepod",
+        "virtual_clock": True,
+        "seed": seed,
+        "daemons": daemons,
+        "pieces": pieces,
+        "piece_size": piece_size,
+        "parallelism": parallelism,
+        "wall_ms": round(wall_ms, 3),
+        "throughput_bps": (round(total_bytes / (wall_ms / 1000.0))
+                           if wall_ms > 0 else 0),
+        "stage_latency_ms": stage_latency,
+        "seed_served_ratio": (round(seed_pieces / total_pieces, 4)
+                              if total_pieces else 0.0),
+        "per_daemon": per_daemon,
+        "schedule_digest": digest,
+        "schedules": schedules,
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dfbench", description="deterministic fakepod benchmark")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--daemons", type=int, default=8)
+    p.add_argument("--pieces", type=int, default=64)
+    p.add_argument("--piece-size", type=int, default=4 << 20)
+    p.add_argument("--parallelism", type=int, default=4)
+    p.add_argument("--out", default="BENCH_pr3.json",
+                   help="result path ('-' = stdout only)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny run (4 daemons x 8 pieces), stdout only — "
+                   "exercised by tier-1 so the harness itself can't rot")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.smoke:
+        args.daemons, args.pieces, args.out = 4, 8, "-"
+    result = run_bench(seed=args.seed, daemons=args.daemons,
+                       pieces=args.pieces, piece_size=args.piece_size,
+                       parallelism=args.parallelism)
+    text = json.dumps(result, indent=2, sort_keys=True)
+    if args.out and args.out != "-":
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+        print(f"dfbench: wrote {args.out} "
+              f"(throughput {result['throughput_bps'] / 1e9:.2f} GB/s, "
+              f"wall {result['wall_ms']:.0f}ms, "
+              f"schedule {result['schedule_digest'][:12]})")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

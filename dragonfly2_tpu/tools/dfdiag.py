@@ -134,6 +134,16 @@ def verdict(summary: dict) -> str:
     if tail:
         parts.append(f"piece latency p50/p90/p99 = {tail.get('p50')}/"
                      f"{tail.get('p90')}/{tail.get('p99')}ms")
+    slo = summary.get("slo_breaches") or {}
+    if slo:
+        # the health plane's per-stage budget verdict (docs/OBSERVABILITY
+        # "SLO budgets"): which configured budget this download blew
+        budgets = summary.get("slo_budgets_ms") or {}
+        blown = ", ".join(
+            f"{n} piece(s) over the {stage} budget"
+            + (f" ({budgets[stage]:.0f}ms)" if stage in budgets else "")
+            for stage, n in sorted(slo.items()))
+        parts.append(f"SLO breach: {blown}")
     rungs = summary.get("rungs") or []
     if rungs:
         # which degradation-ladder rung served this task, and the trail it

@@ -35,6 +35,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 async def serve(cfg: ManagerConfig, debug_port: int = 0) -> None:
+    from ..common import health
+    health.PLANE.acquire()   # loop watchdog + /debug/health on --debug-port
     mgr = Manager(cfg)
     await mgr.start()
     from ..common.debug_http import maybe_start_debug
@@ -48,6 +50,7 @@ async def serve(cfg: ManagerConfig, debug_port: int = 0) -> None:
     if debug_runner is not None:
         await debug_runner.cleanup()
     await mgr.stop()
+    health.PLANE.release()
     from ..common import tracing
     tracing.shutdown()   # don't drop the final span batch of a short run
 

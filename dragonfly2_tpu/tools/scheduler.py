@@ -40,6 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
+    from ..common import health
+    health.PLANE.acquire()   # loop watchdog + /debug/health on --debug-port
     sched = Scheduler(cfg)
     await sched.start()
     from ..common.debug_http import maybe_start_debug
@@ -57,6 +59,7 @@ async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
     if debug_runner is not None:
         await debug_runner.cleanup()
     await sched.stop()
+    health.PLANE.release()
     from ..common import tracing
     tracing.shutdown()   # don't drop the final span batch of a short run
 

@@ -22,6 +22,10 @@ import pytest
 import bench
 from test_churn import start_daemon, teardown
 
+# 16 real daemon processes + mid-wave kills: ~75s alone and flaky under
+# full-suite CPU contention — tier-1 excludes it (ROADMAP -m 'not slow')
+pytestmark = pytest.mark.slow
+
 N_LEECHERS = 16                      # VERDICT r04 #5's wave size
 N_KILLED = 2
 # 96 MB = 24 x 4 MiB pieces: at 16 pieces the per-survivor seed fraction

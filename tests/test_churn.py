@@ -23,6 +23,10 @@ import urllib.request
 
 import pytest
 
+# three multi-process kill scenarios against paced origins: minutes of
+# wall time by design — tier-1 excludes it (ROADMAP -m 'not slow')
+pytestmark = pytest.mark.slow
+
 from test_launchers import free_port, wait_line
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,87 @@
+"""dfbench: the deterministic fakepod perf harness. Tier-1 exercises the
+CLI (--smoke) plus the determinism and schema contracts BENCH_pr3.json
+consumers rely on."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dragonfly2_tpu.tools.dfbench import run_bench
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+
+
+class TestDeterminism:
+    def test_same_seed_identical_schedules_and_numbers(self):
+        a = run_bench(seed=7, daemons=6, pieces=24)
+        b = run_bench(seed=7, daemons=6, pieces=24)
+        # the acceptance bar: identical piece/parent schedules, run to run
+        assert a["schedules"] == b["schedules"]
+        assert a["schedule_digest"] == b["schedule_digest"]
+        assert a["stage_latency_ms"] == b["stage_latency_ms"]
+        assert a["throughput_bps"] == b["throughput_bps"]
+
+    def test_different_seed_different_schedule(self):
+        a = run_bench(seed=7, daemons=6, pieces=24)
+        c = run_bench(seed=11, daemons=6, pieces=24)
+        assert a["schedule_digest"] != c["schedule_digest"]
+
+
+class TestBenchSemantics:
+    def test_mesh_forms_and_schema(self):
+        r = run_bench(seed=7, daemons=8, pieces=32)
+        # every daemon got every piece exactly once
+        for peer, sched in r["schedules"].items():
+            assert sorted(p for p, _ in sched) == list(range(32)), peer
+        # the mesh carried most of the bytes — a fan-out where every piece
+        # comes from the seed means parent selection is broken
+        assert 0.0 < r["seed_served_ratio"] < 0.6
+        assert r["throughput_bps"] > 0
+        assert r["wall_ms"] > 0
+        for stage in ("schedule", "first_byte", "wire", "hbm", "total"):
+            tri = r["stage_latency_ms"][stage]
+            assert tri["p50"] <= tri["p95"] <= tri["p99"]
+        # per-daemon entries carry the flight-summary derived fields
+        for d in r["per_daemon"].values():
+            assert d["pieces"] == 32
+            assert d["done_ms"] >= d["joined_ms"]
+
+    def test_slo_annotation_rides_bench_rows(self):
+        """The bench exercises the real flight summarize() path, so the
+        health plane's SLO annotation appears on its per-daemon output."""
+        r = run_bench(seed=7, daemons=4, pieces=8)
+        for d in r["per_daemon"].values():
+            assert "slo_breaches" in d
+
+
+class TestCLI:
+    def test_smoke_invocation_writes_no_file(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-fakepod"
+        assert r["daemons"] == 4 and r["pieces"] == 8
+        assert not list(tmp_path.iterdir())      # stdout only
+
+    def test_default_out_writes_bench_pr3(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--seed", "7", "--daemons", "4", "--pieces", "8"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads((tmp_path / "BENCH_pr3.json").read_text())
+        assert r["seed"] == 7
+        assert "schedule_digest" in r
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

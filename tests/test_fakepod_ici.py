@@ -22,6 +22,10 @@ import urllib.request
 
 import pytest
 
+# 13 OS processes + a paced 64 MiB fan-out + the ML loop closing: the
+# heaviest e2e in the tree — tier-1 excludes it (ROADMAP -m 'not slow')
+pytestmark = pytest.mark.slow
+
 from test_launchers import free_port, spawn, wait_line
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,381 @@
+"""Runtime health plane: loop-lag sampler, coroutine watchdog, per-stage
+SLO engine, the /debug/health surface, and the acceptance e2e — an armed
+``piece.wire`` hang must self-report (await-chain stacks + SLO breach)
+while the pod recovers through the existing degradation ladder.
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+from dragonfly2_tpu.common import faultgate, health
+from dragonfly2_tpu.common.health import (HealthConfig, SLOEngine,
+                                          format_stacks)
+from dragonfly2_tpu.common.metrics import REGISTRY
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faultgate.reset()
+    yield
+    faultgate.reset()
+
+
+def _breach_count(stage: str, rung: str) -> float:
+    return REGISTRY.counter(
+        "df_slo_breach_total", "per-stage latency budget breaches",
+        ("stage", "rung")).value(stage, rung)
+
+
+def _overrun_count(section: str) -> float:
+    return REGISTRY.counter(
+        "df_watchdog_overrun_total",
+        "watchdog sections past their deadline", ("section",)).value(section)
+
+
+class TestLoopLagSampler:
+    def test_lag_observed_and_stall_event(self):
+        async def go():
+            plane = health.HealthPlane()
+            plane.acquire(HealthConfig(sample_interval_s=0.05,
+                                       stall_threshold_s=0.3))
+            try:
+                await asyncio.sleep(0.12)      # a few clean samples
+                assert plane.samples >= 1
+                assert plane.max_lag_s < 0.3
+                time.sleep(0.5)                # block the loop: a stall
+                await asyncio.sleep(0.1)       # let the monitor sample it
+                assert plane.stalls >= 1
+                assert plane.max_lag_s >= 0.3
+                snap = plane.snapshot()
+                assert snap["status"] == "stalled"
+                kinds = [e["kind"] for e in snap["events"]]
+                assert "loop_stall" in kinds
+            finally:
+                plane.release()
+            assert not plane.active
+
+        asyncio.run(go())
+
+    def test_refcounted_monitor(self):
+        async def go():
+            plane = health.HealthPlane()
+            plane.acquire()
+            plane.acquire()
+            plane.release()
+            assert plane.active            # second holder keeps it alive
+            plane.release()
+            assert not plane.active
+
+        asyncio.run(go())
+
+    def test_disabled_plane_never_starts(self):
+        async def go():
+            plane = health.HealthPlane()
+            plane.acquire(HealthConfig(enabled=False))
+            assert not plane.active
+            # sections become shared no-op contexts: zero per-piece cost
+            ctx = plane.watchdog.section("piece.wire", 1.0, stage="wire")
+            with ctx:
+                pass
+            assert plane.watchdog.snapshot()["active_sections"] == []
+            plane.release()
+
+        asyncio.run(go())
+
+
+class TestWatchdog:
+    def test_failed_overrun_dumps_await_chain_and_counts_breach(self):
+        """A section that overruns and then FAILS (the real hang shape:
+        deadline cancels the read) counts exactly one SLO breach."""
+        async def go():
+            plane = health.HealthPlane()
+            plane.acquire(HealthConfig(sample_interval_s=0.03))
+            before = _breach_count("wire", "p2p")
+
+            async def wedged():
+                with plane.watchdog.section("test.wedge", 0.1, stage="wire"):
+                    await asyncio.wait_for(asyncio.sleep(30.0), 0.4)
+
+            try:
+                with pytest.raises(asyncio.TimeoutError):
+                    await wedged()
+                snap = plane.snapshot()
+                ev = [e for e in snap["events"]
+                      if e["kind"] == "section_overrun"]
+                assert ev, snap["events"]
+                # the dump names WHERE the task was parked (the await
+                # chain, not just the outermost frame)
+                assert "wedged" in ev[-1]["stacks"]
+                assert _breach_count("wire", "p2p") == before + 1
+                assert _overrun_count("test.wedge") >= 1
+            finally:
+                plane.release()
+
+        asyncio.run(go())
+
+    def test_completed_late_section_leaves_breach_to_flight_row(self):
+        """A section that overruns but COMPLETES is counted by its own
+        flight row at task finish — the watchdog must not double-count
+        it (one slow piece = one df_slo_breach_total increment)."""
+        async def go():
+            plane = health.HealthPlane()
+            plane.acquire(HealthConfig(sample_interval_s=0.03))
+            before = _breach_count("wire", "p2p")
+            try:
+                with plane.watchdog.section("test.late", 0.1, stage="wire"):
+                    await asyncio.sleep(0.3)        # late, but succeeds
+                assert _overrun_count("test.late") >= 1   # still reported
+                assert _breach_count("wire", "p2p") == before
+            finally:
+                plane.release()
+
+        asyncio.run(go())
+
+    def test_section_closed_in_time_fires_nothing(self):
+        async def go():
+            plane = health.HealthPlane()
+            plane.acquire(HealthConfig(sample_interval_s=0.03))
+            try:
+                with plane.watchdog.section("test.fast", 5.0, stage="wire"):
+                    await asyncio.sleep(0.01)
+                await asyncio.sleep(0.08)
+                assert not [e for e in plane.events
+                            if e["kind"] == "section_overrun"]
+                assert plane.watchdog.snapshot()["active_sections"] == []
+            finally:
+                plane.release()
+
+        asyncio.run(go())
+
+    def test_format_stacks_walks_await_chain(self):
+        async def go():
+            async def inner():
+                await asyncio.sleep(0.2)
+
+            async def outer():
+                await inner()
+
+            t = asyncio.get_running_loop().create_task(outer(),
+                                                       name="deep-task")
+            await asyncio.sleep(0.05)
+            text = format_stacks()
+            t.cancel()
+            # both frames of the chain appear — Task.get_stack alone would
+            # show only `outer`
+            assert "outer" in text and "inner" in text
+            assert "deep-task" in text
+
+        asyncio.run(go())
+
+
+class TestSLOEngine:
+    ROWS = [
+        # fast piece: inside every budget
+        {"piece": 0, "queue_ms": 1.0, "ttfb_ms": 2.0, "wire_ms": 5.0,
+         "hbm_ms": 0.5, "total_ms": 8.5},
+        # slow wire + slow first byte
+        {"piece": 1, "queue_ms": 1.0, "ttfb_ms": 900.0, "wire_ms": 4000.0,
+         "hbm_ms": 0.5, "total_ms": 4901.5},
+        # slow wire only
+        {"piece": 2, "queue_ms": 1.0, "ttfb_ms": 2.0, "wire_ms": 700.0,
+         "hbm_ms": 0.5, "total_ms": 703.5},
+    ]
+
+    def test_annotate_counts_per_stage(self):
+        slo = SLOEngine({"schedule": 100.0, "first_byte": 500.0,
+                         "wire": 600.0, "hbm": 100.0})
+        summary = {"piece_rows": [dict(r) for r in self.ROWS]}
+        slo.annotate(summary)
+        assert summary["slo_breaches"] == {"first_byte": 1, "wire": 2}
+        assert summary["slo_budgets_ms"]["wire"] == 600.0
+
+    def test_zero_budget_disables_stage(self):
+        slo = SLOEngine({"schedule": 0.0, "first_byte": 0.0, "wire": 600.0,
+                         "hbm": 0.0})
+        summary = {"piece_rows": [dict(r) for r in self.ROWS]}
+        assert slo.annotate(summary)["slo_breaches"] == {"wire": 2}
+
+    def test_observe_summary_counts_by_served_rung(self):
+        slo = SLOEngine({"wire": 600.0})
+        before = _breach_count("wire", "back_source")
+        summary = {"piece_rows": [dict(r) for r in self.ROWS],
+                   "served_rung": "back_source"}
+        got = slo.observe_summary(summary)
+        assert got == {"wire": 2}
+        assert _breach_count("wire", "back_source") == before + 2
+        assert {"stage": "wire", "rung": "back_source", "count": 2} in \
+            slo.snapshot()["breaches"]
+
+    def test_disabled_engine_neither_counts_nor_annotates(self):
+        """health.enabled=false turns the WHOLE plane off: summaries stay
+        untouched and no breach counter moves."""
+        slo = SLOEngine({"wire": 600.0}, enabled=False)
+        before = _breach_count("wire", "p2p")
+        summary = {"piece_rows": [dict(r) for r in self.ROWS]}
+        assert slo.annotate(summary) is summary
+        assert "slo_breaches" not in summary
+        assert slo.observe_summary(summary) == {}
+        slo.breach("wire", "p2p")
+        assert _breach_count("wire", "p2p") == before
+
+    def test_dfdiag_verdict_names_blown_budget(self):
+        from dragonfly2_tpu.tools.dfdiag import verdict
+        slo = SLOEngine({"wire": 600.0})
+        summary = {"piece_rows": [dict(r) for r in self.ROWS],
+                   "tail_ms": {"p50": 8, "p90": 700, "p99": 4900}}
+        slo.annotate(summary)
+        v = verdict(summary)
+        assert "SLO breach" in v
+        assert "wire budget" in v and "600ms" in v
+
+
+class TestHealthEndpoint:
+    def test_debug_health_on_upload_server(self, tmp_path):
+        """/debug/health is always-on next to /debug/flight; ?dump=1
+        returns the text stack dump with the flight-recorder state."""
+        from test_daemon_e2e import daemon_config
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+
+        async def go():
+            daemon = Daemon(daemon_config(tmp_path, "hlt"))
+            await daemon.start()
+            try:
+                assert daemon.health is health.PLANE
+                assert health.PLANE.active
+                import aiohttp
+                port = daemon.upload_server.port
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"http://127.0.0.1:{port}"
+                                     f"/debug/health") as r:
+                        assert r.status == 200
+                        snap = await r.json()
+                    async with s.get(f"http://127.0.0.1:{port}"
+                                     f"/debug/health?dump=1") as r:
+                        dump = await r.text()
+                assert snap["active"] is True
+                assert snap["loop"]["sample_interval_s"] > 0
+                assert "budgets_ms" in snap["slo"]
+                assert "--- asyncio tasks ---" in dump
+            finally:
+                await daemon.stop()
+            # the daemon released its plane handle on stop
+            assert not health.PLANE.active
+
+        asyncio.run(go())
+
+
+class TestWatchdogHangE2E:
+    """Acceptance: a parent wedged mid-piece (faultgate piece.wire hang)
+    becomes a self-reported health event — /debug/health shows the
+    overdue section with full await-chain stacks and the SLO counter
+    increments for the wire stage — while the existing ladder (per-piece
+    deadline -> requeue) still completes the task from the mesh."""
+
+    def test_hang_reports_and_recovers(self, tmp_path):
+        from test_daemon_e2e import daemon_config
+        from test_p2p import (ScriptedScheduler, ScriptedSession,
+                              parent_addr, seed_daemon_with)
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import (DownloadRequest, PeerPacket,
+                                                 RegisterResult, SizeScope)
+
+        data = os.urandom((9 << 20) + 333)
+
+        async def go():
+            seed, origin, url, task_id, seed_peer = await seed_daemon_with(
+                tmp_path, data)
+            await origin.cleanup()       # bytes MUST come from the mesh
+            leech_cfg = daemon_config(tmp_path, "leech")
+            leech_cfg.download.piece_timeout_s = 2.0
+            # budgets far below the hard deadline (the section deadline is
+            # first_byte + wire * group): the watchdog must report the
+            # wedge BEFORE the deadline recovers it
+            leech_cfg.health.slo_first_byte_ms = 100.0
+            leech_cfg.health.slo_wire_ms = 300.0
+            leech_cfg.health.sample_interval_s = 0.05
+            leecher = Daemon(leech_cfg)
+
+            def make_session(conductor):
+                packet = PeerPacket(task_id=conductor.task_id,
+                                    src_peer_id=conductor.peer_id,
+                                    main_peer=parent_addr(seed, seed_peer))
+                return ScriptedSession(RegisterResult(
+                    task_id=conductor.task_id,
+                    size_scope=SizeScope.NORMAL), [packet])
+
+            leecher._scheduler_factory = (
+                lambda d: ScriptedScheduler(make_session))
+            await leecher.start()
+            breaches_before = _breach_count("wire", "p2p")
+            overruns_before = _overrun_count("piece.wire")
+            script = faultgate.arm("piece.wire", "hang", n=1)
+
+            seen: dict = {}
+
+            async def poll_health():
+                """Watch /debug/health WHILE the hang is in progress."""
+                import aiohttp
+                port = leecher.upload_server.port
+                async with aiohttp.ClientSession() as s:
+                    for _ in range(100):
+                        async with s.get(f"http://127.0.0.1:{port}"
+                                         f"/debug/health") as r:
+                            snap = await r.json()
+                        over = [e for e in snap["events"]
+                                if e["kind"] == "section_overrun"
+                                and e["section"] == "piece.wire"]
+                        if over:
+                            seen["event"] = over[-1]
+                            seen["status"] = snap["status"]
+                            seen["sections"] = snap["watchdog"][
+                                "active_sections"]
+                            return
+                        await asyncio.sleep(0.05)
+
+            try:
+                poller = asyncio.get_running_loop().create_task(
+                    poll_health())
+                t0 = time.monotonic()
+                async for _ in leecher.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "out.bin"),
+                        disable_back_source=True, timeout_s=60.0)):
+                    pass
+                elapsed = time.monotonic() - t0
+                await poller
+
+                # -- the hang was REPORTED while in progress -------------
+                assert "event" in seen, "no section_overrun on /debug/health"
+                ev = seen["event"]
+                assert ev["stage"] == "wire"
+                # full await chain: the dump pinpoints the parked read
+                # inside the downloader (the frame Task.get_stack hides)
+                assert "piece_downloader" in ev["stacks"]
+                assert _breach_count("wire", "p2p") >= breaches_before + 1
+                assert _overrun_count("piece.wire") >= overruns_before + 1
+
+                # -- and the pod RECOVERED through the ladder ------------
+                assert (tmp_path / "out.bin").read_bytes() == data
+                conductor = leecher.ptm.conductor(task_id)
+                assert conductor.state == conductor.SUCCESS
+                assert conductor.traffic_p2p == len(data)
+                assert script.fired == 1
+                assert elapsed >= 2.0    # the piece deadline had to fire
+                summary = leecher.flight_recorder.get(task_id).summarize()
+                assert summary["served_rung"] == "p2p"
+            finally:
+                await leecher.stop()
+                await seed.stop()
+
+        asyncio.run(go())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

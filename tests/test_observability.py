@@ -235,28 +235,38 @@ class TestExpositionStrictness:
         assert r.counter("df_dup_total", "x") is not None
 
 
+def _import_all_metric_modules():
+    """Every module that registers process metrics — the lint's universe."""
+    import importlib
+
+    for mod in (
+            "dragonfly2_tpu.common.faultgate",
+            "dragonfly2_tpu.common.gc",
+            "dragonfly2_tpu.common.health",
+            "dragonfly2_tpu.daemon.daemon",
+            "dragonfly2_tpu.daemon.proxy",
+            "dragonfly2_tpu.daemon.objectstorage",
+            "dragonfly2_tpu.daemon.piece_dispatcher",
+            "dragonfly2_tpu.daemon.piece_engine",
+            "dragonfly2_tpu.daemon.scheduler_session",
+            "dragonfly2_tpu.daemon.traffic_shaper",
+            "dragonfly2_tpu.daemon.upload_server",
+            "dragonfly2_tpu.rpc.mux",
+            "dragonfly2_tpu.scheduler.service",
+            "dragonfly2_tpu.scheduler.cluster_view",
+            "dragonfly2_tpu.manager.server",
+            "dragonfly2_tpu.trainer.server",
+            "dragonfly2_tpu.tpu.hbm_sink",
+    ):
+        importlib.import_module(mod)
+
+
 class TestMetricNamespaceLint:
     def test_registry_hygiene_after_importing_all_services(self):
         """Walk the process REGISTRY with every service imported: all
         metrics df_-prefixed, none with empty help (the /metrics surface
         must stay self-describing as it grows)."""
-        import importlib
-
-        for mod in (
-                "dragonfly2_tpu.daemon.daemon",
-                "dragonfly2_tpu.daemon.proxy",
-                "dragonfly2_tpu.daemon.objectstorage",
-                "dragonfly2_tpu.daemon.piece_dispatcher",
-                "dragonfly2_tpu.daemon.piece_engine",
-                "dragonfly2_tpu.daemon.upload_server",
-                "dragonfly2_tpu.rpc.mux",
-                "dragonfly2_tpu.scheduler.service",
-                "dragonfly2_tpu.scheduler.cluster_view",
-                "dragonfly2_tpu.manager.server",
-                "dragonfly2_tpu.trainer.server",
-                "dragonfly2_tpu.tpu.hbm_sink",
-        ):
-            importlib.import_module(mod)
+        _import_all_metric_modules()
         from dragonfly2_tpu.common.metrics import REGISTRY
         metrics = list(REGISTRY._metrics.values())
         assert metrics, "no metrics registered?"
@@ -265,6 +275,102 @@ class TestMetricNamespaceLint:
         assert not bad_prefix, f"non-df_ metric names: {bad_prefix}"
         empty_help = [m.name for m in metrics if not m.help.strip()]
         assert not empty_help, f"metrics with empty help: {empty_help}"
+
+    def test_every_registered_metric_is_documented(self):
+        """The docs/OBSERVABILITY.md catalogue must cover the registry: a
+        metric that exists only in code is invisible to operators, and
+        the PR-3 audit found the doc trailing the code by a third."""
+        import re
+
+        _import_all_metric_modules()
+        from dragonfly2_tpu.common.metrics import REGISTRY
+        doc = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                                "docs", "OBSERVABILITY.md"),
+                   encoding="utf-8").read()
+        documented = set(re.findall(r"df_[a-z0-9_]+", doc))
+        missing = sorted(m for m in REGISTRY._metrics
+                         if m not in documented)
+        assert not missing, (
+            f"metrics registered in code but absent from "
+            f"docs/OBSERVABILITY.md: {missing}")
+
+
+class TestShaperMetrics:
+    def test_shaper_exports_rate_tasks_and_bytes(self):
+        from dragonfly2_tpu.common.metrics import REGISTRY
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+
+        tasks_g = REGISTRY.gauge("df_shaper_tasks", "x")
+        rate_g = REGISTRY.gauge("df_shaper_rate_bps", "x")
+        bytes_c = REGISTRY.counter("df_shaper_throttled_bytes_total", "x")
+        retunes = REGISTRY.counter("df_shaper_retunes_total", "x")
+        before_bytes = bytes_c.value()
+        before_retunes = retunes.value()
+
+        shaper = TrafficShaper(total_rate_bps=1 << 20, kind="sampling")
+        shaper.register("t1")
+        shaper.register("t2")
+        assert tasks_g.value() == 2
+        assert rate_g.value() == 1 << 20
+        shaper.record("t1", 4096)
+        shaper.record("t2", 1024)
+        assert bytes_c.value() == before_bytes + 5120
+        assert retunes.value() >= before_retunes + 2
+        shaper.unregister("t1")
+        shaper.unregister("t2")
+        assert tasks_g.value() == 0
+
+    def test_unlimited_shaper_counts_no_throttled_bytes(self):
+        from dragonfly2_tpu.common.metrics import REGISTRY
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+
+        bytes_c = REGISTRY.counter("df_shaper_throttled_bytes_total", "x")
+        before = bytes_c.value()
+        shaper = TrafficShaper(total_rate_bps=0)
+        shaper.register("t")
+        shaper.record("t", 9999)
+        # pass-through mode: the byte is already counted by the transfer
+        # path; double-counting it here would overstate shaping
+        assert bytes_c.value() == before
+        shaper.unregister("t")
+
+
+class TestGCMetrics:
+    def test_gc_run_records_timestamp_duration_and_reclaimed(self):
+        from dragonfly2_tpu.common.gc import GC, GCTask
+        from dragonfly2_tpu.common.metrics import REGISTRY
+
+        last = REGISTRY.gauge("df_gc_last_run_timestamp_seconds", "x",
+                              ("task",))
+        reclaimed = REGISTRY.counter("df_gc_reclaimed_total", "x", ("task",))
+        runs = REGISTRY.counter("df_gc_runs_total", "x", ("task", "result"))
+        dur = REGISTRY.histogram("df_gc_run_duration_seconds", "x",
+                                 ("task",))
+
+        async def go():
+            gc = GC()
+            gc.add(GCTask("sweep-a", 3600.0, lambda: 3))
+
+            async def failing():
+                raise RuntimeError("disk gone")
+
+            gc.add(GCTask("sweep-b", 3600.0, failing))
+            t0 = __import__("time").time()
+            assert await gc.run_one("sweep-a") == 3
+            assert await gc.run_one("sweep-a") == 3
+            with pytest.raises(RuntimeError):
+                await gc.run_one("sweep-b")
+            assert last.value("sweep-a") >= t0
+            assert reclaimed.value("sweep-a") == 6
+            assert runs.value("sweep-a", "ok") == 2
+            assert runs.value("sweep-b", "error") == 1
+            # duration histogram saw both ok sweeps
+            _counts, _total, n = dur.snapshot("sweep-a")
+            assert n == 2
+            # a failed sweep must NOT advance the liveness timestamp
+            assert last.value("sweep-b") == 0.0
+
+        asyncio.run(go())
 
 
 class TestFlightHTTP:

@@ -17,6 +17,9 @@ stress tool can arm with deterministic scripts:
     hbm.ingest      tpu/hbm_sink.py DeviceIngest.write (sync path)
     sched.register  daemon/scheduler_session.py register, keyed by the
                     scheduler address under attempt
+    pex.gossip      daemon/pex.py gossip round, keyed by the target peer
+                    address ('corrupt' flips an envelope byte so the
+                    receiver's digest verify rejects it)
 
 Script syntax (one clause per site, ';'-separated)::
 
@@ -64,6 +67,7 @@ SITES = frozenset({
     "source.fetch",
     "hbm.ingest",
     "sched.register",
+    "pex.gossip",
 })
 
 KINDS = frozenset({"fail", "error", "delay", "hang", "corrupt"})

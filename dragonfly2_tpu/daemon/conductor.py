@@ -44,7 +44,8 @@ class PeerTaskConductor:
                  device_sink_factory: Any = None,
                  ordered: bool = False,
                  trace: Any = None,
-                 flight: Any = None):
+                 flight: Any = None,
+                 pex: Any = None):
         self.task_id = task_id
         self.peer_id = peer_id
         self.url = url
@@ -62,6 +63,11 @@ class PeerTaskConductor:
         self.ordered = ordered       # stream consumers want low pieces first
         self.trace = trace
         self.flight = flight         # TaskFlight journal (None = disabled)
+        self.pex = pex               # PexGossiper (None = plane disabled)
+        # True when register failed at the TRANSPORT level (every ring
+        # member unreachable) rather than by scheduler verdict — only then
+        # may the pex rung second-guess the missing control plane
+        self._sched_unreachable = False
 
         self.state = self.PENDING
         self.fail_code = Code.OK
@@ -121,7 +127,21 @@ class PeerTaskConductor:
                 if self._session is not None and self._p2p_engine is not None:
                     if self.flight is not None:
                         self.flight.rung(fr.RUNG_P2P)
+                    if self.pex is not None:
+                        # opportunistic: swarm-known holders ride an
+                        # advisory packet so hot tasks have parents before
+                        # the scheduler's assignment lands
+                        self.pex.prime(self, self._session)
                     used_p2p = await self._p2p_engine.pull(self, self._session)
+            if (not used_p2p and self.pex is not None
+                    and (self.scheduler is None or self._sched_unreachable)):
+                # the pex rung (docs/RESILIENCE.md): every scheduler is
+                # unreachable (or none was ever configured) but gossip
+                # knows mesh holders — serve P2P instead of stampeding
+                # the origin. Scheduler VERDICTS (NeedBackSource) are
+                # respected: this rung only replaces a control plane that
+                # is absent, never one that answered.
+                used_p2p = await self.pex.try_pull(self)
             if not used_p2p:
                 if self.disable_back_source:
                     raise DFError(Code.CLIENT_BACK_SOURCE_ERROR,
@@ -155,12 +175,18 @@ class PeerTaskConductor:
         try:
             return await self.scheduler.register(self)
         except DFError as exc:
-            if exc.code in (Code.SCHED_NEED_BACK_SOURCE, Code.UNAVAILABLE,
-                            Code.DEADLINE_EXCEEDED):
+            if exc.code in (Code.UNAVAILABLE, Code.DEADLINE_EXCEEDED):
+                # transport exhaustion, not a verdict: the pex rung may
+                # still find mesh parents before origin
+                self._sched_unreachable = True
+                self.log.info("register unreachable: %s", exc.message)
+                return None
+            if exc.code == Code.SCHED_NEED_BACK_SOURCE:
                 self.log.info("register says back-source: %s", exc.message)
                 return None
             raise
         except Exception as exc:  # scheduler unreachable entirely
+            self._sched_unreachable = True
             self.log.warning("scheduler unreachable (%s); falling back", exc)
             return None
 

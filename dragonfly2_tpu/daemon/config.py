@@ -111,6 +111,21 @@ class HealthSection:
 
 
 @dataclass
+class PexConfig:
+    """Peer-exchange gossip plane (daemon/pex.py): decentralized piece
+    discovery backing the ``pex`` degradation-ladder rung. On by default —
+    a round is a handful of small HTTP exchanges every ``interval_s``
+    (jittered), and with no known peers it is a no-op."""
+
+    enabled: bool = True
+    interval_s: float = 5.0           # gossip cadence (x0.6-1.4 jitter)
+    fanout: int = 3                   # peers pushed to per round
+    ttl_s: float = 60.0               # swarm-index entry lifetime
+    bootstrap: list[str] = field(default_factory=list)  # ip:upload_port seeds
+    max_digest_tasks: int = 256       # tasks advertised per digest
+
+
+@dataclass
 class DownloadConfig:
     piece_parallelism: int = 4             # piece download workers per task
     back_source_parallelism: int = 4       # concurrent origin range streams
@@ -198,6 +213,7 @@ class DaemonConfig:
     tracing: TracingConfig = field(default_factory=TracingConfig)
     flight: FlightConfig = field(default_factory=FlightConfig)
     health: HealthSection = field(default_factory=HealthSection)
+    pex: PexConfig = field(default_factory=PexConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
     proxy: ProxyConfig = field(default_factory=ProxyConfig)
     object_storage: ObjectStorageConfig = field(default_factory=ObjectStorageConfig)

@@ -70,12 +70,27 @@ class Daemon:
         self.flight_recorder = FlightRecorder(
             enabled=cfg.flight.enabled, max_tasks=cfg.flight.max_tasks,
             max_events=cfg.flight.max_events)
+        # PEX gossip plane (daemon/pex.py): swarm index + gossiper exist
+        # before the upload server so its routes mount at start; ports and
+        # topology resolve lazily through host_info()
+        self.pex = None
+        if cfg.pex.enabled:
+            from .pex import PexGossiper
+            from .swarm_index import SwarmIndex
+            self.pex = PexGossiper(
+                storage_mgr=self.storage_mgr,
+                host_info=self.host_info,
+                index=SwarmIndex(ttl_s=cfg.pex.ttl_s),
+                interval_s=cfg.pex.interval_s, fanout=cfg.pex.fanout,
+                max_digest_tasks=cfg.pex.max_digest_tasks,
+                bootstrap=cfg.pex.bootstrap)
         self.upload_server = UploadServer(
             self.storage_mgr, port=cfg.upload.port,
             rate_limit_bps=cfg.upload.rate_limit_bps,
             debug_endpoints=cfg.upload.debug_endpoints,
             concurrent_limit=cfg.upload.concurrent_limit,
-            host=cfg.listen_ip, flight_recorder=self.flight_recorder)
+            host=cfg.listen_ip, flight_recorder=self.flight_recorder,
+            pex=self.pex)
         self._scheduler_factory = scheduler_factory
         self._p2p_engine_factory = p2p_engine_factory
         self.scheduler: Any = None
@@ -252,7 +267,15 @@ class Daemon:
                     downloader=self._piece_downloader,
                     channel_pool=self._peer_channels,
                     slice_name=(self.topology.slice_name
-                                if self.topology else ""))
+                                if self.topology else ""),
+                    peer_observer=(self.pex.observe_parent
+                                   if self.pex is not None else None))
+        if self.pex is not None:
+            # the pex rung builds a FRESH engine per pull (the scheduler
+            # path may already have consumed the conductor's), and gossip
+            # exchanges present the fleet client leaf under mTLS
+            self.pex.engine_factory = engine_factory
+            self.pex.tls = tls_triple
         self.shaper.start()
         self.ptm = PeerTaskManager(
             storage_mgr=self.storage_mgr, piece_mgr=self.piece_mgr,
@@ -262,7 +285,7 @@ class Daemon:
             device_sink_builder=self.device_sink_builder,
             is_seed=self.cfg.is_seed, shaper=self.shaper,
             prefetch_whole_file=self.cfg.download.prefetch_whole_file,
-            flight_recorder=self.flight_recorder)
+            flight_recorder=self.flight_recorder, pex=self.pex)
         svc = DaemonService(self.ptm,
                             upload_addr=f"{self.host_ip}:{self.upload_server.port}")
         # fleet mTLS: enroll with the manager, serve the peer RPC port with
@@ -308,6 +331,9 @@ class Daemon:
                            self.storage_mgr.try_gc))
         self.gc.start()
         await self._wire_scheduler_extras()
+        if self.pex is not None:
+            self.pex.scheduler = self.scheduler
+            await self.pex.start()
         # counted only after everything above succeeded, consumed exactly
         # once by stop(): a failed start() or a double stop() must neither
         # strand the count high (leak fix disabled) nor drive it to zero
@@ -363,6 +389,10 @@ class Daemon:
         after the scheduler."""
         if self.scheduler is None:
             return
+        if self.pex is not None:
+            # a late-adopted scheduler must also get the ticker's demoted-
+            # member revival probe
+            self.pex.scheduler = self.scheduler
         if self.announcer is None and hasattr(self.scheduler,
                                               "announce_host"):
             from .announcer import Announcer
@@ -429,6 +459,8 @@ class Daemon:
         if getattr(self, "prober", None) is not None:
             await self.prober.stop()
         await self.shaper.stop()
+        if self.pex is not None:
+            await self.pex.stop()
         if self.announcer is not None:
             await self.announcer.stop()
         await self.gc.stop()

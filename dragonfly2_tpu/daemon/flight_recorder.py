@@ -44,12 +44,13 @@ HBM_SHARD = "hbm_shard"      # one device DMA completed (piece = shard idx)
 DONE = "done"                # task reached a terminal state
 RUNG = "rung"                # degradation-ladder transition (parent = rung)
 
-# the conductor's five-rung degradation ladder (docs/RESILIENCE.md): the
+# the conductor's six-rung degradation ladder (docs/RESILIENCE.md): the
 # rung event's parent field names which rung the task just entered, so
 # dfdiag can show which rung ultimately served a slow task
 RUNG_P2P = "p2p"                      # scheduler gave parents; mesh pull
 RUNG_RESCHEDULE = "reschedule"        # parents died; waiting re-assignment
 RUNG_RING_FAILOVER = "ring_failover"  # hashed scheduler dead; next member
+RUNG_PEX = "pex"                      # schedulers gone; gossip-found parents
 RUNG_BACK_SOURCE = "back_source"      # fetching from origin
 RUNG_FAIL = "fail"                    # ladder exhausted; coded verdict
 

@@ -30,7 +30,7 @@ class PeerTaskManager:
                  p2p_engine_factory: Any = None,
                  device_sink_builder: Any = None, is_seed: bool = False,
                  shaper: Any = None, prefetch_whole_file: bool = False,
-                 flight_recorder: Any = None):
+                 flight_recorder: Any = None, pex: Any = None):
         self.storage_mgr = storage_mgr
         self.piece_mgr = piece_mgr
         self.hostname = hostname
@@ -42,6 +42,7 @@ class PeerTaskManager:
         self.shaper = shaper
         self.prefetch_whole_file = prefetch_whole_file
         self.flight_recorder = flight_recorder
+        self.pex = pex
         self._conductors: dict[str, PeerTaskConductor] = {}
         self._prefetching: set[str] = set()
         # strong refs: the loop only weak-refs tasks, and a GC'd prefetch
@@ -87,7 +88,7 @@ class PeerTaskManager:
                 content_range=content_range,
                 disable_back_source=disable_back_source, task_type=task_type,
                 device_sink_factory=device_sink_factory, ordered=ordered,
-                flight=flight)
+                flight=flight, pex=self.pex)
             if self.p2p_engine_factory is not None:
                 conductor.set_p2p_engine(self.p2p_engine_factory())
             if self.shaper is not None:

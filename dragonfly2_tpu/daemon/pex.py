@@ -1,0 +1,631 @@
+"""Peer-exchange (PEX) gossip plane: scheduler-less piece discovery.
+
+Role parity: none in the reference — Dragonfly2 has exactly one
+piece-discovery path, the scheduler. When the hash-ring failover
+(scheduler_session.py) is exhausted, every task there falls to
+back-to-source and the origin absorbs the whole pod's load even though
+neighbors one ICI hop away already hold the bytes. This module removes
+that single point of coordination with a BitTorrent-PEX-style exchange
+of availability digests:
+
+* every daemon periodically POSTs a compact digest — {task_id -> piece
+  set, host address triple, ICI coordinates} for the tasks in its
+  StorageManager — to a small fanout of known peers (ICI neighbors
+  first), over the existing upload HTTP port (``POST /pex/digest``);
+* the reply carries the target's digest back (push-pull anti-entropy:
+  one jittered round trip per edge per interval);
+* received digests land in a TTL'd local SwarmIndex (swarm_index.py);
+* membership is seeded from ``pex.bootstrap`` config plus every parent
+  the scheduler ever assigns (piece_engine peer_observer) and grows
+  transitively through the digests themselves, which carry a peer
+  sample;
+* the degradation ladder (docs/RESILIENCE.md) gains a ``pex`` rung
+  between ``ring_failover`` and ``back_source``: a conductor whose every
+  scheduler is unreachable asks ``try_pull`` for SwarmIndex parents and
+  rides the normal P2P engine against them — journaled via the flight
+  recorder so dfdiag and the cluster view name the rung;
+* the ticker also lazily TCP-probes stickily-demoted schedulers
+  (SchedulerConnector.probe_demoted) so a healed control plane is
+  noticed without waiting for the next register to trip over it.
+
+Digest integrity: the envelope is ``sha256hex\\n<canonical JSON>``; a
+body whose hash does not match is rejected and counted
+(``df_pex_rejected_total``) — a corrupted digest must never plant
+phantom holders. The ``pex.gossip`` faultgate site can drop, delay, or
+corrupt outbound digests deterministically (chaos suite,
+tests/test_pex.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import random
+import time
+from typing import Any, Callable
+
+from ..common import faultgate
+from ..common.errors import Code
+from ..common.metrics import REGISTRY
+from ..idl.messages import (PeerAddr, PeerPacket, RegisterResult, SizeScope,
+                            TopologyInfo)
+from ..tpu.topology import ici_hops, link_type
+from . import flight_recorder as fr
+from .swarm_index import SwarmEntry, SwarmIndex
+
+log = logging.getLogger("df.flow.pex")
+
+DIGEST_VERSION = 1
+# peers dropped from membership after this many consecutive failed rounds
+PEER_FAIL_LIMIT = 3
+# membership sample size carried per digest (transitive discovery)
+PEER_SAMPLE = 16
+
+_digests_sent = REGISTRY.counter(
+    "df_pex_digests_sent_total",
+    "PEX availability digests pushed to peers", ("result",))
+_digests_received = REGISTRY.counter(
+    "df_pex_digests_received_total",
+    "PEX digests ingested, by transport direction", ("transport",))
+_rejected = REGISTRY.counter(
+    "df_pex_rejected_total",
+    "PEX digests rejected before ingest", ("reason",))
+_parent_hits = REGISTRY.counter(
+    "df_pex_parent_hits_total",
+    "pieces served by parents discovered via PEX gossip")
+_primes = REGISTRY.counter(
+    "df_pex_prime_total",
+    "advisory parent packets pre-populated from the swarm index")
+_peers_gauge = REGISTRY.gauge(
+    "df_pex_peers", "peers currently in the PEX membership view")
+_sched_revived = REGISTRY.counter(
+    "df_pex_sched_revived_total",
+    "demoted schedulers revived by the PEX ticker's lazy probe")
+
+
+class PeerInfo:
+    """One known gossip peer (keyed by upload address)."""
+
+    __slots__ = ("host_id", "ip", "rpc_port", "download_port", "is_seed",
+                 "topology", "last_seen", "fails")
+
+    def __init__(self, *, host_id: str, ip: str, rpc_port: int = 0,
+                 download_port: int = 0, is_seed: bool = False,
+                 topology: TopologyInfo | None = None):
+        self.host_id = host_id
+        self.ip = ip
+        self.rpc_port = rpc_port
+        self.download_port = download_port
+        self.is_seed = is_seed
+        self.topology = topology
+        self.last_seen = time.monotonic()
+        self.fails = 0
+
+    @property
+    def addr(self) -> str:
+        return f"{self.ip}:{self.download_port}"
+
+    def describe(self) -> dict:
+        return {"host_id": self.host_id, "addr": self.addr,
+                "rpc_port": self.rpc_port, "is_seed": self.is_seed,
+                "fails": self.fails,
+                "age_s": round(time.monotonic() - self.last_seen, 1)}
+
+
+def _topo_to_wire(t: TopologyInfo | None) -> dict | None:
+    if t is None:
+        return None
+    return {"slice": t.slice_name, "ici": list(t.ici_coords or []) or None,
+            "zone": t.zone}
+
+
+def _topo_from_wire(d: dict | None) -> TopologyInfo | None:
+    if not d:
+        return None
+    ici = d.get("ici")
+    return TopologyInfo(slice_name=d.get("slice", ""),
+                        ici_coords=tuple(ici) if ici else None,
+                        zone=d.get("zone", ""))
+
+
+def seal(body: dict) -> bytes:
+    """Envelope a digest body: ``sha256hex\\n<canonical JSON>``."""
+    payload = json.dumps(body, sort_keys=True,
+                         separators=(",", ":")).encode()
+    return hashlib.sha256(payload).hexdigest().encode() + b"\n" + payload
+
+
+def unseal(raw: bytes) -> dict | None:
+    """Verify + parse an envelope; None (and a counted rejection) when the
+    checksum, JSON, or version is bad."""
+    head, sep, payload = raw.partition(b"\n")
+    if not sep or hashlib.sha256(payload).hexdigest().encode() != head:
+        _rejected.labels("checksum").inc()
+        return None
+    try:
+        body = json.loads(payload)
+    except (ValueError, UnicodeDecodeError):
+        _rejected.labels("parse").inc()
+        return None
+    if not isinstance(body, dict) or body.get("v") != DIGEST_VERSION:
+        _rejected.labels("version").inc()
+        return None
+    return body
+
+
+class PexGossiper:
+    """The daemon's PEX plane: membership + ticker + digest codec +
+    the conductor-facing ``prime``/``try_pull`` ladder hooks."""
+
+    def __init__(self, *, storage_mgr: Any, host_info: Callable[[], Any],
+                 index: SwarmIndex | None = None, interval_s: float = 5.0,
+                 fanout: int = 3, max_digest_tasks: int = 256,
+                 bootstrap: list[str] | None = None,
+                 tls: tuple[str, str, str] | None = None,
+                 scheduler: Any = None,
+                 engine_factory: Callable[[], Any] | None = None,
+                 rng: random.Random | None = None):
+        self.storage_mgr = storage_mgr
+        self.host_info = host_info       # lazy: ports resolve after bind
+        self.index = index if index is not None else SwarmIndex()
+        self.interval_s = interval_s
+        self.fanout = max(1, fanout)
+        self.max_digest_tasks = max_digest_tasks
+        self.tls = tls
+        self.scheduler = scheduler       # SchedulerConnector (probe revival)
+        self.engine_factory = engine_factory
+        self.rng = rng or random.Random()
+        self.peers: dict[str, PeerInfo] = {}    # addr -> PeerInfo
+        self._dead_until: dict[str, float] = {}  # evicted addr -> cooldown
+        self._self_keys_memo: tuple[str, str] | None = None
+        self._bootstrap = list(bootstrap or [])
+        self._task: asyncio.Task | None = None
+        self._session = None             # lazy aiohttp.ClientSession
+        self.rounds = 0
+
+    # -- membership ----------------------------------------------------
+
+    def _self_keys(self) -> tuple[str, str]:
+        # cached once the upload port is bound: host_info() rebuilds the
+        # full Host message (os.uname x2) and this runs per observed peer
+        cached = self._self_keys_memo
+        if cached is not None:
+            return cached
+        host = self.host_info()
+        keys = (host.id, f"{host.ip}:{host.download_port}")
+        if host.download_port:
+            self._self_keys_memo = keys
+        return keys
+
+    def observe_peer(self, *, host_id: str, ip: str, rpc_port: int = 0,
+                     download_port: int = 0, is_seed: bool = False,
+                     topology: TopologyInfo | None = None,
+                     direct: bool = False) -> None:
+        """``direct``: first-hand liveness evidence (a digest FROM the peer
+        itself, or a parent the scheduler just assigned). Indirect mentions
+        — bootstrap re-seeds and other peers' gossip samples — may CREATE
+        an entry but never refresh fails/last_seen: otherwise a dead peer
+        that lives on in everyone's peer sample is re-blessed faster than
+        PEER_FAIL_LIMIT can evict it, membership fills with immortal
+        ghosts, and each ghost burns a fanout slot + an HTTP timeout per
+        round. Evicted addresses sit out a cooldown before an indirect
+        mention may re-create them (direct evidence re-admits at once)."""
+        if not ip or not download_port:
+            return
+        self_id, self_addr = self._self_keys()
+        addr = f"{ip}:{download_port}"
+        if addr == self_addr or (host_id and host_id == self_id):
+            return
+        info = self.peers.get(addr)
+        if info is None:
+            if not direct and self._dead_until.get(addr, 0.0) \
+                    > time.monotonic():
+                return
+            info = self.peers[addr] = PeerInfo(
+                host_id=host_id or addr, ip=ip, rpc_port=rpc_port,
+                download_port=download_port, is_seed=is_seed,
+                topology=topology)
+            self._dead_until.pop(addr, None)
+        else:
+            if direct:
+                info.last_seen = time.monotonic()
+                info.fails = 0
+            if host_id:
+                # bootstrap entries start keyed-by-address; the first
+                # digest from the peer upgrades them to its real identity
+                info.host_id = host_id
+            if rpc_port:
+                info.rpc_port = rpc_port
+            if topology is not None:
+                info.topology = topology
+            info.is_seed = info.is_seed or is_seed
+        _peers_gauge.set(len(self.peers))
+
+    def observe_parent(self, parent: PeerAddr) -> None:
+        """piece_engine hook: every scheduler-assigned parent joins the
+        gossip membership — the mesh the scheduler built keeps working as
+        the discovery substrate after the scheduler goes away. A live
+        assignment is first-hand evidence (the scheduler is actively
+        steering traffic at it) — but parents WE minted from the swarm
+        index (prime/try_pull packets, peer_id "pex-...") are this plane's
+        own hearsay and must not loop back as first-hand liveness, or a
+        dead host's 60s-TTL index entries would keep re-blessing its
+        membership entry past the fail-limit eviction."""
+        if parent.peer_id.startswith("pex-"):
+            return
+        self.observe_peer(host_id="", ip=parent.ip,
+                          rpc_port=parent.rpc_port,
+                          download_port=parent.download_port,
+                          is_seed=parent.is_seed, direct=True)
+
+    def _targets(self) -> list[PeerInfo]:
+        """Gossip fanout for this round: ICI neighbors first (cheapest
+        links carry the chattiest traffic), then by freshness, with one
+        random pick appended so distant membership still converges."""
+        host = self.host_info()
+        mine = getattr(host, "topology", None)
+        peers = list(self.peers.values())
+        if not peers:
+            return []
+        peers.sort(key=lambda p: (int(link_type(mine, p.topology)),
+                                  ici_hops(mine, p.topology)
+                                  if mine is not None and
+                                  p.topology is not None else 1 << 16,
+                                  -p.last_seen, p.addr))
+        picked = peers[:self.fanout]
+        rest = peers[self.fanout:]
+        if rest:
+            picked.append(self.rng.choice(rest))
+        return picked
+
+    # -- digest codec --------------------------------------------------
+
+    def build_digest(self) -> dict:
+        host = self.host_info()
+        tasks = []
+        for ts in self.storage_mgr.tasks():
+            md = ts.md
+            if not md.pieces and not (md.done and md.success):
+                continue
+            done = bool(md.done and md.success)
+            entry = {"task_id": md.task_id,
+                     "total": md.total_piece_count,
+                     "content_length": md.content_length,
+                     "piece_size": md.piece_size,
+                     "done": done}
+            if not done:
+                entry["pieces"] = sorted(md.pieces)
+            tasks.append(entry)
+            if len(tasks) >= self.max_digest_tasks:
+                break
+        sample = list(self.peers.values())
+        if len(sample) > PEER_SAMPLE:
+            sample = self.rng.sample(sample, PEER_SAMPLE)
+        return {
+            "v": DIGEST_VERSION,
+            "origin": {"host_id": host.id, "ip": host.ip,
+                       "rpc_port": host.port,
+                       "download_port": host.download_port,
+                       "is_seed": int(host.type) != 0,
+                       "topology": _topo_to_wire(
+                           getattr(host, "topology", None))},
+            "peers": [{"host_id": p.host_id, "ip": p.ip,
+                       "rpc_port": p.rpc_port,
+                       "download_port": p.download_port,
+                       "is_seed": p.is_seed,
+                       "topology": _topo_to_wire(p.topology)}
+                      for p in sample],
+            "tasks": tasks,
+        }
+
+    def envelope(self) -> bytes:
+        return seal(self.build_digest())
+
+    def ingest(self, raw: bytes, *, transport: str = "push") -> bool:
+        """Verify + merge a received envelope. False = rejected (checksum,
+        JSON, version, or field types — the seal only proves the sender
+        sealed these bytes, not that the fields are well-typed, so the
+        whole body is coerced BEFORE anything mutates membership: a
+        version-skewed peer must produce a counted rejection, not a 500
+        and a half-merged view)."""
+        body = unseal(raw)
+        if body is None:
+            return False
+        try:
+            origin = body.get("origin") or {}
+            topo = _topo_from_wire(origin.get("topology"))
+            host_id = str(origin.get("host_id") or "")
+            ip = str(origin.get("ip") or "")
+            rpc_port = int(origin.get("rpc_port") or 0)
+            download_port = int(origin.get("download_port") or 0)
+            is_seed = bool(origin.get("is_seed"))
+            sampled = [dict(host_id=str(p.get("host_id") or ""),
+                            ip=str(p.get("ip") or ""),
+                            rpc_port=int(p.get("rpc_port") or 0),
+                            download_port=int(p.get("download_port") or 0),
+                            is_seed=bool(p.get("is_seed")),
+                            topology=_topo_from_wire(p.get("topology")))
+                       for p in body.get("peers") or []]
+            entries = []
+            for t in body.get("tasks") or []:
+                task_id = str(t.get("task_id") or "")
+                if not task_id:
+                    continue
+                done = bool(t.get("done"))
+                pieces = (None if done
+                          else {int(n) for n in t.get("pieces") or []})
+                if not done and not pieces:
+                    continue
+                entries.append((task_id, SwarmEntry(
+                    host_id=host_id or f"{ip}:{download_port}", ip=ip,
+                    rpc_port=rpc_port, download_port=download_port,
+                    is_seed=is_seed, topology=topo, pieces=pieces,
+                    total_pieces=int(t.get("total", -1)),
+                    content_length=int(t.get("content_length", -1)),
+                    piece_size=int(t.get("piece_size", 0)), done=done)))
+        except (ValueError, TypeError, AttributeError):
+            _rejected.labels("parse").inc()
+            return False
+        self_id, self_addr = self._self_keys()
+        if host_id == self_id or f"{ip}:{download_port}" == self_addr:
+            return True      # our own digest reflected back: nothing to do
+        # the digest came FROM its origin: first-hand liveness; the peer
+        # sample is hearsay and may only create entries, never refresh
+        self.observe_peer(host_id=host_id, ip=ip, rpc_port=rpc_port,
+                          download_port=download_port, is_seed=is_seed,
+                          topology=topo, direct=True)
+        for p in sampled:
+            self.observe_peer(**p)
+        if ip and download_port:
+            for task_id, entry in entries:
+                self.index.update(task_id, entry)
+        _digests_received.labels(transport).inc()
+        return True
+
+    # -- gossip rounds -------------------------------------------------
+
+    def _get_session(self):
+        import aiohttp
+        if self._session is None or self._session.closed:
+            ssl_ctx = None
+            if self.tls is not None:
+                import ssl as _ssl
+                cert, key, ca = self.tls
+                ssl_ctx = _ssl.create_default_context(cafile=ca)
+                ssl_ctx.load_cert_chain(cert, key)
+                ssl_ctx.check_hostname = False   # fleet CA authenticates
+                ssl_ctx.verify_mode = _ssl.CERT_REQUIRED
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=16, ssl=ssl_ctx),
+                timeout=aiohttp.ClientTimeout(total=5.0))
+        return self._session
+
+    @property
+    def _scheme(self) -> str:
+        return "https" if self.tls is not None else "http"
+
+    async def round(self) -> int:
+        """One gossip round: purge, push-pull with the fanout targets,
+        probe demoted schedulers. Returns digests successfully exchanged.
+        Public so tests and operators can drive it deterministically."""
+        self.rounds += 1
+        self.index.purge()
+        for addr in self._bootstrap:
+            ip, _, port = addr.rpartition(":")
+            if ip and port.isdigit():
+                self.observe_peer(host_id="", ip=ip,
+                                  download_port=int(port))
+        exchanged = 0
+        for peer in self._targets():
+            try:
+                if faultgate.ARMED:
+                    # fail/delay/hang drop or stall THIS edge's exchange —
+                    # the round moves on to the next target (fail) or rides
+                    # its own HTTP timeout (hang), exactly like a wedged
+                    # peer; 'corrupt' flips an envelope byte so the
+                    # receiver's checksum rejects it
+                    await faultgate.fire("pex.gossip", key=peer.addr)
+                payload = self.envelope()
+                if faultgate.ARMED:
+                    payload = faultgate.corrupt("pex.gossip", payload,
+                                                key=peer.addr)
+                url = f"{self._scheme}://{peer.addr}/pex/digest"
+                async with self._get_session().post(url,
+                                                    data=payload) as resp:
+                    if resp.status != 200:
+                        raise OSError(f"HTTP {resp.status}")
+                    # anti-entropy pull: the reply is the peer's digest
+                    self.ingest(await resp.read(), transport="pull")
+                peer.last_seen = time.monotonic()
+                peer.fails = 0
+                exchanged += 1
+                _digests_sent.labels("ok").inc()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - peer churn is normal
+                _digests_sent.labels("error").inc()
+                peer.fails += 1
+                log.debug("pex exchange with %s failed (%d/%d): %s",
+                          peer.addr, peer.fails, PEER_FAIL_LIMIT, exc)
+                if peer.fails >= PEER_FAIL_LIMIT:
+                    self.peers.pop(peer.addr, None)
+                    self.index.forget_host(peer.host_id)
+                    # cooldown before hearsay (bootstrap re-seeds, other
+                    # peers' samples) may re-create the entry — a dead
+                    # address must not ride re-creation back to fails=0
+                    # every round; a digest FROM the address re-admits it
+                    # immediately
+                    self._dead_until[peer.addr] = (
+                        time.monotonic() + 10 * self.interval_s)
+                    _peers_gauge.set(len(self.peers))
+        await self._probe_demoted_schedulers()
+        return exchanged
+
+    async def _probe_demoted_schedulers(self) -> None:
+        """Lazy revival ride-along: without this, a demoted scheduler is
+        only ever re-probed when some task's register happens to hash near
+        it — a quiet daemon would sit on the pex/back_source rungs long
+        after the control plane healed."""
+        sched = self.scheduler
+        probe = getattr(sched, "probe_demoted", None)
+        if probe is None or not getattr(sched, "demoted", lambda: ())():
+            return
+        try:
+            revived = await probe()
+            if revived:
+                _sched_revived.inc(len(revived))
+                log.info("pex ticker revived schedulers: %s", revived)
+        except Exception as exc:  # noqa: BLE001 - probe is best-effort
+            log.debug("scheduler probe failed: %s", exc)
+
+    async def _loop(self) -> None:
+        while True:
+            # jittered so a pod's daemons never gossip in phase
+            await asyncio.sleep(self.interval_s *
+                                self.rng.uniform(0.6, 1.4))
+            try:
+                await self.round()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - keep the ticker alive
+                log.exception("pex round failed")
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+            self._session = None
+
+    # -- degradation-ladder hooks (conductor) --------------------------
+
+    def _candidates(self, conductor) -> list:
+        host = self.host_info()
+        return self.index.parents_for(
+            conductor.task_id,
+            self_topology=getattr(host, "topology", None),
+            exclude_host=host.id)
+
+    def _packet(self, conductor, entries, *, advisory: bool) -> PeerPacket:
+        mine = getattr(self.host_info(), "topology", None)
+        return PeerPacket(
+            task_id=conductor.task_id, src_peer_id=conductor.peer_id,
+            advisory=advisory,
+            candidate_peers=[
+                PeerAddr(peer_id=f"pex-{e.host_id}", ip=e.ip,
+                         rpc_port=e.rpc_port,
+                         download_port=e.download_port,
+                         link=link_type(mine, e.topology),
+                         is_seed=e.is_seed)
+                for e in entries if e.rpc_port and e.download_port])
+
+    def prime(self, conductor, session) -> None:
+        """Hot-task pre-population: enqueue swarm-known holders as an
+        ADVISORY packet on a live scheduler session, so the engine has
+        parents to pull from before (or while) the scheduler's own
+        assignment lands. Advisory packets never prune the scheduler's
+        assignment (piece_engine honors the flag) — the scheduler stays
+        the authority whenever it is reachable."""
+        entries = self._candidates(conductor)
+        if not entries:
+            return
+        packet = self._packet(conductor, entries[:self.fanout + 1],
+                              advisory=True)
+        if not packet.candidate_peers:
+            return
+        session.packets.put_nowait(packet)
+        _primes.inc()
+
+    async def try_pull(self, conductor) -> bool:
+        """The ``pex`` rung: serve the task from SwarmIndex holders with a
+        fresh P2P engine and a synthetic session — no scheduler anywhere
+        in the loop. False = rung declined (no holders / no engine) and
+        the ladder continues to back_source."""
+        if self.engine_factory is None:
+            return False
+        entries = self._candidates(conductor)
+        if not entries:
+            return False
+        geo = next((e for e in entries if e.content_length >= 0), None)
+        packet = self._packet(conductor, entries, advisory=False)
+        if not packet.candidate_peers:
+            return False
+        if conductor.flight is not None:
+            conductor.flight.rung(fr.RUNG_PEX)
+        conductor.log.info("pex rung: pulling from %d gossip-discovered "
+                           "holder(s)", len(packet.candidate_peers))
+        session = _PexSession(RegisterResult(
+            task_id=conductor.task_id, size_scope=SizeScope.NORMAL,
+            content_length=geo.content_length if geo is not None else -1,
+            piece_size=geo.piece_size if geo is not None else 0), [packet])
+        engine = self.engine_factory()
+        return await engine.pull(conductor, session)
+
+    # -- debug surface -------------------------------------------------
+
+    def debug_snapshot(self) -> dict:
+        return {
+            "interval_s": self.interval_s,
+            "fanout": self.fanout,
+            "rounds": self.rounds,
+            "peers": [p.describe() for p in self.peers.values()],
+            "swarm": self.index.snapshot(),
+        }
+
+
+class _PexSession:
+    """Synthetic scheduler session for the pex rung: the engine consumes
+    ``result``/``packets`` exactly as from a real PeerSession; piece
+    reports have no scheduler to go to, so they only feed the
+    ``df_pex_parent_hits_total`` counter."""
+
+    def __init__(self, result: RegisterResult, packets: list[PeerPacket]):
+        self.result = result
+        self.packets: asyncio.Queue = asyncio.Queue()
+        for p in packets:
+            self.packets.put_nowait(p)
+
+    async def report_piece(self, result) -> None:
+        if result.success and result.dst_peer_id \
+                and int(result.code or 0) == int(Code.OK):
+            _parent_hits.inc()
+
+    async def close(self, *, success: bool) -> None:
+        return None
+
+
+def add_pex_routes(router, gossiper: PexGossiper) -> None:
+    """Upload-port routes: ``GET /pex/digest`` (pull), ``POST /pex/digest``
+    (push; the 200 body is our digest — the pull half of push-pull), and
+    ``GET /debug/pex`` (membership + swarm snapshot). Mesh-internal and
+    ring-bounded like /debug/flight, so not gated behind the debug flag."""
+    from aiohttp import web
+
+    async def get_digest(_r: web.Request) -> web.Response:
+        return web.Response(body=gossiper.envelope(),
+                            content_type="application/octet-stream")
+
+    async def post_digest(request: web.Request) -> web.Response:
+        raw = await request.read()
+        if not gossiper.ingest(raw, transport="push"):
+            raise web.HTTPBadRequest(text="digest verification failed")
+        return web.Response(body=gossiper.envelope(),
+                            content_type="application/octet-stream")
+
+    async def debug_pex(_r: web.Request) -> web.Response:
+        return web.json_response(gossiper.debug_snapshot())
+
+    router.add_get("/pex/digest", get_digest)
+    router.add_post("/pex/digest", post_digest)
+    router.add_get("/debug/pex", debug_pex)

@@ -145,9 +145,13 @@ class PieceEngine:
                  piece_timeout_s: float = 60.0,
                  downloader: PieceDownloader | None = None,
                  channel_pool: ChannelPool | None = None,
-                 slice_name: str = ""):
+                 slice_name: str = "",
+                 peer_observer=None):
         self.parallelism = parallelism
         self.slice_name = slice_name    # advertised to super-seeding parents
+        # PEX membership hook (daemon/pex.py): every parent the scheduler
+        # assigns is observed so the gossip plane knows the mesh
+        self.peer_observer = peer_observer
         self.schedule_timeout_s = schedule_timeout_s
         self.piece_timeout_s = piece_timeout_s
         self.downloader = downloader or PieceDownloader(timeout_s=piece_timeout_s)
@@ -371,23 +375,29 @@ class PieceEngine:
                                                  is_seed=parent.is_seed,
                                                  link=parent.link)
                 self._current_parents[parent.peer_id] = parent
+                if self.peer_observer is not None:
+                    self.peer_observer(parent)
                 sync = self._synchronizers.get(parent.peer_id)
                 if sync is None or (sync.task is not None and sync.task.done()):
                     sync = _Synchronizer(self, conductor, parent)
                     self._synchronizers[parent.peer_id] = sync
                     sync.start()
-            if parents:
+            if parents and not packet.advisory:
                 # the packet is the scheduler's CURRENT parent assignment —
                 # dropped parents release their upload slot server-side, so
                 # continuing to pull from them would overload hosts the
                 # scheduler is actively shedding (the round-robin that keeps
-                # a loaded seed from serving every child rides on this)
+                # a loaded seed from serving every child rides on this).
+                # Advisory packets (PEX swarm pre-population) skip the
+                # prune: they add opportunistic parents without overriding
+                # the scheduler's assignment.
                 assigned = {p.peer_id for p in parents}
                 for peer_id in list(self._synchronizers):
                     if peer_id not in assigned:
                         self._synchronizers.pop(peer_id).stop()
                         self._current_parents.pop(peer_id, None)
                         await self.dispatcher.remove_parent(peer_id)
+            if parents:
                 self._first_parent.set()
 
     async def _worker(self, conductor, session) -> None:

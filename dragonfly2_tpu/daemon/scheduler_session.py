@@ -268,6 +268,44 @@ class SchedulerConnector:
     def demoted(self) -> set[str]:
         return {a for a in list(self._demoted) if not self._alive(a)}
 
+    async def probe_demoted(self, *, timeout_s: float = 2.0) -> list[str]:
+        """Actively probe every stickily-demoted ring member with a TCP
+        connect; revive the ones that answer. Returns the revived list.
+
+        Closes the latent revival gap: ``_alive`` only re-admits a demoted
+        address when some task's register happens to consult it AFTER the
+        demote window — a daemon with no register traffic (or whose tasks
+        all hash elsewhere) would sit on the pex/back_source rungs long
+        after the scheduler healed. The PEX gossip ticker (daemon/pex.py)
+        rides this on every round. A connect-level probe is deliberately
+        cheap and optimistic: a revived-but-still-sick member is re-demoted
+        by the next register that actually exercises it."""
+        async def probe(addr: str) -> str | None:
+            host, _, port = addr.rpartition(":")
+            if not host or not port.isdigit():
+                return None
+            try:
+                _r, w = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port)), timeout_s)
+            except (OSError, asyncio.TimeoutError):
+                return None
+            w.close()
+            try:
+                await w.wait_closed()
+            except OSError:
+                pass
+            return addr
+
+        # concurrent: with the whole ring down (exactly when the caller —
+        # the PEX ticker — matters most) serial probes would stall the
+        # gossip round by timeout_s PER dead member
+        results = await asyncio.gather(*(probe(a)
+                                         for a in list(self._demoted)))
+        revived = [a for a in results if a is not None]
+        for addr in revived:
+            self.revive(addr)
+        return revived
+
     def _candidates(self, key: str) -> list[str]:
         """Failover order for ``key``: the next-N distinct ring members
         clockwise from the key's hash, live ones first; demoted addresses

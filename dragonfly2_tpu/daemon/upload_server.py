@@ -128,9 +128,10 @@ class UploadServer:
     def __init__(self, storage_mgr: StorageManager, *, port: int = 0,
                  rate_limit_bps: int = 0, concurrent_limit: int = 0,
                  host: str = "0.0.0.0", debug_endpoints: bool = False,
-                 flight_recorder=None):
+                 flight_recorder=None, pex=None):
         self.storage_mgr = storage_mgr
         self.flight_recorder = flight_recorder
+        self.pex = pex
         self.host = host
         self.port = port
         self.tls: tuple[str, str, str] | None = None   # (cert, key, ca)
@@ -180,6 +181,12 @@ class UploadServer:
         # health surface existing only behind a flag defeats its purpose
         from ..common.health import add_health_routes
         add_health_routes(app.router)
+        if self.pex is not None:
+            # PEX gossip exchange + swarm debug view (GET/POST /pex/digest,
+            # GET /debug/pex): mesh-internal like the piece routes, so it
+            # rides the same port and TLS posture
+            from .pex import add_pex_routes
+            add_pex_routes(app.router, self.pex)
         if self.debug_endpoints:
             # pprof-equivalent debug surface (reference cmd/dependency
             # InitMonitor --pprof-port) — OFF by default: profiling slows

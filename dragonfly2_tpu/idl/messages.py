@@ -252,6 +252,10 @@ class PeerPacket:
     main_peer: PeerAddr | None = None
     candidate_peers: list[PeerAddr] | None = None
     code: int = 0                   # e.g. SCHED_NEED_BACK_SOURCE
+    # advisory packets ADD parents without pruning the current assignment
+    # (PEX swarm-index pre-population, daemon/pex.py): the scheduler's own
+    # packets stay authoritative — only they replace the assignment set
+    advisory: bool = False
 
 
 @message

@@ -24,8 +24,22 @@ a base RTT to first byte (inflated by the parent's concurrent transfers
 — upload-slot contention), wire time at the link bandwidth, and an
 HBM-ingest stage at DMA bandwidth; all jittered by the seeded RNG.
 
+Scenarios (``--scenario`` / ``--pr4``): the PR-4 point measures what the
+PEX gossip plane (daemon/pex.py, docs/RESILIENCE.md rung 4) buys when the
+control plane is gone. ``scheds_down_no_pex`` models every scheduler
+unreachable with no gossip: every leecher back-sources every piece from
+the origin over the WAN link, which also absorbs the whole pod's
+contention. ``scheds_down_pex`` models the same outage with PEX: each
+leecher bootstraps knowing only the seed, converges on the swarm
+membership one modeled gossip interval after joining, and then pulls from
+whichever discovered holder is least loaded on the fastest link — the
+scheduler-less analog of the baseline's parent selection. ``--pr4`` runs
+baseline + both outage scenarios on one seed and writes ``BENCH_pr4.json``
+recording the P2P-served ratio with and without PEX.
+
 Usage:
     python -m dragonfly2_tpu.tools.dfbench --seed 7          # BENCH_pr3.json
+    python -m dragonfly2_tpu.tools.dfbench --pr4 --seed 7    # BENCH_pr4.json
     python -m dragonfly2_tpu.tools.dfbench --smoke           # tiny, stdout
     python -m dragonfly2_tpu.tools.dfbench --daemons 16 --pieces 128
 """
@@ -54,6 +68,9 @@ TTFB_QUEUE_FACTOR = 0.35         # parent-side queueing per active transfer
 WIRE_SHARE_FACTOR = 0.15         # bandwidth dilution per active transfer
 REFRESH_EVERY = 8                # pieces landed between parent refreshes
 POLL_MS = 5.0                    # starved-worker re-poll (virtual)
+PEX_CONVERGE_MS = 40.0           # modeled gossip round trip to membership
+
+SCENARIOS = ("baseline", "scheds_down_no_pex", "scheds_down_pex")
 
 STAGES = ("schedule", "first_byte", "wire", "hbm", "total")
 _ROW_KEY = {"schedule": "queue_ms", "first_byte": "ttfb_ms",
@@ -67,7 +84,7 @@ from ..daemon.flight_recorder import _pctl  # noqa: E402
 class _Leecher:
     __slots__ = ("peer", "flight", "done", "inflight", "parents",
                  "schedule", "landed_at", "joined_ms", "done_ms",
-                 "since_refresh")
+                 "since_refresh", "pex_at")
 
     def __init__(self, peer, flight, joined_ms: float):
         self.peer = peer
@@ -80,13 +97,28 @@ class _Leecher:
         self.joined_ms = joined_ms
         self.done_ms = 0.0
         self.since_refresh = 0
+        self.pex_at = 0.0                  # when gossip membership converges
+
+
+# pseudo-parent id for back-source fetches in the scheds-down scenario
+# (flight events carry parent "" so the bytes count as origin bytes)
+_ORIGIN_ID = "origin"
 
 
 def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
-              piece_size: int = 4 << 20, parallelism: int = 4) -> dict:
+              piece_size: int = 4 << 20, parallelism: int = 4,
+              scenario: str = "baseline") -> dict:
     """Run one simulated fan-out; returns the result dict (pure function
     of its arguments — no wall clock, no global state beyond the process
-    metrics registry the flight summaries touch)."""
+    metrics registry the flight summaries touch). ``scenario`` switches
+    the discovery model (SCENARIOS; baseline draws the exact same rng
+    sequence as before the scenario knob existed, so the PR-3 schedule
+    digest is stable)."""
+    if scenario not in SCENARIOS:
+        raise ValueError(f"unknown scenario {scenario!r} "
+                         f"(known: {SCENARIOS})")
+    scheds_up = scenario == "baseline"
+    pex = scenario == "scheds_down_pex"
     from ..daemon import flight_recorder as fr
     from ..daemon.flight_recorder import TaskFlight
     from ..idl.messages import Host as HostMsg
@@ -147,16 +179,34 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
         flight = TaskFlight(task.id, peer.id, url="bench://blob",
                             max_events=5 * pieces + 8)
         flight.events.append((joined, fr.REGISTERED, -1, "", 0, 0.0))
-        leechers.append(_Leecher(peer, flight, joined))
+        lc = _Leecher(peer, flight, joined)
+        if not scheds_up:
+            # gossip convergence: bootstrap names only the seed; one
+            # jittered PEX round later the leecher knows the membership
+            lc.pex_at = joined + PEX_CONVERGE_MS * rng.uniform(1.0, 2.0)
+            flight.rung(fr.RUNG_PEX if pex else fr.RUNG_BACK_SOURCE)
+        leechers.append(lc)
 
     by_peer_id = {lc.peer.id: lc for lc in leechers}
     active: dict[str, int] = {}        # parent peer id -> live transfers
 
-    def refresh_parents(lc: _Leecher) -> None:
-        parents = sched.find_parents(lc.peer)
+    def refresh_parents(lc: _Leecher, now: float = 0.0) -> None:
+        if scheds_up:
+            parents = sched.find_parents(lc.peer)
+            lc.parents = parents
+            lc.peer.last_offer_ids = {p.id for p in parents}
+            task.set_parents(lc.peer.id, [p.id for p in parents])
+            return
+        if not pex:
+            lc.parents = []            # no discovery path at all
+            return
+        # PEX model: the seed (bootstrap) immediately; every leecher that
+        # has itself converged becomes visible once we have too
+        parents = [seed_peer]
+        if now >= lc.pex_at:
+            parents += [o.peer for o in leechers
+                        if o is not lc and now >= o.pex_at]
         lc.parents = parents
-        lc.peer.last_offer_ids = {p.id for p in parents}
-        task.set_parents(lc.peer.id, [p.id for p in parents])
 
     def holds(parent, piece: int, now: float) -> bool:
         if parent is seed_peer:
@@ -168,16 +218,19 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
         return t is not None and t <= now
 
     def pick(lc: _Leecher, now: float):
-        """(piece, parent) for the next fetch, or None while starved.
-        Lowest-numbered needed piece first; among holders, the least
-        loaded parent on the fastest link wins (the dispatcher's
+        """(piece, parent_or_None) for the next fetch, or None while
+        starved. Lowest-numbered needed piece first; among holders, the
+        least loaded parent on the fastest link wins (the dispatcher's
         load-aware locality preference, collapsed to a deterministic
-        rule)."""
+        rule). A None parent means back-source from the origin (the
+        scheds-down-no-PEX scenario's only path)."""
         for piece in range(pieces):
             if piece in lc.done or piece in lc.inflight:
                 continue
             holders = [p for p in lc.parents if holds(p, piece, now)]
             if not holders:
+                if not scheds_up and not pex:
+                    return piece, None     # origin absorbs the pull
                 continue
             lt = {p.id: link_type(lc.peer.host.msg.topology,
                                   p.host.msg.topology) for p in holders}
@@ -218,16 +271,17 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
             lc.since_refresh += 1
             if len(lc.done) >= pieces:
                 lc.flight.state = "success"
-                lc.peer.transit(PeerState.SUCCEEDED)
+                if scheds_up:
+                    lc.peer.transit(PeerState.SUCCEEDED)
                 finished += 1
             elif lc.since_refresh >= REFRESH_EVERY:
                 lc.since_refresh = 0
-                refresh_parents(lc)
+                refresh_parents(lc, now)
             continue
         # worker event
         if len(lc.done) + len(lc.inflight) >= pieces:
             continue                     # nothing left for this worker
-        if lc.peer.id not in task.peers:
+        if scheds_up and lc.peer.id not in task.peers:
             # join: register with the scheduler (exactly once — the first
             # of this leecher's workers to wake does it) and take the
             # initial offer
@@ -235,16 +289,41 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
             lc.peer.transit(PeerState.RUNNING)
             refresh_parents(lc)
         if not lc.parents:
-            refresh_parents(lc)
+            refresh_parents(lc, now)
         got = pick(lc, now)
         if got is None:
             # starved: refresh the offer (the scheduler's re-offer path)
             # and re-poll — content lands in virtual time, not wall time
-            refresh_parents(lc)
+            refresh_parents(lc, now)
             push(now + POLL_MS, "worker", i)
             continue
         piece, parent = got
         lc.inflight.add(piece)
+        if parent is None:
+            # scheds-down, no PEX: the origin serves this piece over the
+            # WAN link, sharing one contended egress with the whole pod
+            lc.schedule.append([piece, _ORIGIN_ID])
+            load = active.get(_ORIGIN_ID, 0)
+            active[_ORIGIN_ID] = load + 1
+            ttfb_ms = (LINK_RTT_MS[LinkType.WAN]
+                       * (1.0 + TTFB_QUEUE_FACTOR * load)
+                       * rng.uniform(0.9, 1.3))
+            wire_ms = (piece_size / LINK_BW_BPS[LinkType.WAN] * 1000.0
+                       * (1.0 + WIRE_SHARE_FACTOR * load)
+                       * rng.uniform(0.9, 1.25))
+            hbm_ms = piece_size / HBM_BW_BPS * 1000.0 * rng.uniform(0.95, 1.15)
+            t_wire = now + ttfb_ms + wire_ms
+            t_hbm = t_wire + hbm_ms
+            # back-source pieces journal like the real conductor's: one
+            # WIRE_DONE (parent "") carrying the measured duration
+            lc.flight.events.append((t_wire, fr.WIRE_DONE, piece, "",
+                                     piece_size, wire_ms))
+            lc.flight.events.append((t_hbm, fr.HBM_DONE, piece, "",
+                                     piece_size, 0.0))
+            lc.done_ms = max(lc.done_ms, t_hbm)
+            push(t_wire, "land", i, piece, _ORIGIN_ID, t_wire)
+            push(t_hbm, "worker", i)
+            continue
         lc.schedule.append([piece, parent.id])
         lt = link_type(lc.peer.host.msg.topology, parent.host.msg.topology)
         load = active.get(parent.id, 0)
@@ -270,19 +349,23 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
         push(t_hbm, "worker", i)         # worker busy through HBM staging
 
     return _summarize(leechers, seed=seed, daemons=daemons, pieces=pieces,
-                      piece_size=piece_size, parallelism=parallelism)
+                      piece_size=piece_size, parallelism=parallelism,
+                      scenario=scenario)
 
 
 def _summarize(leechers, *, seed, daemons, pieces, piece_size,
-               parallelism) -> dict:
+               parallelism, scenario="baseline") -> dict:
     rows: list[dict] = []
     per_daemon = {}
     schedules = {}
     seed_pieces = 0
     total_pieces = 0
+    bytes_p2p = bytes_source = 0
     for lc in leechers:
         summary = lc.flight.summarize()
         rows.extend(summary["piece_rows"])
+        bytes_p2p += summary["bytes_p2p"]
+        bytes_source += summary["bytes_source"]
         per_daemon[lc.peer.id] = {
             "pieces": summary["pieces"],
             "bytes": summary["bytes_p2p"] + summary["bytes_source"],
@@ -309,6 +392,7 @@ def _summarize(leechers, *, seed, daemons, pieces, piece_size,
         "bench": "dfbench-fakepod",
         "virtual_clock": True,
         "seed": seed,
+        "scenario": scenario,
         "daemons": daemons,
         "pieces": pieces,
         "piece_size": piece_size,
@@ -319,6 +403,10 @@ def _summarize(leechers, *, seed, daemons, pieces, piece_size,
         "stage_latency_ms": stage_latency,
         "seed_served_ratio": (round(seed_pieces / total_pieces, 4)
                               if total_pieces else 0.0),
+        # mesh vs origin byte split — THE number the PEX rung exists to
+        # move when the schedulers are gone
+        "p2p_served_ratio": (round(bytes_p2p / (bytes_p2p + bytes_source), 4)
+                             if bytes_p2p + bytes_source else 0.0),
         "per_daemon": per_daemon,
         "schedule_digest": digest,
         "schedules": schedules,
@@ -333,29 +421,78 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pieces", type=int, default=64)
     p.add_argument("--piece-size", type=int, default=4 << 20)
     p.add_argument("--parallelism", type=int, default=4)
-    p.add_argument("--out", default="BENCH_pr3.json",
-                   help="result path ('-' = stdout only)")
+    p.add_argument("--scenario", default="baseline", choices=SCENARIOS,
+                   help="discovery model (scheds_down_* = every scheduler "
+                   "unreachable, with/without the PEX gossip rung)")
+    p.add_argument("--pr4", action="store_true",
+                   help="run baseline + both scheds-down scenarios and "
+                   "write the PR-4 trajectory point (BENCH_pr4.json)")
+    p.add_argument("--out", default="",
+                   help="result path ('-' = stdout only; default "
+                   "BENCH_pr3.json, or BENCH_pr4.json with --pr4)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny run (4 daemons x 8 pieces), stdout only — "
                    "exercised by tier-1 so the harness itself can't rot")
     return p
 
 
+def _run_pr4(args) -> dict:
+    """The PR-4 trajectory point: one seed, three scenarios, P2P-served
+    ratio with and without PEX while the control plane is down. Scenario
+    blobs drop the raw schedules (the digest stays) to keep the committed
+    file reviewable."""
+    scenarios = {}
+    for sc in SCENARIOS:
+        r = run_bench(seed=args.seed, daemons=args.daemons,
+                      pieces=args.pieces, piece_size=args.piece_size,
+                      parallelism=args.parallelism, scenario=sc)
+        del r["schedules"]
+        scenarios[sc] = r
+    return {
+        "bench": "dfbench-pex",
+        "seed": args.seed,
+        "scenarios": scenarios,
+        "p2p_served_ratio": {sc: scenarios[sc]["p2p_served_ratio"]
+                             for sc in SCENARIOS},
+        "wall_ms": {sc: scenarios[sc]["wall_ms"] for sc in SCENARIOS},
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if not args.out:
+        # non-baseline one-off scenarios default to stdout: a bare
+        # '--scenario scheds_down_*' run must never clobber the committed
+        # BENCH_pr3.json baseline with outage numbers
+        if args.pr4:
+            args.out = "BENCH_pr4.json"
+        elif args.scenario == "baseline":
+            args.out = "BENCH_pr3.json"
+        else:
+            args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    result = run_bench(seed=args.seed, daemons=args.daemons,
-                       pieces=args.pieces, piece_size=args.piece_size,
-                       parallelism=args.parallelism)
+    if args.pr4:
+        result = _run_pr4(args)
+    else:
+        result = run_bench(seed=args.seed, daemons=args.daemons,
+                           pieces=args.pieces, piece_size=args.piece_size,
+                           parallelism=args.parallelism,
+                           scenario=args.scenario)
     text = json.dumps(result, indent=2, sort_keys=True)
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        print(f"dfbench: wrote {args.out} "
-              f"(throughput {result['throughput_bps'] / 1e9:.2f} GB/s, "
-              f"wall {result['wall_ms']:.0f}ms, "
-              f"schedule {result['schedule_digest'][:12]})")
+        if args.pr4:
+            ratios = result["p2p_served_ratio"]
+            print(f"dfbench: wrote {args.out} (p2p-served ratio: "
+                  + ", ".join(f"{sc}={ratios[sc]:.2f}" for sc in SCENARIOS)
+                  + ")")
+        else:
+            print(f"dfbench: wrote {args.out} "
+                  f"(throughput {result['throughput_bps'] / 1e9:.2f} GB/s, "
+                  f"wall {result['wall_ms']:.0f}ms, "
+                  f"schedule {result['schedule_digest'][:12]})")
     else:
         print(text)
     return 0

@@ -149,8 +149,12 @@ def verdict(summary: dict) -> str:
         # which degradation-ladder rung served this task, and the trail it
         # took to get there (docs/RESILIENCE.md)
         trail = (f" (ladder: {' -> '.join(rungs)})" if len(rungs) > 1 else "")
-        parts.append(f"served by rung '{summary.get('served_rung', '')}'"
-                     + trail)
+        served = summary.get("served_rung", "")
+        parts.append(f"served by rung '{served}'" + trail)
+        if served == "pex":
+            parts.append("every scheduler was unreachable; parents came "
+                         "from PEX gossip (the swarm index) instead of "
+                         "the origin")
     drops = summary.get("report_drops", 0)
     if drops:
         parts.append(f"{drops} piece reports dropped on a dead scheduler "

@@ -149,12 +149,31 @@ def main(argv: list[str] | None = None) -> int:
                    help="daemon debug base URL (http://host:upload_port); "
                         "the script is POSTed to /debug/faults there and "
                         "disarmed after the run")
+    p.add_argument("--pex-dump", default="",
+                   help="daemon upload base URL (http://host:upload_port); "
+                        "after the run, attach its /debug/pex snapshot "
+                        "(gossip membership + swarm index) to the report — "
+                        "pairs with --chaos 'pex.gossip=...' runs")
     args = p.parse_args(argv)
     result = asyncio.run(_run_with_chaos(args))
     if args.chaos:
         result["chaos"] = args.chaos
+    if args.pex_dump:
+        result["pex"] = asyncio.run(_fetch_pex(args.pex_dump.rstrip("/")))
     print(json.dumps(result))
     return 1 if result["requests"] == result["errors"] else 0
+
+
+async def _fetch_pex(base: str) -> dict:
+    import aiohttp
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{base}/debug/pex",
+                                   timeout=aiohttp.ClientTimeout(
+                                       total=5.0)) as resp:
+                return await resp.json()
+    except Exception as exc:  # noqa: BLE001 - diagnostics must not fail a run
+        return {"error": str(exc)}
 
 
 if __name__ == "__main__":

@@ -58,6 +58,43 @@ class TestBenchSemantics:
             assert "slo_breaches" in d
 
 
+class TestPexScenarios:
+    """PR-4 point: the scheds-down scenarios measure what the PEX rung
+    buys when every scheduler is unreachable (docs/RESILIENCE.md)."""
+
+    def test_scenario_knob_keeps_baseline_digest(self):
+        # the scenario plumbing must not perturb the baseline rng
+        # sequence — the PR-3 trajectory point stays comparable
+        a = run_bench(seed=7, daemons=6, pieces=24)
+        b = run_bench(seed=7, daemons=6, pieces=24, scenario="baseline")
+        assert a["schedule_digest"] == b["schedule_digest"]
+
+    def test_scheds_down_without_pex_all_origin(self):
+        r = run_bench(seed=7, daemons=6, pieces=24,
+                      scenario="scheds_down_no_pex")
+        assert r["p2p_served_ratio"] == 0.0
+        assert r["seed_served_ratio"] == 0.0
+        # every daemon still completed (the origin absorbed it all)
+        for peer, sched in r["schedules"].items():
+            assert sorted(p for p, _ in sched) == list(range(24)), peer
+
+    def test_scheds_down_with_pex_mesh_served_and_faster(self):
+        no = run_bench(seed=7, daemons=6, pieces=24,
+                       scenario="scheds_down_no_pex")
+        yes = run_bench(seed=7, daemons=6, pieces=24,
+                        scenario="scheds_down_pex")
+        assert yes["p2p_served_ratio"] > 0.9
+        assert yes["wall_ms"] < no["wall_ms"]
+        # deterministic like every other scenario
+        again = run_bench(seed=7, daemons=6, pieces=24,
+                          scenario="scheds_down_pex")
+        assert yes["schedule_digest"] == again["schedule_digest"]
+
+    def test_unknown_scenario_rejected(self):
+        with pytest.raises(ValueError, match="unknown scenario"):
+            run_bench(scenario="nope")
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(
@@ -81,6 +118,33 @@ class TestCLI:
         r = json.loads((tmp_path / "BENCH_pr3.json").read_text())
         assert r["seed"] == 7
         assert "schedule_digest" in r
+
+    def test_non_baseline_scenario_never_clobbers_pr3_baseline(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--scenario", "scheds_down_no_pex", "--seed", "7",
+             "--daemons", "4", "--pieces", "8"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        # outage numbers go to stdout, not over the committed baseline
+        assert not (tmp_path / "BENCH_pr3.json").exists()
+        assert json.loads(out.stdout)["scenario"] == "scheds_down_no_pex"
+
+    def test_pr4_writes_all_three_scenarios(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr4", "--seed", "7", "--daemons", "4", "--pieces", "8"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads((tmp_path / "BENCH_pr4.json").read_text())
+        assert r["bench"] == "dfbench-pex"
+        ratios = r["p2p_served_ratio"]
+        assert set(ratios) == {"baseline", "scheds_down_no_pex",
+                               "scheds_down_pex"}
+        assert ratios["scheds_down_no_pex"] == 0.0
+        assert ratios["scheds_down_pex"] > 0.9
 
 
 if __name__ == "__main__":

@@ -297,7 +297,7 @@ class TestFaultgateLint:
                   encoding="utf-8") as f:
             doc = f.read()
         for rung in (fr.RUNG_P2P, fr.RUNG_RESCHEDULE, fr.RUNG_RING_FAILOVER,
-                     fr.RUNG_BACK_SOURCE, fr.RUNG_FAIL):
+                     fr.RUNG_PEX, fr.RUNG_BACK_SOURCE, fr.RUNG_FAIL):
             assert f"`{rung}`" in doc, rung
 
 
@@ -313,11 +313,12 @@ class TestRungJournal:
         f.rung(fr.RUNG_P2P)
         f.rung(fr.RUNG_RESCHEDULE)
         f.rung(fr.RUNG_RESCHEDULE)     # consecutive repeat deduped
+        f.rung(fr.RUNG_PEX)
         f.rung(fr.RUNG_BACK_SOURCE)
         f.report_drops = 3
         s = f.summarize()
         assert s["rungs"] == ["ring_failover", "p2p", "reschedule",
-                              "back_source"]
+                              "pex", "back_source"]
         assert s["served_rung"] == "back_source"
         assert s["report_drops"] == 3
         c = f.compact_summary()

@@ -248,6 +248,8 @@ def _import_all_metric_modules():
             "dragonfly2_tpu.daemon.objectstorage",
             "dragonfly2_tpu.daemon.piece_dispatcher",
             "dragonfly2_tpu.daemon.piece_engine",
+            "dragonfly2_tpu.daemon.pex",
+            "dragonfly2_tpu.daemon.swarm_index",
             "dragonfly2_tpu.daemon.scheduler_session",
             "dragonfly2_tpu.daemon.traffic_shaper",
             "dragonfly2_tpu.daemon.upload_server",

@@ -1,0 +1,668 @@
+"""PEX gossip plane (daemon/pex.py + daemon/swarm_index.py): fast
+single-process units for the swarm index, the digest codec, gossip rounds
+under injected faults, and the demoted-scheduler revival probe — plus the
+chaos e2e proving the `pex` degradation-ladder rung serves a task P2P when
+every scheduler is down (docs/RESILIENCE.md rung 4)."""
+
+import asyncio
+import os
+import sys
+import types
+
+import pytest
+
+from dragonfly2_tpu.common import faultgate
+from dragonfly2_tpu.common.metrics import REGISTRY
+from dragonfly2_tpu.daemon import flight_recorder as fr
+from dragonfly2_tpu.daemon import pex as pexmod
+from dragonfly2_tpu.daemon.pex import PexGossiper, seal, unseal
+from dragonfly2_tpu.daemon.swarm_index import SwarmEntry, SwarmIndex
+from dragonfly2_tpu.idl.messages import Host, HostType, TopologyInfo
+from dragonfly2_tpu.storage.metadata import PieceMeta, TaskMetadata
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faultgate.reset()
+    yield
+    faultgate.reset()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def entry(host_id: str, *, done=True, pieces=None, slice_name="", ici=None,
+          total=3, length=12 << 20, rpc_port=1, download_port=2) -> SwarmEntry:
+    return SwarmEntry(
+        host_id=host_id, ip="10.0.0.1", rpc_port=rpc_port,
+        download_port=download_port,
+        topology=TopologyInfo(slice_name=slice_name, ici_coords=ici),
+        pieces=pieces, total_pieces=total, content_length=length,
+        piece_size=4 << 20, done=done)
+
+
+# ----------------------------------------------------------------------
+# SwarmIndex: TTL, ordering, caps
+# ----------------------------------------------------------------------
+
+class TestSwarmIndex:
+    def test_ttl_expiry_and_purge(self):
+        idx = SwarmIndex(ttl_s=10.0)
+        idx.update("t1", entry("hA"), now=100.0)
+        assert len(idx.parents_for("t1", now=105.0)) == 1
+        # past the TTL the entry is invisible, then purged
+        assert idx.parents_for("t1", now=111.0) == []
+        idx.purge(now=111.0)
+        assert idx.tasks() == []
+
+    def test_parent_ordering_done_then_locality(self):
+        me = TopologyInfo(slice_name="s0", ici_coords=(0, 0))
+        idx = SwarmIndex(ttl_s=60.0)
+        idx.update("t", entry("far-done", slice_name="s1"), now=0.0)
+        idx.update("t", entry("near-done", slice_name="s0", ici=(0, 1)),
+                   now=0.0)
+        idx.update("t", entry("near-partial", done=False, pieces={0, 1},
+                              slice_name="s0", ici=(0, 1)), now=0.0)
+        idx.update("t", entry("nearest-done", slice_name="s0", ici=(0, 0)),
+                   now=0.0)
+        order = [e.host_id for e in
+                 idx.parents_for("t", self_topology=me, now=1.0)]
+        # complete holders first, ICI-nearest first among them; the
+        # partial holder sorts last even though it is one hop away
+        assert order == ["nearest-done", "near-done", "far-done",
+                         "near-partial"]
+
+    def test_exclude_self_and_forget_host(self):
+        idx = SwarmIndex(ttl_s=60.0)
+        idx.update("t", entry("me"), now=0.0)
+        idx.update("t", entry("other"), now=0.0)
+        assert [e.host_id for e in
+                idx.parents_for("t", exclude_host="me", now=1.0)] == ["other"]
+        idx.forget_host("other")
+        idx.forget_host("me")
+        assert idx.tasks() == []
+
+    def test_caps_evict_soonest_expiring(self):
+        idx = SwarmIndex(ttl_s=60.0, max_tasks=2, max_holders_per_task=2)
+        idx.update("t1", entry("a"), now=0.0)
+        idx.update("t2", entry("a"), now=10.0)
+        idx.update("t3", entry("a"), now=20.0)       # evicts t1
+        assert set(idx.tasks()) == {"t2", "t3"}
+        idx.update("t2", entry("b"), now=30.0)
+        idx.update("t2", entry("c"), now=40.0)       # evicts t2's 'a'
+        assert {e.host_id for e in idx.parents_for("t2", now=41.0)} == \
+            {"b", "c"}
+
+
+# ----------------------------------------------------------------------
+# digest codec: seal/unseal + rejection accounting
+# ----------------------------------------------------------------------
+
+class TestDigestCodec:
+    def test_roundtrip(self):
+        body = {"v": pexmod.DIGEST_VERSION, "origin": {"host_id": "h"},
+                "tasks": []}
+        assert unseal(seal(body)) == body
+
+    def test_corrupt_envelope_rejected_and_counted(self):
+        rejected = REGISTRY.counter("df_pex_rejected_total", "x", ("reason",))
+        before = rejected.value("checksum")
+        raw = bytearray(seal({"v": pexmod.DIGEST_VERSION, "tasks": []}))
+        raw[0] ^= 0xFF                       # what faultgate.corrupt does
+        assert unseal(bytes(raw)) is None
+        assert rejected.value("checksum") == before + 1
+
+    def test_version_mismatch_rejected(self):
+        rejected = REGISTRY.counter("df_pex_rejected_total", "x", ("reason",))
+        before = rejected.value("version")
+        assert unseal(seal({"v": 999})) is None
+        assert rejected.value("version") == before + 1
+
+
+# ----------------------------------------------------------------------
+# gossip rounds between two in-process gossipers (no daemons)
+# ----------------------------------------------------------------------
+
+def fake_storage(*task_mds: TaskMetadata):
+    return types.SimpleNamespace(
+        tasks=lambda: [types.SimpleNamespace(md=md) for md in task_mds])
+
+
+def completed_md(task_id: str, *, pieces=3, piece_size=4 << 20) -> TaskMetadata:
+    md = TaskMetadata(task_id=task_id, content_length=pieces * piece_size,
+                      total_piece_count=pieces, piece_size=piece_size,
+                      done=True, success=True)
+    for n in range(pieces):
+        md.pieces[n] = PieceMeta(num=n, start=n * piece_size,
+                                 size=piece_size)
+    return md
+
+
+async def _gossiper_pair(storage_a, storage_b):
+    """Two gossipers, B's routes served over real HTTP; A knows B via
+    bootstrap. Returns (a, b, b_port, cleanup)."""
+    from aiohttp import web
+
+    from dragonfly2_tpu.daemon.pex import add_pex_routes
+
+    ports = {"b": 0}
+
+    def host(name, dport):
+        return lambda: Host(id=f"{name}-host", ip="127.0.0.1", port=7000,
+                            download_port=dport(),
+                            topology=TopologyInfo(slice_name=f"sl-{name}"))
+
+    b = PexGossiper(storage_mgr=storage_b,
+                    host_info=host("b", lambda: ports["b"]))
+    app = web.Application()
+    add_pex_routes(app.router, b)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    for s in runner.sites:
+        server = getattr(s, "_server", None)
+        if server and server.sockets:
+            ports["b"] = server.sockets[0].getsockname()[1]
+    a = PexGossiper(storage_mgr=storage_a,
+                    host_info=host("a", lambda: 65001),
+                    bootstrap=[f"127.0.0.1:{ports['b']}"])
+
+    async def cleanup():
+        await a.stop()
+        await b.stop()
+        await runner.cleanup()
+
+    return a, b, ports["b"], cleanup
+
+
+class TestGossipRound:
+    def test_push_pull_merges_both_ways(self):
+        async def go():
+            md = completed_md("t" * 64)
+            a, b, b_port, cleanup = await _gossiper_pair(
+                fake_storage(), fake_storage(md))
+            try:
+                exchanged = await a.round()
+                assert exchanged == 1
+                # pull half: B's completed task is now in A's index, with
+                # B's address triple and topology riding along
+                holders = a.index.parents_for(md.task_id)
+                assert len(holders) == 1
+                e = holders[0]
+                assert e.done and e.rpc_port == 7000
+                assert e.download_port == b_port
+                assert e.content_length == md.content_length
+                assert e.topology.slice_name == "sl-b"
+                # push half: B learned A's membership entry
+                assert any(p.host_id == "a-host"
+                           for p in b.peers.values())
+            finally:
+                await cleanup()
+
+        run(go())
+
+    def test_partial_task_carries_piece_set(self):
+        async def go():
+            md = completed_md("u" * 64, pieces=4)
+            md.done = md.success = False           # mid-download holder
+            del md.pieces[3]
+            a, b, _port, cleanup = await _gossiper_pair(
+                fake_storage(), fake_storage(md))
+            try:
+                await a.round()
+                e = a.index.parents_for(md.task_id)[0]
+                assert not e.done
+                assert e.pieces == {0, 1, 2}
+            finally:
+                await cleanup()
+
+        run(go())
+
+    def test_gossip_drop_fault_counted_then_recovers(self):
+        sent = REGISTRY.counter("df_pex_digests_sent_total", "x", ("result",))
+
+        async def go():
+            md = completed_md("v" * 64)
+            a, b, _port, cleanup = await _gossiper_pair(
+                fake_storage(), fake_storage(md))
+            try:
+                script = faultgate.arm("pex.gossip", "fail", n=1)
+                before_err = sent.value("error")
+                assert await a.round() == 0          # edge dropped
+                assert script.fired == 1
+                assert sent.value("error") == before_err + 1
+                assert a.index.parents_for(md.task_id) == []
+                assert await a.round() == 1          # script consumed
+                assert len(a.index.parents_for(md.task_id)) == 1
+            finally:
+                await cleanup()
+
+        run(go())
+
+    def test_gossip_corruption_rejected_by_receiver(self):
+        rejected = REGISTRY.counter("df_pex_rejected_total", "x", ("reason",))
+
+        async def go():
+            md_a = completed_md("w" * 64)
+            a, b, _port, cleanup = await _gossiper_pair(
+                fake_storage(md_a), fake_storage())
+            try:
+                faultgate.arm("pex.gossip", "corrupt", n=1)
+                before = rejected.value("checksum")
+                exchanged = await a.round()
+                # the receiver 400s the corrupted push: nothing merged on
+                # either side, and the rejection is counted
+                assert exchanged == 0
+                assert rejected.value("checksum") == before + 1
+                assert b.index.parents_for(md_a.task_id) == []
+                # next round is clean and the digest lands
+                assert await a.round() == 1
+                assert len(b.index.parents_for(md_a.task_id)) == 1
+            finally:
+                await cleanup()
+
+        run(go())
+
+    def test_hearsay_never_refreshes_liveness(self):
+        """Indirect mentions (gossip samples, bootstrap re-seeds) must not
+        reset a peer's fail count — or a dead peer living on in everyone's
+        sample would be re-blessed faster than PEER_FAIL_LIMIT evicts it."""
+        g = PexGossiper(storage_mgr=fake_storage(),
+                        host_info=lambda: Host(id="self", ip="9.9.9.9",
+                                               download_port=1))
+        g.observe_peer(host_id="p", ip="10.0.0.2", download_port=5,
+                       direct=True)
+        peer = g.peers["10.0.0.2:5"]
+        peer.fails = 2
+        g.observe_peer(host_id="p", ip="10.0.0.2", download_port=5)
+        assert peer.fails == 2                 # hearsay: untouched
+        g.observe_peer(host_id="p", ip="10.0.0.2", download_port=5,
+                       direct=True)
+        assert peer.fails == 0                 # first-hand: reset
+
+    def test_pex_minted_parents_do_not_self_bless(self):
+        """Parents the pex plane itself minted (peer_id "pex-...") loop
+        back through the engine's peer_observer — they are this plane's
+        own hearsay and must not count as first-hand liveness."""
+        from dragonfly2_tpu.idl.messages import PeerAddr
+
+        g = PexGossiper(storage_mgr=fake_storage(),
+                        host_info=lambda: Host(id="self", ip="9.9.9.9",
+                                               download_port=1))
+        g.observe_parent(PeerAddr(peer_id="pex-ghost", ip="10.0.0.7",
+                                  rpc_port=1, download_port=2))
+        assert not g.peers
+        g.observe_parent(PeerAddr(peer_id="sched-assigned", ip="10.0.0.7",
+                                  rpc_port=1, download_port=2))
+        assert "10.0.0.7:2" in g.peers
+
+    def test_evicted_peer_cooldown_blocks_hearsay_recreation(self):
+        async def go():
+            a, _b, _port, cleanup = await _gossiper_pair(
+                fake_storage(), fake_storage())
+            try:
+                a._bootstrap = ["127.0.0.1:9"]
+                for _ in range(pexmod.PEER_FAIL_LIMIT):
+                    await a.round()
+                assert "127.0.0.1:9" not in a.peers
+                # the bootstrap re-seed in round() is hearsay: the evicted
+                # address must sit out its cooldown, not resurrect with a
+                # fresh fail budget every round
+                await a.round()
+                assert "127.0.0.1:9" not in a.peers
+                # a digest FROM the address is first-hand and re-admits it
+                a.observe_peer(host_id="back", ip="127.0.0.1",
+                               download_port=9, direct=True)
+                assert "127.0.0.1:9" in a.peers
+            finally:
+                await cleanup()
+
+        run(go())
+
+    def test_well_sealed_but_ill_typed_digest_rejected(self):
+        """The seal proves only that the sender sealed these bytes; bad
+        field types must produce a counted rejection (not a 500) and must
+        not half-merge membership."""
+        rejected = REGISTRY.counter("df_pex_rejected_total", "x", ("reason",))
+        g = PexGossiper(storage_mgr=fake_storage(),
+                        host_info=lambda: Host(id="self", ip="9.9.9.9",
+                                               download_port=1))
+        raw = seal({"v": pexmod.DIGEST_VERSION,
+                    "origin": {"host_id": "evil", "ip": "10.0.0.3",
+                               "rpc_port": "abc", "download_port": 4},
+                    "peers": [], "tasks": []})
+        before = rejected.value("parse")
+        assert not g.ingest(raw)
+        assert rejected.value("parse") == before + 1
+        assert not g.peers                     # nothing mutated
+
+    def test_peer_dropped_after_fail_limit(self):
+        async def go():
+            a, _b, _port, cleanup = await _gossiper_pair(
+                fake_storage(), fake_storage())
+            try:
+                # membership holds one dead peer only
+                a._bootstrap = []
+                a.observe_peer(host_id="dead", ip="127.0.0.1",
+                               download_port=9)
+                assert len(a.peers) == 1
+                for _ in range(pexmod.PEER_FAIL_LIMIT):
+                    await a.round()
+                assert not a.peers
+            finally:
+                await cleanup()
+
+        run(go())
+
+
+# ----------------------------------------------------------------------
+# demoted-scheduler revival probe (the PR-2 latent gap)
+# ----------------------------------------------------------------------
+
+class TestProbeDemoted:
+    def test_probes_run_concurrently(self, monkeypatch):
+        """With the whole ring down the probes must not serialize their
+        connect timeouts — the PEX ticker awaits this every round."""
+        import time as _time
+
+        from dragonfly2_tpu.daemon.scheduler_session import SchedulerConnector
+
+        async def wedged(_host, _port):
+            # a black-holed member: the connect rides out its timeout
+            await asyncio.sleep(3600.0)
+
+        monkeypatch.setattr(asyncio, "open_connection", wedged)
+
+        async def go():
+            addrs = ["10.255.255.1:9", "10.255.255.2:9", "10.255.255.3:9"]
+            conn = SchedulerConnector(addrs, Host(id="h"), demote_s=3600.0)
+            for a in addrs:
+                conn.demote(a)
+            t0 = _time.monotonic()
+            assert await conn.probe_demoted(timeout_s=0.5) == []
+            # 3 serial timeouts would take >= 1.5s; concurrent ~0.5s
+            assert _time.monotonic() - t0 < 1.2
+            assert conn.demoted() == set(addrs)
+            await conn.close()
+
+        run(go())
+
+    def test_probe_revives_listening_scheduler_only(self):
+        from dragonfly2_tpu.daemon.scheduler_session import SchedulerConnector
+
+        async def go():
+            server = await asyncio.start_server(
+                lambda r, w: w.close(), "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            live = f"127.0.0.1:{port}"
+            dead = "127.0.0.1:9"
+            conn = SchedulerConnector([live, dead], Host(id="h"),
+                                      demote_s=3600.0)
+            conn.demote(live)
+            conn.demote(dead)
+            assert conn.demoted() == {live, dead}
+            try:
+                revived = await conn.probe_demoted(timeout_s=1.0)
+                assert revived == [live]
+                # the live member is back in rotation; the dead one stays
+                # stickily demoted until its window expires
+                assert conn.demoted() == {dead}
+            finally:
+                server.close()
+                await server.wait_closed()
+                await conn.close()
+
+        run(go())
+
+
+# ----------------------------------------------------------------------
+# ladder hooks: advisory priming + rung bookkeeping
+# ----------------------------------------------------------------------
+
+class TestLadderHooks:
+    def _gossiper_with_holder(self, task_id):
+        g = PexGossiper(
+            storage_mgr=fake_storage(),
+            host_info=lambda: Host(id="self", ip="127.0.0.1", port=1,
+                                   download_port=2))
+        g.index.update(task_id, entry("holder", rpc_port=7, download_port=8))
+        return g
+
+    def test_prime_enqueues_advisory_packet(self):
+        task_id = "x" * 64
+        g = self._gossiper_with_holder(task_id)
+        conductor = types.SimpleNamespace(task_id=task_id, peer_id="p",
+                                          flight=None)
+        session = types.SimpleNamespace(packets=asyncio.Queue())
+        g.prime(conductor, session)
+        packet = session.packets.get_nowait()
+        assert packet.advisory
+        assert packet.candidate_peers[0].download_port == 8
+        # no holders -> no packet
+        g2 = PexGossiper(storage_mgr=fake_storage(),
+                         host_info=lambda: Host(id="s", ip="1.2.3.4"))
+        g2.prime(conductor, session)
+        assert session.packets.empty()
+
+    def test_try_pull_declines_without_holders_or_engine(self):
+        task_id = "y" * 64
+        conductor = types.SimpleNamespace(task_id=task_id, peer_id="p",
+                                          flight=None)
+
+        async def go():
+            g = self._gossiper_with_holder(task_id)   # no engine_factory
+            assert not await g.try_pull(conductor)
+            g2 = PexGossiper(storage_mgr=fake_storage(),
+                             host_info=lambda: Host(id="s", ip="1.2.3.4"))
+            g2.engine_factory = lambda: None
+            assert not await g2.try_pull(conductor)   # no holders
+
+        run(go())
+
+    def test_try_pull_journals_pex_rung_and_counts_hits(self):
+        from dragonfly2_tpu.daemon.flight_recorder import TaskFlight
+        from dragonfly2_tpu.idl.messages import PieceInfo, PieceResult
+
+        task_id = "z" * 64
+        flight = TaskFlight(task_id, "p")
+        conductor = types.SimpleNamespace(
+            task_id=task_id, peer_id="p", flight=flight,
+            log=types.SimpleNamespace(info=lambda *a, **k: None))
+        hits = REGISTRY.counter("df_pex_parent_hits_total", "x")
+
+        class FakeEngine:
+            async def pull(self, cond, session):
+                # the engine reports pieces as from a real parent; the
+                # synthetic session turns them into pex hit counts
+                await session.report_piece(PieceResult(
+                    task_id=task_id, src_peer_id="p",
+                    dst_peer_id="pex-holder", success=True,
+                    piece_info=PieceInfo(piece_num=0)))
+                return True
+
+        async def go():
+            g = self._gossiper_with_holder(task_id)
+            g.engine_factory = FakeEngine
+            before = hits.value()
+            assert await g.try_pull(conductor)
+            assert hits.value() == before + 1
+            assert flight.summarize()["served_rung"] == "pex"
+
+        run(go())
+
+
+# ----------------------------------------------------------------------
+# chaos e2e: the pex rung under a full scheduler outage
+# ----------------------------------------------------------------------
+
+class TestPexRungE2E:
+    def test_all_scheds_down_served_p2p_via_pex(self, tmp_path):
+        """Warm neighbor + every scheduler faulted dead: the task must
+        complete P2P on the `pex` rung — flight summary `served_rung:
+        "pex"`, df_pex_parent_hits_total > 0, ZERO origin bytes (the
+        origin is torn down to prove it) — and dfdiag must name the
+        rung."""
+        from test_daemon_e2e import daemon_config
+        from test_p2p import seed_daemon_with
+
+        from dragonfly2_tpu.daemon.config import (
+            SchedulerConfig as DaemonSchedCfg)
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest
+        from dragonfly2_tpu.tools.dfdiag import verdict
+
+        hits = REGISTRY.counter("df_pex_parent_hits_total", "x")
+
+        async def go():
+            data = os.urandom((9 << 20) + 333)      # 3 pieces
+            seed, origin, url, task_id, _peer = await seed_daemon_with(
+                tmp_path, data)
+            await origin.cleanup()      # bytes MUST come from the mesh
+            leech_cfg = daemon_config(tmp_path, "leech")
+            # addresses exist but every register is injected dead before
+            # dialing — the full ring-failover ladder runs and exhausts
+            leech_cfg.scheduler = DaemonSchedCfg(
+                addresses=["127.0.0.1:9", "127.0.0.1:10"],
+                register_timeout_s=2.0, schedule_timeout_s=5.0)
+            leech_cfg.probe_enabled = False
+            # gossip: bootstrap names the warm neighbor; drive the round
+            # explicitly instead of waiting out the jittered ticker
+            leech_cfg.pex.bootstrap = [
+                f"127.0.0.1:{seed.upload_server.port}"]
+            leech_cfg.pex.interval_s = 3600.0
+            leech = Daemon(leech_cfg)
+            await leech.start()
+            faultgate.arm("sched.register", "fail", n=-1)
+            try:
+                assert await leech.pex.round() == 1
+                assert len(leech.pex.index.parents_for(task_id)) == 1
+                before = hits.value()
+                async for _ in leech.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "out.bin"),
+                        timeout_s=60.0)):
+                    pass
+                assert (tmp_path / "out.bin").read_bytes() == data
+                conductor = leech.ptm.conductor(task_id)
+                assert conductor.state == conductor.SUCCESS
+                # zero origin hits: every byte rode the mesh via gossip
+                assert conductor.traffic_source == 0
+                assert conductor.traffic_p2p == len(data)
+                assert hits.value() > before
+                summary = leech.flight_recorder.get(task_id).summarize()
+                assert summary["served_rung"] == "pex"
+                assert summary["rungs"] == ["pex"]
+                v = verdict(summary)
+                assert "served by rung 'pex'" in v
+                assert "PEX gossip" in v
+                # the debug surface names the holder the rung used
+                import aiohttp
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                            f"http://127.0.0.1:"
+                            f"{leech.upload_server.port}/debug/pex") as r:
+                        snap = await r.json()
+                assert task_id in snap["swarm"]["tasks"]
+                assert snap["peers"]
+            finally:
+                await leech.stop()
+                await seed.stop()
+
+        run(go())
+
+    def test_sched_verdict_back_source_skips_pex(self, tmp_path):
+        """A scheduler VERDICT (NeedBackSource) must go to origin even
+        when gossip knows holders — the pex rung replaces an absent
+        control plane, never one that answered."""
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.common import ids
+        from dragonfly2_tpu.common.errors import Code, DFError
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest
+
+        class VerdictScheduler:
+            async def register(self, conductor):
+                raise DFError(Code.SCHED_NEED_BACK_SOURCE, "small task")
+
+            async def close(self):
+                pass
+
+        async def go():
+            data = os.urandom(300_000)
+            origin, base = await start_origin({"f.bin": data})
+            cfg = daemon_config(tmp_path, "verdict")
+            daemon = Daemon(cfg)
+            daemon._scheduler_factory = lambda d: VerdictScheduler()
+            await daemon.start()
+            url = f"{base}/f.bin"
+            task_id = ids.task_id(url)
+            # gossip claims a (bogus) holder; the verdict must win
+            daemon.pex.index.update(task_id, entry("bogus", rpc_port=9,
+                                                   download_port=9))
+            try:
+                async for _ in daemon.ptm.start_file_task(DownloadRequest(
+                        url=url, output=str(tmp_path / "o.bin"),
+                        timeout_s=60.0)):
+                    pass
+                assert (tmp_path / "o.bin").read_bytes() == data
+                conductor = daemon.ptm.conductor(task_id)
+                assert conductor.traffic_source == len(data)
+                summary = daemon.flight_recorder.get(task_id).summarize()
+                assert summary["served_rung"] == "back_source"
+                assert "pex" not in summary["rungs"]
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        run(go())
+
+
+@pytest.mark.slow
+class TestPexPropagationE2E:
+    def test_transitive_membership_three_daemons(self, tmp_path):
+        """A -> B bootstrap, B -> C bootstrap: after two rounds A knows C
+        transitively (the digest's peer sample) and holds C's task in its
+        swarm index without ever being configured with C's address."""
+        from test_daemon_e2e import daemon_config
+        from test_p2p import seed_daemon_with
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+
+        async def go():
+            data = os.urandom((4 << 20) + 5)
+            # C is the warm daemon (holds the task)
+            c, origin, _url, task_id, _peer = await seed_daemon_with(
+                tmp_path, data, name="cc")
+            await origin.cleanup()
+            b_cfg = daemon_config(tmp_path, "bb")
+            b_cfg.pex.bootstrap = [f"127.0.0.1:{c.upload_server.port}"]
+            b_cfg.pex.interval_s = 3600.0
+            b = Daemon(b_cfg)
+            await b.start()
+            a_cfg = daemon_config(tmp_path, "aa")
+            a_cfg.pex.bootstrap = [f"127.0.0.1:{b.upload_server.port}"]
+            a_cfg.pex.interval_s = 3600.0
+            a = Daemon(a_cfg)
+            await a.start()
+            try:
+                await b.pex.round()          # B learns C (+ C's task)
+                await a.pex.round()          # A learns B; B's sample names C
+                assert any(p.host_id.startswith("cc")
+                           for p in a.pex.peers.values())
+                await a.pex.round()          # now A exchanges with C too
+                holders = a.pex.index.parents_for(task_id)
+                assert any(e.host_id.startswith("cc") for e in holders)
+            finally:
+                await a.stop()
+                await b.stop()
+                await c.stop()
+
+        run(go())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

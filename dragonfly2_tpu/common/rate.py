@@ -62,6 +62,11 @@ class TokenBucket:
         self._refill()
         self._tokens = min(self.burst, self._tokens + n)
 
+    def refund(self, n: float) -> None:
+        """Hand back ``n`` reserved tokens whose bytes were never moved
+        (cancelled transfer, 404 after an optimistic acquire)."""
+        self._unreserve(n)
+
     async def acquire(self, n: float) -> None:
         # Oversized requests (a 16 MiB piece against a small burst) are allowed
         # through one at a time by paying the full wait instead of deadlocking.

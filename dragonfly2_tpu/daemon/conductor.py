@@ -21,14 +21,24 @@ from typing import Any, AsyncIterator
 from ..common import digest as digestlib
 from ..common.errors import Code, DFError
 from ..common.logging import with_fields
+from ..common.metrics import REGISTRY
 from ..common.piece import Range, compute_piece_size, piece_count
-from ..idl.messages import TaskType, UrlMeta
+from ..idl.messages import PieceInfo, TaskType, UrlMeta
+from ..storage.io_executor import run_io
 from ..storage.manager import StorageManager
 from ..storage.metadata import TaskMetadata
 from ..storage.store import TaskStorage
 from . import flight_recorder as fr
 
 log = logging.getLogger("df.core.conductor")
+
+# which landing path served each downloaded span: "native" (fused
+# pwrite+crc32c, one traversal), "python" (one pwrite + off-loop hashing),
+# or "per_piece" (storage without a span entry point) — the dfbench --pr5
+# smoke gate fails when per_piece shows up on the normal P2P path
+_span_lands = REGISTRY.counter(
+    "df_span_land_total", "downloaded spans landed in storage, by landing "
+    "path", ("path",))
 
 
 class PeerTaskConductor:
@@ -254,17 +264,168 @@ class PeerTaskConductor:
                                  cost_ms: int, parent_id: str,
                                  piece_digest: str = "") -> bool:
         """Returns True when this call landed the piece (the flight
-        recorder and traffic stats count only landed pieces)."""
-        # the P2P downloader verified data against piece_digest already
+        recorder and traffic stats count only landed pieces). The normal
+        P2P path lands through ``on_span_from_peer``; this remains for
+        TINY direct-content tasks and per-piece callers."""
+        # the downloader no longer hashes on the loop: verification happens
+        # in the storage write pass (a mismatch raises DIGEST_MISMATCH)
         landed = await self._land_piece(num, offset, data, cost_ms,
                                         source=parent_id,
-                                        piece_digest=piece_digest,
-                                        pre_verified=bool(piece_digest))
+                                        piece_digest=piece_digest)
         if landed:
             # endgame-raced duplicates are dropped at landing and must not
             # inflate the traffic accounting (egress-saved stats)
             self.traffic_p2p += len(data)
         return landed
+
+    async def on_span_from_peer(self, parent_id: str,
+                                pieces: list[PieceInfo], data,
+                                cost_ms_per_piece: int,
+                                ) -> tuple[list[int], list[int], list[int]]:
+        """Land a whole contiguous downloaded span in ONE pass: one
+        storage-executor hop, one buffer traversal (digest verification
+        fused with the write — ``TaskStorage.write_span``), one condition
+        round for all pieces. This replaces the per-piece landing loop
+        that cost a ``to_thread`` hop, a hash pass, and a write per 4-16
+        MiB piece.
+
+        ``pieces`` are contiguous ascending; ``data`` holds their bytes
+        from ``pieces[0].range_start``. Returns ``(placed, corrupt,
+        raced)`` piece-number lists. ``raced`` pieces were CLAIMED BY AN
+        IN-FLIGHT RACER (endgame duplicate mid-landing) whose outcome is
+        unknown — the caller must report them neither completed nor
+        corrupt (the racer's own report settles them); now that
+        verification happens at landing, treating a still-landing
+        duplicate as done would orphan the piece for good if the racer's
+        copy turns out corrupt. Already-LANDED duplicates appear in none
+        of the three lists: those verified at landing and are safely
+        reportable as complete. The caller owns ``data`` and may release
+        it to the buffer pool as soon as this returns: the storage write
+        and the HBM staging memcpy have both completed by then (the
+        pool's reuse-safety contract).
+        """
+        if self.storage is None:
+            raise DFError(Code.CLIENT_STORAGE_ERROR,
+                          "span before content info")
+        base = pieces[0].range_start
+        raced = [p.piece_num for p in pieces
+                 if p.piece_num in self._landing]
+        claim = [p for p in pieces
+                 if p.piece_num not in self.ready
+                 and p.piece_num not in self._landing]
+        if not claim:
+            return [], [], raced
+        for p in claim:             # same dedup-race claim as _land_piece
+            self._landing.add(p.piece_num)
+        try:
+            write_span = getattr(self.storage, "write_span", None)
+            if write_span is not None:
+                spec = [(p.piece_num, p.range_start, p.range_size, p.digest)
+                        for p in claim]
+                metas, corrupt, path = await run_io(
+                    write_span, spec, data, base=base,
+                    cost_ms=cost_ms_per_piece, source=parent_id)
+                _span_lands.labels(path).inc()
+                landed_nums = [m.num for m in metas]
+            else:
+                # storage without a span entry point (ranged sub-task
+                # views): per-piece landing, still off-loop
+                _span_lands.labels("per_piece").inc()
+                landed_nums, corrupt = [], []
+                mv = memoryview(data)
+                try:
+                    for p in claim:
+                        lo = p.range_start - base
+                        try:
+                            await run_io(
+                                self.storage.write_piece, p.piece_num,
+                                p.range_start, mv[lo:lo + p.range_size],
+                                p.digest, cost_ms=cost_ms_per_piece,
+                                source=parent_id)
+                        except DFError as exc:
+                            if exc.code == Code.CLIENT_DIGEST_MISMATCH:
+                                corrupt.append(p.piece_num)
+                                continue
+                            raise
+                        landed_nums.append(p.piece_num)
+                finally:
+                    mv.release()
+        finally:
+            for p in claim:
+                self._landing.discard(p.piece_num)
+        by_num = {p.piece_num: p for p in claim}
+        landed_set = set(landed_nums)
+        corrupt_set = set(corrupt)
+        # claimed pieces that are neither landed nor corrupt were ALREADY
+        # on disk: md-recorded by an earlier conductor over this same
+        # TaskStorage (retry after a failed download — the ready set died
+        # with the old conductor, the storage did not). Their disk bytes
+        # were verified when first landed, so count them placed here too;
+        # not doing so would report them complete meshside while this
+        # conductor never reaches total_pieces — a silent forever-hang.
+        on_disk = set(p.piece_num for p in claim
+                      if p.piece_num not in landed_set
+                      and p.piece_num not in corrupt_set
+                      and p.piece_num not in self.ready)
+        placed = [n for n in landed_nums if n not in self.ready]
+        placed += sorted(on_disk)
+        if not placed:
+            return [], corrupt, raced
+        if self.device_ingest is not None:
+            # staging memcpy per landed piece, inline (see _land_piece for
+            # why this never rides an executor); the view dies before the
+            # caller can recycle the buffer
+            view = memoryview(data)
+            try:
+                for n in placed:
+                    p = by_num[n]
+                    try:
+                        if n in on_disk:
+                            # this span's copy of an already-recorded
+                            # piece was never digest-checked — stage the
+                            # VERIFIED bytes from disk instead
+                            src = await run_io(self.storage.read_piece, n)
+                            self.device_ingest.write(p.range_start, src)
+                        else:
+                            lo = p.range_start - base
+                            self.device_ingest.write(
+                                p.range_start, view[lo:lo + p.range_size])
+                        if self.flight is not None:
+                            self.flight.event(fr.HBM_DONE, n,
+                                              nbytes=p.range_size)
+                    except Exception:
+                        self.log.exception(
+                            "device ingest write failed; disabling sink")
+                        self.device_ingest.close()
+                        self.device_ingest = None
+                        break
+            finally:
+                view.release()
+        events = []
+        counted = []
+        async with self._piece_cond:
+            for n in placed:
+                if n in self.ready:
+                    # lost a race decided during the awaits above (an
+                    # endgame duplicate re-claimed a just-landed piece in
+                    # the _landing-discard → ready-add window): the winner
+                    # already accounted it — counting twice would inflate
+                    # completed_length past content_length
+                    continue
+                counted.append(n)
+                size = by_num[n].range_size
+                self.ready.add(n)
+                self.completed_length += size
+                self.traffic_p2p += size
+                if self.shaper is not None:
+                    self.shaper.record(self.task_id, size)
+                events.append({"type": "piece", "num": n, "size": size,
+                               "completed": self.completed_length,
+                               "total": self.content_length})
+            self._piece_cond.notify_all()
+        for ev in events:
+            self._publish(ev)
+        return counted, corrupt, raced
 
     async def _land_piece(self, num: int, offset: int, data: bytes,
                           cost_ms: int, source: str,
@@ -282,10 +443,12 @@ class PeerTaskConductor:
             return False
         self._landing.add(num)
         try:
-            # hashing+write can take ms at 16MiB — keep the loop responsive
-            await asyncio.to_thread(self.storage.write_piece, num, offset,
-                                    data, piece_digest, cost_ms=cost_ms,
-                                    source=source, pre_verified=pre_verified)
+            # hashing+write can take ms at 16MiB — runs on the DEDICATED
+            # storage executor (io_executor.py), not the shared default
+            # pool, so piece landing never queues behind TLS handshakes
+            await run_io(self.storage.write_piece, num, offset,
+                         data, piece_digest, cost_ms=cost_ms,
+                         source=source, pre_verified=pre_verified)
         finally:
             self._landing.discard(num)
         if num in self.ready:     # lost a race decided elsewhere
@@ -347,6 +510,10 @@ class PeerTaskConductor:
                         yield b
             return digestlib.hash_stream(algo, chunks())
 
+        # default executor ON PURPOSE (not run_io): this is a full-content
+        # hash — minutes at multi-GB — and the storage pool is 4 threads
+        # sized for piece landings; parking it there would queue every
+        # in-flight span write behind a finalizing task
         got = await asyncio.to_thread(compute)
         if got != want:
             raise DFError(Code.CLIENT_DIGEST_MISMATCH,
@@ -358,10 +525,9 @@ class PeerTaskConductor:
                           f"incomplete: {len(self.ready)}/{self.total_pieces} pieces")
         await self._verify_digest()
         if self.storage is not None:
-            await asyncio.to_thread(
-                self.storage.mark_done, success=True,
-                content_length=self.content_length,
-                total_piece_count=self.total_pieces)
+            await run_io(self.storage.mark_done, success=True,
+                         content_length=self.content_length,
+                         total_piece_count=self.total_pieces)
         if self.device_ingest is not None:
             try:
                 self.device_ingest.flush()   # enqueue-only, non-blocking
@@ -417,7 +583,7 @@ class PeerTaskConductor:
             self.device_ingest = None
         if self.storage is not None:
             try:
-                await asyncio.to_thread(self.storage.mark_done, success=False)
+                await run_io(self.storage.mark_done, success=False)
             except Exception:  # noqa: BLE001
                 pass
         self._publish({"type": "done", "success": False, "code": int(code),
@@ -480,7 +646,7 @@ class PeerTaskConductor:
                     await self._piece_cond.wait()
             if num in self.ready:
                 assert self.storage is not None
-                data = await asyncio.to_thread(self.storage.read_piece, num)
+                data = await run_io(self.storage.read_piece, num)
                 yield data
                 num += 1
                 if self.total_pieces >= 0 and num >= self.total_pieces:

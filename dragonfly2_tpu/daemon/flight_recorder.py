@@ -38,6 +38,10 @@ DISPATCHED = "dispatched"    # HTTP GET to the parent is about to fire
 FIRST_BYTE = "first_byte"    # first body chunk arrived (per request)
 WIRE_DONE = "wire_done"      # piece bytes fully on the wire, verified
 HBM_DONE = "hbm_done"        # piece staged for the device sink
+CORRUPT = "corrupt"          # digest mismatch at landing (parent = sender):
+# the piece was requeued; repeated corrupt events from one parent are the
+# dfdiag fingerprint of a corrupting peer (bad NIC/disk), and the summary
+# counts them per parent so the verdict can name it
 # task-level stages
 REGISTERED = "registered"    # scheduler register returned
 HBM_SHARD = "hbm_shard"      # one device DMA completed (piece = shard idx)
@@ -144,10 +148,14 @@ class TaskFlight:
         pieces: dict[int, dict] = {}
         parents: dict[str, dict] = {}
         rungs: list[str] = []
+        corrupt: dict[str, int] = {}
         hbm_dma_ms = 0.0
         for t, stage, piece, parent, nbytes, dur in self.events:
             if stage == HBM_SHARD:
                 hbm_dma_ms += dur
+                continue
+            if stage == CORRUPT:
+                corrupt[parent] = corrupt.get(parent, 0) + 1
                 continue
             if stage == RUNG:
                 # dedupe consecutive repeats (reschedule can re-fire while
@@ -243,6 +251,10 @@ class TaskFlight:
             "rungs": rungs,
             "served_rung": rungs[-1] if rungs else "",
             "report_drops": self.report_drops,
+            # digest-mismatched transfers per sending parent (the piece
+            # itself was requeued and its eventual row credits whoever
+            # delivered the good copy)
+            "corrupt_pieces": corrupt,
             "piece_rows": piece_rows,
         }
         total_bytes = summary["bytes_p2p"] + summary["bytes_source"]

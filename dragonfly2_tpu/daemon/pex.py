@@ -547,6 +547,31 @@ class PexGossiper:
         session.packets.put_nowait(packet)
         _primes.inc()
 
+    @staticmethod
+    def _covers_task(entries, conductor) -> bool:
+        """Coverage gate for the pex rung: there is no scheduler behind a
+        pex pull, so nobody rescues it if the gossip-known holders turn
+        out not to have the whole task — the engine would land the covered
+        pieces and then park forever waiting for announcements that can
+        never come (a seed riding this rung while its leechers wait on IT
+        is a distributed deadlock: the chaos seed-restart scenario).
+        Proceed only when some holder is complete, or the partial holders'
+        piece sets collectively cover every piece this conductor still
+        needs; otherwise decline and let the ladder continue to
+        back_source."""
+        if any(e.done or e.pieces is None for e in entries):
+            return True
+        total = max((e.total_pieces for e in entries), default=-1)
+        if total < 0:
+            # nobody is complete and nobody knows the geometry: the pull
+            # could not even tell how much is missing
+            return False
+        union: set[int] = set()
+        for e in entries:
+            union |= e.pieces or set()
+        need = set(range(total)) - set(conductor.ready)
+        return need <= union
+
     async def try_pull(self, conductor) -> bool:
         """The ``pex`` rung: serve the task from SwarmIndex holders with a
         fresh P2P engine and a synthetic session — no scheduler anywhere
@@ -556,6 +581,8 @@ class PexGossiper:
             return False
         entries = self._candidates(conductor)
         if not entries:
+            return False
+        if not self._covers_task(entries, conductor):
             return False
         geo = next((e for e in entries if e.content_length >= 0), None)
         packet = self._packet(conductor, entries, advisory=False)
@@ -589,6 +616,10 @@ class _PexSession:
     ``result``/``packets`` exactly as from a real PeerSession; piece
     reports have no scheduler to go to, so they only feed the
     ``df_pex_parent_hits_total`` counter."""
+
+    # no scheduler behind this session: the engine must self-abort on a
+    # stall instead of waiting for a control plane that will never act
+    rescuable = False
 
     def __init__(self, result: RegisterResult, packets: list[PeerPacket]):
         self.result = result

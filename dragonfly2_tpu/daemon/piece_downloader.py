@@ -8,6 +8,14 @@ piece digest announced in the parent's PiecePacket.
 One shared aiohttp session with keep-alive connections per daemon: parents
 are fetched from many times, so connection reuse is the difference between
 one RTT and three per piece.
+
+Zero-stall contract: this module never traverses piece bytes on the event
+loop. Bodies stream into POOLED buffers (common/bufpool.py — callers
+release them once landed) with only the per-chunk memcpy on-loop; digest
+verification happens in the storage landing pass, off-loop, fused with
+the write (store.write_span) — hashing each 4-16 MiB piece on the loop
+made piece bytes compete with sockets, gossip, and gRPC for the daemon's
+one core, and was the dominant term in df_loop_lag_seconds at fan-out.
 """
 
 from __future__ import annotations
@@ -18,8 +26,8 @@ import time
 
 import aiohttp
 
-from ..common import digest as digestlib
 from ..common import faultgate, tracing
+from ..common.bufpool import POOL
 from ..common.errors import Code, DFError
 from ..idl.messages import PieceInfo
 
@@ -62,51 +70,64 @@ class PieceDownloader:
             await self._session.close()
 
     @staticmethod
-    async def _read_body(resp, size: int, hasher, what: str,
+    async def _read_body(resp, size: int, what: str,
                          on_first=None) -> bytearray:
-        """Stream the body into ONE preallocated buffer, folding each
-        cache-hot chunk into the digest as it arrives. Replaces
-        ``resp.read()``: no chunk-list join copy, and no second cold
-        traversal of a 4-16 MiB piece just to hash it — per-byte CPU is
-        the fan-out ceiling on core-bound hosts. ``on_first`` fires once
+        """Stream the body into ONE pooled buffer. Replaces
+        ``resp.read()``: no chunk-list join copy, and — unlike the PR 3/4
+        shape — NO digest folding here: hashing a 4-16 MiB piece on the
+        loop thread was the per-byte CPU that set the fan-out ceiling on
+        core-bound hosts; verification now rides the storage write pass
+        off-loop. Only the per-chunk memcpy stays on the loop. The buffer
+        comes from the process buffer pool; ownership passes to the
+        caller (released back to the pool after landing), and is returned
+        to the pool here on every failure path. ``on_first`` fires once
         when the first body chunk lands (flight-recorder ttfb)."""
         if faultgate.ARMED:
             # inside the request's timeout window: a 'hang' script parks
             # here until the per-piece deadline cancels the read, exactly
             # like a parent that wedged mid-transfer; 'corrupt' flips a
-            # byte BEFORE hashing so digest verification trips downstream
+            # byte BEFORE landing so digest verification trips downstream
             await faultgate.fire("piece.wire", key=what)
-        buf = bytearray(size)
-        mv = memoryview(buf)
-        off = 0
-        async for chunk in resp.content.iter_any():
-            if off == 0 and faultgate.ARMED:
-                chunk = faultgate.corrupt("piece.wire", chunk, key=what)
-            if off == 0 and on_first is not None:
-                on_first()
-                on_first = None
-            n = len(chunk)
-            if off + n > size:
-                raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                              f"{what}: long read {off + n} > {size}")
-            mv[off:off + n] = chunk
-            if hasher is not None:
-                hasher.update(chunk)
-            off += n
-        if off != size:
-            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                          f"{what}: short read {off}/{size}")
+        buf = POOL.acquire(size)
+        try:
+            mv = memoryview(buf)
+            try:
+                off = 0
+                async for chunk in resp.content.iter_any():
+                    if off == 0 and faultgate.ARMED:
+                        chunk = faultgate.corrupt("piece.wire", chunk,
+                                                  key=what)
+                    if off == 0 and on_first is not None:
+                        on_first()
+                        on_first = None
+                    n = len(chunk)
+                    if off + n > size:
+                        raise DFError(
+                            Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                            f"{what}: long read {off + n} > {size}")
+                    mv[off:off + n] = chunk
+                    off += n
+                if off != size:
+                    raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                                  f"{what}: short read {off}/{size}")
+            finally:
+                # drop the export before any release() probes it
+                mv.release()
+        except BaseException:
+            POOL.release(buf)
+            raise
         return buf
 
     async def download_piece(self, *, dst_addr: str, task_id: str,
                              src_peer_id: str, piece: PieceInfo,
                              on_first_byte=None,
                              ) -> tuple[bytearray, int]:
-        """Fetch one piece from a parent. Returns (data, cost_ms).
-
-        Raises CLIENT_PIECE_DOWNLOAD_FAIL on transport/status errors and
-        CLIENT_DIGEST_MISMATCH when the bytes do not match the announced
-        piece digest (the caller treats both as retry-on-another-parent).
+        """Fetch one piece from a parent. Returns (data, cost_ms); ``data``
+        is a POOLED buffer the caller owns (release to ``bufpool.POOL``
+        after landing). Bytes are NOT digest-verified here — verification
+        happens off-loop in the storage landing pass (the caller treats a
+        landing-time mismatch as retry-on-another-parent, same as the
+        transport errors raised here as CLIENT_PIECE_DOWNLOAD_FAIL).
         """
         url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start, size = piece.range_start, piece.range_size
@@ -115,9 +136,6 @@ class PieceDownloader:
         if tp:   # trace ctx rides the piece request (ref piece_downloader.go:227)
             headers["traceparent"] = tp
         what = f"parent {dst_addr} piece {piece.piece_num}"
-        algo = want = ""
-        if piece.digest:
-            algo, want = digestlib.parse(piece.digest)
         t0 = time.monotonic()
 
         async def fetch():
@@ -141,17 +159,15 @@ class PieceDownloader:
                     raise DFError(
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                         f"{what}: HTTP {resp.status}")
-                hasher = digestlib.Hasher(algo) if algo else None
-                body = await self._read_body(resp, size, hasher, what,
+                return await self._read_body(resp, size, what,
                                              on_first=on_first_byte)
-                return body, hasher
 
         try:
             # hard per-piece deadline OUTSIDE aiohttp: the session's total
             # timeout only interrupts aiohttp's own awaits, so a parent (or
             # an injected piece.wire hang) that wedges BETWEEN body reads
             # would stall the worker forever without this
-            data, hasher = await asyncio.wait_for(fetch(), self.timeout_s)
+            data = await asyncio.wait_for(fetch(), self.timeout_s)
         except asyncio.TimeoutError:
             raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                           f"{what}: per-piece deadline "
@@ -162,32 +178,28 @@ class PieceDownloader:
             raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                           f"{what}: {type(exc).__name__}: {exc}") from None
         cost_ms = int((time.monotonic() - t0) * 1000)
-        if hasher is not None and hasher.hexdigest() != want:
-            raise DFError(Code.CLIENT_DIGEST_MISMATCH,
-                          f"piece {piece.piece_num} from {dst_addr}: "
-                          f"digest mismatch")
         return data, cost_ms
 
     async def download_span(self, *, dst_addr: str, task_id: str,
                             src_peer_id: str, pieces: list[PieceInfo],
                             on_first_byte=None,
-                            ) -> tuple[list[tuple[PieceInfo, memoryview]], int]:
-        """Fetch CONTIGUOUS pieces in one ranged GET; split + verify each.
+                            ) -> tuple[bytearray, int]:
+        """Fetch CONTIGUOUS pieces in one ranged GET.
 
-        Returns ([(piece, data), ...] for every piece whose digest checked
-        out, cost_ms) — data items are memoryviews over one shared buffer
-        (zero per-piece copies; consumers write them to storage and drop
-        them). A digest mismatch drops that piece (the dispatcher requeues
+        Returns (buf, cost_ms): ONE pooled buffer holding every piece's
+        bytes back to back from ``pieces[0].range_start`` — the caller
+        owns it (release to ``bufpool.POOL`` after landing). No per-piece
+        hashing happens here: verification is fused into the storage
+        landing pass (``TaskStorage.write_span``), off the event loop,
+        where a digest mismatch drops that piece (the dispatcher requeues
         it) without failing its groupmates. Transport errors raise like
         ``download_piece``.
         """
         if len(pieces) == 1:
-            p = pieces[0]
-            data, cost = await self.download_piece(
+            return await self.download_piece(
                 dst_addr=dst_addr, task_id=task_id,
-                src_peer_id=src_peer_id, piece=p,
+                src_peer_id=src_peer_id, piece=pieces[0],
                 on_first_byte=on_first_byte)
-            return [(p, memoryview(data))], cost
         url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start = pieces[0].range_start
         size = sum(p.range_size for p in pieces)
@@ -215,7 +227,7 @@ class PieceDownloader:
                     raise DFError(
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                         f"{what}: HTTP {resp.status}")
-                return await self._read_body(resp, size, None, what,
+                return await self._read_body(resp, size, what,
                                              on_first=on_first_byte)
 
         try:
@@ -231,17 +243,4 @@ class PieceDownloader:
             raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                           f"{what}: {type(exc).__name__}: {exc}") from None
         cost_ms = int((time.monotonic() - t0) * 1000)
-        out: list[tuple[PieceInfo, memoryview]] = []
-        view = memoryview(data)
-        off = 0
-        for p in pieces:
-            chunk = view[off:off + p.range_size]
-            off += p.range_size
-            if p.digest:
-                algo, want = digestlib.parse(p.digest)
-                if digestlib.hash_bytes(algo, chunk) != want:
-                    log.debug("span piece %d from %s: digest mismatch",
-                              p.piece_num, dst_addr)
-                    continue
-            out.append((p, chunk))
-        return out, cost_ms
+        return data, cost_ms

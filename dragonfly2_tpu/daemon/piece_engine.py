@@ -22,6 +22,7 @@ import time
 from typing import TYPE_CHECKING
 
 from ..common import health
+from ..common.bufpool import POOL
 from ..common.errors import Code, DFError
 from ..common.metrics import BYTES_BUCKETS, REGISTRY
 from ..idl.messages import (PeerAddr, PeerPacket, PieceInfo, PieceResult,
@@ -234,18 +235,45 @@ class PieceEngine:
                 code=exc.code))
             return False
         t_wire = flight.now_ms() if flight is not None else 0.0
-        placed = await conductor.on_piece_from_peer(
-            info.piece_num, info.range_start, data, cost,
-            single.dst_peer_id, piece_digest=info.digest)
+        try:
+            placed, corrupt, raced = await conductor.on_span_from_peer(
+                single.dst_peer_id, [info], data, cost)
+        finally:
+            POOL.release(data)
+        if corrupt:
+            self._note_corrupt(conductor, info, single.dst_peer_id)
+            await session.report_piece(self._piece_result(
+                conductor, info, single.dst_peer_id, t0, ok=False,
+                code=Code.CLIENT_DIGEST_MISMATCH))
+            return False
+        if raced:
+            # an endgame racer is mid-landing: its outcome is unknown, so
+            # report NOTHING for this piece — the racer's own path settles
+            # it (reporting ok here would orphan the piece if the racer's
+            # copy fails verification)
+            return True
         if flight is not None and placed:
             flight.event(fr.WIRE_DONE, info.piece_num, single.dst_peer_id,
-                         len(data), dur_ms=cost, t_ms=t_wire)
+                         info.range_size, dur_ms=cost, t_ms=t_wire)
         if placed:
-            _p2p_piece_bytes.observe(len(data))
+            _p2p_piece_bytes.observe(info.range_size)
         _p2p_pieces.labels("ok").inc()
         await session.report_piece(self._piece_result(
             conductor, info, single.dst_peer_id, t0, ok=True, cost_ms=cost))
         return True
+
+    @staticmethod
+    def _note_corrupt(conductor, info: PieceInfo, parent_id: str) -> None:
+        """A transfer failed digest verification at landing: count it
+        (df_p2p_piece_total{result="corrupt"}) and journal a flight event
+        so dfdiag can name the corrupting parent — pre-PR5 this was a
+        log.debug and an invisible requeue."""
+        _p2p_pieces.labels("corrupt").inc()
+        log.warning("piece %d from %s: digest mismatch (requeued)",
+                    info.piece_num, parent_id[-12:])
+        if conductor.flight is not None:
+            conductor.flight.event(fr.CORRUPT, info.piece_num, parent_id,
+                                   info.range_size)
 
     async def _pull_normal(self, conductor, session) -> bool:
         if session.result.content_length >= 0:
@@ -268,12 +296,33 @@ class PieceEngine:
             if self._need_back_source:
                 return False
 
+            # sessions without a scheduler behind them (the pex rung's
+            # synthetic session, rescuable=False) must self-abort when the
+            # swarm stops producing: with live-but-incomplete parents no
+            # packet, verdict, or re-assignment is ever coming, so a stall
+            # would otherwise tick forever (and a seed stuck here while
+            # its leechers wait on IT is a pod-wide deadlock)
+            rescuable = getattr(session, "rescuable", True)
+            last_ready = len(conductor.ready)
+            last_progress = time.monotonic()
+
             while True:
                 if self._need_back_source:
                     return False
                 if (conductor.total_pieces >= 0
                         and len(conductor.ready) >= conductor.total_pieces):
                     return True
+                if not rescuable:
+                    if len(conductor.ready) != last_ready:
+                        last_ready = len(conductor.ready)
+                        last_progress = time.monotonic()
+                    elif (time.monotonic() - last_progress
+                            > self.schedule_timeout_s):
+                        log.info("scheduler-less pull stalled %.1fs at "
+                                 "%d/%d pieces; returning to the ladder",
+                                 self.schedule_timeout_s, last_ready,
+                                 conductor.total_pieces)
+                        return False
                 # endgame gate: duplicate-request racing only for the task's
                 # actual tail (see dispatcher._pick_endgame)
                 self.dispatcher.endgame = (
@@ -489,7 +538,7 @@ class PieceEngine:
                         "piece.wire",
                         health.PLANE.slo.section_deadline_s(len(d.pieces)),
                         stage="wire"):
-                    landed, cost = await self.downloader.download_span(
+                    buf, cost = await self.downloader.download_span(
                         dst_addr=d.parent.addr, task_id=conductor.task_id,
                         src_peer_id=conductor.peer_id, pieces=d.pieces,
                         on_first_byte=on_first)
@@ -519,29 +568,53 @@ class PieceEngine:
                     conductor, info, d.parent.peer_id, t0, ok=False,
                     code=exc.code))
             return
-        per_piece_cost = max(1, cost // max(len(landed), 1))
-        for info, data in landed:
-            # timestamp before the landing await, journaled only for
-            # pieces that actually land — an endgame duplicate must not
-            # overwrite the real deliverer's attribution
-            t_wire = flight.now_ms() if flight is not None else 0.0
-            placed = await conductor.on_piece_from_peer(
-                info.piece_num, info.range_start, data, per_piece_cost,
-                d.parent.peer_id, piece_digest=info.digest)
-            if flight is not None and placed:
-                flight.event(fr.WIRE_DONE, info.piece_num, d.parent.peer_id,
-                             len(data), dur_ms=per_piece_cost, t_ms=t_wire)
-            if placed:
-                _p2p_piece_bytes.observe(len(data))
+        per_piece_cost = max(1, cost // len(d.pieces))
+        # timestamp before the landing await, journaled only for pieces
+        # that actually land — an endgame duplicate must not overwrite the
+        # real deliverer's attribution
+        t_wire = flight.now_ms() if flight is not None else 0.0
+        try:
+            # ONE landing hop for the whole span (storage write + verify
+            # fused off-loop; HBM memcpy inline) — pre-PR5 this was one
+            # to_thread + one hash pass + one write PER piece
+            placed, corrupt, raced = await conductor.on_span_from_peer(
+                d.parent.peer_id, d.pieces, buf, per_piece_cost)
+        finally:
+            # landing (including the sink's staging memcpy) has completed:
+            # the buffer is recyclable — this kills the 4-16 MiB
+            # alloc/free churn per download at fan-out
+            POOL.release(buf)
+        placed_set, corrupt_set = set(placed), set(corrupt)
+        raced_set = set(raced)
+        for info in d.pieces:
+            if info.piece_num in corrupt_set:
+                self._note_corrupt(conductor, info, d.parent.peer_id)
+                await session.report_piece(self._piece_result(
+                    conductor, info, d.parent.peer_id, t0, ok=False,
+                    code=Code.CLIENT_DIGEST_MISMATCH))
+                continue
+            if info.piece_num in raced_set:
+                # an endgame racer is mid-landing: outcome unknown — say
+                # nothing; the racer's own report settles the piece
+                continue
+            if info.piece_num in placed_set:
+                if flight is not None:
+                    flight.event(fr.WIRE_DONE, info.piece_num,
+                                 d.parent.peer_id, info.range_size,
+                                 dur_ms=per_piece_cost, t_ms=t_wire)
+                _p2p_piece_bytes.observe(info.range_size)
             _p2p_pieces.labels("ok").inc()
             await session.report_piece(self._piece_result(
                 conductor, info, d.parent.peer_id, t0, ok=True,
                 cost_ms=per_piece_cost, finished=len(conductor.ready)))
         await self.dispatcher.report(
             d, ok=True, cost_ms=cost,
-            completed=[info.piece_num for info, _ in landed])
-        if len(landed) < len(d.pieces):
-            _p2p_pieces.labels("fail").inc()
+            # a raced piece must NOT be marked done (the racer may yet
+            # fail verification — it would be orphaned forever); leaving
+            # it out requeues it, and the winner's report retires it
+            completed=[info.piece_num for info in d.pieces
+                       if info.piece_num not in corrupt_set
+                       and info.piece_num not in raced_set])
 
     @staticmethod
     def _piece_result(conductor, info: PieceInfo, parent_id: str, t0: int, *,

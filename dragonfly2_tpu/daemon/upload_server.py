@@ -20,6 +20,7 @@ from ..common.errors import DFError
 from ..common.metrics import BYTES_BUCKETS, REGISTRY
 from ..common.piece import parse_http_range
 from ..common.rate import TokenBucket
+from ..storage.io_executor import run_io
 from ..storage.manager import StorageManager
 
 log = logging.getLogger("df.http.upload")
@@ -348,13 +349,33 @@ class UploadServer:
                 _upload_piece_bytes.observe(rng.length)
                 _upload_reqs.labels("206").inc()
                 return _SlotFileResponse(data_path(), slot)
+            # acquire BEFORE the read, matching the sendfile branch: a
+            # rate-limited seed must not buffer a multi-MiB range it then
+            # sits on for the whole token wait (the bytes pin memory and
+            # go cold while the limiter holds them back)
+            await self.limiter.acquire(rng.length)
             try:
-                data = await asyncio.to_thread(ts.read_range, rng.start,
-                                               rng.length)
-            except DFError as exc:
+                # dedicated storage executor: piece serves never queue
+                # behind the default pool's TLS handshakes (or vice versa)
+                data = await run_io(ts.read_range, rng.start, rng.length)
+            except (DFError, OSError) as exc:
+                # read_range wraps IO failure in DFError (evicted task ->
+                # missing data file); OSError belt-and-braces for storage
+                # impls that don't. The bytes were never moved: hand the
+                # tokens back (same contract as acquire's cancel path), or
+                # leechers retrying a just-GC'd hot task would drain the
+                # rate budget with 404s and throttle real serves
+                self.limiter.refund(rng.length)
                 _upload_reqs.labels("404").inc()
-                raise web.HTTPNotFound(text=exc.message)
-            await self.limiter.acquire(len(data))
+                msg = exc.message if isinstance(exc, DFError) else str(exc)
+                raise web.HTTPNotFound(text=msg)
+            except BaseException:
+                # cancelled mid-read (client disconnect, peer's per-piece
+                # deadline): zero bytes served, so the tokens go back —
+                # otherwise deadline churn drains a rate-limited seed's
+                # budget with aborted requests
+                self.limiter.refund(rng.length)
+                raise
             _upload_bytes.inc(len(data))
             _upload_piece_bytes.observe(len(data))
             _upload_reqs.labels("206").inc()

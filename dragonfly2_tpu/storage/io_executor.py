@@ -1,0 +1,67 @@
+"""Dedicated bounded executor for storage IO (and the off-loop hash work
+that rides it).
+
+Before this module, every storage call went through ``asyncio.to_thread``
+— i.e. the event loop's SHARED default executor, the same pool that runs
+proxy TLS handshakes (``ssl.create_default_context`` et al), tracer
+flushes, and any library's incidental ``run_in_executor``. Under a
+connect burst a 4-16 MiB piece write (with its verify hash) queued behind
+multi-ms handshakes, and vice versa — the two workloads have nothing in
+common except the pool they were defaulted into.
+
+Storage IO now runs on a small dedicated pool:
+
+* **bounded** — ``MAX_WORKERS`` threads; piece landings beyond that queue
+  here (visible as ``df_storage_io_queue_depth``) instead of growing the
+  default executor toward its 32-thread ceiling;
+* **isolated** — nothing but storage (and conductor finalize/verify) work
+  is submitted, so piece hashing can't sit behind a TLS handshake;
+* **loop-independent** — plain ``concurrent.futures`` pool wrapped per
+  call with ``run_in_executor``, so sequential ``asyncio.run`` loops (the
+  test suite) share it safely.
+
+Use ``run_io(fn, *args)`` from async code; the pool threads are daemonic
+and live for the process (parity with the default executor's lifetime).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from ..common.metrics import REGISTRY
+
+# Small on purpose: storage on one host is one disk (or tmpfs); more
+# threads than ~4 only shuffle the same bandwidth while adding GIL churn.
+MAX_WORKERS = 4
+
+_depth = REGISTRY.gauge(
+    "df_storage_io_queue_depth",
+    "storage-executor jobs submitted and not yet finished")
+
+_executor: ThreadPoolExecutor | None = None
+_lock = threading.Lock()
+
+
+def executor() -> ThreadPoolExecutor:
+    global _executor
+    if _executor is None:
+        with _lock:
+            if _executor is None:
+                _executor = ThreadPoolExecutor(
+                    max_workers=MAX_WORKERS,
+                    thread_name_prefix="df-storage")
+    return _executor
+
+
+async def run_io(fn, *args, **kwargs):
+    """Run ``fn(*args, **kwargs)`` on the storage pool; awaitable."""
+    loop = asyncio.get_running_loop()
+    _depth.inc()
+    try:
+        return await loop.run_in_executor(
+            executor(), functools.partial(fn, *args, **kwargs))
+    finally:
+        _depth.dec()

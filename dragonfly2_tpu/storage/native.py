@@ -76,6 +76,20 @@ def _bind(lib) -> None:
         lib._df_has_piece_io = True
     except AttributeError:
         lib._df_has_piece_io = False
+    try:
+        # int df_span_write(fd, offset, data, uint64* piece_sizes,
+        #                   n_pieces, uint32* crcs_out) — fused span landing
+        # over a cached fd; bound separately so a pre-span .so keeps its
+        # working piece IO
+        lib.df_span_write.argtypes = [ctypes.c_int, ctypes.c_uint64,
+                                      ctypes.c_char_p,
+                                      ctypes.POINTER(ctypes.c_uint64),
+                                      ctypes.c_size_t,
+                                      ctypes.POINTER(ctypes.c_uint32)]
+        lib.df_span_write.restype = ctypes.c_int
+        lib._df_has_span_io = True
+    except AttributeError:
+        lib._df_has_span_io = False
 
 
 def available() -> bool:
@@ -139,10 +153,37 @@ def piece_write(path: str, offset: int, data: bytes | memoryview
     return f"{crc.value:08x}"
 
 
+def span_write(fd: int, offset: int, data: bytes | bytearray | memoryview,
+               piece_sizes: list[int]) -> list[str] | None:
+    """Fused span landing: ONE pwrite traversal of ``data`` at ``offset``
+    through an already-open ``fd``, folding per-piece crc32c as it goes.
+    Returns the per-piece crc32c hex list, or None to signal fallback to
+    the pure-Python path (no .so, or a stale .so without the export).
+    Raises OSError on IO failure."""
+    lib = load()
+    if lib is None or not getattr(lib, "_df_has_span_io", False):
+        return None
+    ptr, n = _buf_arg(data)
+    if n != sum(piece_sizes):
+        raise ValueError(f"span buffer {n} != sum(piece_sizes) "
+                         f"{sum(piece_sizes)}")
+    sizes = (ctypes.c_uint64 * len(piece_sizes))(*piece_sizes)
+    crcs = (ctypes.c_uint32 * len(piece_sizes))()
+    rc = lib.df_span_write(fd, offset, ptr, sizes, len(piece_sizes), crcs)
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc))
+    return [f"{c:08x}" for c in crcs]
+
+
 def piece_read(path: str, offset: int, length: int) -> bytes | None:
     """pread a piece straight into a fresh buffer via the native lib, or
     None to signal fallback. Raises OSError on IO failure; short reads
-    past EOF return the available bytes."""
+    past EOF return the available bytes.
+
+    LEGACY: the store's hot read path moved to plain os.pread on the
+    cached per-task fd (store._data_fd) — same zero-copy profile without
+    a ctypes hop. Kept for external tooling against the path-based ABI
+    (exercised by tests/test_storage.py)."""
     lib = load()
     if lib is None or not getattr(lib, "_df_has_piece_io", False):
         return None

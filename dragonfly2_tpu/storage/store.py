@@ -6,11 +6,20 @@ parent's file). Pieces are written at their offsets with per-piece digest
 verification; reads serve other peers (upload server) and the final sink.
 
 Piece hashing rides the native C++ crc32c path when the library is built
-(see native.py); file IO is buffered Python on a sparse file.
+(see native.py); file IO is positioned pread/pwrite on a per-task CACHED
+fd (opening the data file per piece was a measurable per-piece tax at
+fan-out), issued from the dedicated storage executor (io_executor.py) —
+never the event loop.
+
+``write_span`` is the one-pass landing path: a whole contiguous
+downloaded span costs ONE buffer traversal (pwrite + per-piece crc32c
+fused in the native library, or one pwrite + off-loop hashing in the
+Python fallback) and one write syscall chain instead of N of each.
 """
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import shutil
@@ -25,6 +34,32 @@ from .metadata import DATA_FILE, TaskMetadata, PieceMeta
 log = logging.getLogger("df.storage.task")
 
 
+def _pread_all(fd: int, length: int, offset: int) -> bytes:
+    """pread ``length`` bytes at ``offset``; short only at EOF."""
+    out = os.pread(fd, length, offset)
+    if len(out) == length or not out:
+        return out
+    parts = [out]
+    got = len(out)
+    while got < length:
+        b = os.pread(fd, length - got, offset + got)
+        if not b:
+            break
+        parts.append(b)
+        got += len(b)
+    return b"".join(parts)
+
+
+def _pwrite_all(fd: int, data, offset: int) -> None:
+    """pwrite the whole buffer (kernel may write short); EINTR-safe via
+    os.pwrite's PEP 475 retry."""
+    view = memoryview(data)
+    while len(view):
+        n = os.pwrite(fd, view, offset)
+        view = view[n:]
+        offset += n
+
+
 class TaskStorage:
     """One task's on-disk state. Thread-safe for concurrent piece writes."""
 
@@ -32,11 +67,87 @@ class TaskStorage:
         self.dir = task_dir
         self.md = metadata
         self._lock = threading.Lock()
+        self._fd: int | None = None        # cached O_RDWR fd (lazy)
+        self._fd_users = 0                 # leases out via _data_fd()
+        self._fd_close_deferred = False    # close() arrived mid-lease
         self._data_path = os.path.join(task_dir, DATA_FILE)
         os.makedirs(task_dir, exist_ok=True)
         if not os.path.exists(self._data_path):
             with open(self._data_path, "wb"):
                 pass
+
+    @contextlib.contextmanager
+    def _data_fd(self):
+        """Refcounted lease on the task's cached data fd. Piece IO is
+        pread/pwrite against this one descriptor — per-call open() was
+        pure per-piece overhead and capped the storage executor at the
+        dentry lock, not the disk.
+
+        The refcount exists because close() (GC eviction, destroy) can
+        race in-flight IO on the storage executor: closing the fd under a
+        lease would at best EBADF the IO and at worst — once the fd
+        number is reused by another task's open() — land the bytes in the
+        WRONG task's file. close() during a lease is deferred to the last
+        releaser; an acquire after destroy() re-opens the unlinked path
+        and fails safe (FileNotFoundError), same as the per-call-open
+        behavior this cache replaced. While a close is DEFERRED the
+        cached fd is doomed — it may point at an already-unlinked inode
+        (destroy closes then rmtrees), so new leases must not extend it:
+        they open a private fd from the path, which fails safe post-
+        destroy instead of silently writing bytes that vanish with the
+        inode."""
+        private = None
+        with self._lock:
+            if self._fd_close_deferred:
+                private = True           # opened below, outside the lock
+            else:
+                if self._fd is None:
+                    self._fd = os.open(self._data_path, os.O_RDWR)
+                fd = self._fd
+                self._fd_users += 1
+        if private:
+            fd = os.open(self._data_path, os.O_RDWR)
+            try:
+                yield fd
+            finally:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            return
+        try:
+            yield fd
+        finally:
+            with self._lock:
+                self._fd_users -= 1
+                close_now = (self._fd_close_deferred
+                             and self._fd_users == 0
+                             and self._fd is not None)
+                if close_now:
+                    fd, self._fd = self._fd, None
+                    self._fd_close_deferred = False
+            if close_now:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        """Drop the cached fd (destroy() and GC call this; reopening after
+        close is transparent). With IO in flight the close is deferred to
+        the last lease holder — never yanked out from under a pread/pwrite."""
+        with self._lock:
+            if self._fd is None:
+                self._fd_close_deferred = False
+                return
+            if self._fd_users:
+                self._fd_close_deferred = True
+                return
+            fd, self._fd = self._fd, None
+        try:
+            os.close(fd)
+        except OSError:
+            pass
 
     # -- writes --------------------------------------------------------
 
@@ -68,7 +179,15 @@ class TaskStorage:
         fused_crc = None
         if crc_capable:
             try:
-                fused_crc = native.piece_write(self._data_path, offset, data)
+                # fd-based fused span write (one piece = a span of one)
+                # first — cached fd, no per-call open; fall back to the
+                # path-based export for a stale .so
+                with self._data_fd() as fd:
+                    crcs = native.span_write(fd, offset, data,
+                                             [len(data)])
+                fused_crc = (crcs[0] if crcs is not None
+                             else native.piece_write(self._data_path,
+                                                     offset, data))
             except OSError as exc:
                 raise DFError(Code.CLIENT_STORAGE_ERROR,
                               f"piece {num} write failed: {exc}") from None
@@ -89,15 +208,106 @@ class TaskStorage:
             else:
                 piece_digest = digestlib.for_bytes(
                     digestlib.preferred_piece_algo(), data)
-            with open(self._data_path, "r+b") as f:
-                f.seek(offset)
-                f.write(data)
+            try:
+                with self._data_fd() as fd:
+                    _pwrite_all(fd, data, offset)
+            except OSError as exc:
+                raise DFError(Code.CLIENT_STORAGE_ERROR,
+                              f"piece {num} write failed: {exc}") from None
         meta = PieceMeta(num=num, start=offset, size=len(data),
                          digest=piece_digest, cost_ms=cost_ms, source=source)
         with self._lock:
             self.md.pieces[num] = meta
             self.md.access_time = time.time()
         return meta
+
+    def write_span(self, pieces: list[tuple[int, int, int, str]], data,
+                   *, base: int | None = None, cost_ms: int = 0,
+                   source: str = "") -> tuple[list[PieceMeta], list[int], str]:
+        """Land a whole contiguous downloaded span in ONE pass.
+
+        ``pieces``: ``(num, offset, size, digest)`` in ascending offset
+        order; ``data`` holds their bytes contiguously, ``data[i]`` being
+        content offset ``base + i`` (``base`` defaults to the first
+        piece's offset). Returns ``(landed_metas, corrupt_nums, path)``
+        where ``path`` names the traversal used (``"native"`` fused
+        pwrite+crc32c, ``"python"`` one pwrite + off-loop hashing).
+
+        Per-piece verdicts: a digest-mismatched piece is returned in
+        ``corrupt_nums`` — its bytes hit the file but are never recorded
+        in ``md.pieces``, so the region stays "absent" (never served,
+        re-written by the retry) and its groupmates land normally.
+        Already-recorded pieces (endgame duplicates) are skipped without
+        being re-written: overwriting a verified region with a racer's
+        unverified bytes would let a corrupt duplicate trash good data.
+        """
+        if base is None:
+            base = pieces[0][1]
+        mv = memoryview(data)
+        with self._lock:
+            fresh = [p for p in pieces if p[0] not in self.md.pieces]
+        # contiguous runs: normally one covering the whole span; a landed
+        # duplicate mid-span splits it (each run is still one write+pass)
+        runs: list[list[tuple[int, int, int, str]]] = []
+        for p in fresh:
+            if runs and runs[-1][-1][1] + runs[-1][-1][2] == p[1]:
+                runs[-1].append(p)
+            else:
+                runs.append([p])
+        metas: list[PieceMeta] = []
+        corrupt: list[int] = []
+        used_native = False
+        for run in runs:
+            run_off = run[0][1]
+            sizes = [p[2] for p in run]
+            run_len = sum(sizes)
+            lo = run_off - base
+            run_view = mv[lo:lo + run_len]
+            digests = [digestlib.parse(p[3]) if p[3] else ("", "")
+                       for p in run]
+            crc_capable = all(a in ("", "crc32c") for a, _ in digests)
+            crcs = None
+            try:
+                with self._data_fd() as fd:
+                    if crc_capable:
+                        crcs = native.span_write(fd, run_off,
+                                                 run_view, sizes)
+                    if crcs is None:
+                        _pwrite_all(fd, run_view, run_off)
+            except OSError as exc:
+                raise DFError(Code.CLIENT_STORAGE_ERROR,
+                              f"span write @{run_off}+{run_len} failed: "
+                              f"{exc}") from None
+            pos = 0
+            for i, (num, off, size, dg) in enumerate(run):
+                piece_view = run_view[pos:pos + size]
+                pos += size
+                if crcs is not None:
+                    used_native = True
+                    if dg and crcs[i] != digests[i][1]:
+                        corrupt.append(num)
+                        continue
+                    if not dg:
+                        dg = f"crc32c:{crcs[i]}"
+                else:
+                    # python fallback: bytes already written above in one
+                    # pwrite; verify by hashing the slice here — we are on
+                    # the storage executor, never the event loop
+                    if dg:
+                        if not digestlib.verify(dg, piece_view):
+                            corrupt.append(num)
+                            continue
+                    else:
+                        dg = digestlib.for_bytes(
+                            digestlib.preferred_piece_algo(), piece_view)
+                metas.append(PieceMeta(num=num, start=off, size=size,
+                                       digest=dg, cost_ms=cost_ms,
+                                       source=source))
+        with self._lock:
+            for meta in metas:
+                self.md.pieces.setdefault(meta.num, meta)
+            self.md.access_time = time.time()
+        return metas, corrupt, ("native" if used_native else "python")
 
     def mark_done(self, *, success: bool, content_length: int | None = None,
                   total_piece_count: int | None = None, digest: str = "") -> None:
@@ -123,11 +333,14 @@ class TaskStorage:
         if meta is None:
             raise DFError(Code.CLIENT_PIECE_NOT_FOUND,
                           f"piece {num} not in task {self.md.task_id[:12]}")
-        data = native.piece_read(self._data_path, meta.start, meta.size)
-        if data is None:   # no native lib: plain Python file IO
-            with open(self._data_path, "rb") as f:
-                f.seek(meta.start)
-                data = f.read(meta.size)
+        # one pread on the cached fd: no per-call open, no Python file
+        # object, no intermediate copies
+        try:
+            with self._data_fd() as fd:
+                data = _pread_all(fd, meta.size, meta.start)
+        except OSError as exc:
+            raise DFError(Code.CLIENT_STORAGE_ERROR,
+                          f"piece {num} read failed: {exc}") from None
         if len(data) != meta.size:
             raise DFError(Code.CLIENT_STORAGE_ERROR,
                           f"short read piece {num}: {len(data)}/{meta.size}")
@@ -135,9 +348,15 @@ class TaskStorage:
         return data
 
     def read_range(self, start: int, length: int) -> bytes:
-        with open(self._data_path, "rb") as f:
-            f.seek(start)
-            return f.read(length)
+        try:
+            with self._data_fd() as fd:
+                return _pread_all(fd, length, start)
+        except OSError as exc:
+            # evicted/destroyed task (or real IO failure): a typed error
+            # the upload server maps to 404 instead of a bare 500
+            raise DFError(Code.CLIENT_STORAGE_ERROR,
+                          f"range read @{start}+{length} failed: "
+                          f"{exc}") from None
 
     def has_range(self, start: int, length: int) -> bool:
         """True if stored pieces fully cover [start, start+length)."""
@@ -218,6 +437,7 @@ class TaskStorage:
             return 0
 
     def destroy(self) -> None:
+        self.close()
         shutil.rmtree(self.dir, ignore_errors=True)
 
 
@@ -255,9 +475,12 @@ class SubTaskStorage:
             if existing is not None:
                 return existing
         abs_off = self.md.range_start + offset
-        with open(self.parent.data_path(), "r+b") as f:
-            f.seek(abs_off)
-            f.write(data)
+        try:
+            with self.parent._data_fd() as fd:
+                _pwrite_all(fd, data, abs_off)
+        except OSError as exc:
+            raise DFError(Code.CLIENT_STORAGE_ERROR,
+                          f"piece {num} write failed: {exc}") from None
         meta = PieceMeta(num=num, start=offset, size=len(data),
                          digest=piece_digest, cost_ms=cost_ms, source=source)
         with self._lock:

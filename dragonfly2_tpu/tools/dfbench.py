@@ -84,7 +84,7 @@ from ..daemon.flight_recorder import _pctl  # noqa: E402
 class _Leecher:
     __slots__ = ("peer", "flight", "done", "inflight", "parents",
                  "schedule", "landed_at", "joined_ms", "done_ms",
-                 "since_refresh", "pex_at")
+                 "since_refresh", "pex_at", "timeline")
 
     def __init__(self, peer, flight, joined_ms: float):
         self.peer = peer
@@ -98,6 +98,9 @@ class _Leecher:
         self.done_ms = 0.0
         self.since_refresh = 0
         self.pex_at = 0.0                  # when gossip membership converges
+        # (t_wire_done, wire_ms, size) per landed piece — feeds the PR-5
+        # data-plane replay (collect_timeline); never in the rng path
+        self.timeline: list[tuple[float, float, int]] = []
 
 
 # pseudo-parent id for back-source fetches in the scheds-down scenario
@@ -107,7 +110,8 @@ _ORIGIN_ID = "origin"
 
 def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
               piece_size: int = 4 << 20, parallelism: int = 4,
-              scenario: str = "baseline") -> dict:
+              scenario: str = "baseline",
+              collect_timeline: bool = False) -> dict:
     """Run one simulated fan-out; returns the result dict (pure function
     of its arguments — no wall clock, no global state beyond the process
     metrics registry the flight summaries touch). ``scenario`` switches
@@ -321,6 +325,8 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
             lc.flight.events.append((t_hbm, fr.HBM_DONE, piece, "",
                                      piece_size, 0.0))
             lc.done_ms = max(lc.done_ms, t_hbm)
+            if collect_timeline:
+                lc.timeline.append((t_wire, wire_ms, piece_size))
             push(t_wire, "land", i, piece, _ORIGIN_ID, t_wire)
             push(t_hbm, "worker", i)
             continue
@@ -345,12 +351,18 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
         ev((t_wire, fr.WIRE_DONE, piece, parent.id, piece_size, wire_ms))
         ev((t_hbm, fr.HBM_DONE, piece, "", piece_size, 0.0))
         lc.done_ms = max(lc.done_ms, t_hbm)
+        if collect_timeline:
+            lc.timeline.append((t_wire, wire_ms, piece_size))
         push(t_wire, "land", i, piece, parent.id, t_wire)
         push(t_hbm, "worker", i)         # worker busy through HBM staging
 
-    return _summarize(leechers, seed=seed, daemons=daemons, pieces=pieces,
-                      piece_size=piece_size, parallelism=parallelism,
-                      scenario=scenario)
+    result = _summarize(leechers, seed=seed, daemons=daemons, pieces=pieces,
+                        piece_size=piece_size, parallelism=parallelism,
+                        scenario=scenario)
+    if collect_timeline:
+        result["timeline"] = {lc.peer.id: sorted(lc.timeline)
+                              for lc in leechers}
+    return result
 
 
 def _summarize(leechers, *, seed, daemons, pieces, piece_size,
@@ -413,6 +425,165 @@ def _summarize(leechers, *, seed, daemons, pieces, piece_size,
     }
 
 
+# ---------------------------------------------------------------- PR-5
+# Data-plane replay: the PR-5 trajectory point measures what taking
+# per-byte CPU off the event loop buys, against the SAME schedule as the
+# PR-3/PR-4 baseline (schedule_digest byte-identical, so the delta is pure
+# data plane). The sim's schedule is replayed through two landing models:
+#
+#   legacy      — the PR-3/4 shape: every landed piece hashed ON the event
+#                 loop (downloader hasher / span per-piece hash_bytes) plus
+#                 one to_thread landing hop per piece;
+#   zero_stall  — the PR-5 shape: only the network-chunk memcpy stays on
+#                 the loop; verify+write are fused off-loop and a span
+#                 costs one landing hop.
+#
+# Each daemon's landings serialize on its single loop: landing i starts at
+# max(t_wire_i, loop_free), runs its on-loop cost, and delays both the
+# piece (wire latency) and every landing queued behind it. The "loop lag"
+# column is what PR 3's df_loop_lag_seconds sampler would see: the length
+# of contiguous loop-busy runs.
+LOOP_HASH_BPS = 2.5e9       # on-loop verify traversal (ctypes crc32c path)
+LOOP_MEMCPY_BPS = 12e9      # network-chunk copy into the piece buffer
+LEGACY_LAND_MS = 0.15       # one to_thread hop per piece (legacy)
+ZERO_STALL_LAND_MS = 0.05   # one landing hop per span (zero_stall)
+BENCH_STALL_MS = 10.0       # loop-busy run length that counts as a stall
+# (the virtual pod is ICI-fast; the health plane's 1s wall-clock threshold
+# would never trip at modeled scale, so the bench uses a budget matched to
+# its own piece cadence)
+
+REPLAY_MODELS = ("legacy", "zero_stall")
+
+
+def replay_dataplane(timelines: dict, model: str) -> dict:
+    """Post-pass over a FIXED schedule (run_bench collect_timeline=True):
+    per-daemon landing serialization under one landing-cost model. Pure
+    function — never touches the sim rng, so the schedule digest cannot
+    move."""
+    if model not in REPLAY_MODELS:
+        raise ValueError(f"unknown replay model {model!r}")
+    delays: list[float] = []      # per-piece landing delay (queue + cost)
+    adj_wire: list[float] = []    # wire_ms + landing delay
+    busy_runs: list[float] = []   # contiguous loop-busy stretches
+    total_busy = 0.0
+    total_span = 0.0
+    for events in timelines.values():
+        free_at = None
+        run_start = None
+        first_t = last_done = None
+        for t, wire_ms, size in sorted(events):
+            cost = size / LOOP_MEMCPY_BPS * 1e3
+            if model == "legacy":
+                cost += size / LOOP_HASH_BPS * 1e3 + LEGACY_LAND_MS
+            else:
+                cost += ZERO_STALL_LAND_MS
+            if free_at is None or t >= free_at:
+                if run_start is not None:
+                    busy_runs.append(free_at - run_start)
+                run_start = t
+                start = t
+            else:
+                start = free_at
+            done = start + cost
+            free_at = done
+            delays.append(done - t)
+            adj_wire.append(wire_ms + (done - t))
+            total_busy += cost
+            first_t = t if first_t is None else first_t
+            last_done = done
+        if run_start is not None:
+            busy_runs.append(free_at - run_start)
+        if first_t is not None:
+            total_span += max(last_done - first_t, 1e-9)
+    delays.sort()
+    adj_wire.sort()
+    return {
+        "loop_lag_ms": {"p50": _pctl(delays, 0.50),
+                        "p95": _pctl(delays, 0.95),
+                        "p99": _pctl(delays, 0.99)},
+        "max_loop_lag_ms": round(max(busy_runs, default=0.0), 3),
+        "loop_stalls": sum(1 for r in busy_runs if r > BENCH_STALL_MS),
+        "loop_busy_fraction": (round(total_busy / total_span, 4)
+                               if total_span else 0.0),
+        "stage_latency_ms": {"wire": {"p50": _pctl(adj_wire, 0.50),
+                                      "p95": _pctl(adj_wire, 0.95),
+                                      "p99": _pctl(adj_wire, 0.99)}},
+    }
+
+
+def _selfcheck_span_landing() -> dict:
+    """Prove the REAL span landing path works before stamping the bench:
+    a two-piece span through ``TaskStorage.write_span`` must land in one
+    pass (native or python), verify digests, and reject a corrupted piece
+    without failing its groupmate. ``per_piece_fallback: true`` in the
+    output fails the tier-1 gate (tests/test_dfbench.py)."""
+    import tempfile
+
+    from ..common import digest as digestlib
+    from ..storage.metadata import TaskMetadata
+    from ..storage.store import TaskStorage
+
+    algo = digestlib.preferred_piece_algo()
+    path = "unavailable"
+    ok = False
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            blob = bytes(range(256)) * 1024            # 2 x 128 KiB pieces
+            half = len(blob) // 2
+            spec = [(0, 0, half, digestlib.for_bytes(algo, blob[:half])),
+                    (1, half, half, digestlib.for_bytes(algo, blob[half:]))]
+            ts = TaskStorage(f"{d}/good", TaskMetadata(
+                task_id="bench-selfcheck-good", url="bench://selfcheck"))
+            metas, corrupt, path = ts.write_span(spec, blob)
+            ok = (len(metas) == 2 and not corrupt
+                  and ts.read_piece(0) == blob[:half]
+                  and ts.read_piece(1) == blob[half:])
+            ts.close()
+            bad = bytearray(blob)
+            bad[3] ^= 0xFF                             # corrupt piece 0 only
+            ts2 = TaskStorage(f"{d}/bad", TaskMetadata(
+                task_id="bench-selfcheck-bad", url="bench://selfcheck"))
+            metas2, corrupt2, _ = ts2.write_span(spec, bytes(bad))
+            ok = ok and corrupt2 == [0] and [m.num for m in metas2] == [1]
+            ts2.close()
+    except Exception:  # noqa: BLE001 - the gate wants a verdict, not a trace
+        ok = False
+    return {"span_write": path, "per_piece_fallback": not ok}
+
+
+def _run_pr5(args) -> dict:
+    """The PR-5 trajectory point: one baseline sim (digest byte-identical
+    to BENCH_pr3/pr4 — same seed, same rng path) replayed through both
+    landing models, plus a live self-check that span landing is actually
+    wired (not silently back on the per-piece path)."""
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism, collect_timeline=True)
+    timeline = base.pop("timeline")
+    del base["schedules"]       # digest stays; raw schedules stay reviewable
+    models = {m: replay_dataplane(timeline, m) for m in REPLAY_MODELS}
+    return {
+        "bench": "dfbench-dataplane",
+        "seed": args.seed,
+        "daemons": args.daemons,
+        "pieces": args.pieces,
+        "piece_size": args.piece_size,
+        "parallelism": args.parallelism,
+        "schedule_digest": base["schedule_digest"],
+        "baseline": base,
+        "models": models,
+        "improvement": {
+            "wire_p95_ms": {m: models[m]["stage_latency_ms"]["wire"]["p95"]
+                            for m in REPLAY_MODELS},
+            "max_loop_lag_ms": {m: models[m]["max_loop_lag_ms"]
+                                for m in REPLAY_MODELS},
+            "loop_stalls": {m: models[m]["loop_stalls"]
+                            for m in REPLAY_MODELS},
+        },
+        "landing": _selfcheck_span_landing(),
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -427,9 +598,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pr4", action="store_true",
                    help="run baseline + both scheds-down scenarios and "
                    "write the PR-4 trajectory point (BENCH_pr4.json)")
+    p.add_argument("--pr5", action="store_true",
+                   help="replay the baseline schedule through the legacy "
+                   "and zero-stall data-plane models and write the PR-5 "
+                   "trajectory point (BENCH_pr5.json); the schedule digest "
+                   "stays byte-identical to BENCH_pr3/pr4")
     p.add_argument("--out", default="",
                    help="result path ('-' = stdout only; default "
-                   "BENCH_pr3.json, or BENCH_pr4.json with --pr4)")
+                   "BENCH_pr3.json, BENCH_pr4.json with --pr4, or "
+                   "BENCH_pr5.json with --pr5)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny run (4 daemons x 8 pieces), stdout only — "
                    "exercised by tier-1 so the harness itself can't rot")
@@ -464,7 +641,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr4:
+        if args.pr5:
+            args.out = "BENCH_pr5.json"
+        elif args.pr4:
             args.out = "BENCH_pr4.json"
         elif args.scenario == "baseline":
             args.out = "BENCH_pr3.json"
@@ -472,7 +651,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr4:
+    if args.pr5:
+        result = _run_pr5(args)
+    elif args.pr4:
         result = _run_pr4(args)
     else:
         result = run_bench(seed=args.seed, daemons=args.daemons,
@@ -483,7 +664,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr4:
+        if args.pr5:
+            imp = result["improvement"]
+            print(f"dfbench: wrote {args.out} (wire p95 "
+                  f"legacy={imp['wire_p95_ms']['legacy']:.2f}ms -> "
+                  f"zero_stall={imp['wire_p95_ms']['zero_stall']:.2f}ms, "
+                  f"max loop lag "
+                  f"{imp['max_loop_lag_ms']['legacy']:.2f}ms -> "
+                  f"{imp['max_loop_lag_ms']['zero_stall']:.2f}ms, "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.pr4:
             ratios = result["p2p_served_ratio"]
             print(f"dfbench: wrote {args.out} (p2p-served ratio: "
                   + ", ".join(f"{sc}={ratios[sc]:.2f}" for sc in SCENARIOS)

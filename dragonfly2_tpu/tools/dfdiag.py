@@ -155,6 +155,15 @@ def verdict(summary: dict) -> str:
             parts.append("every scheduler was unreachable; parents came "
                          "from PEX gossip (the swarm index) instead of "
                          "the origin")
+    corrupt = summary.get("corrupt_pieces") or {}
+    if corrupt:
+        total = sum(corrupt.values())
+        worst = max(corrupt, key=corrupt.get)
+        parts.append(
+            f"{total} transfer(s) failed digest verification and were "
+            f"refetched — worst sender {worst[-12:] or 'origin'} "
+            f"({corrupt[worst]}); a repeat offender here is a corrupting "
+            "parent (bad NIC/disk), not congestion")
     drops = summary.get("report_drops", 0)
     if drops:
         parts.append(f"{drops} piece reports dropped on a dead scheduler "

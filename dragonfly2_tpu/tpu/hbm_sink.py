@@ -175,7 +175,15 @@ class DeviceIngest:
 
     def write(self, offset: int, data: bytes | memoryview) -> None:
         """Land one verified piece; enqueues device transfers for any shard
-        the piece completes. Returns as soon as the memcpy is done."""
+        the piece completes. Returns as soon as the memcpy is done.
+
+        Buffer lifetime rule (the piece-buffer pool depends on it): this
+        method NEVER retains a reference to ``data`` past its return. The
+        numpy assignment below copies into the sink's own host buffer and
+        the transient ``frombuffer`` view dies with the statement — so the
+        landing path may recycle the piece buffer (bufpool.POOL.release)
+        the moment its landing call stack unwinds. Device transfers read
+        ONLY ``self.host``, never the caller's buffer."""
         if faultgate.ARMED:
             # a raising script here exercises the conductor's sink-failure
             # path: ingest disabled, download continues to disk

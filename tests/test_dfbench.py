@@ -95,6 +95,73 @@ class TestPexScenarios:
             run_bench(scenario="nope")
 
 
+class TestPr5DataPlane:
+    """PR-5 point: the data-plane replay must ride the EXACT PR-3/PR-4
+    schedule (digest byte-identical — any drift means the perf delta is
+    confounded by scheduling changes) and must fail loudly if span
+    landing has fallen back to the per-piece path."""
+
+    def test_timeline_collection_never_moves_the_digest(self):
+        a = run_bench(seed=7, daemons=6, pieces=24)
+        b = run_bench(seed=7, daemons=6, pieces=24, collect_timeline=True)
+        assert a["schedule_digest"] == b["schedule_digest"]
+        assert sum(len(v) for v in b["timeline"].values()) == 6 * 24
+
+    def test_replay_models_and_improvement(self):
+        from dragonfly2_tpu.tools.dfbench import replay_dataplane
+        r = run_bench(seed=7, daemons=6, pieces=24, collect_timeline=True)
+        legacy = replay_dataplane(r["timeline"], "legacy")
+        zero = replay_dataplane(r["timeline"], "zero_stall")
+        # the whole point of the PR: hashing off-loop improves both the
+        # wire tail and the loop-lag high-water on the same schedule
+        assert zero["stage_latency_ms"]["wire"]["p95"] \
+            < legacy["stage_latency_ms"]["wire"]["p95"]
+        assert zero["max_loop_lag_ms"] < legacy["max_loop_lag_ms"]
+        assert zero["loop_busy_fraction"] < legacy["loop_busy_fraction"]
+        # deterministic: same timeline, same numbers
+        assert replay_dataplane(r["timeline"], "legacy") == legacy
+        with pytest.raises(ValueError, match="unknown replay model"):
+            replay_dataplane(r["timeline"], "nope")
+
+    def test_pr5_matches_committed_pr3_pr4_baselines(self, tmp_path):
+        """The committed trajectory gate: a default-size --pr5 run must
+        produce the same schedule digest as the committed BENCH_pr3.json
+        and BENCH_pr4.json baselines, with span landing live (no
+        per-piece fallback) and both improvement columns improved."""
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr5", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads((tmp_path / "BENCH_pr5.json").read_text())
+        assert r["bench"] == "dfbench-dataplane"
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        pr4 = json.loads(open(os.path.join(REPO, "BENCH_pr4.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["schedule_digest"] == \
+            pr4["scenarios"]["baseline"]["schedule_digest"]
+        assert r["landing"]["per_piece_fallback"] is False
+        imp = r["improvement"]
+        assert imp["wire_p95_ms"]["zero_stall"] < imp["wire_p95_ms"]["legacy"]
+        assert imp["max_loop_lag_ms"]["zero_stall"] \
+            < imp["max_loop_lag_ms"]["legacy"]
+        assert imp["loop_stalls"]["zero_stall"] \
+            <= imp["loop_stalls"]["legacy"]
+
+    def test_pr5_smoke_stdout_only(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr5", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-dataplane"
+        assert set(r["models"]) == {"legacy", "zero_stall"}
+        assert not list(tmp_path.iterdir())      # stdout only
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(

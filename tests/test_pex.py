@@ -463,6 +463,69 @@ class TestLadderHooks:
 
         run(go())
 
+    def test_try_pull_coverage_gate(self):
+        """Chaos seed-restart regression: nobody rescues a pex pull (the
+        synthetic session has no scheduler), so holders that do NOT
+        collectively cover the conductor's missing pieces must DECLINE the
+        rung — riding them would land the covered pieces and then park the
+        engine forever waiting for announcements that can never come,
+        deadlocking a seed against the very leechers that wait on it."""
+        task_id = "w" * 64
+        pulls = []
+
+        class FakeEngine:
+            async def pull(self, cond, session):
+                pulls.append(session)
+                return True
+
+        def gossiper():
+            g = PexGossiper(
+                storage_mgr=fake_storage(),
+                host_info=lambda: Host(id="self", ip="127.0.0.1", port=1,
+                                       download_port=2))
+            g.engine_factory = FakeEngine
+            return g
+
+        def conductor(ready=()):
+            return types.SimpleNamespace(
+                task_id=task_id, peer_id="p", flight=None, ready=set(ready),
+                log=types.SimpleNamespace(info=lambda *a, **k: None))
+
+        async def go():
+            # partial holders short of the full piece range: decline
+            g = gossiper()
+            g.index.update(task_id, entry("h1", done=False, pieces={0, 1}))
+            assert not await g.try_pull(conductor())
+            assert not pulls
+            # union of partials covers -> rung proceeds
+            g.index.update(task_id, entry("h2", done=False, pieces={2}))
+            assert await g.try_pull(conductor())
+            assert len(pulls) == 1
+            # pieces this conductor already holds count toward coverage
+            g2 = gossiper()
+            g2.index.update(task_id, entry("h3", done=False, pieces={1, 2}))
+            assert await g2.try_pull(conductor(ready={0}))
+            # geometry unknown (total=-1) and nobody complete: decline
+            g3 = gossiper()
+            g3.index.update(task_id, entry("h4", done=False, pieces={0},
+                                           total=-1))
+            assert not await g3.try_pull(conductor())
+            # one complete holder always passes the gate
+            g4 = gossiper()
+            g4.index.update(task_id, entry("h5", done=True))
+            assert await g4.try_pull(conductor())
+
+        run(go())
+
+    def test_pex_session_is_not_rescuable(self):
+        """The engine's stall detector keys off rescuable=False: a pex
+        pull that stops landing pieces must return to the ladder instead
+        of ticking forever (real scheduler sessions stay rescuable)."""
+        from dragonfly2_tpu.daemon.pex import _PexSession
+        from dragonfly2_tpu.daemon.scheduler_session import PeerSession
+        assert _PexSession.rescuable is False
+        assert getattr(PeerSession, "rescuable", True) is True
+
     def test_try_pull_journals_pex_rung_and_counts_hits(self):
         from dragonfly2_tpu.daemon.flight_recorder import TaskFlight
         from dragonfly2_tpu.idl.messages import PieceInfo, PieceResult

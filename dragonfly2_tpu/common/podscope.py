@@ -1,0 +1,588 @@
+"""Podscope: pod-wide distribution-tree aggregation over daemon snapshots.
+
+Role parity: none in the reference — the paper's fabric is judged at pod
+scope (1 seed fanning out to N daemons over ICI/DCN), but every per-daemon
+surface (`/debug/flight`, `/debug/health`) sees one end of each transfer
+and the scheduler's `/debug/cluster` is blind to the scheduler-less `pex`
+rung. Podscope ingests the debug snapshots of a daemon SET and
+reconstructs, per task, the distribution tree the pod actually used:
+
+  * **edges** — who served whom, with bytes, wire ms, and estimated
+    bandwidth. Each edge is seen from the child side (flight piece rows)
+    and, when the parent journaled the serve (`TaskFlight.serve`,
+    `upload` rows), confirmed from the parent side with serve/limiter
+    timings attached.
+  * **tree + depth** — each daemon's tree parent is the peer that
+    delivered most of its bytes; depth is measured from the origin
+    (origin = 0, a back-sourcing or pre-seeded root holder = 1).
+  * **pod makespan** — first download activity to last daemon complete,
+    on the daemons' wall clocks (an NTP-synced pod; ms-level skew is in
+    the noise at fan-out timescales).
+  * **origin amplification** — origin bytes ÷ content size. A healthy
+    mesh fetches the content across the origin uplink exactly once
+    (≈ 1.0); N means the mesh carried nothing. A pod serving content
+    seeded before the observation window (origin bytes 0) reports 1.0
+    with a note — the content still crossed that uplink exactly once.
+  * **seed-uplink utilization** — the heaviest-serving node, its share
+    of all mesh bytes, and its estimated serve bandwidth.
+  * **a bottleneck-edge verdict** — the slowest substantial edge; named
+    as a *breach* only when it runs under ``BOTTLENECK_FACTOR`` of the
+    median edge bandwidth (the dfdiag straggler rule, pod-scoped).
+
+Everything below ``collect_pod`` is a pure function over dict snapshots,
+so dfbench feeds it simulated flights and the tests feed it synthetic
+ones; ``collect_pod`` is the thin HTTP half ``dfdiag --pod`` and
+``stress --pod-report`` share. ``edges_from_summary`` is the
+``kind=edge`` row source for ``scheduler/records.py`` — the per-edge
+bandwidth observations the trainer's parent-quality model learns from.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+ORIGIN = "origin"                # node label for back-source fetches
+BOTTLENECK_FACTOR = 3.0          # edge slower than median/3 = breach
+SUBSTANTIAL_EDGE_SHARE = 0.05    # edges carrying <5% of content are noise
+AMPLIFICATION_BREACH = 1.5       # origin pulled >1.5x the content = breach
+
+
+def _pctl(vals: list[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return round(s[min(len(s) - 1, int(q * len(s)))], 3)
+
+
+# ---------------------------------------------------------------- collect
+
+def _get_json(url: str, timeout_s: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def collect_daemon(addr: str, *, timeout_s: float = 10.0,
+                   max_flights: int = 16) -> dict:
+    """One daemon's podscope snapshot over HTTP: the flight index + the
+    ``max_flights`` most recent full flights, plus /debug/health and
+    /debug/pex (each optional — absence is recorded, never raised)."""
+    base = f"http://{addr}"
+    snap: dict = {"addr": addr, "flights": {}, "health": None, "pex": None}
+    index = _get_json(f"{base}/debug/flight", timeout_s)   # raises: caller
+    snap["flight_index"] = {k: index.get(k) for k in
+                            ("enabled", "max_tasks", "occupancy",
+                             "evicted_total")}
+    tasks = index.get("tasks") or []
+    for row in tasks[-max_flights:]:
+        tid = row.get("task_id", "")
+        try:
+            snap["flights"][tid] = _get_json(
+                f"{base}/debug/flight/{tid}", timeout_s)
+        except (OSError, ValueError):
+            continue            # flight evicted between index and fetch
+    for key, path in (("health", "/debug/health"), ("pex", "/debug/pex")):
+        try:
+            snap[key] = _get_json(f"{base}{path}", timeout_s)
+        except (OSError, ValueError):
+            snap[key] = None    # older daemon / surface disabled
+    return snap
+
+
+def collect_pod(addrs: list[str], *, timeout_s: float = 10.0,
+                max_flights: int = 16) -> list[dict]:
+    """Snapshot every daemon; an unreachable one yields
+    ``{"addr": ..., "error": ...}`` instead of failing the sweep — a pod
+    diagnosis that dies on the first wedged daemon diagnoses nothing.
+    Daemons are fetched CONCURRENTLY: one half-stalled daemon answering
+    at the timeout edge (the exact condition this tool exists to catch)
+    must cost the sweep one daemon's worth of wall time, not the pod's."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def one(addr: str) -> dict:
+        try:
+            return collect_daemon(addr, timeout_s=timeout_s,
+                                  max_flights=max_flights)
+        except (OSError, ValueError) as exc:
+            return {"addr": addr, "error": str(exc) or type(exc).__name__}
+
+    if not addrs:
+        return []
+    with ThreadPoolExecutor(max_workers=min(16, len(addrs))) as pool:
+        return list(pool.map(one, addrs))
+
+
+# -------------------------------------------------------------- aggregate
+
+def _flight_summary(flight: dict) -> dict:
+    return flight.get("summary") or flight
+
+
+def _flight_times(flight: dict, summary: dict) -> tuple[float, float]:
+    """(abs_start_s, abs_end_s) of a flight on its daemon's wall clock."""
+    start = float(flight.get("started_at") or 0.0)
+    events = flight.get("events") or []
+    if events:
+        end_ms = max(e.get("t_ms", 0.0) for e in events)
+    else:
+        end_ms = max((r.get("start_ms", 0.0) + r.get("total_ms", 0.0)
+                      for r in summary.get("piece_rows") or []),
+                     default=0.0)
+    return start, start + end_ms / 1000.0
+
+
+def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
+    """One task's tree/edge/makespan report from [(addr, flight), ...]."""
+    peer_to_addr: dict[str, str] = {}
+    for addr, flight in holders:
+        pid = flight.get("peer_id") or ""
+        if pid:
+            peer_to_addr[pid] = addr
+
+    def label(peer_id: str) -> str:
+        if peer_id == "":
+            return ORIGIN
+        return peer_to_addr.get(peer_id, peer_id)
+
+    # child-side edges from piece rows; key on resolved (src, dst) labels
+    edges: dict[tuple[str, str], dict] = {}
+    serve_by_peers: dict[tuple[str, str], dict] = {}
+    content = 0
+    origin_bytes = 0
+    starts: list[float] = []
+    ends: list[float] = []
+    complete = 0
+    downloaders = 0
+    slo: dict[str, int] = {}
+    rungs: dict[str, int] = {}
+    for addr, flight in holders:
+        summary = _flight_summary(flight)
+        rows = summary.get("piece_rows") or []
+        dl_bytes = (summary.get("bytes_p2p", 0)
+                    + summary.get("bytes_source", 0))
+        content = max(content, dl_bytes)
+        origin_bytes += summary.get("bytes_source", 0)
+        for stage, n in (summary.get("slo_breaches") or {}).items():
+            slo[stage] = slo.get(stage, 0) + n
+        served_rung = summary.get("served_rung") or ""
+        if served_rung:
+            rungs[served_rung] = rungs.get(served_rung, 0) + 1
+        if rows:
+            downloaders += 1
+            t0, t1 = _flight_times(flight, summary)
+            starts.append(t0)
+            if flight.get("state") == "success":
+                complete += 1
+                ends.append(t1)
+        for r in rows:
+            key = (label(r.get("parent") or ""), addr)
+            e = edges.setdefault(key, {
+                "src": key[0], "dst": key[1],
+                "src_peer": r.get("parent") or "",
+                "dst_peer": flight.get("peer_id") or "",
+                "bytes": 0, "pieces": 0, "wire_ms": 0.0,
+                "confirmed": False})
+            e["bytes"] += r.get("bytes", 0)
+            e["pieces"] += 1
+            e["wire_ms"] += r.get("wire_ms", 0.0)
+        # parent-side serve rows (the upload journal): keyed by peer ids —
+        # resolved against the child edges below
+        my_peer = flight.get("peer_id") or ""
+        for srv in flight.get("serves") or []:
+            skey = (my_peer, srv.get("peer") or srv.get("addr") or "")
+            s = serve_by_peers.setdefault(skey, {
+                "bytes": 0, "pieces": 0, "serve_ms": 0.0, "wait_ms": 0.0,
+                "src": addr})
+            s["bytes"] += srv.get("bytes", 0)
+            s["pieces"] += srv.get("pieces", 1)
+            s["serve_ms"] += srv.get("serve_ms", 0.0)
+            s["wait_ms"] += srv.get("wait_ms", 0.0)
+
+    # stitch: a child edge (src_peer -> dst_peer) confirmed by the
+    # parent's serve journal carries the parent-side timings too
+    def _attach(e: dict, s: dict) -> None:
+        e["confirmed"] = True
+        e["serve_ms"] = round(s["serve_ms"], 3)
+        e["wait_ms"] = round(s["wait_ms"], 3)
+        e["serve_bps"] = (round(s["bytes"] / (s["serve_ms"] / 1e3))
+                          if s["serve_ms"] > 0 else 0)
+
+    used_serves: set[tuple[str, str]] = set()
+    for e in edges.values():
+        # origin edges (src_peer "") must never match an ANONYMOUS serve
+        # key ("" is also the peer id of a serve-only flight) — origin
+        # bytes by definition did not come off a daemon's upload port
+        s = (serve_by_peers.get((e["src_peer"], e["dst_peer"]))
+             if e["src_peer"] else None)
+        if s is not None:
+            used_serves.add((e["src_peer"], e["dst_peer"]))
+            _attach(e, s)
+        e["wire_ms"] = round(e["wire_ms"], 3)
+        e["bandwidth_bps"] = (round(e["bytes"] / (e["wire_ms"] / 1e3))
+                              if e["wire_ms"] > 0 else 0)
+    # fallback stitch: a parent that never downloaded the task here (a
+    # restarted seed re-seeded from disk) journals serves on a flight
+    # with NO peer id, so the exact key can't match. When a child edge's
+    # src peer resolved to no known daemon and exactly ONE daemon holds
+    # otherwise-unmatched serve rows for that child, that daemon is the
+    # parent: confirm the edge and relabel it to the daemon's address.
+    for e in edges.values():
+        if e["confirmed"] or not e["src_peer"] or e["src"] == ORIGIN:
+            continue               # origin edges never stitch to a daemon
+        if e["src"] != e["src_peer"]:
+            continue               # src resolved to a daemon; exact only
+        cands = [(key, s) for key, s in serve_by_peers.items()
+                 if key not in used_serves and key[1] == e["dst_peer"]]
+        if len({s["src"] for _k, s in cands}) == 1:
+            key, s = cands[0]
+            used_serves.add(key)
+            e["src"] = s["src"]
+            _attach(e, s)
+
+    # the distribution TREE: each node hangs off the src that delivered
+    # most of its bytes (the DAG stays in `edges`; the tree is the story)
+    nodes = ({e["src"] for e in edges.values()}
+             | {e["dst"] for e in edges.values()})
+    tree: dict[str, str] = {}
+    for dst in {e["dst"] for e in edges.values()}:
+        best = max((e for e in edges.values() if e["dst"] == dst),
+                   key=lambda e: e["bytes"])
+        tree[dst] = best["src"]
+
+    depth_memo: dict[str, int] = {ORIGIN: 0}
+
+    def depth_of(node: str, seen: frozenset = frozenset()) -> int:
+        if node in depth_memo:
+            return depth_memo[node]
+        if node in seen:        # swarm cross-serve cycle: cut here
+            return 1
+        parent = tree.get(node)
+        # a node that only serves (pre-seeded / restarted seed) is a
+        # root holder: depth 1, same as a back-sourcing daemon
+        d = 1 if parent is None else depth_of(parent, seen | {node}) + 1
+        depth_memo[node] = d
+        return d
+
+    depth = max((depth_of(n) for n in nodes), default=0)
+
+    # seed uplink: the heaviest server and what it sustained. The serve
+    # journal's rate is preferred, but only over the bytes it actually
+    # covered — a node with one confirmed and one unconfirmed edge must
+    # not have ALL its bytes divided by the confirmed edge's serve time
+    served: dict[str, dict] = {}
+    for e in edges.values():
+        if e["src"] == ORIGIN:
+            continue
+        sv = served.setdefault(e["src"], {"bytes": 0, "wire_ms": 0.0,
+                                          "serve_ms": 0.0,
+                                          "serve_bytes": 0})
+        sv["bytes"] += e["bytes"]
+        sv["wire_ms"] += e["wire_ms"]
+        if e.get("serve_ms"):
+            sv["serve_ms"] += e["serve_ms"]
+            sv["serve_bytes"] += e["bytes"]
+    p2p_bytes = sum(sv["bytes"] for sv in served.values())
+    seed_uplink = None
+    if served:
+        top = max(served, key=lambda n: served[n]["bytes"])
+        sv = served[top]
+        if sv["serve_ms"] > 0:
+            rate = sv["serve_bytes"] / (sv["serve_ms"] / 1e3)
+        elif sv["wire_ms"] > 0:
+            rate = sv["bytes"] / (sv["wire_ms"] / 1e3)
+        else:
+            rate = 0.0
+        seed_uplink = {
+            "node": top, "bytes": sv["bytes"],
+            "share": round(sv["bytes"] / p2p_bytes, 4) if p2p_bytes else 0.0,
+            "est_bandwidth_bps": round(rate)}
+
+    # bottleneck: slowest edge that carried a substantial share
+    bottleneck = None
+    floor = max(1, int(content * SUBSTANTIAL_EDGE_SHARE))
+    substantial = [e for e in edges.values()
+                   if e["bytes"] >= floor and e["bandwidth_bps"] > 0]
+    if substantial:
+        worst = min(substantial, key=lambda e: e["bandwidth_bps"])
+        med = _pctl([e["bandwidth_bps"] for e in substantial], 0.5)
+        bottleneck = {
+            "src": worst["src"], "dst": worst["dst"],
+            "bytes": worst["bytes"],
+            "bandwidth_bps": worst["bandwidth_bps"],
+            "median_bps": med,
+            "straggler": (len(substantial) >= 3 and med > 0
+                          and worst["bandwidth_bps"]
+                          * BOTTLENECK_FACTOR < med)}
+
+    if origin_bytes == 0 and content > 0:
+        amplification, amp_note = 1.0, "seeded before observation"
+    else:
+        amplification = (round(origin_bytes / content, 4) if content
+                         else 0.0)
+        amp_note = ""
+    makespan_ms = (round((max(ends) - min(starts)) * 1000.0, 3)
+                   if starts and ends else 0.0)
+    return {
+        "task_id": task_id,
+        "content_length": content,
+        "daemons": downloaders,
+        "complete": complete,
+        "makespan_ms": makespan_ms,
+        "depth": depth,
+        "origin_bytes": origin_bytes,
+        "amplification": amplification,
+        "amplification_note": amp_note,
+        "edges": sorted(edges.values(),
+                        key=lambda e: (e["src"], e["dst"])),
+        "tree": tree,
+        "bottleneck": bottleneck,
+        "seed_uplink": seed_uplink,
+        "slo_breaches": slo,
+        "rungs": rungs,
+    }
+
+
+def aggregate(snapshots: list[dict]) -> dict:
+    """The pod report: per-task tree/edge/makespan aggregation plus a
+    pod-level breach list (the CI-gate surface — `dfdiag --pod` exits
+    non-zero when it is non-empty) and a one-paragraph verdict."""
+    unreachable = {s["addr"]: s["error"] for s in snapshots if "error" in s}
+    by_task: dict[str, list[tuple[str, dict]]] = {}
+    daemons_detail: dict[str, dict] = {}
+    for s in snapshots:
+        for tid, flight in (s.get("flights") or {}).items():
+            by_task.setdefault(tid, []).append((s["addr"], flight))
+        if "error" in s:
+            continue
+        # the per-daemon health/pex halves of the snapshot, compacted:
+        # a stalled loop or empty gossip view explains a bad tree
+        health = s.get("health") or {}
+        pex = s.get("pex") or {}
+        daemons_detail[s["addr"]] = {
+            "health_status": health.get("status", ""),
+            "loop_max_lag_s": (health.get("loop") or {}).get(
+                "max_lag_s", 0.0),
+            "pex_peers": len(pex.get("peers") or []),
+            "flight_index": s.get("flight_index") or {},
+        }
+    tasks = {tid: _aggregate_task(tid, holders)
+             for tid, holders in sorted(by_task.items())}
+
+    breaches: list[str] = []
+    for addr, err in sorted(unreachable.items()):
+        breaches.append(f"unreachable: {addr} ({err})")
+    for addr, d in sorted(daemons_detail.items()):
+        if d["health_status"] == "stalled":
+            breaches.append(
+                f"health: {addr} reports a stalled event loop "
+                f"(max lag {d['loop_max_lag_s']:.3f}s)")
+    for tid, t in tasks.items():
+        short = tid[:12]
+        if t["slo_breaches"]:
+            blown = ", ".join(f"{stage}x{n}" for stage, n in
+                              sorted(t["slo_breaches"].items()))
+            breaches.append(f"slo: task {short} blew budgets ({blown})")
+        if (t["amplification"] > AMPLIFICATION_BREACH
+                and t["origin_bytes"] > 0):
+            breaches.append(
+                f"amplification: task {short} pulled "
+                f"{t['amplification']:.2f}x its content from origin — "
+                "the mesh is not carrying the bytes")
+        b = t["bottleneck"]
+        if b and b.get("straggler"):
+            breaches.append(
+                f"bottleneck: task {short} edge {b['src']} -> {b['dst']} "
+                f"ran at {_fmt_bps(b['bandwidth_bps'])} vs median "
+                f"{_fmt_bps(b['median_bps'])} — a straggler edge")
+        if t["daemons"] and t["complete"] < t["daemons"]:
+            breaches.append(
+                f"incomplete: task {short} finished on {t['complete']}/"
+                f"{t['daemons']} daemons")
+
+    report = {
+        "daemons": [s["addr"] for s in snapshots],
+        "daemons_detail": daemons_detail,
+        "unreachable": unreachable,
+        "tasks": tasks,
+        "breaches": breaches,
+    }
+    report["verdict"] = pod_verdict(report)
+    return report
+
+
+def bench_summary(task_report: dict) -> dict:
+    """The compact per-scenario form dfbench stamps into BENCH_pr6.json:
+    the headline pod numbers + per-edge distribution percentiles."""
+    bws = [e["bandwidth_bps"] for e in task_report["edges"]
+           if e["src"] != ORIGIN and e["bandwidth_bps"] > 0]
+    wires = [e["wire_ms"] for e in task_report["edges"]
+             if e["src"] != ORIGIN]
+    return {
+        "makespan_ms": task_report["makespan_ms"],
+        "depth": task_report["depth"],
+        "amplification": task_report["amplification"],
+        "origin_bytes": task_report["origin_bytes"],
+        "edges": len(task_report["edges"]),
+        "edge_bandwidth_bps": {"p5": _pctl(bws, 0.05),
+                               "p50": _pctl(bws, 0.50),
+                               "p95": _pctl(bws, 0.95)},
+        "edge_wire_ms": {"p50": _pctl(wires, 0.50),
+                         "p95": _pctl(wires, 0.95)},
+        "seed_uplink": task_report["seed_uplink"],
+        "bottleneck": task_report["bottleneck"],
+    }
+
+
+# ------------------------------------------------------- records (edges)
+
+def edges_from_summary(task_id: str, dst_peer_id: str, dst_host_id: str,
+                       summary: dict) -> list[dict]:
+    """``kind=edge`` rows for the trainer's record stream: one per parent
+    that served this flight, carrying the observed per-edge bandwidth —
+    the label source for a learned parent-quality model (ROADMAP item 1).
+    Pure; ``scheduler/records.py`` stamps ``created_at``."""
+    rows = []
+    for parent, pp in (summary.get("per_parent") or {}).items():
+        rows.append({
+            "kind": "edge",
+            "task_id": task_id,
+            "src_peer_id": parent or ORIGIN,
+            "dst_peer_id": dst_peer_id,
+            "dst_host_id": dst_host_id,
+            "bytes": pp.get("bytes", 0),
+            "pieces": pp.get("pieces", 0),
+            "wire_ms": pp.get("wire_ms", 0.0),
+            "bandwidth_bps": pp.get("throughput_bps", 0),
+        })
+    return rows
+
+
+# ----------------------------------------------------------------- render
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _fmt_bps(n: float) -> str:
+    return f"{_fmt_bytes(n)}/s"
+
+
+def render_pod(report: dict, *, max_edges_per_node: int = 8) -> str:
+    """ASCII distribution tree per task, one line per NODE under its
+    tree parent with the delivering edge's bytes / estimated bandwidth /
+    both-ends confirmation, bottleneck flagged. The walk follows
+    ``tree`` (each node rendered exactly once), not the full edge DAG —
+    a dense pex swarm where every daemon serves every later joiner has
+    combinatorially many DAG paths, and rendering each one would flood
+    the terminal at exactly the pod sizes the tool exists for. Cross
+    edges beyond the tree are counted per task; ``--json`` carries the
+    full DAG. Pure function over an aggregate() report (or a saved
+    copy)."""
+    out: list[str] = []
+    for addr, err in sorted((report.get("unreachable") or {}).items()):
+        out.append(f"UNREACHABLE {addr}: {err}")
+    for tid, t in (report.get("tasks") or {}).items():
+        amp = (f"{t['amplification']:.2f}"
+               + (" (seeded)" if t["amplification_note"] else ""))
+        out.append(
+            f"task {tid[:24]}  content={_fmt_bytes(t['content_length'])}  "
+            f"daemons={t['complete']}/{t['daemons']} complete  "
+            f"makespan={t['makespan_ms']:.0f}ms  depth={t['depth']}  "
+            f"amplification={amp}")
+        tree = t.get("tree") or {}
+        edge_by_key = {(e["src"], e["dst"]): e for e in t["edges"]}
+        kids_of: dict[str, list[str]] = {}
+        for child, parent in tree.items():
+            kids_of.setdefault(parent, []).append(child)
+        b = t.get("bottleneck") or {}
+        rendered: set[str] = set()
+
+        def walk(node: str, prefix: str) -> None:
+            kids = sorted(kids_of.get(node, []),
+                          key=lambda d: -edge_by_key[(node, d)]["bytes"])
+            shown = kids[:max_edges_per_node]
+            for i, dst in enumerate(shown):
+                e = edge_by_key[(node, dst)]
+                last = i == len(shown) - 1
+                tick = "└─ " if last else "├─ "
+                mark = ""
+                if e.get("confirmed"):
+                    mark += "  [confirmed]"
+                if (b and e["src"] == b.get("src")
+                        and e["dst"] == b.get("dst")):
+                    mark += "  <- bottleneck"
+                bw = (f"  {_fmt_bps(e['bandwidth_bps'])}"
+                      if e["bandwidth_bps"] else "")
+                out.append(
+                    f"{prefix}{tick}{dst}  "
+                    f"{_fmt_bytes(e['bytes'])}/{e['pieces']}pc{bw}{mark}")
+                if dst not in rendered:     # tree-parent cycle guard
+                    rendered.add(dst)
+                    walk(dst, prefix + ("   " if last else "│  "))
+            if len(kids) > len(shown):
+                out.append(f"{prefix}└… +{len(kids) - len(shown)} more")
+                # the "+N more" line accounts for the truncated children
+                # AND their subtrees — without this they would fall into
+                # the rootless sweep below and print as phantom cycles
+                stack = list(kids[len(shown):])
+                while stack:
+                    n = stack.pop()
+                    if n in rendered:
+                        continue
+                    rendered.add(n)
+                    stack.extend(kids_of.get(n, []))
+
+        all_nodes = set(tree) | set(tree.values())
+        roots = [n for n in all_nodes if n not in tree]
+        for root in sorted(roots, key=lambda n: (n != ORIGIN, n)):
+            out.append(f"  {root}")
+            rendered.add(root)
+            walk(root, "  ")
+        for n in sorted(all_nodes - rendered):
+            # a mutual-heaviest-source pair forms a rootless tree cycle:
+            # surface the node flat rather than dropping it silently
+            out.append(f"  {n}  (in a cross-serve cycle; see --json)")
+        cross = len(t["edges"]) - len(tree)
+        if cross > 0:
+            out.append(f"  (+{cross} cross edge(s) beyond the tree — "
+                       "full DAG in --json)")
+        su = t.get("seed_uplink")
+        if su:
+            out.append(
+                f"  seed uplink: {su['node']} served "
+                f"{_fmt_bytes(su['bytes'])} at "
+                f"~{_fmt_bps(su['est_bandwidth_bps'])} "
+                f"({100 * su['share']:.0f}% of p2p bytes)")
+    out.append(report.get("verdict") or pod_verdict(report))
+    return "\n".join(out)
+
+
+def pod_verdict(report: dict) -> str:
+    """One-paragraph pod attribution: what limited this pod, or 'healthy'."""
+    parts: list[str] = []
+    tasks = report.get("tasks") or {}
+    for tid, t in tasks.items():
+        b = t.get("bottleneck")
+        if b:
+            parts.append(
+                f"task {tid[:12]}: bottleneck edge {b['src']} -> "
+                f"{b['dst']} at {_fmt_bps(b['bandwidth_bps'])}"
+                + (" — a straggler vs the "
+                   f"{_fmt_bps(b['median_bps'])} median"
+                   if b.get("straggler") else
+                   f" (median {_fmt_bps(b['median_bps'])})"))
+        if t.get("rungs"):
+            trail = ", ".join(f"{r}x{n}" for r, n in
+                              sorted(t["rungs"].items()))
+            parts.append(f"task {tid[:12]}: served by rungs {trail}")
+    breaches = report.get("breaches") or []
+    if breaches:
+        parts.append("BREACH " + "; BREACH ".join(breaches))
+    if not parts:
+        return "pod verdict: healthy — nothing to attribute."
+    return "pod verdict: " + ";\n  ".join(parts) + "."

@@ -78,6 +78,9 @@ class FlightConfig:
     enabled: bool = True
     max_tasks: int = 64               # flights kept (drop-oldest)
     max_events: int = 4096            # events per flight (ring)
+    max_serves: int = 1024            # serve-side edge rows per flight
+    # (ring; a hot seed fans one task out to the whole pod, so the serve
+    # journal is bounded separately from the download journal)
 
 
 @dataclass

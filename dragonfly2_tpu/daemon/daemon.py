@@ -69,7 +69,8 @@ class Daemon:
         from .flight_recorder import FlightRecorder
         self.flight_recorder = FlightRecorder(
             enabled=cfg.flight.enabled, max_tasks=cfg.flight.max_tasks,
-            max_events=cfg.flight.max_events)
+            max_events=cfg.flight.max_events,
+            max_serves=cfg.flight.max_serves)
         # PEX gossip plane (daemon/pex.py): swarm index + gossiper exist
         # before the upload server so its routes mount at start; ports and
         # topology resolve lazily through host_info()

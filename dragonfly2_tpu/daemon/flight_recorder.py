@@ -31,6 +31,20 @@ from __future__ import annotations
 import time
 from collections import OrderedDict, deque
 
+from ..common.metrics import REGISTRY
+
+# flight-ring visibility: operators must be able to tell when max_tasks
+# is silently dropping history under churn (the index carries occupancy
+# and this counter carries the drops)
+_flight_evicted = REGISTRY.counter(
+    "df_flight_evicted_total",
+    "flights dropped from the recorder ring to admit newer tasks")
+_flight_tasks = REGISTRY.gauge(
+    "df_flight_tasks", "flights currently held in the recorder ring")
+_serve_rows = REGISTRY.counter(
+    "df_flight_serve_rows_total",
+    "serve-side edge rows journaled by the upload server")
+
 # piece lifecycle stages (strings, interned by the parser — kept short
 # because every event tuple carries one)
 SCHEDULED = "scheduled"      # dispatcher handed the piece to a worker
@@ -47,6 +61,11 @@ REGISTERED = "registered"    # scheduler register returned
 HBM_SHARD = "hbm_shard"      # one device DMA completed (piece = shard idx)
 DONE = "done"                # task reached a terminal state
 RUNG = "rung"                # degradation-ladder transition (parent = rung)
+UPLOAD = "upload"            # serve-side edge row (TaskFlight.serve ring):
+# a piece/range THIS daemon served to a child, journaled by the upload
+# server so every transfer edge is observed from both ends — podscope
+# stitches these against the child's download rows even on the
+# scheduler-less pex rung, where no scheduler ever saw the edge
 
 # the conductor's six-rung degradation ladder (docs/RESILIENCE.md): the
 # rung event's parent field names which rung the task just entered, so
@@ -66,16 +85,23 @@ class TaskFlight:
     bytes, dur_ms)`` tuples relative to the flight's start."""
 
     __slots__ = ("task_id", "peer_id", "started_at", "_m0", "events",
-                 "state", "url", "report_drops", "_sum_key", "_sum_cache")
+                 "serves", "state", "url", "report_drops", "_sum_key",
+                 "_sum_cache")
 
     def __init__(self, task_id: str, peer_id: str, *, url: str = "",
-                 max_events: int = 4096):
+                 max_events: int = 4096, max_serves: int = 1024):
         self.task_id = task_id
         self.peer_id = peer_id
         self.url = url
         self.started_at = time.time()
         self._m0 = time.monotonic()
         self.events: deque = deque(maxlen=max_events)
+        # serve-side edge journal (UPLOAD rows): (t_ms, peer, addr, piece,
+        # bytes, serve_ms, wait_ms) per range served to a child. A separate
+        # ring so a hot seed's thousands of serves can never evict its own
+        # download journal, and so the piece-row stage math stays blind to
+        # them.
+        self.serves: deque = deque(maxlen=max_serves)
         self.state = "running"
         # piece reports dropped because the scheduler stream's writer died
         # (scheduler_session.report_piece) — a silent drop becomes a ghost
@@ -107,6 +133,23 @@ class TaskFlight:
         """Journal a degradation-ladder transition (RUNG_* constants)."""
         self.event(RUNG, parent=name)
 
+    def serve(self, *, peer: str, addr: str = "", piece: int = -1,
+              nbytes: int = 0, serve_ms: float = 0.0,
+              wait_ms: float = 0.0, pieces: int = 1) -> None:
+        """Journal one range served to a child (the UPLOAD edge row).
+
+        ``peer`` is the requesting child's peer id (the ?peerId= on the
+        piece GET) and ``addr`` its socket address; ``serve_ms`` covers
+        limiter wait + storage read + body transmit (the upload slot's
+        hold time), ``wait_ms`` the limiter share of it. ``piece`` is the
+        FIRST piece of the range and ``pieces`` how many it spans — a
+        grouped span GET is one row, but the parent-side piece count must
+        still agree with the child's per-piece rows. One deque append —
+        same hot-path overhead contract as event()."""
+        self.serves.append((self.now_ms(), peer, addr, piece, nbytes,
+                            serve_ms, wait_ms, pieces))
+        _serve_rows.inc()
+
     def hbm_spans(self, spans: list) -> None:
         """Adopt a DeviceIngest's completed transfer spans ((monotonic
         start, end) pairs) as shard-level events on this flight's clock."""
@@ -126,6 +169,13 @@ class TaskFlight:
                         "dur_ms": round(dur, 3)}
                        for t, stage, piece, parent, nbytes, dur in
                        self.events],
+            "serves": [{"t_ms": round(t, 3), "stage": UPLOAD, "peer": peer,
+                        "addr": addr, "piece": piece, "pieces": pieces,
+                        "bytes": nbytes,
+                        "serve_ms": round(serve, 3),
+                        "wait_ms": round(wait, 3)}
+                       for t, peer, addr, piece, nbytes, serve, wait,
+                       pieces in self.serves],
         }
 
     def summarize(self) -> dict:
@@ -142,7 +192,8 @@ class TaskFlight:
         # length while events churn, so length alone would serve a stale
         # mid-flight summary from the HTTP surface
         key = (len(self.events), self.state, self.report_drops,
-               self.events[-1] if self.events else None)
+               self.events[-1] if self.events else None,
+               len(self.serves), self.serves[-1] if self.serves else None)
         if key == self._sum_key:
             return dict(self._sum_cache)
         pieces: dict[int, dict] = {}
@@ -229,6 +280,25 @@ class TaskFlight:
             pp["wire_ms"] = round(ms, 3)
             pp["throughput_bps"] = (
                 round(pp["bytes"] / (ms / 1000.0)) if ms > 0 else 0)
+        # serve-side edges, aggregated per requesting child: the parent
+        # half of every transfer edge (podscope joins this against the
+        # child's piece rows to confirm the edge from both ends)
+        uploads: dict[str, dict] = {}
+        for _t, peer, addr, _piece, nbytes, serve, wait, npieces in \
+                self.serves:
+            up = uploads.setdefault(peer or addr, {
+                "addr": addr, "bytes": 0, "pieces": 0,
+                "serve_ms": 0.0, "wait_ms": 0.0})
+            up["bytes"] += nbytes
+            up["pieces"] += npieces
+            up["serve_ms"] += serve
+            up["wait_ms"] += wait
+        for up in uploads.values():
+            ms = up["serve_ms"]
+            up["serve_ms"] = round(ms, 3)
+            up["wait_ms"] = round(up["wait_ms"], 3)
+            up["serve_bps"] = (round(up["bytes"] / (ms / 1000.0))
+                               if ms > 0 else 0)
         totals = sorted(r["total_ms"] for r in piece_rows)
         slowest = max(piece_rows, key=lambda r: r["total_ms"],
                       default=None)
@@ -241,6 +311,8 @@ class TaskFlight:
             "bytes_source": sum(r["bytes"] for r in piece_rows
                                 if r["source"] == "origin"),
             "per_parent": parents,
+            "uploads": uploads,
+            "bytes_served": sum(u["bytes"] for u in uploads.values()),
             "tail_ms": {"p50": _pctl(totals, 0.50),
                         "p90": _pctl(totals, 0.90),
                         "p99": _pctl(totals, 0.99)},
@@ -286,24 +358,31 @@ class TaskFlight:
         parents = sorted(s["per_parent"].items(),
                          key=lambda kv: kv[1]["bytes"], reverse=True)
         s["per_parent"] = dict(parents[:max_parents])
+        uploads = sorted(s["uploads"].items(),
+                         key=lambda kv: kv[1]["bytes"], reverse=True)
+        s["uploads"] = dict(uploads[:max_parents])
         return s
 
 
-def _pctl(sorted_vals: list[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
-    return round(sorted_vals[idx], 3)
+# one percentile rule repo-wide (canonical impl in common/podscope.py;
+# re-exported here because every flight-summary consumer — dfbench, the
+# SLO engine, podscope itself — keys on these exact cut points)
+from ..common.podscope import _pctl  # noqa: E402
 
 
 class FlightRecorder:
     """Daemon-wide registry of TaskFlights, ring-capped on task count."""
 
     def __init__(self, *, enabled: bool = True, max_tasks: int = 64,
-                 max_events: int = 4096):
+                 max_events: int = 4096, max_serves: int = 1024):
         self.enabled = enabled
         self.max_tasks = max_tasks
         self.max_events = max_events
+        self.max_serves = max_serves
+        # flights dropped to admit newer tasks since boot — surfaced in
+        # the /debug/flight index so an operator can tell a quiet pod
+        # from one whose history is churning out of the ring
+        self.evicted = 0
         self._tasks: OrderedDict[str, TaskFlight] = OrderedDict()
 
     def begin(self, task_id: str, peer_id: str,
@@ -316,11 +395,49 @@ class FlightRecorder:
         # auth-gated: strip the query string (presigned-URL credentials)
         # before the URL becomes queryable debug state
         flight = TaskFlight(task_id, peer_id, url=url.split("?", 1)[0],
-                            max_events=self.max_events)
+                            max_events=self.max_events,
+                            max_serves=self.max_serves)
         self._tasks[task_id] = flight
         self._tasks.move_to_end(task_id)
         while len(self._tasks) > self.max_tasks:
             self._tasks.popitem(last=False)
+            self.evicted += 1
+            _flight_evicted.inc()
+        _flight_tasks.set(len(self._tasks))
+        return flight
+
+    def serving(self, task_id: str, peer_id: str = "") -> TaskFlight | None:
+        """Get-or-create the flight a serve row lands on. A daemon that
+        downloaded the task journals serves onto its download flight (one
+        surface per task); a daemon serving content it never downloaded
+        here — a restarted seed re-seeded from disk — gets a fresh flight
+        in state 'serving' so its edges are still observable.
+
+        Serve traffic must NEVER evict a download flight: a seed holding
+        more tasks than ``max_tasks`` would otherwise churn its own
+        in-flight download journals out of the ring with every fan-out.
+        A serve-only flight is admitted by evicting the oldest OTHER
+        serve-only flight; with the ring full of download flights it is
+        simply not journaled (the child side still observes the edge)."""
+        if not self.enabled:
+            return None
+        flight = self._tasks.get(task_id)
+        if flight is not None:
+            return flight            # no move_to_end: serves don't renew
+        if len(self._tasks) >= self.max_tasks:
+            victim = next((tid for tid, f in self._tasks.items()
+                           if f.state == "serving"), None)
+            if victim is None:
+                return None
+            del self._tasks[victim]
+            self.evicted += 1
+            _flight_evicted.inc()
+        flight = TaskFlight(task_id, peer_id,
+                            max_events=self.max_events,
+                            max_serves=self.max_serves)
+        flight.state = "serving"
+        self._tasks[task_id] = flight
+        _flight_tasks.set(len(self._tasks))
         return flight
 
     def get(self, task_id: str) -> TaskFlight | None:
@@ -328,7 +445,8 @@ class FlightRecorder:
 
     def index(self) -> list[dict]:
         return [{"task_id": f.task_id, "state": f.state,
-                 "started_at": f.started_at, "events": len(f.events)}
+                 "started_at": f.started_at, "events": len(f.events),
+                 "serves": len(f.serves)}
                 for f in self._tasks.values()]
 
 
@@ -342,7 +460,13 @@ def add_flight_routes(router, recorder: FlightRecorder) -> None:
     from aiohttp import web
 
     async def flight_index(_r: web.Request) -> web.Response:
+        # ring visibility: occupancy vs max_tasks + the eviction count —
+        # evicted > 0 with a full ring means history is being dropped
+        # under churn and max_tasks needs raising (or dfdiag, run sooner)
         return web.json_response({"enabled": recorder.enabled,
+                                  "max_tasks": recorder.max_tasks,
+                                  "occupancy": len(recorder._tasks),
+                                  "evicted_total": recorder.evicted,
                                   "tasks": recorder.index()})
 
     async def flight_one(request: web.Request) -> web.Response:

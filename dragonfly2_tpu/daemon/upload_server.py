@@ -34,6 +34,16 @@ _upload_active = REGISTRY.gauge("df_upload_active_transfers",
 _upload_piece_bytes = REGISTRY.histogram(
     "df_upload_transfer_bytes", "size of each piece/span transfer served",
     buckets=BYTES_BUCKETS)
+# serve-side edge accounting (podscope): how long each served range held
+# its upload slot (limiter wait + storage read + body transmit), and the
+# limiter-wait share — the parent-side numbers that say whether a slow
+# edge was the parent's uplink or the child's intake
+_upload_serve_secs = REGISTRY.histogram(
+    "df_upload_serve_seconds",
+    "upload-slot hold time per served range (wait + read + transmit)")
+_upload_wait_secs = REGISTRY.histogram(
+    "df_upload_limiter_wait_seconds",
+    "rate-limiter wait per served range")
 
 
 class _Slot:
@@ -44,7 +54,7 @@ class _Slot:
     path (the round-3 defect: with rate_limit_bps=0 the slot was held for
     microseconds and the 503 backpressure never engaged)."""
 
-    __slots__ = ("server", "released", "t0")
+    __slots__ = ("server", "released", "t0", "on_release", "ok")
 
     def __init__(self, server: "UploadServer", *, adopted: bool = False):
         """``adopted``: this slot's capacity was transferred from a
@@ -53,6 +63,15 @@ class _Slot:
         self.server = server
         self.released = False
         self.t0 = time.monotonic()
+        # armed just before the response is handed off (serve journal):
+        # fires with the measured hold time once the body is fully sent,
+        # so serve_ms covers the actual transmit, sendfile included.
+        # ``ok`` is set by the response classes only when the transmit
+        # COMPLETED — a child that disconnected mid-body must not journal
+        # a serve row claiming the full range landed (bytes_served and
+        # the seed-uplink bandwidth estimate would inflate under churn)
+        self.on_release = None
+        self.ok = False
         if not adopted:
             server._active += 1
             _upload_active.set(server._active)
@@ -66,6 +85,8 @@ class _Slot:
             srv._transfer_ms = (0.8 * srv._transfer_ms + 0.2 * held_ms
                                 if srv._transfer_ms > 0 else held_ms)
             srv._transfer_ms_at = time.monotonic()
+            if self.on_release is not None:
+                self.on_release(held_ms)
             # hand the slot STRAIGHT to the longest-queued request
             # (ownership transfer, _active unchanged): decrementing first
             # would let a fresh arrival's gate check win the race against
@@ -84,7 +105,9 @@ class _SlotFileResponse(web.FileResponse):
 
     async def prepare(self, request):
         try:
-            return await super().prepare(request)
+            result = await super().prepare(request)
+            self._slot.ok = True        # sendfile body fully transmitted
+            return result
         finally:
             self._slot.release()
 
@@ -109,7 +132,9 @@ class _SlotResponse(web.Response):
 
     async def write_eof(self, data: bytes = b""):
         try:
-            return await super().write_eof(data)
+            result = await super().write_eof(data)
+            self._slot.ok = True        # buffered body fully transmitted
+            return result
         finally:
             self._slot.release()
 
@@ -247,6 +272,44 @@ class UploadServer:
         if self.mux is not None:
             self.mux.cleanup_backend_files()
 
+    def _arm_serve_journal(self, slot: _Slot, request: web.Request, ts,
+                           rng, *, wait_ms: float) -> None:
+        """Arm the slot to journal this serve once the body is fully sent:
+        one UPLOAD edge row (requesting peer, piece idx, bytes, slot-hold
+        serve ms, limiter-wait ms) on the task's flight — the parent half
+        of the transfer edge podscope stitches pod-wide, observable even
+        on the scheduler-less pex rung where no control plane saw it."""
+        _upload_wait_secs.observe(wait_ms / 1000.0)
+        # the id the child addressed us by (same as storage's), present
+        # for every piece route — storage test fakes may carry no md id
+        task_id = request.match_info["task_id"]
+        piece_size = getattr(ts.md, "piece_size", 0)
+        # a grouped span GET is one row spanning several pieces: journal
+        # the first index + the span count so the parent-side piece
+        # tally agrees with the child's per-piece rows
+        piece = rng.start // piece_size if piece_size > 0 else -1
+        span = (-(-rng.length // piece_size) if piece_size > 0 else 1)
+        peer_id = request.query.get("peerId", "")
+        addr = request.remote or ""
+        nbytes = rng.length
+
+        def journal(held_ms: float) -> None:
+            if not slot.ok:
+                return     # transmit aborted: the child never got the range
+            _upload_serve_secs.observe(held_ms / 1000.0)
+            # flight resolved only NOW, once the transmit is known good:
+            # serving() may have to evict another serve-only flight to
+            # admit this task, and an aborted transfer must not pay that
+            # price for a row it will never write
+            if self.flight_recorder is not None:
+                flight = self.flight_recorder.serving(task_id)
+                if flight is not None:
+                    flight.serve(peer=peer_id, addr=addr, piece=piece,
+                                 nbytes=nbytes, serve_ms=held_ms,
+                                 wait_ms=wait_ms, pieces=span)
+
+        slot.on_release = journal
+
     async def _traced(self, request: web.Request) -> web.StreamResponse:
         """Server half of the piece-request trace: the child's traceparent
         rides the GET (piece_downloader) and this span joins its trace, so
@@ -344,16 +407,25 @@ class UploadServer:
             # the hottest loop on a seed peer.
             data_path = getattr(ts, "data_path", None)
             if data_path is not None and total >= 0:
+                wait_t0 = time.monotonic()
                 await self.limiter.acquire(rng.length)
                 _upload_bytes.inc(rng.length)
                 _upload_piece_bytes.observe(rng.length)
                 _upload_reqs.labels("206").inc()
+                self._arm_serve_journal(
+                    slot, request, ts, rng,
+                    wait_ms=(time.monotonic() - wait_t0) * 1000.0)
                 return _SlotFileResponse(data_path(), slot)
             # acquire BEFORE the read, matching the sendfile branch: a
             # rate-limited seed must not buffer a multi-MiB range it then
             # sits on for the whole token wait (the bytes pin memory and
             # go cold while the limiter holds them back)
+            wait_t0 = time.monotonic()
             await self.limiter.acquire(rng.length)
+            # wait_ms measured HERE, not at arm time: the storage read
+            # below must not masquerade as limiter wait in the serve
+            # journal (dfdiag would blame rate limiting for a slow disk)
+            wait_ms = (time.monotonic() - wait_t0) * 1000.0
             try:
                 # dedicated storage executor: piece serves never queue
                 # behind the default pool's TLS handshakes (or vice versa)
@@ -379,6 +451,8 @@ class UploadServer:
             _upload_bytes.inc(len(data))
             _upload_piece_bytes.observe(len(data))
             _upload_reqs.labels("206").inc()
+            self._arm_serve_journal(slot, request, ts, rng,
+                                    wait_ms=wait_ms)
             return _SlotResponse(
                 slot, status=206, body=data,
                 headers={"Content-Range":

@@ -120,6 +120,17 @@ class DownloadRecords:
             "created_at": time.time(),
         }
         self._append_peer_row(row)
+        # per-edge bandwidth rows (podscope schema): one row per parent
+        # that served this flight, with the observed edge throughput —
+        # the feature/label source the learned parent-quality model
+        # (ROADMAP item 1) trains on, and the same shape `dfdiag --pod`
+        # reconstructs live from the daemon set
+        from ..common.podscope import edges_from_summary
+        now = time.time()
+        for edge in edges_from_summary(peer.task.id, peer.id,
+                                       peer.host.id, summary):
+            edge["created_at"] = now
+            self._append_peer_row(edge)
 
     # -- internals -----------------------------------------------------
 

@@ -111,13 +111,16 @@ _ORIGIN_ID = "origin"
 def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
               piece_size: int = 4 << 20, parallelism: int = 4,
               scenario: str = "baseline",
-              collect_timeline: bool = False) -> dict:
+              collect_timeline: bool = False,
+              collect_podscope: bool = False) -> dict:
     """Run one simulated fan-out; returns the result dict (pure function
     of its arguments — no wall clock, no global state beyond the process
     metrics registry the flight summaries touch). ``scenario`` switches
     the discovery model (SCENARIOS; baseline draws the exact same rng
     sequence as before the scenario knob existed, so the PR-3 schedule
-    digest is stable)."""
+    digest is stable). ``collect_podscope`` attaches per-daemon snapshots
+    in the ``common/podscope.py`` shape (a pure readout of the flights —
+    never in the rng path, so the digest cannot move)."""
     if scenario not in SCENARIOS:
         raise ValueError(f"unknown scenario {scenario!r} "
                          f"(known: {SCENARIOS})")
@@ -362,6 +365,19 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     if collect_timeline:
         result["timeline"] = {lc.peer.id: sorted(lc.timeline)
                               for lc in leechers}
+    if collect_podscope:
+        # per-daemon snapshots in the podscope shape, on one shared
+        # virtual epoch (started_at=0: the sim's event t_ms values are
+        # already absolute virtual times). The seed rides along with no
+        # flight — podscope treats a serve-only node as a root holder.
+        snaps = [{"addr": seed_peer.id, "flights": {}}]
+        for lc in leechers:
+            dump = lc.flight.timeline()
+            dump["started_at"] = 0.0
+            dump["summary"] = lc.flight.summarize()
+            snaps.append({"addr": lc.peer.id,
+                          "flights": {task.id: dump}})
+        result["podscope_snapshots"] = snaps
     return result
 
 
@@ -584,6 +600,57 @@ def _run_pr5(args) -> dict:
     }
 
 
+def _run_pr6(args) -> dict:
+    """The PR-6 trajectory point: the podscope pod-level numbers (pod
+    makespan, distribution-tree depth, origin-byte amplification,
+    per-edge bandwidth percentiles) per scenario, from the same sims as
+    the earlier points — the baseline's ``schedule_digest`` stays
+    byte-identical to BENCH_pr3, so this is the observability baseline
+    the streaming-relay work (ROADMAP item 2) must beat on the SAME
+    schedule. Healthy-mesh acceptance: baseline amplification ≈ 1.0 (the
+    content crossed the origin uplink once); the no-PEX outage scenario
+    shows amplification = N daemons — the number podscope exists to
+    catch."""
+    from ..common import podscope
+    scenarios = {}
+    for sc in SCENARIOS:
+        r = run_bench(seed=args.seed, daemons=args.daemons,
+                      pieces=args.pieces, piece_size=args.piece_size,
+                      parallelism=args.parallelism, scenario=sc,
+                      collect_podscope=True)
+        report = podscope.aggregate(r.pop("podscope_snapshots"))
+        task_report = next(iter(report["tasks"].values()))
+        scenarios[sc] = {
+            "schedule_digest": r["schedule_digest"],
+            "wall_ms": r["wall_ms"],
+            "p2p_served_ratio": r["p2p_served_ratio"],
+            "podscope": podscope.bench_summary(task_report),
+        }
+    base = scenarios["baseline"]["podscope"]
+    return {
+        "bench": "dfbench-podscope",
+        "seed": args.seed,
+        "daemons": args.daemons,
+        "pieces": args.pieces,
+        "piece_size": args.piece_size,
+        "parallelism": args.parallelism,
+        # byte-identical to BENCH_pr3/pr4/pr5 — the pod numbers below
+        # describe the SAME schedule those points measured
+        "schedule_digest": scenarios["baseline"]["schedule_digest"],
+        "scenarios": scenarios,
+        "pod_makespan_ms": {sc: scenarios[sc]["podscope"]["makespan_ms"]
+                            for sc in SCENARIOS},
+        "tree_depth": {sc: scenarios[sc]["podscope"]["depth"]
+                       for sc in SCENARIOS},
+        "amplification": {sc: scenarios[sc]["podscope"]["amplification"]
+                          for sc in SCENARIOS},
+        "edge_bandwidth_p95_bps":
+            {sc: scenarios[sc]["podscope"]["edge_bandwidth_bps"]["p95"]
+             for sc in SCENARIOS},
+        "baseline_bottleneck": base["bottleneck"],
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -603,10 +670,15 @@ def build_parser() -> argparse.ArgumentParser:
                    "and zero-stall data-plane models and write the PR-5 "
                    "trajectory point (BENCH_pr5.json); the schedule digest "
                    "stays byte-identical to BENCH_pr3/pr4")
+    p.add_argument("--pr6", action="store_true",
+                   help="aggregate each scenario through the podscope "
+                   "pod-level view (makespan, tree depth, origin "
+                   "amplification, per-edge p95) and write the PR-6 "
+                   "trajectory point (BENCH_pr6.json); the baseline "
+                   "schedule digest stays byte-identical to BENCH_pr3")
     p.add_argument("--out", default="",
                    help="result path ('-' = stdout only; default "
-                   "BENCH_pr3.json, BENCH_pr4.json with --pr4, or "
-                   "BENCH_pr5.json with --pr5)")
+                   "BENCH_pr3.json, or BENCH_pr<N>.json with --pr<N>)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny run (4 daemons x 8 pieces), stdout only — "
                    "exercised by tier-1 so the harness itself can't rot")
@@ -641,7 +713,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr5:
+        if args.pr6:
+            args.out = "BENCH_pr6.json"
+        elif args.pr5:
             args.out = "BENCH_pr5.json"
         elif args.pr4:
             args.out = "BENCH_pr4.json"
@@ -651,7 +725,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr5:
+    if args.pr6:
+        result = _run_pr6(args)
+    elif args.pr5:
         result = _run_pr5(args)
     elif args.pr4:
         result = _run_pr4(args)
@@ -664,7 +740,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr5:
+        if args.pr6:
+            amp = result["amplification"]
+            depth = result["tree_depth"]
+            print(f"dfbench: wrote {args.out} (pod makespan baseline="
+                  f"{result['pod_makespan_ms']['baseline']:.0f}ms, depth "
+                  + "/".join(f"{sc}={depth[sc]}" for sc in SCENARIOS)
+                  + ", amplification "
+                  + ", ".join(f"{sc}={amp[sc]:.2f}" for sc in SCENARIOS)
+                  + f", schedule {result['schedule_digest'][:12]})")
+        elif args.pr5:
             imp = result["improvement"]
             print(f"dfbench: wrote {args.out} (wire p95 "
                   f"legacy={imp['wire_p95_ms']['legacy']:.2f}ms -> "

@@ -2,13 +2,22 @@
 
 Reads the flight recorder's debug surface (daemon/flight_recorder.py) and
 renders an ASCII waterfall per piece plus a "why was this download slow"
-verdict; ``--cluster`` instead reads a scheduler's pod-wide health view.
+verdict; ``--cluster`` instead reads a scheduler's pod-wide health view;
+``--pod`` sweeps a daemon SET and renders the podscope distribution tree
+(common/podscope.py): per-edge bytes/bandwidth, pod makespan, tree depth,
+origin amplification, and a bottleneck-edge verdict.
 
 Usage:
     python -m dragonfly2_tpu.tools.dfdiag --daemon 10.0.0.4:65002 <task_id>
     python -m dragonfly2_tpu.tools.dfdiag --daemon 10.0.0.4:65002 --list
     python -m dragonfly2_tpu.tools.dfdiag --file flight.json
     python -m dragonfly2_tpu.tools.dfdiag --cluster --scheduler host:port
+    python -m dragonfly2_tpu.tools.dfdiag --pod h1:65002,h2:65002,h3:65002
+
+Exit codes (CI/chaos-gate contract): 0 healthy, 1 fetch/IO failure,
+2 usage, 3 the verdict names an SLO breach / straggler bottleneck /
+pod-level breach — so a chaos pipeline can gate on
+``dfdiag --pod ... --json``.
 
 Waterfall legend: ``.`` queue (rate-limiter wait), ``-`` ttfb (request +
 parent-side queueing), ``=`` wire transfer, ``#`` HBM staging.
@@ -19,7 +28,13 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import urllib.request
+
+from ..common.podscope import _fmt_bytes, _get_json
+
+EXIT_OK = 0
+EXIT_IO = 1          # a daemon/scheduler could not be reached or parsed
+EXIT_USAGE = 2
+EXIT_BREACH = 3      # the verdict names an SLO breach or bottleneck
 
 # (stage duration key, bar glyph, human name) — waterfall + verdict order
 STAGES = (
@@ -30,29 +45,21 @@ STAGES = (
 )
 
 
-def _get(url: str) -> dict:
-    with urllib.request.urlopen(url, timeout=10) as resp:
-        return json.loads(resp.read())
+def _get(url: str, timeout_s: float = 10.0) -> dict:
+    return _get_json(url, timeout_s)
 
 
-def fetch_flight(daemon: str, task_id: str) -> dict:
-    return _get(f"http://{daemon}/debug/flight/{task_id}")
+def fetch_flight(daemon: str, task_id: str,
+                 timeout_s: float = 10.0) -> dict:
+    return _get(f"http://{daemon}/debug/flight/{task_id}", timeout_s)
 
 
-def fetch_index(daemon: str) -> dict:
-    return _get(f"http://{daemon}/debug/flight")
+def fetch_index(daemon: str, timeout_s: float = 10.0) -> dict:
+    return _get(f"http://{daemon}/debug/flight", timeout_s)
 
 
-def fetch_cluster(scheduler: str) -> dict:
-    return _get(f"http://{scheduler}/debug/cluster")
-
-
-def _fmt_bytes(n: int) -> str:
-    for unit in ("B", "KiB", "MiB", "GiB"):
-        if n < 1024 or unit == "GiB":
-            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
-        n /= 1024
-    return f"{n:.1f}GiB"
+def fetch_cluster(scheduler: str, timeout_s: float = 10.0) -> dict:
+    return _get(f"http://{scheduler}/debug/cluster", timeout_s)
 
 
 def render_waterfall(summary: dict, *, width: int = 64) -> str:
@@ -209,8 +216,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="list recorded flights on the daemon")
     p.add_argument("--cluster", action="store_true",
                    help="show the scheduler's cluster health view")
+    p.add_argument("--pod", default="",
+                   help="comma-separated daemon upload host:port set — "
+                   "render the podscope distribution tree (per-edge "
+                   "bytes/bandwidth, makespan, depth, amplification, "
+                   "bottleneck verdict) across the whole pod")
     p.add_argument("--json", action="store_true",
-                   help="raw JSON instead of rendered text")
+                   help="machine-readable JSON instead of rendered text "
+                   "(with --pod: the full aggregate report for CI gates)")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="per-request HTTP timeout in seconds")
     p.add_argument("--width", type=int, default=64, help="waterfall width")
     return p
 
@@ -218,40 +233,66 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     try:
+        if args.pod:
+            from ..common import podscope
+            addrs = [a.strip() for a in args.pod.split(",") if a.strip()]
+            if not addrs:
+                print("dfdiag: --pod needs at least one host:port",
+                      file=sys.stderr)
+                return EXIT_USAGE
+            # collect_pod never raises: unreachable daemons land in the
+            # report (and the breach list) instead of a traceback — a pod
+            # diagnosis must survive the exact failures it exists to see
+            snaps = podscope.collect_pod(addrs, timeout_s=args.timeout)
+            report = podscope.aggregate(snaps)
+            print(json.dumps(report, indent=2) if args.json
+                  else render_pod_report(report))
+            if len(report["unreachable"]) == len(addrs):
+                return EXIT_IO          # nothing answered: not a verdict
+            return EXIT_BREACH if report["breaches"] else EXIT_OK
         if args.cluster:
             if not args.scheduler:
                 # the daemon upload port serves /debug/flight, never
                 # /debug/cluster — a silent fallback would just 404
                 print("dfdiag: --cluster needs --scheduler host:port "
                       "(the scheduler's --debug-port)", file=sys.stderr)
-                return 2
-            snap = fetch_cluster(args.scheduler)
+                return EXIT_USAGE
+            snap = fetch_cluster(args.scheduler, args.timeout)
             print(json.dumps(snap, indent=2) if args.json
                   else render_cluster(snap))
-            return 0
+            return EXIT_OK
         if args.list:
-            idx = fetch_index(args.daemon)
+            idx = fetch_index(args.daemon, args.timeout)
             print(json.dumps(idx, indent=2))
-            return 0
+            return EXIT_OK
         if args.file:
             with open(args.file, encoding="utf-8") as f:
                 flight = json.load(f)
         elif args.task_id:
-            flight = fetch_flight(args.daemon, args.task_id)
+            flight = fetch_flight(args.daemon, args.task_id, args.timeout)
         else:
-            print("dfdiag: need a task_id, --file, --list, or --cluster",
-                  file=sys.stderr)
-            return 2
+            print("dfdiag: need a task_id, --file, --list, --cluster, "
+                  "or --pod", file=sys.stderr)
+            return EXIT_USAGE
         summary = flight.get("summary") or flight
         if args.json:
             print(json.dumps(summary, indent=2))
-            return 0
-        print(render_waterfall(summary, width=args.width))
-        print(verdict(summary))
-        return 0
-    except OSError as exc:
-        print(f"dfdiag: {exc}", file=sys.stderr)
-        return 1
+        else:
+            print(render_waterfall(summary, width=args.width))
+            print(verdict(summary))
+        # gate contract: a flight that blew an SLO budget exits non-zero
+        # even when rendered, so chaos pipelines can assert on it
+        return EXIT_BREACH if summary.get("slo_breaches") else EXIT_OK
+    except (OSError, ValueError) as exc:
+        # URLError/HTTPError/timeout/bad JSON: one line, no traceback —
+        # an unreachable daemon is a finding, not a crash
+        print(f"dfdiag: {type(exc).__name__}: {exc}", file=sys.stderr)
+        return EXIT_IO
+
+
+def render_pod_report(report: dict) -> str:
+    from ..common.podscope import render_pod
+    return render_pod(report)
 
 
 if __name__ == "__main__":

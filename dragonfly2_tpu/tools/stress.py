@@ -12,7 +12,10 @@ Usage:
          --chaos-target http://127.0.0.1:UPLOAD_PORT]
 
 ``--chaos`` arms a faultgate script (common/faultgate.py syntax; see
-docs/RESILIENCE.md) for the duration of the run and disarms it after.
+docs/RESILIENCE.md) for the duration of the run and disarms it after;
+``--pod-report host1:port,host2:port`` attaches the podscope pod summary
+(docs/OBSERVABILITY.md) so the report says what the POD did under load,
+not just what this client saw.
 With ``--chaos-target`` the script is POSTed to that daemon's
 ``/debug/faults`` surface (requires ``upload.debug_endpoints: true``), so
 a LIVE daemon takes the faults while this tool measures what its clients
@@ -154,14 +157,41 @@ def main(argv: list[str] | None = None) -> int:
                         "after the run, attach its /debug/pex snapshot "
                         "(gossip membership + swarm index) to the report — "
                         "pairs with --chaos 'pex.gossip=...' runs")
+    p.add_argument("--pod-report", default="",
+                   help="comma-separated daemon upload host:port set; "
+                        "after the run, attach the podscope pod summary "
+                        "(distribution-tree depth, makespan, origin "
+                        "amplification, bottleneck edge, breaches) so a "
+                        "stress/chaos report says what the POD did, not "
+                        "just what this client saw")
     args = p.parse_args(argv)
     result = asyncio.run(_run_with_chaos(args))
     if args.chaos:
         result["chaos"] = args.chaos
     if args.pex_dump:
         result["pex"] = asyncio.run(_fetch_pex(args.pex_dump.rstrip("/")))
+    if args.pod_report:
+        result["podscope"] = _pod_report(args.pod_report)
     print(json.dumps(result))
     return 1 if result["requests"] == result["errors"] else 0
+
+
+def _pod_report(pod: str) -> dict:
+    """Podscope summary for the stress report: compact per-task numbers +
+    the breach list and verdict (diagnostics must not fail a run)."""
+    from ..common import podscope
+    try:
+        addrs = [a.strip() for a in pod.split(",") if a.strip()]
+        report = podscope.aggregate(podscope.collect_pod(addrs))
+        return {
+            "tasks": {tid: podscope.bench_summary(t)
+                      for tid, t in report["tasks"].items()},
+            "unreachable": report["unreachable"],
+            "breaches": report["breaches"],
+            "verdict": report["verdict"],
+        }
+    except Exception as exc:  # noqa: BLE001 - diagnostics must not fail a run
+        return {"error": str(exc)}
 
 
 async def _fetch_pex(base: str) -> dict:

@@ -162,6 +162,67 @@ class TestPr5DataPlane:
         assert not list(tmp_path.iterdir())      # stdout only
 
 
+class TestPr6Podscope:
+    """PR-6 point: the podscope pod-level numbers per scenario, on the
+    SAME schedules as every earlier point — the observability baseline
+    the streaming-relay work (ROADMAP item 2) must beat."""
+
+    def test_podscope_collection_never_moves_the_digest(self):
+        a = run_bench(seed=7, daemons=6, pieces=24)
+        b = run_bench(seed=7, daemons=6, pieces=24, collect_podscope=True)
+        assert a["schedule_digest"] == b["schedule_digest"]
+        snaps = b["podscope_snapshots"]
+        assert len(snaps) == 7            # 6 leechers + the seed node
+        assert sum(len(s["flights"]) for s in snaps) == 6
+
+    def test_pr6_pod_numbers_per_scenario(self):
+        import argparse
+
+        from dragonfly2_tpu.tools.dfbench import _run_pr6
+        args = argparse.Namespace(seed=7, daemons=6, pieces=24,
+                                  piece_size=4 << 20, parallelism=4)
+        r = _run_pr6(args)
+        base = run_bench(seed=7, daemons=6, pieces=24)
+        # the baseline pod numbers describe the PR-3 schedule, verbatim
+        assert r["schedule_digest"] == base["schedule_digest"]
+        # a healthy mesh moves the content across the origin uplink
+        # exactly once; the no-PEX outage pulls it once PER DAEMON —
+        # origin amplification is the number podscope exists to catch
+        assert r["amplification"]["baseline"] == 1.0
+        assert r["amplification"]["scheds_down_no_pex"] == 6.0
+        assert r["amplification"]["scheds_down_pex"] == 1.0
+        # the mesh relays (depth > 1); all-origin is a flat depth-1 star
+        assert r["tree_depth"]["baseline"] > 1
+        assert r["tree_depth"]["scheds_down_no_pex"] == 1
+        assert (r["pod_makespan_ms"]["scheds_down_pex"]
+                < r["pod_makespan_ms"]["scheds_down_no_pex"])
+        for sc, blob in r["scenarios"].items():
+            ps = blob["podscope"]
+            assert ps["makespan_ms"] > 0, sc
+            assert ps["edge_wire_ms"]["p50"] <= ps["edge_wire_ms"]["p95"]
+        assert r["baseline_bottleneck"] is not None
+
+    def test_pr6_matches_committed_pr3_baseline(self, tmp_path):
+        """The committed trajectory gate: a default-size --pr6 run must
+        carry the same schedule digest as the committed BENCH_pr3.json
+        and a healthy-mesh baseline (amplification ≈ 1.0)."""
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr6", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads((tmp_path / "BENCH_pr6.json").read_text())
+        assert r["bench"] == "dfbench-podscope"
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["amplification"]["baseline"] == pytest.approx(1.0)
+        committed = json.loads(
+            open(os.path.join(REPO, "BENCH_pr6.json")).read())
+        assert committed["schedule_digest"] == pr3["schedule_digest"]
+        assert committed["amplification"]["baseline"] == pytest.approx(1.0)
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(

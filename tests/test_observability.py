@@ -244,6 +244,7 @@ def _import_all_metric_modules():
             "dragonfly2_tpu.common.gc",
             "dragonfly2_tpu.common.health",
             "dragonfly2_tpu.daemon.daemon",
+            "dragonfly2_tpu.daemon.flight_recorder",
             "dragonfly2_tpu.daemon.proxy",
             "dragonfly2_tpu.daemon.objectstorage",
             "dragonfly2_tpu.daemon.piece_dispatcher",
@@ -295,6 +296,38 @@ class TestMetricNamespaceLint:
         assert not missing, (
             f"metrics registered in code but absent from "
             f"docs/OBSERVABILITY.md: {missing}")
+
+    def test_every_flight_event_kind_and_rung_documented(self):
+        """Same contract as the metric catalogue, for the flight
+        recorder's vocabulary: every event kind the journal can emit and
+        every degradation-ladder rung name must appear backticked in the
+        docs (event kinds in OBSERVABILITY.md; rung names there or in
+        RESILIENCE.md, where the ladder lives) — an undocumented stage
+        in a /debug/flight dump is a surface operators cannot read."""
+        import re
+
+        from dragonfly2_tpu.daemon import flight_recorder as fr
+        docs_dir = os.path.join(os.path.dirname(__file__), os.pardir,
+                                "docs")
+        obs = open(os.path.join(docs_dir, "OBSERVABILITY.md"),
+                   encoding="utf-8").read()
+        res = open(os.path.join(docs_dir, "RESILIENCE.md"),
+                   encoding="utf-8").read()
+        kinds = {v for k, v in vars(fr).items()
+                 if k.isupper() and isinstance(v, str) and v
+                 and not k.startswith("RUNG_")}
+        rungs = {v for k, v in vars(fr).items() if k.startswith("RUNG_")}
+        assert kinds and rungs, "flight_recorder vocabulary went missing?"
+        ticked_obs = set(re.findall(r"`([a-z0-9_.]+)`", obs))
+        ticked_any = ticked_obs | set(re.findall(r"`([a-z0-9_.]+)`", res))
+        missing_kinds = sorted(kinds - ticked_obs)
+        assert not missing_kinds, (
+            f"flight event kinds emitted in code but absent from "
+            f"docs/OBSERVABILITY.md: {missing_kinds}")
+        missing_rungs = sorted(rungs - ticked_any)
+        assert not missing_rungs, (
+            f"ladder rung names emitted in code but undocumented: "
+            f"{missing_rungs}")
 
 
 class TestShaperMetrics:

@@ -1,0 +1,585 @@
+"""Podscope: pod-wide distribution-tree observability.
+
+Units cover the serve-side edge journal (flight recorder + summarize),
+the flight-ring visibility counters, the pure aggregation math
+(tree/depth/edges/amplification/makespan/bottleneck), and the
+``kind=edge`` record rows; the e2e drives ``dfdiag --pod`` against a real
+3-daemon mesh (seed + 2 leechers, one of them P2P-served by the other
+leecher) and asserts the rendered tree names every edge — with per-edge
+bytes/bandwidth confirmed from BOTH ends — and the bottleneck.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from dragonfly2_tpu.common import podscope
+from dragonfly2_tpu.daemon import flight_recorder as fr
+from dragonfly2_tpu.daemon.flight_recorder import FlightRecorder, TaskFlight
+from dragonfly2_tpu.tools import dfdiag
+
+MB = 1 << 20
+
+
+# ------------------------------------------------------------- serve rows
+
+class TestServeJournal:
+    def test_serve_rows_aggregate_into_uploads(self):
+        f = TaskFlight("t" * 64, "me")
+        f.serve(peer="child-a", addr="10.0.0.2", piece=0, nbytes=4 * MB,
+                serve_ms=40.0, wait_ms=4.0)
+        f.serve(peer="child-a", addr="10.0.0.2", piece=1, nbytes=4 * MB,
+                serve_ms=40.0, wait_ms=0.0)
+        f.serve(peer="child-b", addr="10.0.0.3", piece=0, nbytes=2 * MB,
+                serve_ms=10.0, wait_ms=0.0)
+        s = f.summarize()
+        assert s["bytes_served"] == 10 * MB
+        ups = s["uploads"]
+        assert ups["child-a"]["pieces"] == 2
+        assert ups["child-a"]["bytes"] == 8 * MB
+        assert ups["child-a"]["wait_ms"] == 4.0
+        # 8 MiB over 80 ms = 100 MiB/s
+        assert ups["child-a"]["serve_bps"] == 100 * MB
+        assert ups["child-b"]["addr"] == "10.0.0.3"
+        # the timeline carries the raw rows for podscope stitching
+        rows = f.timeline()["serves"]
+        assert len(rows) == 3
+        assert rows[0]["stage"] == fr.UPLOAD
+        assert rows[0]["peer"] == "child-a"
+
+    def test_serve_ring_bounded_separately_from_events(self):
+        f = TaskFlight("t" * 64, "me", max_events=8, max_serves=4)
+        for i in range(100):
+            f.serve(peer="c", piece=i, nbytes=1)
+        assert len(f.serves) == 4
+        assert f.serves[-1][3] == 99          # newest kept
+        assert len(f.events) == 0             # download journal untouched
+
+    def test_summary_memo_sees_new_serves(self):
+        f = TaskFlight("t" * 64, "me")
+        assert f.summarize()["bytes_served"] == 0
+        f.serve(peer="c", piece=0, nbytes=512, serve_ms=1.0)
+        assert f.summarize()["bytes_served"] == 512
+
+    def test_compact_summary_caps_uploads(self):
+        f = TaskFlight("t" * 64, "me")
+        for i in range(20):
+            f.serve(peer=f"c{i:02d}", piece=0, nbytes=1024 * (i + 1))
+        c = f.compact_summary(max_parents=8)
+        assert len(c["uploads"]) == 8
+        # heaviest kept
+        assert "c19" in c["uploads"]
+
+
+class TestRingVisibility:
+    def test_eviction_counted_and_occupancy(self):
+        rec = FlightRecorder(max_tasks=3)
+        for i in range(5):
+            rec.begin(f"task-{i}", "p")
+        assert rec.evicted == 2
+        assert len(rec.index()) == 3
+
+    def test_serving_get_or_create(self):
+        rec = FlightRecorder(max_tasks=4)
+        dl = rec.begin("dl-task", "me")
+        assert rec.serving("dl-task") is dl          # download flight reused
+        srv = rec.serving("cold-task", "me")
+        assert srv is not None and srv.state == "serving"
+        assert rec.serving("cold-task") is srv
+        off = FlightRecorder(enabled=False)
+        assert off.serving("x") is None
+
+    def test_serve_traffic_never_evicts_download_flights(self):
+        """A seed holding more tasks than max_tasks must not let serve
+        churn flush its own download journals out of the ring."""
+        rec = FlightRecorder(max_tasks=2)
+        rec.begin("dl-1", "me")
+        rec.begin("dl-2", "me")
+        # ring full of download flights: serve-only tasks are refused,
+        # never admitted by evicting a download journal
+        assert rec.serving("cold-1") is None
+        assert rec.get("dl-1") is not None and rec.get("dl-2") is not None
+        assert rec.evicted == 0
+        # a serve-only flight IS evictable by another serve-only task
+        rec2 = FlightRecorder(max_tasks=2)
+        rec2.begin("dl", "me")
+        assert rec2.serving("cold-a") is not None
+        assert rec2.serving("cold-b") is not None
+        assert rec2.get("cold-a") is None            # serving evicted
+        assert rec2.get("dl") is not None            # download survived
+        assert rec2.evicted == 1
+
+
+# ------------------------------------------------------------- aggregation
+
+def _rows(parent, *, n=3, wire=10.0, size=4 * MB):
+    src = "origin" if parent == "" else "p2p"
+    return [{"piece": i, "parent": parent, "source": src, "bytes": size,
+             "start_ms": 5.0 * i, "total_ms": wire + 2.0, "queue_ms": 0.5,
+             "ttfb_ms": 1.5, "wire_ms": wire, "hbm_ms": 0.0}
+            for i in range(n)]
+
+
+def _serves(child, *, n=3, serve=8.0, size=4 * MB):
+    return [{"t_ms": 10.0 * i, "peer": child, "addr": "127.0.0.1",
+             "piece": i, "bytes": size, "serve_ms": serve, "wait_ms": 0.5}
+            for i in range(n)]
+
+
+def _flight(peer, parent, started, *, wire=10.0, serves=None,
+            state="success", rung="p2p"):
+    p2p = 0 if parent == "" else 12 * MB
+    return {"peer_id": peer, "started_at": started, "state": state,
+            "serves": serves or [],
+            "summary": {"piece_rows": _rows(parent, wire=wire),
+                        "bytes_p2p": p2p, "bytes_source": 12 * MB - p2p,
+                        "slo_breaches": {}, "served_rung": rung}}
+
+
+def _chain_snapshots():
+    """origin -> seed -> l1 -> l2, l1->l2 slow, one daemon dead."""
+    tid = "T" * 64
+    return [
+        {"addr": "seed:1", "flights": {tid: _flight(
+            "seed-peer", "", 100.0, serves=_serves("l1-peer"))}},
+        {"addr": "l1:1", "flights": {tid: _flight(
+            "l1-peer", "seed-peer", 100.1, serves=_serves("l2-peer"))}},
+        {"addr": "l2:1", "flights": {tid: _flight(
+            "l2-peer", "l1-peer", 100.2, wire=120.0)}},
+        {"addr": "dead:1", "error": "connection refused"},
+    ], tid
+
+
+class TestAggregate:
+    def test_tree_depth_edges_and_confirmation(self):
+        snaps, tid = _chain_snapshots()
+        t = podscope.aggregate(snaps)["tasks"][tid]
+        assert t["depth"] == 3
+        assert t["daemons"] == 3 and t["complete"] == 3
+        edges = {(e["src"], e["dst"]): e for e in t["edges"]}
+        assert set(edges) == {("origin", "seed:1"), ("seed:1", "l1:1"),
+                              ("l1:1", "l2:1")}
+        assert all(e["bytes"] == 12 * MB for e in edges.values())
+        # serve-journal stitching: p2p edges confirmed from both ends,
+        # with the parent-side serve/limiter timings attached
+        assert edges[("seed:1", "l1:1")]["confirmed"]
+        assert edges[("seed:1", "l1:1")]["serve_ms"] == pytest.approx(24.0)
+        assert edges[("seed:1", "l1:1")]["wait_ms"] == pytest.approx(1.5)
+        assert not edges[("origin", "seed:1")]["confirmed"]
+        # tree: every node hangs off its heaviest source
+        assert t["tree"] == {"seed:1": "origin", "l1:1": "seed:1",
+                             "l2:1": "l1:1"}
+
+    def test_amplification_exact_when_origin_observed(self):
+        snaps, tid = _chain_snapshots()
+        t = podscope.aggregate(snaps)["tasks"][tid]
+        # the origin served the content exactly once (the seed's fetch)
+        assert t["origin_bytes"] == 12 * MB
+        assert t["amplification"] == 1.0
+        assert t["amplification_note"] == ""
+
+    def test_amplification_preseeded_note(self):
+        tid = "S" * 64
+        snaps = [{"addr": "l1:1", "flights": {tid: _flight(
+            "l1-peer", "seed-peer", 100.0)}}]
+        t = podscope.aggregate(snaps)["tasks"][tid]
+        assert t["origin_bytes"] == 0
+        assert t["amplification"] == 1.0
+        assert t["amplification_note"] == "seeded before observation"
+        # a serve-only root holder sits at depth 1, its child at 2
+        assert t["depth"] == 2
+
+    def test_fallback_stitch_for_restarted_seed(self):
+        """A restarted seed serves content it never downloaded here: its
+        flight has state 'serving', NO peer id, and only serve rows. The
+        exact (src_peer, dst_peer) key can't match, so the fallback
+        stitches by child + uniqueness, confirms the edge, and relabels
+        its src from the unresolvable peer id to the seed's address."""
+        tid = "R" * 64
+        seed_flight = {"peer_id": "", "started_at": 99.0,
+                       "state": "serving", "serves": _serves("l1-peer"),
+                       "summary": {"piece_rows": [], "bytes_p2p": 0,
+                                   "bytes_source": 0}}
+        snaps = [
+            {"addr": "seed:1", "flights": {tid: seed_flight}},
+            {"addr": "l1:1", "flights": {tid: _flight(
+                "l1-peer", "old-seed-peer-id", 100.0)}},
+        ]
+        t = podscope.aggregate(snaps)["tasks"][tid]
+        (e,) = t["edges"]
+        assert e["src"] == "seed:1"          # relabeled to the daemon
+        assert e["dst"] == "l1:1"
+        assert e["confirmed"]
+        assert e["serve_ms"] == pytest.approx(24.0)
+        assert t["tree"] == {"l1:1": "seed:1"}
+        assert t["depth"] == 2
+
+    def test_fallback_stitch_never_steals_for_origin_edges(self):
+        """A child that pulled SOME pieces from origin and some from a
+        restarted seed: the origin edge must stay an origin edge — the
+        seed's anonymous serve rows belong to the mesh edge only."""
+        tid = "O" * 64
+        seed_flight = {"peer_id": "", "started_at": 99.0,
+                       "state": "serving", "serves": _serves("l1-peer"),
+                       "summary": {"piece_rows": [], "bytes_p2p": 0,
+                                   "bytes_source": 0}}
+        mixed_rows = _rows("", n=2) + [
+            {**r, "piece": r["piece"] + 2}
+            for r in _rows("old-seed-peer", n=2)]
+        snaps = [
+            {"addr": "seed:1", "flights": {tid: seed_flight}},
+            {"addr": "l1:1", "flights": {tid: {
+                "peer_id": "l1-peer", "started_at": 100.0,
+                "state": "success", "serves": [],
+                "summary": {"piece_rows": mixed_rows,
+                            "bytes_p2p": 8 * MB, "bytes_source": 8 * MB,
+                            "slo_breaches": {}, "served_rung": "p2p"}}}},
+        ]
+        t = podscope.aggregate(snaps)["tasks"][tid]
+        edges = {(e["src"], e["dst"]): e for e in t["edges"]}
+        assert set(edges) == {("origin", "l1:1"), ("seed:1", "l1:1")}
+        assert not edges[("origin", "l1:1")]["confirmed"]
+        assert edges[("seed:1", "l1:1")]["confirmed"]
+
+    def test_render_is_linear_on_dense_cross_serve_mesh(self):
+        """A pex swarm where every daemon serves every later joiner has
+        combinatorially many DAG paths; the render walks the TREE (one
+        line per node) and summarizes cross edges, or dfdiag --pod would
+        flood the terminal at exactly the pod sizes it exists for."""
+        tid = "D" * 64
+        n = 24
+        snaps = []
+        for i in range(n):
+            parents = [f"d{j}-peer" for j in range(i)] or [""]
+            rows = []
+            for k, par in enumerate(parents):
+                rows.append({"piece": k, "parent": par,
+                             "source": "origin" if par == "" else "p2p",
+                             "bytes": 4 * MB, "start_ms": 1.0 * k,
+                             "total_ms": 12.0, "queue_ms": 0.5,
+                             "ttfb_ms": 1.5, "wire_ms": 10.0,
+                             "hbm_ms": 0.0})
+            snaps.append({"addr": f"d{i}:1", "flights": {tid: {
+                "peer_id": f"d{i}-peer", "started_at": 100.0 + i,
+                "state": "success", "serves": [],
+                "summary": {"piece_rows": rows,
+                            "bytes_p2p": sum(r["bytes"] for r in rows
+                                             if r["parent"]),
+                            "bytes_source": sum(r["bytes"] for r in rows
+                                                if not r["parent"]),
+                            "slo_breaches": {}, "served_rung": "p2p"}}}})
+        rep = podscope.aggregate(snaps)
+        assert len(rep["tasks"][tid]["edges"]) == n * (n - 1) // 2 + 1
+        text = podscope.render_pod(rep)
+        lines = text.splitlines()
+        # one line per node + headers/cross-note/verdict, never per-path
+        assert len(lines) < 3 * n
+        assert "cross edge" in text
+        # children truncated by the per-node cap are accounted for by
+        # the "+N more" line, never misdiagnosed as cross-serve cycles
+        assert "cycle" not in text
+        assert "more" in text
+
+    def test_makespan_first_request_to_last_complete(self):
+        snaps, tid = _chain_snapshots()
+        t = podscope.aggregate(snaps)["tasks"][tid]
+        # first start 100.0s; last completion = l2 start (100.2s) + its
+        # last piece end (start_ms 10 + total 122 = 132ms)
+        assert t["makespan_ms"] == pytest.approx(332.0, abs=0.5)
+
+    def test_bottleneck_straggler_breach_and_verdict(self):
+        snaps, tid = _chain_snapshots()
+        rep = podscope.aggregate(snaps)
+        b = rep["tasks"][tid]["bottleneck"]
+        assert (b["src"], b["dst"]) == ("l1:1", "l2:1")
+        assert b["straggler"]
+        assert any(x.startswith("bottleneck:") for x in rep["breaches"])
+        assert any(x.startswith("unreachable: dead:1")
+                   for x in rep["breaches"])
+        assert "bottleneck edge l1:1 -> l2:1" in rep["verdict"]
+        text = podscope.render_pod(rep)
+        assert "<- bottleneck" in text
+        assert "[confirmed]" in text
+        assert "UNREACHABLE dead:1" in text
+        # tree renders root-down with the seed uplink line
+        assert "seed uplink:" in text
+
+    def test_incomplete_daemon_is_a_breach(self):
+        tid = "I" * 64
+        snaps = [
+            {"addr": "a:1", "flights": {tid: _flight("a-peer", "", 1.0)}},
+            {"addr": "b:1", "flights": {tid: _flight(
+                "b-peer", "a-peer", 1.1, state="running")}},
+        ]
+        rep = podscope.aggregate(snaps)
+        assert rep["tasks"][tid]["complete"] == 1
+        assert any(x.startswith("incomplete:") for x in rep["breaches"])
+
+    def test_span_serve_rows_keep_parent_piece_tally(self):
+        """A grouped span GET journals ONE serve row spanning N pieces;
+        the parent-side tallies must still agree with the child's
+        per-piece rows."""
+        f = TaskFlight("t" * 64, "me")
+        f.serve(peer="c", piece=0, nbytes=16 * MB, serve_ms=40.0,
+                pieces=4)
+        s = f.summarize()
+        assert s["uploads"]["c"]["pieces"] == 4
+        assert f.timeline()["serves"][0]["pieces"] == 4
+
+    def test_stalled_daemon_health_is_surfaced_and_breaches(self):
+        snaps, tid = _chain_snapshots()
+        snaps[1]["health"] = {"status": "stalled",
+                              "loop": {"max_lag_s": 2.5}}
+        snaps[1]["pex"] = {"peers": [{"addr": "x"}]}
+        rep = podscope.aggregate(snaps)
+        d = rep["daemons_detail"]["l1:1"]
+        assert d["health_status"] == "stalled"
+        assert d["pex_peers"] == 1
+        assert any(x.startswith("health: l1:1") for x in rep["breaches"])
+
+    def test_partially_confirmed_seed_uplink_not_inflated(self):
+        """A node with one confirmed and one unconfirmed edge: the
+        serve-journal rate applies only to the bytes it covered."""
+        tid = "U" * 64
+        snaps = [
+            {"addr": "seed:1", "flights": {tid: _flight(
+                "seed-peer", "", 100.0,
+                serves=_serves("l1-peer", serve=100.0))}},
+            {"addr": "l1:1", "flights": {tid: _flight(
+                "l1-peer", "seed-peer", 100.1)}},
+            # l2 also pulled from the seed, but the seed's serve rows for
+            # it are gone (ring evicted): unconfirmed edge
+            {"addr": "l2:1", "flights": {tid: _flight(
+                "l2-peer", "seed-peer", 100.2)}},
+        ]
+        t = podscope.aggregate(snaps)["tasks"][tid]
+        su = t["seed_uplink"]
+        assert su["node"] == "seed:1" and su["bytes"] == 24 * MB
+        # 12 MiB confirmed over 300ms serve time = 40 MiB/s — NOT 24 MiB
+        # over the same 300ms (80 MiB/s, the pre-fix inflation)
+        assert su["est_bandwidth_bps"] == pytest.approx(40 * MB, rel=0.01)
+
+    def test_healthy_pod_no_breaches(self):
+        tid = "H" * 64
+        snaps = [
+            {"addr": "a:1", "flights": {tid: _flight(
+                "a-peer", "", 1.0, serves=_serves("b-peer"))}},
+            {"addr": "b:1", "flights": {tid: _flight(
+                "b-peer", "a-peer", 1.1)}},
+        ]
+        rep = podscope.aggregate(snaps)
+        assert rep["breaches"] == []
+        # the slowest edge is still NAMED (informational), but nothing
+        # rises to a breach — the CI gate stays green
+        assert "BREACH" not in rep["verdict"]
+        assert "bottleneck edge" in rep["verdict"]
+
+    def test_bench_summary_shape(self):
+        snaps, tid = _chain_snapshots()
+        s = podscope.bench_summary(podscope.aggregate(snaps)["tasks"][tid])
+        assert s["depth"] == 3 and s["amplification"] == 1.0
+        assert s["edges"] == 3
+        assert s["edge_bandwidth_bps"]["p5"] \
+            <= s["edge_bandwidth_bps"]["p95"]
+        assert s["seed_uplink"]["node"] == "seed:1"
+
+
+class TestEdgeRecords:
+    def test_on_flight_emits_edge_rows(self):
+        from dragonfly2_tpu.idl.messages import Host
+        from dragonfly2_tpu.scheduler.records import DownloadRecords
+        from dragonfly2_tpu.scheduler.resource import Resource, Task
+        res = Resource()
+        task = Task("t" * 64, "u")
+        host = res.store_host(Host(id="h-child", ip="127.0.0.1", port=1,
+                                   download_port=2))
+        peer = res.get_or_create_peer("child", task, host)
+        rec = DownloadRecords()
+        rec.on_flight(peer, {"per_parent": {
+            "parentA": {"bytes": 8 * MB, "pieces": 2, "wire_ms": 80.0,
+                        "throughput_bps": 100 * MB},
+            "": {"bytes": 4 * MB, "pieces": 1, "wire_ms": 40.0,
+                 "throughput_bps": 100 * MB}}})
+        rows = rec.drain()
+        edges = {r["src_peer_id"]: r for r in rows
+                 if r["kind"] == "edge"}
+        assert set(edges) == {"parentA", "origin"}
+        assert edges["parentA"]["bandwidth_bps"] == 100 * MB
+        assert edges["parentA"]["dst_peer_id"] == "child"
+        assert edges["parentA"]["dst_host_id"] == "h-child"
+        assert edges["origin"]["bytes"] == 4 * MB
+        assert all(e["task_id"] == task.id and e["created_at"] > 0
+                   for e in edges.values())
+        # the flight row itself still rides along
+        assert sum(1 for r in rows if r["kind"] == "flight") == 1
+
+
+class TestServeJournalGating:
+    def test_aborted_transmit_never_journals(self):
+        """A child that disconnects mid-body releases the slot without
+        the ok mark: the serve row must not claim the range landed."""
+        from dragonfly2_tpu.daemon.upload_server import UploadServer, _Slot
+        srv = UploadServer.__new__(UploadServer)
+        srv._active = 0
+        srv._transfer_ms = 0.0
+        srv._transfer_ms_at = 0.0
+        srv._slot_waiters = []
+        fired = []
+        slot = _Slot(srv)
+        slot.on_release = lambda held: fired.append(slot.ok)
+        slot.release()                      # aborted: ok never set
+        ok_slot = _Slot(srv)
+        ok_slot.on_release = lambda held: fired.append(ok_slot.ok)
+        ok_slot.ok = True                   # transmit completed
+        ok_slot.release()
+        assert fired == [False, True]
+
+
+class TestDfdiagPodCLI:
+    def test_all_unreachable_is_io_exit_not_traceback(self, capsys):
+        rc = dfdiag.main(["--pod", "127.0.0.1:9,127.0.0.1:19",
+                          "--timeout", "2"])
+        assert rc == dfdiag.EXIT_IO
+        out = capsys.readouterr().out
+        assert "UNREACHABLE 127.0.0.1:9" in out
+
+    def test_flight_gate_exit_on_slo_breach(self, tmp_path, capsys):
+        saved = tmp_path / "flight.json"
+        saved.write_text(json.dumps({
+            "summary": {"piece_rows": _rows("p"), "slo_breaches":
+                        {"wire": 2}, "slo_budgets_ms": {"wire": 1.0}}}))
+        rc = dfdiag.main(["--file", str(saved)])
+        assert rc == dfdiag.EXIT_BREACH
+        assert "SLO breach" in capsys.readouterr().out
+        healthy = tmp_path / "ok.json"
+        healthy.write_text(json.dumps({
+            "summary": {"piece_rows": _rows("p"), "slo_breaches": {}}}))
+        assert dfdiag.main(["--file", str(healthy)]) == dfdiag.EXIT_OK
+
+
+# ------------------------------------------------------------------- e2e
+
+class TestPodscopeE2E:
+    def test_dfdiag_pod_over_three_daemon_mesh(self, tmp_path, capsys):
+        """Acceptance: seed + 2 leechers (leech2 served P2P by leech1);
+        ``dfdiag --pod`` renders the distribution tree with per-edge
+        bytes/bandwidth — every p2p edge confirmed from both ends by the
+        serve journal — and names the bottleneck edge."""
+        from test_daemon_e2e import daemon_config
+        from test_p2p import (ScriptedScheduler, ScriptedSession,
+                              parent_addr, seed_daemon_with)
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import (DownloadRequest,
+                                                 PeerPacket, RegisterResult,
+                                                 SizeScope)
+
+        data = os.urandom(9 * MB + 333)          # 3 pieces
+        checked = {}
+
+        def scripted(daemon, peer_id):
+            def make_session(conductor):
+                return ScriptedSession(
+                    RegisterResult(task_id=conductor.task_id,
+                                   size_scope=SizeScope.NORMAL),
+                    [PeerPacket(task_id=conductor.task_id,
+                                src_peer_id=conductor.peer_id,
+                                main_peer=parent_addr(daemon, peer_id))])
+            return lambda d: ScriptedScheduler(make_session)
+
+        async def pull(daemon, url, out):
+            async for _ in daemon.ptm.start_file_task(DownloadRequest(
+                    url=url, output=str(out), disable_back_source=True,
+                    timeout_s=60.0)):
+                pass
+
+        async def go():
+            seed, origin, url, task_id, seed_peer = await seed_daemon_with(
+                tmp_path, data, name="pseed")
+            l1 = Daemon(daemon_config(tmp_path, "pl1"),
+                        scheduler_factory=scripted(seed, seed_peer))
+            await l1.start()
+            l2 = None
+            try:
+                await pull(l1, url, tmp_path / "l1.bin")
+                l1_peer = l1.ptm.conductor(task_id).peer_id
+                l2 = Daemon(daemon_config(tmp_path, "pl2"),
+                            scheduler_factory=scripted(l1, l1_peer))
+                await l2.start()
+                await pull(l2, url, tmp_path / "l2.bin")
+                assert (tmp_path / "l2.bin").read_bytes() == data
+
+                addrs = [f"127.0.0.1:{d.upload_server.port}"
+                         for d in (seed, l1, l2)]
+                seed_addr, l1_addr, l2_addr = addrs
+                # sync urllib must not run on the loop serving it
+                snaps = await asyncio.to_thread(
+                    podscope.collect_pod, addrs)
+                report = podscope.aggregate(snaps)
+                t = report["tasks"][task_id]
+                edges = {(e["src"], e["dst"]): e for e in t["edges"]}
+                assert set(edges) == {("origin", seed_addr),
+                                      (seed_addr, l1_addr),
+                                      (l1_addr, l2_addr)}
+                for e in edges.values():
+                    assert e["bytes"] == len(data)
+                # both-ends confirmation via the serve journal, with
+                # parent-side serve timings attached
+                assert edges[(seed_addr, l1_addr)]["confirmed"]
+                assert edges[(seed_addr, l1_addr)]["serve_ms"] > 0
+                assert edges[(l1_addr, l2_addr)]["confirmed"]
+                assert edges[(l1_addr, l2_addr)]["bandwidth_bps"] > 0
+                assert t["depth"] == 3
+                assert t["amplification"] == 1.0
+                assert t["complete"] == 3
+                assert t["makespan_ms"] > 0
+                assert t["bottleneck"] is not None
+                # ring visibility rode the flight index
+                idx = snaps[0]["flight_index"]
+                assert idx["occupancy"] >= 1
+                assert idx["max_tasks"] == 64
+                assert "evicted_total" in idx
+                # the CLI against the live mesh
+                rc = await asyncio.to_thread(
+                    dfdiag.main, ["--pod", ",".join(addrs)])
+                checked["rc"] = rc
+                rc_json = await asyncio.to_thread(
+                    dfdiag.main, ["--pod", ",".join(addrs), "--json"])
+                checked["rc_json"] = rc_json
+                checked["edges"] = edges
+                checked["addrs"] = addrs
+            finally:
+                if l2 is not None:
+                    await l2.stop()
+                await l1.stop()
+                await seed.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+        out = capsys.readouterr().out
+        text, _, json_text = out.partition("{")
+        # rendered tree: every daemon appears, edges carry bytes, the
+        # bottleneck is named
+        addrs = checked["addrs"]
+        for addr in addrs:
+            assert addr in text
+        assert "origin" in text
+        assert "9.0MiB/3pc" in text
+        assert "[confirmed]" in text
+        assert "<- bottleneck" in text
+        assert "pod verdict:" in text
+        # healthy mesh: exits 0, or 3 only for a named breach
+        assert checked["rc"] in (dfdiag.EXIT_OK, dfdiag.EXIT_BREACH)
+        report = json.loads("{" + json_text)
+        assert set(report["tasks"]) and report["unreachable"] == {}
+        assert checked["rc_json"] == (
+            dfdiag.EXIT_BREACH if report["breaches"] else dfdiag.EXIT_OK)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

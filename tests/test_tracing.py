@@ -203,6 +203,23 @@ class TestCrossDaemonTrace:
             # and that trace is rooted at B's peertask span
             task_traces = {r["trace_id"] for r in by_name["peertask"]}
             assert piece_traces <= task_traces
+            # the STITCH itself, not just trace-id co-membership: the
+            # traceparent header that rode the piece GET carried the
+            # piece.download span's identity, so A's upload.serve span
+            # must be a direct CHILD of one of B's piece.download spans —
+            # a regenerated or dropped header would keep the ids in the
+            # same trace file while silently breaking the parent link
+            piece_spans = {r["span_id"] for r in by_name["piece.download"]}
+            joined = [r for r in by_name["upload.serve"]
+                      if r["parent_span_id"] in piece_spans]
+            assert joined, (
+                "no upload.serve span is parented by a piece.download "
+                "span — the cross-daemon hop lost the header join",
+                [(r["trace_id"], r["parent_span_id"])
+                 for r in by_name["upload.serve"]])
+            # every joined serve span completed with a 206 for the child
+            assert all(r["attributes"].get("status") in (200, 206)
+                       for r in joined)
 
         asyncio.run(main())
 

@@ -55,6 +55,7 @@ async def debug_profile(request: web.Request) -> web.Response:
         prof = cProfile.Profile()
         try:
             prof.enable()
+            # dflint: disable=DF005 — the sleep IS the profiling window; the lock exists precisely to serialize profilers
             await asyncio.sleep(seconds)
         finally:
             prof.disable()

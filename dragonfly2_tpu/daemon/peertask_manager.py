@@ -273,8 +273,36 @@ class PeerTaskManager:
             except Exception as exc:  # noqa: BLE001
                 await conductor._finish_fail(Code.UNKNOWN, str(exc))
 
-        asyncio.get_running_loop().create_task(run_import())
-        ok = await conductor.wait_done()
+        # retain + drain (DF002): a fire-and-forget import task is only
+        # weakly referenced by the loop — GC could kill it mid-import and
+        # wait_done() below would park forever on a conductor nobody is
+        # feeding
+        import_task = asyncio.get_running_loop().create_task(run_import())
+        try:
+            ok = await conductor.wait_done()
+        except BaseException:
+            # caller gone/cancelled: reap the import without letting its
+            # CancelledError mask what we're already raising (run_import
+            # catches everything else internally)
+            import_task.cancel()
+            try:
+                await import_task
+            except asyncio.CancelledError:
+                pass
+            raise
+        try:
+            # normal path: wait_done() returns at done_event.set(), but
+            # _finish_* may still owe a _piece_cond notify_all — let it
+            # run to completion rather than cancelling it mid-finish and
+            # stranding piece waiters until their timeouts
+            await import_task
+        except asyncio.CancelledError:
+            import_task.cancel()
+            try:
+                await import_task
+            except asyncio.CancelledError:
+                pass
+            raise
         if not ok:
             raise DFError(conductor.fail_code, conductor.fail_message)
         return task_id

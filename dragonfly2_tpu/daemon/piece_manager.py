@@ -245,17 +245,25 @@ class PieceManager:
     async def import_file(self, conductor: "PeerTaskConductor", path: str) -> None:
         import os
 
-        total = os.path.getsize(path)
+        # dfcache import can be GBs: the per-piece reads go through the
+        # DEFAULT executor, not the 4-thread storage pool — a multi-GB
+        # import queued on the pool would park every in-flight span
+        # landing behind it (same rationale as conductor._verify_digest).
+        loop = asyncio.get_running_loop()
+        total = await loop.run_in_executor(None, os.path.getsize, path)
         piece_size = conductor.set_content_info(total)
-        with open(path, "rb") as f:
+        f = await loop.run_in_executor(None, lambda: open(path, "rb"))
+        try:
             num, off = 0, 0
             while True:
-                data = f.read(piece_size)
+                data = await loop.run_in_executor(None, f.read, piece_size)
                 if not data:
                     break
                 await conductor.on_piece_from_source(num, off, data, 0)
                 num += 1
                 off += len(data)
+        finally:
+            f.close()
         conductor.on_source_complete(total)
 
 

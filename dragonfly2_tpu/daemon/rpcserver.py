@@ -226,6 +226,7 @@ class DaemonService:
                     rate_limit_bps=request.rate_limit_bps,
                     keep_original_offset=request.keep_original_offset)
                 async for resp in self.ptm.start_file_task(sub):
+                    # dflint: disable=DF005 — out_q is unbounded, put() never parks; the sem intentionally spans the whole leaf download to bound fan-out
                     await out_q.put(resp)
 
         async def produce() -> None:
@@ -260,6 +261,7 @@ class DaemonService:
                 producer.cancel()
                 try:
                     await producer
+                # dflint: disable=DF004 — cancel-and-reap: we JUST cancelled the producer while unwinding; its CancelledError must not mask the original exception
                 except BaseException:  # noqa: BLE001 - already unwinding
                     pass
 

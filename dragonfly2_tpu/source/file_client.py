@@ -1,7 +1,14 @@
-"""file:// origin client (also the default for bare paths)."""
+"""file:// origin client (also the default for bare paths).
+
+All filesystem work hops through the default executor (DF001): a file://
+origin feeds the same back-source path as HTTP origins, so its multi-MiB
+piece reads would otherwise traverse buffers on the daemon's one event
+loop — exactly the stall class PR 5 removed from the P2P landing path.
+"""
 
 from __future__ import annotations
 
+import asyncio
 import os
 from typing import AsyncIterator
 from urllib.parse import unquote, urlsplit
@@ -21,8 +28,10 @@ def _path(url: str) -> str:
 
 class FileSourceClient:
     async def content_length(self, req: SourceRequest) -> int:
+        loop = asyncio.get_running_loop()
         try:
-            size = os.path.getsize(_path(req.url))
+            size = await loop.run_in_executor(None, os.path.getsize,
+                                              _path(req.url))
         except OSError:
             raise DFError(Code.SOURCE_NOT_FOUND, f"no such file: {req.url}") from None
         if req.range is not None:
@@ -34,14 +43,16 @@ class FileSourceClient:
 
     async def last_modified(self, req: SourceRequest) -> str:
         try:
-            return str(os.path.getmtime(_path(req.url)))
+            return str(await asyncio.get_running_loop().run_in_executor(
+                None, os.path.getmtime, _path(req.url)))
         except OSError:
             return ""
 
     async def download(self, req: SourceRequest) -> SourceResponse:
         path = _path(req.url)
+        loop = asyncio.get_running_loop()
         try:
-            total = os.path.getsize(path)
+            total = await loop.run_in_executor(None, os.path.getsize, path)
         except OSError:
             raise DFError(Code.SOURCE_NOT_FOUND, f"no such file: {req.url}") from None
         start, length = 0, total
@@ -50,32 +61,47 @@ class FileSourceClient:
             length = min(req.range.length, max(0, total - start))
 
         async def chunks() -> AsyncIterator[bytes]:
-            with open(path, "rb") as f:
+            def _open():
+                f = open(path, "rb")
                 f.seek(start)
+                return f
+
+            f = await loop.run_in_executor(None, _open)
+            try:
                 remaining = length
                 while remaining > 0:
-                    data = f.read(min(_CHUNK, remaining))
+                    data = await loop.run_in_executor(
+                        None, f.read, min(_CHUNK, remaining))
                     if not data:
                         return
                     remaining -= len(data)
                     yield data
+            finally:
+                f.close()
 
         return SourceResponse(status=200, content_length=length, total_length=total,
                               supports_range=True, chunks=chunks())
 
     async def list(self, req: SourceRequest) -> list[ListEntry]:
         path = _path(req.url)
-        if not os.path.isdir(path):
+
+        def _scan() -> list[ListEntry] | None:
+            if not os.path.isdir(path):
+                return None
+            out = []
+            for name in sorted(os.listdir(path)):
+                full = os.path.join(path, name)
+                is_dir = os.path.isdir(full)
+                out.append(ListEntry(
+                    url=f"file://{full}", name=name, is_dir=is_dir,
+                    content_length=-1 if is_dir else os.path.getsize(full)))
+            return out
+
+        entries = await asyncio.get_running_loop().run_in_executor(None, _scan)
+        if entries is None:
             return [ListEntry(url=req.url, name=os.path.basename(path), is_dir=False,
                               content_length=await self.content_length(req))]
-        out = []
-        for name in sorted(os.listdir(path)):
-            full = os.path.join(path, name)
-            is_dir = os.path.isdir(full)
-            out.append(ListEntry(
-                url=f"file://{full}", name=name, is_dir=is_dir,
-                content_length=-1 if is_dir else os.path.getsize(full)))
-        return out
+        return entries
 
 
 register_client(["file"], FileSourceClient())

@@ -1,0 +1,246 @@
+"""dflint rule engine: one registry, one walker, one output format.
+
+dflint is this fabric's project-specific static analyzer. Every rule is
+distilled from a real post-mortem in this repo (the incident lives in the
+rule's docstring), because three of the first six PRs each burned a
+debugging cycle on the *same class* of asyncio bug: a lost ``wait_for``
+cancellation (PR 1), a cross-task ``wait_for(cond.wait(), t)`` lock leak
+that deadlocked the pod with zero log output (PR 2), and event-loop
+starvation from per-byte CPU on the loop thread (PR 5). The daemon runs
+ONE event loop; anything that blocks it caps feeder throughput for every
+task in the process, which is exactly the core-bound bottleneck the
+concurrency-limits literature (PAPERS.md) identifies.
+
+Suppression grammar (the reason is MANDATORY and surfaced in ``--json``)::
+
+    some_call()  # dflint: disable=DF001 — tiny /proc read, not worth a hop
+
+A suppression comment applies to findings on its own line or on the line
+directly below it (banner form).  A ``# dflint:`` comment that does not
+parse — unknown code, missing reason — is itself a finding (DF000) so a
+suppression can never silently rot.
+
+See docs/ANALYSIS.md for the rule catalogue.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+__all__ = [
+    "Finding", "Suppression", "ModuleCtx", "Rule", "RULES",
+    "lint_source", "lint_file", "lint_paths",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dflint:\s*disable=(?P<codes>DF\d{3}(?:\s*,\s*DF\d{3})*)"
+    r"\s*(?:—|–|--+|-)\s*(?P<reason>\S.*?)\s*$")
+_MENTION_RE = re.compile(r"#\s*dflint\s*:")
+
+
+@dataclass
+class Suppression:
+    """One parsed ``# dflint: disable=…`` comment."""
+    codes: tuple[str, ...]
+    reason: str
+    line: int
+    used: bool = False
+
+
+@dataclass
+class Finding:
+    code: str
+    path: str           # repo-relative when under repo_root
+    line: int
+    col: int
+    message: str
+    suppression: Suppression | None = None
+
+    @property
+    def suppressed(self) -> bool:
+        return self.suppression is not None
+
+    def as_dict(self) -> dict:
+        d = {"code": self.code, "path": self.path, "line": self.line,
+             "col": self.col, "message": self.message}
+        if self.suppression is not None:
+            d["suppressed"] = True
+            d["reason"] = self.suppression.reason
+        return d
+
+    def render(self) -> str:
+        tag = " (suppressed: %s)" % self.suppression.reason \
+            if self.suppression else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.code} {self.message}{tag}")
+
+
+@dataclass
+class ModuleCtx:
+    """Everything a rule may need about one module under analysis."""
+    path: str                   # absolute
+    rel: str                    # repo-relative (display + scoping)
+    src: str
+    tree: ast.Module
+    repo_root: str
+    # cross-file caches shared by every module of one lint run (docs
+    # text, package-wide faultgate fire sites, …) — see catalogue rules
+    project: dict = field(default_factory=dict)
+
+
+class Rule:
+    """Base class: subclass, set ``code``/``name``, implement ``check``.
+
+    The class docstring of each concrete rule carries the incident that
+    motivates it — dflint rules are post-mortems made executable, and the
+    docstring is the part a developer reads when the rule fires on them.
+    """
+
+    code: str = "DF000"
+    name: str = "base"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+#: The one registry. Populated by the rule modules at import time below.
+RULES: list[Rule] = []
+
+
+def register(rule_cls: type[Rule]) -> type[Rule]:
+    RULES.append(rule_cls())
+    return rule_cls
+
+
+# ---------------------------------------------------------------------------
+# suppression scanning
+# ---------------------------------------------------------------------------
+
+def scan_suppressions(src: str, rel: str) -> tuple[list[Suppression],
+                                                   list[Finding]]:
+    """Parse every ``# dflint:`` comment; malformed ones become DF000
+    findings (a suppression with no reason is itself a violation — the
+    reason is the suppression's audit trail)."""
+    sups: list[Suppression] = []
+    bad: list[Finding] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        comments = [(t.start[0], t.start[1], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return [], []
+    for line, col, text in comments:
+        if not _MENTION_RE.search(text):
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            bad.append(Finding(
+                "DF000", rel, line, col,
+                "malformed dflint suppression — grammar is "
+                "`# dflint: disable=DF00X — <reason>` and the reason "
+                "is mandatory"))
+            continue
+        codes = tuple(c.strip() for c in m.group("codes").split(","))
+        sups.append(Suppression(codes, m.group("reason"), line))
+    return sups, bad
+
+
+def _apply_suppressions(findings: list[Finding], sups: list[Suppression],
+                        rel: str) -> None:
+    by_line: dict[int, list[Suppression]] = {}
+    for s in sups:
+        by_line.setdefault(s.line, []).append(s)
+    for f in findings:
+        if f.code == "DF000":
+            continue        # the suppression police cannot be suppressed
+        for line in (f.line, f.line - 1):
+            done = False
+            for s in by_line.get(line, ()):
+                if f.code in s.codes:
+                    f.suppression = s
+                    s.used = True
+                    done = True
+                    break
+            if done:
+                break
+    # a suppression that matches nothing is rot: the hazard it excused
+    # was fixed or moved, and leaving it in place would silently excuse
+    # the NEXT finding introduced on that line
+    for s in sups:
+        if not s.used:
+            findings.append(Finding(
+                "DF000", rel, s.line, 0,
+                f"unused suppression for {', '.join(s.codes)} — no "
+                f"matching finding on this or the next line; remove it "
+                f"(a stale disable would mask the next real hazard here)"))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def lint_source(src: str, path: str, *, repo_root: str | None = None,
+                project: dict | None = None) -> list[Finding]:
+    """Lint one module's source text. Returns ALL findings, suppressed
+    ones included (marked); callers filter on ``.suppressed``."""
+    root = os.path.abspath(repo_root or os.getcwd())
+    apath = os.path.abspath(path)
+    rel = os.path.relpath(apath, root) if apath.startswith(root) else path
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        return [Finding("DF000", rel, exc.lineno or 1, exc.offset or 0,
+                        f"syntax error, file not analyzed: {exc.msg}")]
+    ctx = ModuleCtx(path=apath, rel=rel, src=src, tree=tree,
+                    repo_root=root,
+                    project=project if project is not None else {})
+    sups, bad = scan_suppressions(src, rel)
+    findings: list[Finding] = list(bad)
+    for rule in RULES:
+        findings.extend(rule.check(ctx))
+    _apply_suppressions(findings, sups, rel)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+def lint_file(path: str, *, repo_root: str | None = None,
+              project: dict | None = None) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, path, repo_root=repo_root, project=project)
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+        elif p.endswith(".py"):
+            yield p
+
+
+def lint_paths(paths: Iterable[str], *,
+               repo_root: str | None = None) -> list[Finding]:
+    """Lint every ``.py`` under the given files/directories with one
+    shared project cache (docs are read once per run, not per file)."""
+    project: dict = {}
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, repo_root=repo_root,
+                                  project=project))
+    return findings
+
+
+# rule modules self-register on import — keep these at the bottom so the
+# registry and helpers above exist when they do
+from . import concurrency  # noqa: E402,F401
+from . import catalogue    # noqa: E402,F401

@@ -1,0 +1,231 @@
+"""DF006: observable-vocabulary catalogue lints, consolidated.
+
+These started life as three ad-hoc runtime lints buried in
+tests/test_observability.py (metric catalogue) and tests/test_faults.py
+(faultgate sites, rung names): walk the live registry after importing
+every service, then diff against the docs. Moving them into dflint makes
+them static (no imports, so a module nobody imports is still covered),
+gives them the one shared suppression grammar, and leaves ONE registry,
+ONE walker, ONE output format for every project invariant.
+
+Incident (PR 3 audit): docs/OBSERVABILITY.md trailed the code by a third
+of the metric namespace — a metric that exists only in code is invisible
+to operators, and an undocumented flight-event kind or ladder rung is a
+/debug/flight surface nobody can read.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from . import Finding, ModuleCtx, Rule, register
+from .concurrency import _terminal
+
+_METRIC_METHODS = frozenset({"counter", "gauge", "histogram"})
+_FIRE_RE = re.compile(
+    r"faultgate\.(?:fire|fire_sync|corrupt)\(\s*[\"']([a-z.]+)[\"']")
+_TICK_RE = re.compile(r"`([a-z0-9_.]+)`")
+_METRIC_NAME_RE = re.compile(r"df_[a-z0-9_]+")
+
+
+def _read_doc(ctx: ModuleCtx, name: str) -> str | None:
+    key = f"doc:{name}"
+    if key not in ctx.project:
+        path = os.path.join(ctx.repo_root, "docs", name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                ctx.project[key] = f.read()
+        except OSError:
+            ctx.project[key] = None
+    return ctx.project[key]
+
+
+def _doc_metric_names(ctx: ModuleCtx) -> set[str] | None:
+    if "doc_metrics" not in ctx.project:
+        doc = _read_doc(ctx, "OBSERVABILITY.md")
+        ctx.project["doc_metrics"] = (
+            None if doc is None else set(_METRIC_NAME_RE.findall(doc)))
+    return ctx.project["doc_metrics"]
+
+
+def _ticked(ctx: ModuleCtx, name: str) -> set[str]:
+    key = f"ticked:{name}"
+    if key not in ctx.project:
+        doc = _read_doc(ctx, name)
+        ctx.project[key] = set() if doc is None else \
+            set(_TICK_RE.findall(doc))
+    return ctx.project[key]
+
+
+@register
+class MetricCatalogue(Rule):
+    """DF006 (metrics): every registered metric must be ``df_``-prefixed,
+    carry help text, and appear in docs/OBSERVABILITY.md.
+
+    Replaces tests/test_observability.py's runtime registry walk (PR 1
+    metric-namespace lint + PR 3 catalogue lint). Static analysis covers
+    modules the old import list forgot to enumerate.
+    """
+
+    code = "DF006"
+    name = "metric-catalogue"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            mname = node.args[0].value
+            if not mname.startswith("df_"):
+                yield Finding(
+                    self.code, ctx.rel, node.lineno, node.col_offset,
+                    f"metric {mname!r} is outside the df_ namespace — "
+                    f"every metric this fabric exports is df_-prefixed")
+                continue
+            help_arg = None
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+                help_arg = node.args[1].value
+            elif len(node.args) < 2:
+                for kw in node.keywords:
+                    if kw.arg == "help_" and isinstance(kw.value,
+                                                        ast.Constant):
+                        help_arg = kw.value.value
+            if isinstance(help_arg, str) and not help_arg.strip() \
+                    or (len(node.args) < 2
+                        and not any(kw.arg == "help_"
+                                    for kw in node.keywords)):
+                yield Finding(
+                    self.code, ctx.rel, node.lineno, node.col_offset,
+                    f"metric {mname!r} registered without help text — "
+                    f"/metrics must stay self-describing as it grows")
+            documented = _doc_metric_names(ctx)
+            if documented is None:
+                if not ctx.project.get("warned_no_obs_doc"):
+                    ctx.project["warned_no_obs_doc"] = True
+                    yield Finding(
+                        self.code, ctx.rel, node.lineno, node.col_offset,
+                        "docs/OBSERVABILITY.md not found — the metric "
+                        "catalogue has nothing to lint against")
+            elif mname not in documented:
+                yield Finding(
+                    self.code, ctx.rel, node.lineno, node.col_offset,
+                    f"metric {mname!r} is not documented in "
+                    f"docs/OBSERVABILITY.md — a metric that exists only "
+                    f"in code is invisible to operators")
+
+
+@register
+class FlightVocabulary(Rule):
+    """DF006 (flight recorder): every event kind and ladder rung the
+    journal can emit must be backticked in the docs (kinds in
+    OBSERVABILITY.md; rungs there or in RESILIENCE.md, where the ladder
+    lives). An undocumented stage in a /debug/flight dump is a surface
+    operators cannot read. Replaces the runtime vocabulary lint."""
+
+    code = "DF006"
+    name = "flight-vocabulary"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if not ctx.rel.replace(os.sep, "/").endswith(
+                "daemon/flight_recorder.py"):
+            return
+        obs = _ticked(ctx, "OBSERVABILITY.md")
+        any_doc = obs | _ticked(ctx, "RESILIENCE.md")
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not (isinstance(value, ast.Constant)
+                    and isinstance(value.value, str) and value.value):
+                continue
+            for tgt in targets:
+                if not (isinstance(tgt, ast.Name) and tgt.id.isupper()):
+                    continue
+                if tgt.id.startswith("RUNG_"):
+                    if value.value not in any_doc:
+                        yield Finding(
+                            self.code, ctx.rel, node.lineno,
+                            node.col_offset,
+                            f"ladder rung {value.value!r} ({tgt.id}) is "
+                            f"emitted in flight journals but undocumented "
+                            f"in docs/OBSERVABILITY.md or RESILIENCE.md")
+                elif value.value not in obs:
+                    yield Finding(
+                        self.code, ctx.rel, node.lineno, node.col_offset,
+                        f"flight event kind {value.value!r} ({tgt.id}) is "
+                        f"emitted in flight journals but undocumented in "
+                        f"docs/OBSERVABILITY.md")
+
+
+@register
+class FaultgateSites(Rule):
+    """DF006 (faultgate): the site registry, the ``faultgate.fire(…)``
+    call sites across the package, and docs/RESILIENCE.md must agree —
+    a registered-but-never-fired site is a chaos surface that tests
+    nothing, a fired-but-unregistered name raises at arm time, and an
+    undocumented site can't be scripted by operators. Replaces the
+    runtime site lint from tests/test_faults.py."""
+
+    code = "DF006"
+    name = "faultgate-sites"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if not ctx.rel.replace(os.sep, "/").endswith("common/faultgate.py"):
+            return
+        sites: dict[str, int] = {}
+        sites_line = 1
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "SITES"
+                            for t in node.targets)):
+                continue
+            sites_line = node.lineno
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) \
+                        and isinstance(const.value, str):
+                    sites[const.value] = const.lineno
+        if not sites:
+            return
+        # package-wide fire() sweep, rooted at the package holding this
+        # file (…/common/faultgate.py -> …/) so fixtures self-contain
+        pkg_root = os.path.dirname(os.path.dirname(ctx.path))
+        fired: set[str] = set()
+        for dirpath, dirs, files in os.walk(pkg_root):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for name in files:
+                if not name.endswith(".py") or name == "faultgate.py":
+                    continue
+                try:
+                    with open(os.path.join(dirpath, name),
+                              encoding="utf-8") as f:
+                        fired.update(_FIRE_RE.findall(f.read()))
+                except OSError:
+                    continue
+        res = _ticked(ctx, "RESILIENCE.md")
+        for site, line in sorted(sites.items()):
+            if site not in fired:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"faultgate site {site!r} is registered but never "
+                    f"fired anywhere in the package — dead chaos surface")
+            if site not in res:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"faultgate site {site!r} is not documented in "
+                    f"docs/RESILIENCE.md")
+        for site in sorted(fired - set(sites)):
+            yield Finding(
+                self.code, ctx.rel, sites_line, 0,
+                f"faultgate.fire({site!r}) appears in the package but "
+                f"{site!r} is not in the SITES registry — arming it "
+                f"raises ValueError")

@@ -1,0 +1,545 @@
+"""DF001–DF005: the asyncio hazard classes this fabric has actually hit.
+
+Every rule here is a post-mortem made executable. The daemon runs ONE
+event loop; these are the five ways this codebase has managed to wedge,
+starve, or silently poison it across PRs 1–5.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from . import Finding, ModuleCtx, Rule, register
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a pure Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(node: ast.AST) -> str | None:
+    """The last segment of a call target: `x` for x(), `m` for a.b.m()."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _walk_scope(body: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function scopes.
+
+    A nested sync ``def`` or ``lambda`` inside a coroutine is (in this
+    codebase) almost always an executor thunk or a callback — its body
+    does not run on the event loop in the coroutine's context, so
+    blocking calls there are exactly the *fix* for DF001, not the bug.
+    Nested ``async def``s are separate coroutines and are visited in
+    their own right by the rules' outer loops.
+    """
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_NODES):
+            continue    # a def seeded directly from `body` stays opaque too
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                continue
+            stack.append(child)
+
+
+def _lock_ctor_map(tree: ast.Module) -> dict[str, str]:
+    """terminal-name -> 'cond' | 'event' | 'lock' for every assignment
+    like ``self._cond = asyncio.Condition()`` anywhere in the module."""
+    kinds = {"Condition": "cond", "Event": "event", "Lock": "lock",
+             "Semaphore": "lock", "BoundedSemaphore": "lock"}
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        else:
+            continue
+        if not isinstance(value, ast.Call):
+            continue
+        ctor = _terminal(value.func)
+        kind = kinds.get(ctor or "")
+        if kind is None:
+            continue
+        for t in targets:
+            name = _terminal(t)
+            if name:
+                out[name] = kind
+    return out
+
+
+def _async_display(fn: ast.AsyncFunctionDef, owner: str | None) -> str:
+    return f"{owner}.{fn.name}" if owner else fn.name
+
+
+def _module_functions(tree: ast.Module):
+    """(key -> sync def node, list of (async def node, owner-class-name)).
+
+    Keys are ('', name) for module-level defs and (class, name) for
+    methods — enough resolution to follow ``self.helper()`` and bare
+    ``helper()`` call edges without a real type checker.
+    """
+    sync: dict[tuple[str, str], ast.FunctionDef] = {}
+    asyncs: list[tuple[ast.AsyncFunctionDef, str | None]] = []
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            sync[("", node.name)] = node
+        elif isinstance(node, ast.AsyncFunctionDef):
+            asyncs.append((node, None))
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef):
+                    sync[(node.name, sub.name)] = sub
+                elif isinstance(sub, ast.AsyncFunctionDef):
+                    asyncs.append((sub, node.name))
+    # a NESTED async def (a coroutine/async generator defined inside
+    # another function, like file_client's `chunks()`) still runs on the
+    # event loop — it must be a DF001 scan root too, or blocking IO can
+    # hide one indentation level down
+    top = {id(fn) for fn, _ in asyncs}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef) and id(node) not in top:
+            asyncs.append((node, None))
+    return sync, asyncs
+
+
+def _call_edges(fn, owner: str | None) -> Iterator[tuple[str, str]]:
+    """Keys of module-local functions this function calls directly."""
+    for node in _walk_scope(fn.body):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            yield ("", f.id)
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name)
+              and f.value.id in ("self", "cls") and owner):
+            yield (owner, f.attr)
+
+
+# ---------------------------------------------------------------------------
+# DF001 — blocking call on the event loop
+# ---------------------------------------------------------------------------
+
+_OS_IO = frozenset({
+    "stat", "lstat", "listdir", "scandir", "walk", "remove", "unlink",
+    "rename", "replace", "makedirs", "mkdir", "rmdir", "removedirs",
+    "fsync", "ftruncate", "truncate", "utime", "link", "symlink",
+    "chmod", "chown", "statvfs", "system", "popen",
+})
+_OSPATH_IO = frozenset({
+    "getsize", "getmtime", "getctime", "exists", "isfile", "isdir",
+    "islink", "samefile", "realpath",
+})
+_SHUTIL_IO = frozenset({
+    "rmtree", "copy", "copy2", "copyfile", "copyfileobj", "copytree",
+    "move", "disk_usage", "which",
+})
+_SOCKET_IO = frozenset({
+    "getaddrinfo", "gethostbyname", "gethostbyaddr", "create_connection",
+    "getfqdn",
+})
+_PATHLIB_IO = frozenset({
+    "read_bytes", "read_text", "write_bytes", "write_text",
+})
+_DIGEST_HELPERS = frozenset({"hash_bytes", "hash_file"})
+_FILE_METHODS = frozenset({"read", "write", "readline", "readlines",
+                           "writelines"})
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    d = _dotted(call.func)
+    t = _terminal(call.func)
+    if d in ("open", "io.open"):
+        return "blocking open() — route file IO through an executor"
+    if d == "time.sleep":
+        return "time.sleep() parks the whole event loop — use asyncio.sleep"
+    if d is not None:
+        head, _, rest = d.partition(".")
+        if head == "subprocess":
+            return f"subprocess.{rest or d} blocks the loop — use " \
+                   f"asyncio.create_subprocess_*"
+        if head == "os" and rest in _OS_IO:
+            return f"os.{rest} does synchronous IO on the loop thread"
+        if d.startswith("os.path.") and d[len("os.path."):] in _OSPATH_IO:
+            return f"{d} stats the filesystem on the loop thread"
+        if head == "shutil" and rest in _SHUTIL_IO:
+            return f"shutil.{rest} does synchronous IO on the loop thread"
+        if head == "socket" and rest in _SOCKET_IO:
+            return f"socket.{rest} can block on DNS/connect — use the " \
+                   f"loop's async equivalents"
+        if head == "hashlib" and call.args:
+            return "whole-buffer hashlib digest on the loop thread — " \
+                   "hash off-loop (see storage write_span / PR 5)"
+    if t in _DIGEST_HELPERS:
+        return f"{t}() traverses the whole buffer on the loop thread"
+    if t in _PATHLIB_IO:
+        return f".{t}() does synchronous file IO on the loop thread"
+    return None
+
+
+def _scan_blocking(fn_body: list[ast.stmt]) -> Iterator[tuple[ast.Call, str]]:
+    """Yield (call, reason) for blocking calls lexically in this scope,
+    plus reads/writes on file handles and hasher updates bound here."""
+    handles: set[str] = set()
+    hashers: set[str] = set()
+    for node in _walk_scope(fn_body):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if (isinstance(item.context_expr, ast.Call)
+                        and _dotted(item.context_expr.func)
+                        in ("open", "io.open")
+                        and isinstance(item.optional_vars, ast.Name)):
+                    handles.add(item.optional_vars.id)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            d = _dotted(node.value.func)
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if d in ("open", "io.open"):
+                    handles.add(tgt.id)
+                elif d is not None and d.startswith("hashlib."):
+                    hashers.add(tgt.id)
+    for node in _walk_scope(fn_body):
+        if not isinstance(node, ast.Call):
+            continue
+        reason = _blocking_reason(node)
+        if reason is not None:
+            yield node, reason
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)):
+            if f.value.id in handles and f.attr in _FILE_METHODS:
+                yield node, (f"{f.value.id}.{f.attr}() on a blocking file "
+                             f"handle — route file IO through an executor")
+            elif f.value.id in hashers and f.attr == "update":
+                yield node, ("whole-buffer hasher.update on the loop "
+                             "thread — hash off-loop (PR 5 zero-stall rule)")
+
+
+@register
+class BlockingInAsync(Rule):
+    """DF001: blocking call reachable from a coroutine.
+
+    Incident (PR 5, zero-stall data plane): per-byte CPU and synchronous
+    IO on the single event loop capped wire p95 at 68.6 ms and loop lag
+    at 139 ms; moving hashing/IO off-loop cut them to 7.2 ms / 1.6 ms.
+    The loop thread is the daemon's scarcest resource — a blocking
+    ``open()``/``read()``/``time.sleep()``/whole-buffer hash anywhere a
+    coroutine can reach stalls EVERY task in the process. Fix: hop
+    through ``loop.run_in_executor`` (default executor for cold/control
+    paths; the 4-thread storage pool is reserved for span landing).
+    The rule follows module-local call edges, so a sync helper called
+    from a coroutine (e.g. ``announcer.host_with_stats``) is analyzed
+    too; code inside nested sync ``def``s/lambdas is exempt because
+    those are the executor thunks themselves.
+    """
+
+    code = "DF001"
+    name = "blocking-call-in-coroutine"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        sync, asyncs = _module_functions(ctx.tree)
+        # transitively mark sync defs reachable from any coroutine
+        reached: dict[tuple[str, str], str] = {}
+        frontier: list[tuple[tuple[str, str], str]] = []
+        for fn, owner in asyncs:
+            origin = _async_display(fn, owner)
+            for key in _call_edges(fn, owner):
+                if key in sync and key not in reached:
+                    reached[key] = origin
+                    frontier.append((key, origin))
+        while frontier:
+            key, origin = frontier.pop()
+            node = sync[key]
+            owner = key[0] or None
+            for nxt in _call_edges(node, owner):
+                if nxt in sync and nxt not in reached:
+                    reached[nxt] = origin
+                    frontier.append((nxt, origin))
+
+        for fn, owner in asyncs:
+            where = _async_display(fn, owner)
+            for call, reason in _scan_blocking(fn.body):
+                yield Finding(self.code, ctx.rel, call.lineno,
+                              call.col_offset,
+                              f"{reason} (in async def {where})")
+        for key, origin in sorted(reached.items()):
+            node = sync[key]
+            where = f"{key[0]}.{key[1]}" if key[0] else key[1]
+            for call, reason in _scan_blocking(node.body):
+                yield Finding(self.code, ctx.rel, call.lineno,
+                              call.col_offset,
+                              f"{reason} (in {where}(), called from "
+                              f"coroutine {origin})")
+
+
+# ---------------------------------------------------------------------------
+# DF002 — orphaned create_task
+# ---------------------------------------------------------------------------
+
+_TASKGROUP_NAMES = frozenset({"tg", "taskgroup", "task_group", "nursery"})
+
+
+@register
+class OrphanedCreateTask(Rule):
+    """DF002: ``create_task`` whose result is dropped on the floor.
+
+    Incident class: the event loop keeps only a WEAK reference to tasks;
+    a fire-and-forget ``create_task`` can be garbage-collected mid-
+    flight, and if it isn't, its exception is swallowed silently ("Task
+    exception was never retrieved" at interpreter exit, long after the
+    damage). Both rpc/balancer.py and scheduler_session.py grew
+    ``_close_tasks`` retain-and-discard sets after channel-close tasks
+    leaked exactly this way. Fix: retain the task (and drain it on
+    close), await it, or attach a done-callback that logs the exception
+    — then the rule sees the result captured and stays quiet.
+    """
+
+    code = "DF002"
+    name = "orphaned-create-task"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            call: ast.Call | None = None
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+            elif (isinstance(node, ast.Assign)
+                  and len(node.targets) == 1
+                  and isinstance(node.targets[0], ast.Name)
+                  and node.targets[0].id == "_"
+                  and isinstance(node.value, ast.Call)):
+                call = node.value
+            if call is None or _terminal(call.func) != "create_task":
+                continue
+            recv = (call.func.value if isinstance(call.func, ast.Attribute)
+                    else None)
+            rname = (_terminal(recv) or "").lower() if recv is not None \
+                else ""
+            if rname in _TASKGROUP_NAMES:
+                continue        # TaskGroup retains and joins its children
+            yield Finding(
+                self.code, ctx.rel, call.lineno, call.col_offset,
+                "create_task result discarded — the loop holds only a "
+                "weak ref, so the task can be GC'd mid-flight and its "
+                "exception is silently swallowed; retain it (and drain "
+                "on close), await it, or add a done-callback that logs")
+
+
+# ---------------------------------------------------------------------------
+# DF003 — wait_for around Condition.wait
+# ---------------------------------------------------------------------------
+
+_CONDISH_RE = re.compile(r"cond", re.IGNORECASE)
+
+
+@register
+class WaitForOnConditionWait(Rule):
+    """DF003: ``asyncio.wait_for(<cond>.wait(), t)`` — the PR 2 shape.
+
+    Incident (PR 2, silent pod deadlock, zero log output):
+    ``wait_for(self._cond.wait(), t)`` under the caller's ``async with``
+    splits the lock scope and the wait across TWO tasks. A worker
+    cancelled while parked there orphans the inner ``Condition.wait``,
+    which re-acquires the condition lock in its ``finally`` and dies
+    HOLDING it — every later acquirer (close(), add_parent, the
+    teardown gather) queues on the poisoned lock forever. Fix: an
+    atomic acquire+wait helper so the lock scope and the wait live in
+    ONE coroutine (see ``piece_dispatcher._notified``), then
+    ``wait_for`` that helper. ``Event.wait`` has no lock and is exempt
+    when the receiver is a known ``asyncio.Event``.
+    """
+
+    code = "DF003"
+    name = "wait-for-on-condition-wait"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        ctors = _lock_ctor_map(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _terminal(node.func) != "wait_for" or not node.args:
+                continue
+            inner = node.args[0]
+            if not (isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr == "wait"):
+                continue
+            rname = _terminal(inner.func.value) or ""
+            kind = ctors.get(rname)
+            if kind == "event":
+                continue
+            if kind == "cond" or (kind is None and _CONDISH_RE.search(rname)):
+                yield Finding(
+                    self.code, ctx.rel, node.lineno, node.col_offset,
+                    f"wait_for({rname}.wait(), …) on a Condition splits "
+                    f"the lock scope and the wait across two tasks — a "
+                    f"cancellation leaves the condition lock held forever "
+                    f"(PR 2 silent deadlock); use an atomic acquire+wait "
+                    f"helper and wait_for THAT")
+
+
+# ---------------------------------------------------------------------------
+# DF004 — cancellation-swallowing except in a coroutine
+# ---------------------------------------------------------------------------
+
+def _type_names(expr: ast.expr | None) -> set[str]:
+    if expr is None:
+        return {"<bare>"}
+    if isinstance(expr, ast.Tuple):
+        return {t for e in expr.elts for t in _type_names(e)}
+    t = _terminal(expr)
+    return {t} if t else set()
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    for node in _walk_scope(handler.body):
+        if isinstance(node, ast.Raise) and node.exc is None:
+            return True
+    return False
+
+
+@register
+class BroadExceptInCoroutine(Rule):
+    """DF004: bare/``BaseException`` except in a coroutine without
+    re-raise — it eats ``CancelledError``.
+
+    Incident (PR 1, seed-inherited stall): ``CancelledError`` is a
+    ``BaseException`` precisely so ``except Exception`` misses it; a
+    broad handler that doesn't re-raise turns a cancellation into a
+    normal code path, leaving an undead coroutine its owner believes is
+    gone — the e2e suites timed out on exactly such an orphan. A
+    handler is clean if it contains a bare ``raise``, or if an earlier
+    ``except CancelledError`` arm of the same ``try`` already re-raised.
+    ``except Exception`` is always fine.
+    """
+
+    code = "DF004"
+    name = "cancellation-swallowing-except"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _walk_scope(fn.body):
+                if not isinstance(node, ast.Try):
+                    continue
+                cancelled_handled = False
+                for handler in node.handlers:
+                    names = _type_names(handler.type)
+                    if "CancelledError" in names and _reraises(handler):
+                        cancelled_handled = True
+                        continue
+                    if not names & {"<bare>", "BaseException"}:
+                        continue
+                    if cancelled_handled or _reraises(handler):
+                        continue
+                    what = "bare except" if "<bare>" in names \
+                        else "except BaseException"
+                    yield Finding(
+                        self.code, ctx.rel, handler.lineno,
+                        handler.col_offset,
+                        f"{what} in async def {fn.name} swallows "
+                        f"CancelledError — re-raise it (bare `raise`, or "
+                        f"an `except asyncio.CancelledError: raise` arm "
+                        f"first), or narrow to `except Exception`")
+
+
+# ---------------------------------------------------------------------------
+# DF005 — slow await while holding an async lock
+# ---------------------------------------------------------------------------
+
+_LOCKISH_RE = re.compile(r"lock|cond|sem|mutex", re.IGNORECASE)
+_SLOW_AWAITS = frozenset({
+    "sleep", "gather", "wait", "wait_for", "open_connection",
+    "getaddrinfo", "connect", "request", "get", "post", "put", "patch",
+    "delete", "fetch", "recv", "read", "readexactly", "readline",
+    "readuntil", "drain", "send", "send_json", "json", "text",
+})
+
+
+@register
+class SlowAwaitUnderLock(Rule):
+    """DF005: awaiting network/sleep/queue primitives while holding an
+    ``async with`` lock or condition.
+
+    Incident class (PR 2 adjacent): the dispatcher deadlock taught us
+    that anything parked inside a held condition outlives the caller's
+    patience — and a network read or sleep under a lock converts one
+    slow peer into a process-wide convoy (every other task queues on
+    the lock for the duration of a stranger's RTT). Inside ``async with
+    <lock>:`` the only await that belongs is the lock's own
+    ``wait``/``wait_for``; compute the decision under the lock, do the
+    IO outside it.
+    """
+
+    code = "DF005"
+    name = "slow-await-under-lock"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        ctors = _lock_ctor_map(ctx.tree)
+
+        def lockish(expr: ast.expr) -> str | None:
+            name = _terminal(expr)
+            if name is None and isinstance(expr, ast.Call):
+                name = _terminal(expr.func)
+            if name is None:
+                return None
+            kind = ctors.get(name)
+            if kind in ("cond", "lock"):
+                return name
+            if kind is None and _LOCKISH_RE.search(name):
+                return name
+            return None
+
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _walk_scope(fn.body):
+                if not isinstance(node, ast.AsyncWith):
+                    continue
+                held = {n for item in node.items
+                        if (n := lockish(item.context_expr)) is not None}
+                if not held:
+                    continue
+                for sub in _walk_scope(node.body):
+                    if not (isinstance(sub, ast.Await)
+                            and isinstance(sub.value, ast.Call)):
+                        continue
+                    call = sub.value
+                    fname = _terminal(call.func)
+                    if fname not in _SLOW_AWAITS:
+                        continue
+                    recv = (call.func.value
+                            if isinstance(call.func, ast.Attribute) else None)
+                    if recv is not None and _terminal(recv) in held:
+                        continue    # cond.wait()/.wait_for(): the pattern
+                    yield Finding(
+                        self.code, ctx.rel, sub.lineno, sub.col_offset,
+                        f"await {fname}(…) while holding "
+                        f"{'/'.join(sorted(held))} — a slow peer or timer "
+                        f"convoys every other task on this lock; move the "
+                        f"IO outside the lock scope (in async def "
+                        f"{fn.name})")

@@ -1,0 +1,577 @@
+"""dflint: the tier-1 static-analysis gate plus per-rule fixtures.
+
+Every rule gets a flagged-positive, a clean-negative, and a suppressed
+case; DF003 additionally gets the PR 2 ``wait_for(cond.wait(), t)``
+deadlock pattern verbatim. The gate test at the bottom walks the whole
+package and fails on ANY unsuppressed finding — concurrency discipline
+enforced mechanically, not by reviewer memory.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dragonfly2_tpu.tools.dflint_rules import lint_file, lint_paths, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "dragonfly2_tpu")
+
+
+def run_lint(src: str, path: str = "mod.py", **kw):
+    return lint_source(textwrap.dedent(src), path, **kw)
+
+
+def active(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+def codes(findings):
+    return [f.code for f in active(findings)]
+
+
+# ---------------------------------------------------------------------------
+# DF001 — blocking call on the event loop
+# ---------------------------------------------------------------------------
+
+class TestDF001:
+    def test_flags_open_sleep_and_handle_reads_in_async(self):
+        fs = run_lint("""
+            import time
+
+            async def work(path):
+                time.sleep(1)
+                with open(path) as f:
+                    data = f.read()
+                return data
+        """)
+        assert codes(fs) == ["DF001", "DF001", "DF001"]
+        msgs = " ".join(f.message for f in fs)
+        assert "time.sleep" in msgs and "open()" in msgs and "f.read" in msgs
+
+    def test_flags_sync_helper_reachable_from_coroutine(self):
+        # the announcer shape: coroutine -> sync method -> sync helper
+        fs = run_lint("""
+            def _memory():
+                with open("/proc/meminfo") as f:
+                    return f.read()
+
+            class Announcer:
+                def host_with_stats(self):
+                    return _memory()
+
+                async def _loop(self):
+                    while True:
+                        self.host_with_stats()
+        """)
+        assert codes(fs) == ["DF001", "DF001"]
+        assert "called from coroutine Announcer._loop" in fs[0].message
+
+    def test_executor_thunk_and_pure_sync_are_clean(self):
+        fs = run_lint("""
+            import asyncio
+
+            def cli_main(path):          # never called from a coroutine
+                return open(path).read()
+
+            async def work(loop, path):
+                def _thunk():            # executor thunk: the FIX for DF001
+                    with open(path, "rb") as f:
+                        return f.read()
+                return await loop.run_in_executor(None, _thunk)
+        """)
+        assert codes(fs) == []
+
+    def test_flags_nested_async_def(self):
+        # a coroutine defined INSIDE another function (file_client's
+        # `chunks()` shape) still runs on the loop — the blind spot a
+        # review pass found: without nested roots, reverting this PR's
+        # own file_client fix would have kept the gate green
+        fs = run_lint("""
+            async def download(path):
+                async def chunks():
+                    with open(path, "rb") as f:
+                        yield f.read(1 << 20)
+                return chunks()
+        """)
+        assert "DF001" in codes(fs)
+
+    def test_hashlib_whole_buffer_and_update(self):
+        fs = run_lint("""
+            import hashlib
+
+            async def digest(buf):
+                h = hashlib.sha256()
+                h.update(buf)
+                return hashlib.sha256(buf).hexdigest()
+        """)
+        assert codes(fs) == ["DF001", "DF001"]
+
+    def test_suppression_with_reason(self):
+        fs = run_lint("""
+            async def announce():
+                # dflint: disable=DF001 — tiny /proc read, cheaper than the executor hop
+                with open("/proc/meminfo") as f:
+                    pass
+        """)
+        assert codes(fs) == []
+        sup = [f for f in fs if f.suppressed]
+        assert len(sup) == 1
+        assert sup[0].suppression.reason.startswith("tiny /proc read")
+
+
+# ---------------------------------------------------------------------------
+# DF002 — orphaned create_task
+# ---------------------------------------------------------------------------
+
+class TestDF002:
+    def test_flags_fire_and_forget(self):
+        fs = run_lint("""
+            import asyncio
+
+            async def go():
+                asyncio.get_running_loop().create_task(work())
+        """)
+        assert codes(fs) == ["DF002"]
+
+    def test_retained_awaited_and_taskgroup_are_clean(self):
+        fs = run_lint("""
+            import asyncio
+
+            async def go(self):
+                t = asyncio.get_running_loop().create_task(work())
+                self._tasks.add(t)
+                t.add_done_callback(self._tasks.discard)
+                await asyncio.create_task(other())
+                async with asyncio.TaskGroup() as tg:
+                    tg.create_task(third())
+        """)
+        assert codes(fs) == []
+
+    def test_suppressed(self):
+        fs = run_lint("""
+            import asyncio
+
+            async def go():
+                # dflint: disable=DF002 — daemon-lifetime loop; dies with the process by design
+                asyncio.get_running_loop().create_task(work())
+        """)
+        assert codes(fs) == []
+        assert [f.code for f in fs if f.suppressed] == ["DF002"]
+
+
+# ---------------------------------------------------------------------------
+# DF003 — wait_for around Condition.wait
+# ---------------------------------------------------------------------------
+
+# the PR 2 silent-deadlock shape, verbatim: lock scope in the caller,
+# cond.wait parked in a second task via wait_for — a cancellation leaves
+# the inner wait to die holding the re-acquired condition lock
+PR2_DEADLOCK = """
+import asyncio
+
+class PieceDispatcher:
+    def __init__(self):
+        self._cond = asyncio.Condition()
+
+    async def get(self, timeout):
+        async with self._cond:
+            await asyncio.wait_for(self._cond.wait(), timeout)
+"""
+
+
+class TestDF003:
+    def test_catches_pr2_deadlock_pattern_verbatim(self):
+        fs = run_lint(PR2_DEADLOCK)
+        assert "DF003" in codes(fs)
+        hit = next(f for f in active(fs) if f.code == "DF003")
+        assert "atomic acquire+wait" in hit.message
+
+    def test_event_wait_is_exempt(self):
+        fs = run_lint("""
+            import asyncio
+
+            class GC:
+                def __init__(self):
+                    self._stopped = asyncio.Event()
+
+                async def _loop(self, interval):
+                    await asyncio.wait_for(self._stopped.wait(), interval)
+        """)
+        assert "DF003" not in codes(fs)
+
+    def test_condish_name_flags_without_ctor_evidence(self):
+        fs = run_lint("""
+            import asyncio
+
+            async def poll(cond, t):
+                await asyncio.wait_for(cond.wait(), t)
+        """)
+        assert "DF003" in codes(fs)
+
+    def test_suppressed(self):
+        fs = run_lint("""
+            import asyncio
+
+            async def poll(cond, t):
+                # dflint: disable=DF003,DF005 — fixture reproducing the bug for a chaos test
+                await asyncio.wait_for(cond.wait(), t)
+        """)
+        assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# DF004 — cancellation-swallowing except in a coroutine
+# ---------------------------------------------------------------------------
+
+class TestDF004:
+    def test_flags_bare_and_base_exception(self):
+        fs = run_lint("""
+            async def a():
+                try:
+                    await work()
+                except:
+                    pass
+
+            async def b():
+                try:
+                    await work()
+                except BaseException:
+                    log.exception("boom")
+        """)
+        assert codes(fs) == ["DF004", "DF004"]
+
+    def test_reraise_earlier_cancelled_arm_and_sync_are_clean(self):
+        fs = run_lint("""
+            import asyncio
+
+            async def reraises():
+                try:
+                    await work()
+                except BaseException:
+                    cleanup()
+                    raise
+
+            async def cancelled_arm_first():
+                try:
+                    await work()
+                except asyncio.CancelledError:
+                    raise
+                except BaseException:
+                    pass
+
+            async def narrow():
+                try:
+                    await work()
+                except Exception:
+                    pass
+
+            def sync_main():
+                try:
+                    work()
+                except:          # not a coroutine: CancelledError can't land here
+                    pass
+        """)
+        assert codes(fs) == []
+
+    def test_suppressed(self):
+        fs = run_lint("""
+            async def reap(t):
+                t.cancel()
+                try:
+                    await t
+                # dflint: disable=DF004 — cancel-and-reap: we just cancelled t ourselves
+                except BaseException:
+                    pass
+        """)
+        assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# DF005 — slow await while holding an async lock
+# ---------------------------------------------------------------------------
+
+class TestDF005:
+    def test_flags_sleep_and_network_under_lock(self):
+        fs = run_lint("""
+            import asyncio
+
+            class Shaper:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+
+                async def tick(self, session, url):
+                    async with self._lock:
+                        await asyncio.sleep(1.0)
+                        await session.get(url)
+        """)
+        assert codes(fs) == ["DF005", "DF005"]
+
+    def test_cond_wait_on_held_lock_and_plain_ctx_are_clean(self):
+        fs = run_lint("""
+            import asyncio
+
+            class D:
+                def __init__(self):
+                    self._cond = asyncio.Condition()
+
+                async def wait_notified(self):
+                    async with self._cond:
+                        await self._cond.wait()
+
+                async def fetch(self, session, url):
+                    async with session.get(url) as resp:   # not a lock
+                        return await resp.read()
+        """)
+        assert codes(fs) == []
+
+    def test_suppressed(self):
+        fs = run_lint("""
+            import asyncio
+
+            _profile_lock = asyncio.Lock()
+
+            async def profile(seconds):
+                async with _profile_lock:
+                    # dflint: disable=DF005 — the sleep IS the critical section
+                    await asyncio.sleep(seconds)
+        """)
+        assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# DF000 — the suppression grammar polices itself
+# ---------------------------------------------------------------------------
+
+class TestSuppressionGrammar:
+    def test_missing_reason_is_a_finding_and_does_not_suppress(self):
+        fs = run_lint("""
+            async def go():
+                # dflint: disable=DF001
+                with open("x") as f:
+                    pass
+        """)
+        got = codes(fs)
+        assert "DF000" in got and "DF001" in got
+
+    def test_df000_cannot_be_suppressed(self):
+        fs = run_lint("""
+            # dflint: disable=DF000 — trying to silence the police
+            # dflint: disable=DF001
+            x = 1
+        """)
+        assert "DF000" in codes(fs)
+
+    def test_multi_code_and_banner_form(self):
+        fs = run_lint("""
+            import time
+
+            async def go(path):
+                # dflint: disable=DF001,DF002 — fixture: both hazards on one line
+                time.sleep(1)
+        """)
+        assert codes(fs) == []
+
+    def test_unused_suppression_is_a_finding(self):
+        # the hazard was fixed but the disable stayed: stale suppressions
+        # must surface, or they silently excuse the NEXT hazard here
+        fs = run_lint("""
+            # dflint: disable=DF001 — excuse with nothing left to excuse
+            x = 1
+        """)
+        assert codes(fs) == ["DF000"]
+        assert "unused suppression" in active(fs)[0].message
+
+    def test_suppression_only_covers_its_own_lines(self):
+        fs = run_lint("""
+            import time
+
+            async def go():
+                # dflint: disable=DF001 — covers only the next line
+                time.sleep(1)
+                time.sleep(2)
+        """)
+        assert codes(fs) == ["DF001"]
+
+
+# ---------------------------------------------------------------------------
+# DF006 — catalogue rules (metrics / flight vocabulary / faultgate sites)
+# ---------------------------------------------------------------------------
+
+class TestDF006Metrics:
+    def _lint(self, tmp_path, src, doc="catalogued: `df_ok_total`"):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text(doc)
+        mod = tmp_path / "mod.py"
+        mod.write_text(textwrap.dedent(src))
+        return lint_file(str(mod), repo_root=str(tmp_path))
+
+    def test_documented_df_metric_is_clean(self, tmp_path):
+        fs = self._lint(tmp_path, """
+            c = REGISTRY.counter("df_ok_total", "all good", ("kind",))
+        """)
+        assert codes(fs) == []
+
+    def test_undocumented_bad_prefix_and_empty_help_flag(self, tmp_path):
+        fs = self._lint(tmp_path, """
+            a = REGISTRY.counter("df_mystery_total", "undocumented")
+            b = REGISTRY.gauge("wrong_prefix", "x")
+            c = REGISTRY.histogram("df_ok_total", "")
+        """)
+        assert codes(fs) == ["DF006", "DF006", "DF006"]
+        msgs = " ".join(f.message for f in fs)
+        assert "not documented" in msgs
+        assert "df_ namespace" in msgs
+        assert "without help" in msgs
+
+    def test_suppressed(self, tmp_path):
+        fs = self._lint(tmp_path, """
+            # dflint: disable=DF006 — internal bench-only metric, not an operator surface
+            a = REGISTRY.counter("df_bench_only_total", "bench")
+        """)
+        assert codes(fs) == []
+
+
+class TestDF006FlightVocabulary:
+    def _lint(self, tmp_path, src, obs="", res=""):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text(obs)
+        (tmp_path / "docs" / "RESILIENCE.md").write_text(res)
+        mod = tmp_path / "daemon"
+        mod.mkdir(exist_ok=True)
+        f = mod / "flight_recorder.py"
+        f.write_text(textwrap.dedent(src))
+        return lint_file(str(f), repo_root=str(tmp_path))
+
+    def test_documented_kind_and_rung_clean(self, tmp_path):
+        fs = self._lint(tmp_path, """
+            WIRE_DONE = "wire_done"
+            RUNG_PEX = "pex"
+        """, obs="kinds: `wire_done`", res="ladder: `pex`")
+        assert codes(fs) == []
+
+    def test_undocumented_kind_and_rung_flag(self, tmp_path):
+        fs = self._lint(tmp_path, """
+            NEW_KIND = "teleported"
+            RUNG_WARP = "warp"
+        """)
+        assert codes(fs) == ["DF006", "DF006"]
+
+    def test_other_modules_are_not_vocabulary(self, tmp_path):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text("")
+        mod = tmp_path / "other.py"
+        mod.write_text('SOME_CONST = "not_a_flight_kind"\n')
+        assert codes(lint_file(str(mod), repo_root=str(tmp_path))) == []
+
+
+class TestDF006Faultgate:
+    def _tree(self, tmp_path, *, sites, fired, res_doc):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "RESILIENCE.md").write_text(res_doc)
+        pkg = tmp_path / "pkg"
+        (pkg / "common").mkdir(parents=True, exist_ok=True)
+        gate = pkg / "common" / "faultgate.py"
+        names = ",\n    ".join(f'"{s}"' for s in sites)
+        gate.write_text(f"SITES = frozenset({{\n    {names},\n}})\n")
+        calls = "\n".join(
+            f'    await faultgate.fire("{s}", key=x)' for s in fired)
+        (pkg / "caller.py").write_text(f"async def go(x):\n{calls or '    pass'}\n")
+        return gate
+
+    def test_in_sync_is_clean(self, tmp_path):
+        gate = self._tree(tmp_path, sites=["rpc.unary"],
+                          fired=["rpc.unary"], res_doc="site: `rpc.unary`")
+        assert codes(lint_file(str(gate), repo_root=str(tmp_path))) == []
+
+    def test_never_fired_undocumented_and_unregistered_flag(self, tmp_path):
+        gate = self._tree(tmp_path, sites=["rpc.unary", "dead.site"],
+                          fired=["rpc.unary", "ghost.site"],
+                          res_doc="site: `rpc.unary`")
+        fs = active(lint_file(str(gate), repo_root=str(tmp_path)))
+        msgs = " ".join(f.message for f in fs)
+        assert "never fired" in msgs            # dead.site
+        assert "not documented" in msgs         # dead.site
+        assert "not in the SITES registry" in msgs  # ghost.site
+        assert len(fs) == 3
+
+
+# ---------------------------------------------------------------------------
+# CLI: --json, --changed, exit codes
+# ---------------------------------------------------------------------------
+
+def _cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "dragonfly2_tpu.tools.dflint", *args],
+        capture_output=True, text=True, cwd=cwd, timeout=120)
+
+
+class TestCLI:
+    def test_json_output_and_exit_one_on_findings(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import time
+
+            async def go():
+                time.sleep(1)
+                # dflint: disable=DF001 — justified example
+                open("x")
+        """))
+        p = _cli("--json", str(bad))
+        assert p.returncode == 1, p.stderr
+        doc = json.loads(p.stdout)
+        assert doc["counts"]["findings"] == 1
+        assert doc["counts"]["by_code"] == {"DF001": 1}
+        [sup] = doc["suppressed"]
+        assert sup["reason"] == "justified example"   # reasons surface in --json
+        [f] = doc["findings"]
+        assert f["code"] == "DF001" and f["line"] == 5
+
+    def test_exit_zero_on_clean_file(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text("async def go():\n    return 1\n")
+        p = _cli(str(ok))
+        assert p.returncode == 0, p.stdout
+
+    def test_missing_path_is_usage_error(self):
+        p = _cli("/nonexistent/nope.py")
+        assert p.returncode == 2
+
+    def test_changed_mode_smoke(self):
+        # --changed must run green against whatever the working tree holds
+        # (package files are gated separately below; non-package files
+        # aren't required to be clean, so accept 0 or 1 but not a crash)
+        p = _cli("--changed", "--json")
+        assert p.returncode in (0, 1), p.stderr
+        json.loads(p.stdout)
+
+
+# ---------------------------------------------------------------------------
+# THE GATE: zero unsuppressed findings over the whole package
+# ---------------------------------------------------------------------------
+
+class TestTier1Gate:
+    def test_package_is_clean_and_every_suppression_carries_a_reason(self):
+        findings = lint_paths([PKG], repo_root=REPO)
+        bad = [f.render() for f in findings if not f.suppressed]
+        assert not bad, (
+            "unsuppressed dflint findings (fix the hazard or add "
+            "`# dflint: disable=DF00X — <reason>` with a real reason; "
+            "see docs/ANALYSIS.md):\n" + "\n".join(bad))
+        # the grammar makes reasons mandatory; assert the invariant held
+        for f in findings:
+            if f.suppressed:
+                assert f.suppression.reason.strip()
+
+    def test_gate_covers_known_incident_shapes(self):
+        """The gate is only worth its runtime if the rules still catch
+        the original incidents — re-lint the PR 2 fixture here so a
+        future rule refactor can't silently hollow the gate out."""
+        assert "DF003" in codes(run_lint(PR2_DEADLOCK))
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -263,42 +263,9 @@ class TestFaultgate:
         assert faultgate.status() == {"armed": False, "scripts": []}
 
 
-class TestFaultgateLint:
-    """Tier-1 hygiene: every registered site is fired somewhere in the
-    tree, every fired name is registered, and every site is documented in
-    docs/RESILIENCE.md (mirrors the PR-1 metric-namespace lint)."""
-
-    def test_sites_fired_and_registered(self):
-        pat = re.compile(
-            r"faultgate\.(?:fire|fire_sync|corrupt)\(\s*[\"']([a-z.]+)[\"']")
-        fired: set[str] = set()
-        pkg = os.path.join(REPO, "dragonfly2_tpu")
-        for dirpath, _dirs, files in os.walk(pkg):
-            for name in files:
-                if not name.endswith(".py") or name == "faultgate.py":
-                    continue
-                with open(os.path.join(dirpath, name), encoding="utf-8") as f:
-                    fired.update(pat.findall(f.read()))
-        assert fired == set(faultgate.SITES), (
-            f"faultgate sites out of sync: fired-but-unregistered="
-            f"{fired - faultgate.SITES}, registered-but-never-fired="
-            f"{faultgate.SITES - fired}")
-
-    def test_sites_documented(self):
-        doc_path = os.path.join(REPO, "docs", "RESILIENCE.md")
-        with open(doc_path, encoding="utf-8") as f:
-            doc = f.read()
-        missing = [s for s in sorted(faultgate.SITES) if f"`{s}`" not in doc]
-        assert not missing, f"sites missing from docs/RESILIENCE.md: {missing}"
-
-    def test_rung_names_documented(self):
-        from dragonfly2_tpu.daemon import flight_recorder as fr
-        with open(os.path.join(REPO, "docs", "RESILIENCE.md"),
-                  encoding="utf-8") as f:
-            doc = f.read()
-        for rung in (fr.RUNG_P2P, fr.RUNG_RESCHEDULE, fr.RUNG_RING_FAILOVER,
-                     fr.RUNG_PEX, fr.RUNG_BACK_SOURCE, fr.RUNG_FAIL):
-            assert f"`{rung}`" in doc, rung
+# The faultgate-site and rung-name lints that lived here moved into
+# dflint as DF006 rules (tests/test_dflint.py gates them tier-1; see
+# docs/ANALYSIS.md) — same sweep, now in the one shared rule engine.
 
 
 # ----------------------------------------------------------------------

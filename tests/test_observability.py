@@ -235,99 +235,11 @@ class TestExpositionStrictness:
         assert r.counter("df_dup_total", "x") is not None
 
 
-def _import_all_metric_modules():
-    """Every module that registers process metrics — the lint's universe."""
-    import importlib
-
-    for mod in (
-            "dragonfly2_tpu.common.faultgate",
-            "dragonfly2_tpu.common.gc",
-            "dragonfly2_tpu.common.health",
-            "dragonfly2_tpu.daemon.daemon",
-            "dragonfly2_tpu.daemon.flight_recorder",
-            "dragonfly2_tpu.daemon.proxy",
-            "dragonfly2_tpu.daemon.objectstorage",
-            "dragonfly2_tpu.daemon.piece_dispatcher",
-            "dragonfly2_tpu.daemon.piece_engine",
-            "dragonfly2_tpu.daemon.pex",
-            "dragonfly2_tpu.daemon.swarm_index",
-            "dragonfly2_tpu.daemon.scheduler_session",
-            "dragonfly2_tpu.daemon.traffic_shaper",
-            "dragonfly2_tpu.daemon.upload_server",
-            "dragonfly2_tpu.rpc.mux",
-            "dragonfly2_tpu.scheduler.service",
-            "dragonfly2_tpu.scheduler.cluster_view",
-            "dragonfly2_tpu.manager.server",
-            "dragonfly2_tpu.trainer.server",
-            "dragonfly2_tpu.tpu.hbm_sink",
-    ):
-        importlib.import_module(mod)
-
-
-class TestMetricNamespaceLint:
-    def test_registry_hygiene_after_importing_all_services(self):
-        """Walk the process REGISTRY with every service imported: all
-        metrics df_-prefixed, none with empty help (the /metrics surface
-        must stay self-describing as it grows)."""
-        _import_all_metric_modules()
-        from dragonfly2_tpu.common.metrics import REGISTRY
-        metrics = list(REGISTRY._metrics.values())
-        assert metrics, "no metrics registered?"
-        bad_prefix = [m.name for m in metrics
-                      if not m.name.startswith("df_")]
-        assert not bad_prefix, f"non-df_ metric names: {bad_prefix}"
-        empty_help = [m.name for m in metrics if not m.help.strip()]
-        assert not empty_help, f"metrics with empty help: {empty_help}"
-
-    def test_every_registered_metric_is_documented(self):
-        """The docs/OBSERVABILITY.md catalogue must cover the registry: a
-        metric that exists only in code is invisible to operators, and
-        the PR-3 audit found the doc trailing the code by a third."""
-        import re
-
-        _import_all_metric_modules()
-        from dragonfly2_tpu.common.metrics import REGISTRY
-        doc = open(os.path.join(os.path.dirname(__file__), os.pardir,
-                                "docs", "OBSERVABILITY.md"),
-                   encoding="utf-8").read()
-        documented = set(re.findall(r"df_[a-z0-9_]+", doc))
-        missing = sorted(m for m in REGISTRY._metrics
-                         if m not in documented)
-        assert not missing, (
-            f"metrics registered in code but absent from "
-            f"docs/OBSERVABILITY.md: {missing}")
-
-    def test_every_flight_event_kind_and_rung_documented(self):
-        """Same contract as the metric catalogue, for the flight
-        recorder's vocabulary: every event kind the journal can emit and
-        every degradation-ladder rung name must appear backticked in the
-        docs (event kinds in OBSERVABILITY.md; rung names there or in
-        RESILIENCE.md, where the ladder lives) — an undocumented stage
-        in a /debug/flight dump is a surface operators cannot read."""
-        import re
-
-        from dragonfly2_tpu.daemon import flight_recorder as fr
-        docs_dir = os.path.join(os.path.dirname(__file__), os.pardir,
-                                "docs")
-        obs = open(os.path.join(docs_dir, "OBSERVABILITY.md"),
-                   encoding="utf-8").read()
-        res = open(os.path.join(docs_dir, "RESILIENCE.md"),
-                   encoding="utf-8").read()
-        kinds = {v for k, v in vars(fr).items()
-                 if k.isupper() and isinstance(v, str) and v
-                 and not k.startswith("RUNG_")}
-        rungs = {v for k, v in vars(fr).items() if k.startswith("RUNG_")}
-        assert kinds and rungs, "flight_recorder vocabulary went missing?"
-        ticked_obs = set(re.findall(r"`([a-z0-9_.]+)`", obs))
-        ticked_any = ticked_obs | set(re.findall(r"`([a-z0-9_.]+)`", res))
-        missing_kinds = sorted(kinds - ticked_obs)
-        assert not missing_kinds, (
-            f"flight event kinds emitted in code but absent from "
-            f"docs/OBSERVABILITY.md: {missing_kinds}")
-        missing_rungs = sorted(rungs - ticked_any)
-        assert not missing_rungs, (
-            f"ladder rung names emitted in code but undocumented: "
-            f"{missing_rungs}")
+# The metric-catalogue and flight-vocabulary lints that lived here
+# (PR 1 namespace lint, PR 3 catalogue lint) moved into dflint as DF006
+# rules — one registry, one walker, one output format. The tier-1 gate
+# is tests/test_dflint.py::TestTier1Gate; the rule catalogue is
+# docs/ANALYSIS.md.
 
 
 class TestShaperMetrics:

@@ -57,9 +57,14 @@ class _HostAgg:
 
 
 class ClusterView:
-    def __init__(self) -> None:
+    def __init__(self, ledger=None) -> None:
         self._hosts: dict[str, _HostAgg] = {}
         self.started_at = time.time()
+        # decision ledger (scheduler/decision_ledger.py): its compact
+        # counters ride the cluster snapshot so /debug/cluster answers
+        # "is the pod herding onto no-slots/bad-node exclusions" next to
+        # the throughput it is costing
+        self.ledger = ledger
 
     def _agg(self, host_id: str) -> _HostAgg:
         agg = self._hosts.get(host_id)
@@ -140,7 +145,7 @@ class ClusterView:
                 "last_seen": a.last_seen,
                 "last_flight": a.last_flight,
             }
-        return {
+        snap = {
             "since": self.started_at,
             "hosts": hosts,
             "bytes_p2p": p2p,
@@ -149,6 +154,9 @@ class ClusterView:
                                      if (p2p + src) else 0.0),
             "stragglers": self.stragglers(),
         }
+        if self.ledger is not None:
+            snap["decisions"] = self.ledger.stats()
+        return snap
 
 
 def add_cluster_routes(router, view: ClusterView) -> None:

@@ -32,7 +32,35 @@ W_FREE_UPLOAD = 0.15
 W_HOST_TYPE = 0.15
 W_LOCALITY = 0.30
 
+# (term name, weight) in evaluate()'s exact summation order — the decision
+# ledger's explain() and the dfbench --pr8 offline replay both rebuild the
+# total from these, and floats only stay bit-identical to evaluate() when
+# the summation order matches
+SCORE_TERMS = (
+    ("piece", W_PIECE),
+    ("upload_success", W_UPLOAD_SUCCESS),
+    ("free_upload", W_FREE_UPLOAD),
+    ("host_type", W_HOST_TYPE),
+    ("locality", W_LOCALITY),
+)
+
 BAD_NODE_Z = 3.0                 # reference uses 3-sigma piece-cost outliers
+
+
+def weighted_total(terms: dict) -> float:
+    """Weighted sum over SCORE_TERMS in declaration order (== the order
+    ``evaluate`` adds them, so a rebuilt total is bit-identical)."""
+    total = 0.0
+    for name, weight in SCORE_TERMS:
+        total += weight * terms[name]
+    return total
+
+
+def rtt_locality_score(rtt_us: float) -> float:
+    """Measured-RTT locality mapping shared by the live ``nt`` evaluator
+    and the offline decision replay: <=50us (ICI neighborhood) ~1.0,
+    10ms ~0.1 (reference ``evaluator_network_topology.go:30-57``)."""
+    return max(0.05, min(1.0, 50.0 / max(rtt_us, 50.0) + 0.05))
 
 
 class Evaluator:
@@ -40,11 +68,29 @@ class Evaluator:
 
     def evaluate(self, child: Peer, parent: Peer, *,
                  total_piece_count: int) -> float:
-        return (W_PIECE * self._piece_score(parent, total_piece_count)
-                + W_UPLOAD_SUCCESS * parent.host.upload_success_ratio()
-                + W_FREE_UPLOAD * self._free_upload_score(parent)
-                + W_HOST_TYPE * self._host_type_score(parent)
-                + W_LOCALITY * self._locality_score(child, parent))
+        return weighted_total(self._term_scores(
+            child, parent, total_piece_count=total_piece_count))
+
+    def _term_scores(self, child: Peer, parent: Peer, *,
+                     total_piece_count: int) -> dict:
+        return {
+            "piece": self._piece_score(parent, total_piece_count),
+            "upload_success": parent.host.upload_success_ratio(),
+            "free_upload": self._free_upload_score(parent),
+            "host_type": self._host_type_score(parent),
+            "locality": self._locality_score(child, parent),
+        }
+
+    def explain(self, child: Peer, parent: Peer, *,
+                total_piece_count: int) -> dict:
+        """Per-term score decomposition for the decision ledger:
+        ``{"terms": {name: raw score}, "total": float}`` where ``total``
+        is bit-identical to ``evaluate()`` on the same state. Variants
+        annotate what they substituted (``nt``: the locality term from
+        measured RTT; ``ml``: the whole total from the served model)."""
+        terms = self._term_scores(child, parent,
+                                  total_piece_count=total_piece_count)
+        return {"terms": terms, "total": weighted_total(terms)}
 
     # -- individual scores --------------------------------------------
 
@@ -109,8 +155,20 @@ class RTTEvaluator(Evaluator):
         rtt_us = self.topo.avg_rtt_us(child.host.id, parent.host.id)
         if rtt_us is None:
             return Evaluator._locality_score(child, parent)
-        # map RTT to (0,1]: <=50us (ICI neighborhood) ~1.0, 10ms ~0.1
-        return max(0.05, min(1.0, 50.0 / max(rtt_us, 50.0) + 0.05))
+        return rtt_locality_score(rtt_us)
+
+    def explain(self, child: Peer, parent: Peer, *,
+                total_piece_count: int) -> dict:
+        out = super().explain(child, parent,
+                              total_piece_count=total_piece_count)
+        rtt_us = self.topo.avg_rtt_us(child.host.id, parent.host.id)
+        if rtt_us is not None:
+            # the locality term above already carries the RTT-derived
+            # score; record that it was measured, and the measurement, so
+            # the offline replay can re-map it instead of synthesizing one
+            out["substituted"] = {"locality": "rtt"}
+            out["rtt_us"] = rtt_us
+        return out
 
 
 def make_evaluator(algorithm: str, *, topo_store=None, infer=None,
@@ -140,3 +198,13 @@ class _PluginEvaluator(Evaluator):
     def evaluate(self, child, parent, *, total_piece_count: int) -> float:
         return float(self.impl.evaluate(
             child, parent, total_piece_count=total_piece_count))
+
+    def explain(self, child, parent, *, total_piece_count: int) -> dict:
+        # base terms stay as context; the ruling total is the plugin's
+        out = super().explain(child, parent,
+                              total_piece_count=total_piece_count)
+        out["base_total"] = out["total"]
+        out["total"] = self.evaluate(child, parent,
+                                     total_piece_count=total_piece_count)
+        out["substituted"] = {"total": "plugin"}
+        return out

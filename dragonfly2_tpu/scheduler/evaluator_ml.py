@@ -63,6 +63,30 @@ class MLEvaluator(Evaluator):
         return super().evaluate(child, parent,
                                 total_piece_count=total_piece_count)
 
+    def explain(self, child: Peer, parent: Peer, *,
+                total_piece_count: int) -> dict:
+        """Decision-ledger decomposition: base terms stay for context;
+        when the served model answered, the total is the model's and the
+        row says so (``substituted: {"total": "ml"}``, heuristic total
+        preserved as ``base_total``). Mirrors ``evaluate``'s control flow
+        exactly — including the fallback — so the logged total is always
+        the score the ranking actually used."""
+        out = super().explain(child, parent,
+                              total_piece_count=total_piece_count)
+        if self.infer is not None:
+            try:
+                row = self.feature_row(child, parent,
+                                       total_piece_count=total_piece_count)
+                pred = self.infer([row])
+                if pred:
+                    out["base_total"] = out["total"]
+                    out["total"] = float(pred[0])
+                    out["substituted"] = {"total": "ml"}
+            except Exception as exc:  # noqa: BLE001 - model serving is optional
+                log.debug("ml inference failed (%s); explaining base score",
+                          exc)
+        return out
+
     def feature_row(self, child: Peer, parent: Peer, *,
                     total_piece_count: int) -> list[float]:
         return parent_feature_row(child, parent,

@@ -20,11 +20,23 @@ import logging
 import os
 import time
 
+from ..common.metrics import REGISTRY
 from ..trainer.features import FEATURE_DIM, label_from_cost
 from .evaluator_ml import parent_feature_row
 from .resource import Peer
 
 log = logging.getLogger("df.sched.records")
+
+_rows_total = REGISTRY.counter(
+    "df_records_rows_total", "record rows appended to the ring", ("kind",))
+_dropped = REGISTRY.counter(
+    "df_records_dropped_total",
+    "record rows dropped by the drop-oldest ring bound")
+_flush_failures = REGISTRY.counter(
+    "df_records_flush_failures_total",
+    "record-file flush batches that failed (rows lost from the file copy)")
+_rotations = REGISTRY.counter(
+    "df_records_rotations_total", "download.jsonl size rotations")
 
 MAX_BUFFERED_ROWS = 50_000          # ring bound: drop-oldest beyond this
 ROTATE_BYTES = 64 << 20             # rotate download.jsonl past 64 MiB
@@ -55,6 +67,7 @@ class DownloadRecords:
         if os.path.exists(path) and os.path.getsize(path) > ROTATE_BYTES:
             # dflint: disable=DF001 — rare size-boundary rotation, metadata syscall
             os.replace(path, path + ".1")
+            _rotations.inc()
         # dflint: disable=DF001 — append-mode open once per rotation window
         self._file = open(path, "a", encoding="utf-8")
         self._file_bytes = self._file.tell()
@@ -77,6 +90,9 @@ class DownloadRecords:
             "task_id": peer.task.id,
             "peer_id": peer.id,
             "host_id": peer.host.id,
+            # join key to the kind=decision row whose offer this piece
+            # acted on (the child's newest ruling at scoring time)
+            "decision_id": peer.last_decision_id,
             "parent_peer_id": parent.id,
             "parent_host_id": parent.host.id,
             "piece_num": info.piece_num,
@@ -135,18 +151,33 @@ class DownloadRecords:
             edge["created_at"] = now
             self._append_peer_row(edge)
 
+    def on_decision(self, row: dict) -> None:
+        """One row per scheduler ruling (``Scheduling._decide`` via the
+        decision ledger): the candidate set with per-term decomposition,
+        exclusions, and the chosen offer — the decision half that
+        ``kind=piece``/``kind=edge`` outcome rows join against."""
+        if "created_at" not in row:
+            row = dict(row)
+            row["created_at"] = time.time()
+        self._append_peer_row(row)
+
     # -- internals -----------------------------------------------------
 
     def _append_peer_row(self, row: dict) -> None:
-        """Ring-append a non-piece (peer/flight) row + buffer its line."""
+        """Ring-append a non-piece (peer/flight/edge/decision) row +
+        buffer its line."""
         self._peer_rows.append(row)
+        _rows_total.labels(str(row.get("kind", ""))).inc()
         if len(self._peer_rows) > MAX_BUFFERED_ROWS:
+            _dropped.inc(len(self._peer_rows) - MAX_BUFFERED_ROWS)
             self._peer_rows = self._peer_rows[-MAX_BUFFERED_ROWS:]
         self._write(row)
 
     def _append(self, row: dict) -> None:
         self._rows.append(row)
+        _rows_total.labels(str(row.get("kind", ""))).inc()
         if len(self._rows) > MAX_BUFFERED_ROWS:
+            _dropped.inc(len(self._rows) - MAX_BUFFERED_ROWS)
             self._rows = self._rows[-MAX_BUFFERED_ROWS:]
         self._write(row)
 
@@ -207,7 +238,15 @@ class DownloadRecords:
         if self._file is None:
             return
         data = "".join(batch)
-        self._file.write(data)
+        try:
+            self._file.write(data)
+        except (OSError, ValueError):
+            # counted at the raise site so every flush path (batch task,
+            # timer, sync fallback, close) is covered; ValueError is the
+            # closed-file race. The batch is lost from the FILE copy only
+            # — the ring already holds the rows
+            _flush_failures.inc()
+            raise
         self._file_bytes += len(data)
         if self._file_bytes > ROTATE_BYTES:
             self._file.close()
@@ -229,7 +268,13 @@ class DownloadRecords:
         """Return drained rows after a failed upload (oldest first; the
         ring bound still applies)."""
         piece = [r for r in rows if r.get("kind") == "piece"]
-        peer = [r for r in rows if r.get("kind") != "piece"]  # peer + flight
+        # peer + flight + edge + decision
+        peer = [r for r in rows if r.get("kind") != "piece"]
+        over = (max(0, len(piece) + len(self._rows) - MAX_BUFFERED_ROWS)
+                + max(0, len(peer) + len(self._peer_rows)
+                      - MAX_BUFFERED_ROWS))
+        if over:
+            _dropped.inc(over)
         self._rows = (piece + self._rows)[-MAX_BUFFERED_ROWS:]
         self._peer_rows = (peer + self._peer_rows)[-MAX_BUFFERED_ROWS:]
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import random
 
+from ..common.metrics import REGISTRY
 from ..idl.messages import PeerAddr, PeerPacket
 from ..tpu.topology import link_type
 from .config import SchedulerConfig
@@ -20,15 +21,35 @@ from .resource import Peer
 
 log = logging.getLogger("df.sched.core")
 
+_filter_excluded = REGISTRY.counter(
+    "df_sched_filter_excluded_total",
+    "candidate parents excluded by the scheduling filter", ("reason",))
+
+# The filter's exclusion-reason vocabulary. Every reason ``_trace`` fires
+# must be registered here and documented in docs/OBSERVABILITY.md — a pod
+# herding onto ``no-slots`` or ``bad-node`` shows up in the counter above
+# and in decision-row ``excluded`` entries, and an undocumented reason is
+# a surface operators cannot read (dflint DF006 decision-vocabulary).
+EXCLUSION_REASONS = ("stream-gone", "blocklist", "no-slots", "bad-node",
+                     "cycle")
+
 
 class Scheduling:
     def __init__(self, cfg: SchedulerConfig, evaluator: Evaluator):
         self.cfg = cfg
         self.evaluator = evaluator
+        # decision ledger hook: callable(row dict) receiving one
+        # ``kind=decision`` row per find/refresh ruling. None (default)
+        # skips ALL ledger work — scoring then runs the exact pre-ledger
+        # path, which is how dfbench's baseline schedule_digest stays
+        # byte-identical with the ledger code in the tree.
+        self.decision_sink = None
+        self._decision_seq = 0
 
     # ------------------------------------------------------------------
 
-    def filter_candidates(self, child: Peer) -> list[Peer]:
+    def filter_candidates(self, child: Peer,
+                          excluded: list | None = None) -> list[Peer]:
         """All legal parents for ``child``, pre-scoring (the filter half).
 
         The pool is sampled in random order (reference ``LoadRandomPeers``,
@@ -55,10 +76,10 @@ class Scheduling:
                 # mid-download peer whose report stream died: almost
                 # certainly a dead process — offering it strands children
                 # on a parent that will never answer (chaos e2e)
-                self._trace(child, parent, "stream-gone")
+                self._trace(child, parent, "stream-gone", excluded)
                 continue
             if child.is_blocked(parent.id):
-                self._trace(child, parent, "blocklist")
+                self._trace(child, parent, "blocklist", excluded)
                 continue
             if not parent.has_content() and parent.is_done():
                 # finished-but-empty (failed) peers serve nothing. RUNNING
@@ -77,19 +98,25 @@ class Scheduling:
             # engine's packet prune would then tear down their sync streams
             if (parent.host.free_upload_slots() <= 0
                     and parent.id not in child.last_offer_ids):
-                self._trace(child, parent, "no-slots")
+                self._trace(child, parent, "no-slots", excluded)
                 continue
             if self.evaluator.is_bad_node(parent):
-                self._trace(child, parent, "bad-node")
+                self._trace(child, parent, "bad-node", excluded)
                 continue
             if task.would_cycle(parent.id, child.id):
-                self._trace(child, parent, "cycle")
+                self._trace(child, parent, "cycle", excluded)
                 continue
             out.append(parent)
         return out
 
     @staticmethod
-    def _trace(child: Peer, parent: Peer, reason: str) -> None:
+    def _trace(child: Peer, parent: Peer, reason: str,
+               excluded: list | None = None) -> None:
+        """One exclusion: counted always, collected for the decision row
+        when the ledger is armed, logged only at DEBUG."""
+        _filter_excluded.labels(reason).inc()
+        if excluded is not None:
+            excluded.append((parent, reason))
         if log.isEnabledFor(logging.DEBUG):
             log.debug("filter %s: parent %s excluded (%s)",
                       child.id[-12:], parent.id[-12:], reason)
@@ -107,35 +134,116 @@ class Scheduling:
         return [*top[:-1], holder] if top else [holder]
 
     def find_parents(self, child: Peer) -> list[Peer]:
-        candidates = self.filter_candidates(child)
-        if not candidates:
-            return []
-        total = child.task.total_piece_count
-        scored = sorted(
-            candidates,
-            key=lambda p: self.evaluator.evaluate(child, p,
-                                                  total_piece_count=total),
-            reverse=True)
-        return self._ensure_holder(scored,
-                                   scored[:self.cfg.candidate_parent_limit])
+        return self._decide(child, "find")
 
     def refresh_parents(self, child: Peer) -> list[Peer]:
         """Sticky variant of ``find_parents`` for mid-download re-offers:
         current parents that are still legal stay, best newcomers fill the
         remaining candidate slots."""
-        candidates = self.filter_candidates(child)
-        if not candidates:
-            return []
+        return self._decide(child, "refresh")
+
+    def _decide(self, child: Peer, decision_kind: str) -> list[Peer]:
+        """Filter, score, choose — and, when the decision ledger is armed,
+        emit one ``kind=decision`` row carrying the full candidate set with
+        per-term score decomposition, every exclusion with its reason, and
+        the chosen offer. PURE OBSERVATION: with the sink armed the ranking
+        key is ``explain()["total"]``, which is bit-identical to
+        ``evaluate()`` (same term computations, same summation order), and
+        ``sorted(..., reverse=True)`` is stable either way — the offer, and
+        therefore the schedule digest, cannot move (gated by
+        tests/test_dfbench.py on the PR-3 baseline)."""
+        sink = self.decision_sink
+        excluded: list | None = [] if sink is not None else None
+        candidates = self.filter_candidates(child, excluded)
         total = child.task.total_piece_count
-        scored = sorted(
-            candidates,
-            key=lambda p: self.evaluator.evaluate(child, p,
-                                                  total_piece_count=total),
-            reverse=True)
-        kept = [p for p in scored if p.id in child.last_offer_ids]
-        fresh = [p for p in scored if p.id not in child.last_offer_ids]
-        return self._ensure_holder(
-            scored, (kept + fresh)[:self.cfg.candidate_parent_limit])
+        explained: list[tuple[Peer, dict]] = []
+        prev_offer = set(child.last_offer_ids)
+        if not candidates:
+            offer: list[Peer] = []
+        else:
+            if sink is None:
+                scored = sorted(
+                    candidates,
+                    key=lambda p: self.evaluator.evaluate(
+                        child, p, total_piece_count=total),
+                    reverse=True)
+            else:
+                explained = [(p, self.evaluator.explain(
+                    child, p, total_piece_count=total))
+                    for p in candidates]
+                explained.sort(key=lambda pe: pe[1]["total"], reverse=True)
+                scored = [p for p, _ in explained]
+            if decision_kind == "refresh":
+                kept = [p for p in scored if p.id in prev_offer]
+                fresh = [p for p in scored if p.id not in prev_offer]
+                offer = self._ensure_holder(
+                    scored, (kept + fresh)[:self.cfg.candidate_parent_limit])
+            else:
+                offer = self._ensure_holder(
+                    scored, scored[:self.cfg.candidate_parent_limit])
+        if sink is not None:
+            self._emit_decision(child, decision_kind, explained,
+                                excluded or [], offer, prev_offer, total)
+        return offer
+
+    def _emit_decision(self, child: Peer, decision_kind: str,
+                       explained: list, excluded: list, offer: list[Peer],
+                       prev_offer: set, total: int) -> None:
+        self._decision_seq += 1
+        decision_id = f"d{self._decision_seq:08d}.{child.id[-12:]}"
+        candidates = []
+        for rank, (p, ex) in enumerate(explained, 1):
+            terms = ex["terms"]
+            # the exact scoring-time feature row (trainer layout:
+            # evaluator_ml.parent_feature_row), rebuilt from the terms
+            # explain() already computed instead of re-scoring every
+            # candidate — same staticmethod outputs, half the hot-path
+            # cost. features[4] must stay the STATIC locality (the
+            # train/serve contract): when the nt evaluator substituted
+            # measured RTT into the locality term, recompute the base
+            # score for the row
+            locality = terms["locality"]
+            if "locality" in (ex.get("substituted") or {}):
+                locality = Evaluator._locality_score(child, p)
+            cand = {
+                "peer_id": p.id,
+                "host_id": p.host.id,
+                "rank": rank,
+                "total": ex["total"],
+                "terms": terms,
+                "features": [terms["piece"], terms["upload_success"],
+                             terms["free_upload"], terms["host_type"],
+                             locality, float(len(p.finished_pieces)),
+                             float(p.host.concurrent_upload_count)],
+            }
+            for key in ("substituted", "rtt_us", "base_total"):
+                if key in ex:
+                    cand[key] = ex[key]
+            candidates.append(cand)
+        row = {
+            "kind": "decision",
+            "decision_id": decision_id,
+            "decision_kind": decision_kind,
+            "task_id": child.task.id,
+            "peer_id": child.id,
+            "host_id": child.host.id,
+            "total_piece_count": total,
+            "evaluator": type(self.evaluator).__name__,
+            "candidates": candidates,
+            "excluded": [{"peer_id": p.id, "host_id": p.host.id,
+                          "reason": reason} for p, reason in excluded],
+            "chosen": [p.id for p in offer],
+        }
+        if decision_kind == "refresh":
+            # sticky attribution of the final offer: which slots the
+            # stickiness held vs which the newcomers won
+            row["kept"] = [p.id for p in offer if p.id in prev_offer]
+            row["fresh"] = [p.id for p in offer if p.id not in prev_offer]
+        if offer:
+            # join key for outcome rows: records.on_piece stamps each piece
+            # row with the child's newest ruling (see records.py)
+            child.last_decision_id = decision_id
+        self.decision_sink(row)
 
     # ------------------------------------------------------------------
 

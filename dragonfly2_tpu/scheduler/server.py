@@ -39,9 +39,15 @@ class Scheduler:
         if records is None and (cfg.records_dir or cfg.trainer_address):
             from .records import DownloadRecords
             records = DownloadRecords(cfg.records_dir)
+        # decision ledger: every find/refresh ruling explained — live ring
+        # at GET /debug/decisions, kind=decision rows into records (when
+        # records are on) for the outcome join + dfbench --pr8 replay
+        from .decision_ledger import DecisionLedger
+        self.ledger = DecisionLedger(records=records)
+        self.scheduling.decision_sink = self.ledger.on_decision
         self.service = SchedulerService(cfg, self.resource, self.scheduling,
                                         self.seed_client, self.topo,
-                                        records=records)
+                                        records=records, ledger=self.ledger)
         self.announcer = None
         self.rpc: RPCServer | None = None
         self.gc = GC()

@@ -59,14 +59,15 @@ SEED_RETRIGGER_BACKOFF_CAP_S = 30.0
 class SchedulerService:
     def __init__(self, cfg: SchedulerConfig, resource: Resource,
                  scheduling: Scheduling, seed_client: SeedPeerClient,
-                 topo: TopologyStore, *, records=None):
+                 topo: TopologyStore, *, records=None, ledger=None):
         self.cfg = cfg
         self.resource = resource
         self.scheduling = scheduling
         self.seed_client = seed_client
         self.topo = topo
         self.records = records          # download-record sink (trainer dataset)
-        self.cluster = ClusterView()    # pod-wide health (GET /debug/cluster)
+        self.ledger = ledger            # decision ledger (GET /debug/decisions)
+        self.cluster = ClusterView(ledger=ledger)  # GET /debug/cluster
         self._seed_tasks: set[asyncio.Task] = set()
         # application name -> Priority numeric, fed from the manager's
         # applications table (reference dynconfig.GetApplications); consulted

@@ -112,7 +112,8 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
               piece_size: int = 4 << 20, parallelism: int = 4,
               scenario: str = "baseline",
               collect_timeline: bool = False,
-              collect_podscope: bool = False) -> dict:
+              collect_podscope: bool = False,
+              collect_decisions: bool = False) -> dict:
     """Run one simulated fan-out; returns the result dict (pure function
     of its arguments — no wall clock, no global state beyond the process
     metrics registry the flight summaries touch). ``scenario`` switches
@@ -120,7 +121,13 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     sequence as before the scenario knob existed, so the PR-3 schedule
     digest is stable). ``collect_podscope`` attaches per-daemon snapshots
     in the ``common/podscope.py`` shape (a pure readout of the flights —
-    never in the rng path, so the digest cannot move)."""
+    never in the rng path, so the digest cannot move).
+    ``collect_decisions`` arms the REAL decision ledger hook
+    (``Scheduling.decision_sink``) and attaches the ``kind=decision``
+    rows — explain() totals are bit-identical to evaluate() and the sink
+    never touches the rng, so the digest cannot move (gated in
+    tests/test_dfbench.py); these rows feed the --pr8 counterfactual
+    replay."""
     if scenario not in SCENARIOS:
         raise ValueError(f"unknown scenario {scenario!r} "
                          f"(known: {SCENARIOS})")
@@ -145,6 +152,9 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     task = Task("bench" + "0" * 59, "bench://blob")
     task.set_content_info(pieces * piece_size, piece_size, pieces)
     sched = Scheduling(SchedulerConfig(), make_evaluator("default"))
+    decision_rows: list[dict] = []
+    if collect_decisions:
+        sched.decision_sink = decision_rows.append
 
     def topo(slice_name: str, x: int, y: int) -> TopologyInfo:
         return TopologyInfo(slice_name=slice_name, ici_coords=(x, y),
@@ -365,6 +375,8 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     if collect_timeline:
         result["timeline"] = {lc.peer.id: sorted(lc.timeline)
                               for lc in leechers}
+    if collect_decisions:
+        result["decisions"] = decision_rows
     if collect_podscope:
         # per-daemon snapshots in the podscope shape, on one shared
         # virtual epoch (started_at=0: the sim's event t_ms values are
@@ -651,6 +663,48 @@ def _run_pr6(args) -> dict:
     }
 
 
+def _run_pr8(args) -> dict:
+    """The PR-8 trajectory point: decision-ledger purity + counterfactual
+    replay. One baseline sim (digest byte-identical to BENCH_pr3 — the
+    gate in tests/test_dfbench.py), one ledger-armed sim of the SAME seed
+    proving the ledger is pure observation (``ledger_pure``), then the
+    logged candidate sets re-scored entirely offline under each replay
+    evaluator (default vs nt vs ml, scheduler/decision_ledger.py):
+    rank-agreement / choice-flip rates per pair, each evaluator's
+    agreement with the logged choice, and a deterministic
+    ``decision_digest`` — the offline A/B harness ROADMAP item 1's
+    learned evaluator will be judged against before it serves traffic."""
+    from ..scheduler.decision_ledger import replay_decisions
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    led = run_bench(seed=args.seed, daemons=args.daemons,
+                    pieces=args.pieces, piece_size=args.piece_size,
+                    parallelism=args.parallelism, collect_decisions=True)
+    decisions = led["decisions"]
+    replay = replay_decisions(decisions)
+    return {
+        "bench": "dfbench-decisions",
+        "seed": args.seed,
+        "daemons": args.daemons,
+        "pieces": args.pieces,
+        "piece_size": args.piece_size,
+        "parallelism": args.parallelism,
+        # byte-identical to BENCH_pr3 — AND to the ledger-armed run:
+        # the ledger observed every ruling without perturbing one
+        "schedule_digest": base["schedule_digest"],
+        "ledger_pure": (base["schedule_digest"]
+                        == led["schedule_digest"]),
+        "decision_rows": len(decisions),
+        "decisions_with_candidates": replay["decisions_scored"],
+        "excluded_rows": sum(len(d.get("excluded") or [])
+                             for d in decisions),
+        "cross_evaluator": replay["pairs"],
+        "logged_choice_agreement": replay["logged_choice_agreement"],
+        "decision_digest": replay["decision_digest"],
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -676,6 +730,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "amplification, per-edge p95) and write the PR-6 "
                    "trajectory point (BENCH_pr6.json); the baseline "
                    "schedule digest stays byte-identical to BENCH_pr3")
+    p.add_argument("--pr8", action="store_true",
+                   help="replay the baseline run's decision-ledger rows "
+                   "through every offline evaluator (default/nt/ml) and "
+                   "write the PR-8 trajectory point (BENCH_pr8.json): "
+                   "rank-agreement + choice-flip rates, a deterministic "
+                   "decision_digest, and a ledger-purity check against "
+                   "the BENCH_pr3 schedule digest")
     p.add_argument("--out", default="",
                    help="result path ('-' = stdout only; default "
                    "BENCH_pr3.json, or BENCH_pr<N>.json with --pr<N>)")
@@ -713,7 +774,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr6:
+        if args.pr8:
+            args.out = "BENCH_pr8.json"
+        elif args.pr6:
             args.out = "BENCH_pr6.json"
         elif args.pr5:
             args.out = "BENCH_pr5.json"
@@ -725,7 +788,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr6:
+    if args.pr8:
+        result = _run_pr8(args)
+    elif args.pr6:
         result = _run_pr6(args)
     elif args.pr5:
         result = _run_pr5(args)
@@ -740,7 +805,17 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr6:
+        if args.pr8:
+            cross = result["cross_evaluator"]
+            print(f"dfbench: wrote {args.out} "
+                  f"({result['decision_rows']} decision rows, ledger "
+                  f"{'pure' if result['ledger_pure'] else 'IMPURE'}, "
+                  + ", ".join(
+                      f"{pair} agree={v['rank_agreement']:.2f}/"
+                      f"flip={v['choice_flip_rate']:.2f}"
+                      for pair, v in cross.items())
+                  + f", decisions {result['decision_digest'][:12]})")
+        elif args.pr6:
             amp = result["amplification"]
             depth = result["tree_depth"]
             print(f"dfbench: wrote {args.out} (pod makespan baseline="

@@ -216,6 +216,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="list recorded flights on the daemon")
     p.add_argument("--cluster", action="store_true",
                    help="show the scheduler's cluster health view")
+    p.add_argument("--decisions", action="store_true",
+                   help="show the scheduler's live decision ledger "
+                   "(/debug/decisions on --scheduler): recent rulings "
+                   "with per-term score decomposition and exclusions — "
+                   "tools/dfsched.py is the full inspector with outcome "
+                   "joins over a records file")
     p.add_argument("--pod", default="",
                    help="comma-separated daemon upload host:port set — "
                    "render the podscope distribution tree (per-edge "
@@ -250,6 +256,24 @@ def main(argv: list[str] | None = None) -> int:
             if len(report["unreachable"]) == len(addrs):
                 return EXIT_IO          # nothing answered: not a verdict
             return EXIT_BREACH if report["breaches"] else EXIT_OK
+        if args.decisions:
+            if not args.scheduler:
+                print("dfdiag: --decisions needs --scheduler host:port "
+                      "(the scheduler's --debug-port)", file=sys.stderr)
+                return EXIT_USAGE
+            from .dfsched import render_decision
+            q = f"?task={args.task_id}" if args.task_id else ""
+            snap = _get(
+                f"http://{args.scheduler}/debug/decisions{q}", args.timeout)
+            if args.json:
+                print(json.dumps(snap, indent=2))
+                return EXIT_OK
+            rows = snap.get("decisions") or []
+            for d in rows[-8:]:
+                print(render_decision(d))
+                print()
+            print(f"ledger: {json.dumps(snap.get('stats') or {})}")
+            return EXIT_OK
         if args.cluster:
             if not args.scheduler:
                 # the daemon upload port serves /debug/flight, never

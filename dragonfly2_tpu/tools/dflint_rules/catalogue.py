@@ -27,7 +27,7 @@ from .concurrency import _terminal
 _METRIC_METHODS = frozenset({"counter", "gauge", "histogram"})
 _FIRE_RE = re.compile(
     r"faultgate\.(?:fire|fire_sync|corrupt)\(\s*[\"']([a-z.]+)[\"']")
-_TICK_RE = re.compile(r"`([a-z0-9_.]+)`")
+_TICK_RE = re.compile(r"`([a-z0-9_.-]+)`")   # hyphens: exclusion reasons
 _METRIC_NAME_RE = re.compile(r"df_[a-z0-9_]+")
 
 
@@ -165,6 +165,81 @@ class FlightVocabulary(Rule):
                         f"flight event kind {value.value!r} ({tgt.id}) is "
                         f"emitted in flight journals but undocumented in "
                         f"docs/OBSERVABILITY.md")
+
+
+@register
+class DecisionVocabulary(Rule):
+    """DF006 (decision ledger): the scheduling filter's exclusion-reason
+    vocabulary must stay closed and documented — the ``EXCLUSION_REASONS``
+    registry in ``scheduler/scheduling.py``, the literal reasons passed to
+    ``Scheduling._trace`` (which become ``df_sched_filter_excluded_total``
+    labels and decision-row ``excluded`` entries), and the backticked
+    vocabulary in docs/OBSERVABILITY.md must agree. Same contract as the
+    flight-kind/rung and faultgate-site lints: an unregistered reason is
+    an invisible metric label, a registered-but-never-fired one is dead
+    vocabulary, and an undocumented one is a ledger surface operators
+    cannot read.
+
+    Incident (PR 8): filter exclusions survived only as DEBUG log lines —
+    a pod herding onto ``no-slots``/``bad-node`` was invisible without
+    redeploying at DEBUG, and nothing pinned the reason strings the
+    decision ledger now persists.
+    """
+
+    code = "DF006"
+    name = "decision-vocabulary"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if not ctx.rel.replace(os.sep, "/").endswith(
+                "scheduler/scheduling.py"):
+            return
+        declared: dict[str, int] = {}
+        declared_line = 1
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "EXCLUSION_REASONS"
+                            for t in node.targets)):
+                continue
+            declared_line = node.lineno
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) \
+                        and isinstance(const.value, str):
+                    declared[const.value] = const.lineno
+        fired: dict[str, int] = {}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_trace"
+                    and len(node.args) >= 3
+                    and isinstance(node.args[2], ast.Constant)
+                    and isinstance(node.args[2].value, str)):
+                continue
+            fired.setdefault(node.args[2].value, node.lineno)
+        if not declared and not fired:
+            return
+        obs = _ticked(ctx, "OBSERVABILITY.md")
+        for reason, line in sorted(declared.items()):
+            if reason not in fired:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"exclusion reason {reason!r} is registered in "
+                    f"EXCLUSION_REASONS but no _trace call fires it — "
+                    f"dead vocabulary")
+            if reason not in obs:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"exclusion reason {reason!r} is not documented in "
+                    f"docs/OBSERVABILITY.md — decision-row excluded "
+                    f"entries and the df_sched_filter_excluded_total "
+                    f"label are unreadable to operators")
+        for reason, line in sorted(fired.items()):
+            if reason not in declared:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"_trace fires exclusion reason {reason!r} but it is "
+                    f"not in the EXCLUSION_REASONS registry "
+                    f"(line {declared_line})")
 
 
 @register

@@ -1,0 +1,269 @@
+"""dfsched: explain scheduler rulings — decomposition, exclusions, payoff.
+
+Reads the decision ledger (scheduler/decision_ledger.py) and answers
+"why did child X get parent Y, what did the runner-up score, and how did
+the choice pay off": every ``kind=decision`` row is rendered with its
+per-term score breakdown next to each candidate's total, every
+filtered-out parent with its exclusion reason, sticky-refresh kept/fresh
+marks — and, when outcome rows are present, the pieces/bytes each chosen
+parent actually served plus the observed edge bandwidth beside the
+predicted rank.
+
+Sources:
+  --records PATH   a records JSONL file (or the directory holding
+                   download.jsonl; the rotated .1 half is read first) —
+                   decisions AND their kind=piece / kind=edge outcome
+                   rows, stitched offline;
+  --scheduler H:P  the live /debug/decisions ring on the scheduler's
+                   --debug-port (no outcome join: the ring holds rulings,
+                   the records file holds what happened next).
+
+Usage:
+    python -m dragonfly2_tpu.tools.dfsched --records records/ <task_id>
+    python -m dragonfly2_tpu.tools.dfsched --records download.jsonl --stats
+    python -m dragonfly2_tpu.tools.dfsched --scheduler 127.0.0.1:65100
+    python -m dragonfly2_tpu.tools.dfsched --records records/ --child f3a9
+
+Exit codes (CI contract, same shape as dfdiag): 0 ok, 1 fetch/IO
+failure, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..common.podscope import _fmt_bytes, _get_json
+from ..scheduler.decision_ledger import stitch_outcomes
+from ..scheduler.evaluator import SCORE_TERMS
+
+EXIT_OK = 0
+EXIT_IO = 1
+EXIT_USAGE = 2
+
+# rendered term columns, in weight-table order
+_TERM_COLS = tuple(name for name, _ in SCORE_TERMS)
+_TERM_HDR = {"piece": "piece", "upload_success": "upsucc",
+             "free_upload": "free", "host_type": "host",
+             "locality": "local"}
+
+
+def load_rows(path: str) -> list[dict]:
+    """Rows from a records JSONL file or a records dir (rotated .1 half
+    first so decisions precede their outcomes in replay order)."""
+    if os.path.isdir(path):
+        base = os.path.join(path, "download.jsonl")
+        paths = [p for p in (base + ".1", base) if os.path.exists(p)]
+        if not paths:
+            raise FileNotFoundError(f"no download.jsonl under {path}")
+    else:
+        paths = [path]
+    rows: list[dict] = []
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue       # torn tail line of a live file
+    return rows
+
+
+def render_decision(d: dict, *, max_candidates: int = 10) -> str:
+    """One ruling, human-readable. Pure function over a stitched (or raw)
+    decision row so it is testable offline and reusable by dfdiag
+    --decisions."""
+    chosen = d.get("chosen") or []
+    kept = set(d.get("kept") or [])
+    fresh = set(d.get("fresh") or [])
+    outcomes = d.get("outcomes") or {}
+    edges = d.get("edges") or {}
+    out = [f"decision {d.get('decision_id', '?')} "
+           f"({d.get('decision_kind', '?')}, {d.get('evaluator', '?')})  "
+           f"task {d.get('task_id', '?')[:16]}  "
+           f"child {d.get('peer_id', '?')[-16:]}"]
+    cands = d.get("candidates") or []
+    if cands:
+        hdr = (f"  {'':>2} {'rank':>4} {'peer':>18} {'total':>7} "
+               + " ".join(f"{_TERM_HDR[c]:>6}" for c in _TERM_COLS))
+        out.append(hdr)
+        for c in cands[:max_candidates]:
+            pid = c.get("peer_id", "")
+            mark = "*" if pid in chosen else " "
+            terms = c.get("terms") or {}
+            line = (f"  {mark:>2} {c.get('rank', 0):>4} {pid[-18:]:>18} "
+                    f"{c.get('total', 0.0):>7.4f} "
+                    + " ".join(f"{terms.get(t, 0.0):>6.3f}"
+                               for t in _TERM_COLS))
+            notes = []
+            if pid == (chosen[0] if chosen else None):
+                notes.append("chosen (main)")
+            elif pid in chosen:
+                notes.append("chosen")
+            if pid in kept:
+                notes.append("kept")
+            elif pid in fresh and pid in chosen:
+                notes.append("fresh")
+            sub = c.get("substituted")
+            if sub:
+                notes.append("/".join(f"{k}<-{v}" for k, v in sub.items()))
+            if notes:
+                line += "   " + ", ".join(notes)
+            out.append(line)
+        if len(cands) > max_candidates:
+            out.append(f"     … +{len(cands) - max_candidates} more "
+                       f"candidates")
+    else:
+        out.append("  (no legal candidates — every parent filtered)")
+    excl = d.get("excluded") or []
+    if excl:
+        out.append("  excluded: " + "; ".join(
+            f"{e.get('peer_id', '')[-14:]} {e.get('reason', '?')}"
+            for e in excl))
+    if outcomes:
+        rank_of = {c.get("peer_id"): c.get("rank")
+                   for c in d.get("candidates") or []}
+        for pid, o in sorted(outcomes.items(),
+                             key=lambda kv: -kv[1]["pieces"]):
+            mean = o["cost_ms"] / o["pieces"] if o["pieces"] else 0.0
+            line = (f"  outcome: {pid[-16:]} served {o['pieces']} "
+                    f"piece(s) / {_fmt_bytes(o['bytes'])}, "
+                    f"mean {mean:.1f}ms/piece (predicted rank "
+                    f"{rank_of.get(pid, '?')})")
+            edge = edges.get(pid)
+            if edge and edge.get("bandwidth_bps"):
+                line += (f", observed edge "
+                         f"{_fmt_bytes(edge['bandwidth_bps'])}/s")
+            out.append(line)
+        runner = next((c for c in d.get("candidates") or []
+                       if c.get("peer_id") not in chosen), None)
+        if runner is not None:
+            served = outcomes.get(runner.get("peer_id"), {}).get("pieces", 0)
+            out.append(f"  runner-up: {runner.get('peer_id', '')[-16:]} "
+                       f"scored {runner.get('total', 0.0):.4f}, "
+                       f"served {served} piece(s)")
+    return "\n".join(out)
+
+
+def render_stats(stitched: dict) -> str:
+    cov = stitched["coverage"]
+    decisions = stitched["decisions"]
+    by_kind: dict[str, int] = {}
+    excl: dict[str, int] = {}
+    for d in decisions:
+        by_kind[d.get("decision_kind", "?")] = \
+            by_kind.get(d.get("decision_kind", "?"), 0) + 1
+        for e in d.get("excluded") or []:
+            excl[e.get("reason", "?")] = excl.get(e.get("reason", "?"), 0) + 1
+    out = [f"decisions: {len(decisions)} "
+           f"({', '.join(f'{k}={v}' for k, v in sorted(by_kind.items()))})",
+           f"outcome join: {cov['joined']}/{cov['piece_rows']} piece rows "
+           f"stitched to a logged decision ({cov['ratio']:.1%})"]
+    if excl:
+        out.append("exclusions: " + ", ".join(
+            f"{r}={n}" for r, n in sorted(excl.items(), key=lambda kv: -kv[1])))
+    return "\n".join(out)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dfsched",
+        description="decision-ledger inspector: score decomposition, "
+                    "exclusions, outcome joins")
+    p.add_argument("task_id", nargs="?", default="",
+                   help="task id (prefix ok); default: the task with the "
+                   "most logged decisions")
+    p.add_argument("--records", default="",
+                   help="records JSONL file, or the scheduler records dir "
+                   "holding download.jsonl")
+    p.add_argument("--scheduler", default="",
+                   help="scheduler --debug-port host:port serving "
+                   "/debug/decisions (live ring; no outcome join)")
+    p.add_argument("--child", default="",
+                   help="filter to one child peer id (suffix ok)")
+    p.add_argument("--limit", type=int, default=8,
+                   help="newest-N decisions to render (default 8)")
+    p.add_argument("--stats", action="store_true",
+                   help="coverage + exclusion summary instead of rulings")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON instead of rendered text")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="HTTP timeout for --scheduler fetches")
+    return p
+
+
+def _pick_task(decisions: list[dict], prefix: str) -> str:
+    if prefix:
+        return prefix
+    counts: dict[str, int] = {}
+    for d in decisions:
+        tid = d.get("task_id", "")
+        counts[tid] = counts.get(tid, 0) + 1
+    return max(counts, key=counts.get) if counts else ""
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.scheduler:
+            # fetch the whole ring (bounded server-side at DEFAULT_RING_ROWS)
+            # and slice locally: asking for only --limit rows would truncate
+            # to the newest N across ALL tasks BEFORE the task/child filter
+            # runs, under-filling the output exactly on a busy scheduler
+            from ..scheduler.decision_ledger import DEFAULT_RING_ROWS
+            snap = _get_json(
+                f"http://{args.scheduler}/debug/decisions"
+                f"?task={args.task_id}&peer={args.child}"
+                f"&limit={max(args.limit, DEFAULT_RING_ROWS)}", args.timeout)
+            stitched = {"decisions": snap.get("decisions") or [],
+                        "coverage": {"piece_rows": 0, "joined": 0,
+                                     "ratio": 1.0}}
+            stats = snap.get("stats") or {}
+        elif args.records:
+            rows = load_rows(args.records)
+            stitched = stitch_outcomes(rows)
+            stats = {}
+        else:
+            print("dfsched: need --records PATH or --scheduler host:port",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        decisions = stitched["decisions"]
+        task = _pick_task(decisions, args.task_id)
+        picked = [d for d in decisions
+                  if d.get("task_id", "").startswith(task)
+                  and (not args.child
+                       or d.get("peer_id", "").endswith(args.child))]
+        if args.json:
+            print(json.dumps({"coverage": stitched["coverage"],
+                              "stats": stats,
+                              "decisions": picked[-args.limit:]}, indent=2))
+            return EXIT_OK
+        if args.stats:
+            if stats:
+                print(f"ledger: {json.dumps(stats)}")
+            print(render_stats(stitched))
+            return EXIT_OK
+        if not picked:
+            print("dfsched: no decisions recorded"
+                  + (f" for task {task[:16]}" if task else ""),
+                  file=sys.stderr)
+            return EXIT_OK
+        for d in picked[-args.limit:]:
+            print(render_decision(d))
+            print()
+        print(render_stats(stitched))
+        return EXIT_OK
+    except (OSError, ValueError) as exc:
+        # unreachable scheduler / missing or torn file: one line, no
+        # traceback — same CI contract as dfdiag
+        print(f"dfsched: {type(exc).__name__}: {exc}", file=sys.stderr)
+        return EXIT_IO
+
+
+if __name__ == "__main__":
+    sys.exit(main())

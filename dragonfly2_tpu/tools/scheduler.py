@@ -46,10 +46,14 @@ async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
     await sched.start()
     from ..common.debug_http import maybe_start_debug
     from ..scheduler.cluster_view import add_cluster_routes
-    debug_runner = await maybe_start_debug(
-        debug_port,
-        extra_routes=lambda router: add_cluster_routes(
-            router, sched.service.cluster))
+    from ..scheduler.decision_ledger import add_decision_routes
+
+    def _extra_routes(router) -> None:
+        add_cluster_routes(router, sched.service.cluster)
+        add_decision_routes(router, sched.ledger)
+
+    debug_runner = await maybe_start_debug(debug_port,
+                                           extra_routes=_extra_routes)
     print(f"scheduler up: {sched.address}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

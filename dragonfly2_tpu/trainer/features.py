@@ -84,6 +84,54 @@ def records_to_arrays(rows: list[dict]) -> dict[str, np.ndarray] | None:
     return {"x": np.asarray(xs, np.float32), "y": np.asarray(ys, np.float32)}
 
 
+def decision_outcome_rows(rows: list[dict]) -> list[dict]:
+    """The decision-ledger join contract (scheduler/decision_ledger.py):
+    fold ``kind=decision`` candidate rows with the ``kind=piece`` outcomes
+    that joined back to them into trainer-ready rows.
+
+    Each output row is one (decision, parent) pair that actually served:
+    the candidate's scoring-time feature vector (``PARENT_FEATURES``
+    layout, exactly what the ``ml`` evaluator would have seen), the mean
+    observed ``label_from_cost`` label over the pieces it delivered, and
+    the rank the live evaluator predicted. ``records_to_arrays``-
+    compatible, so a learned parent-quality model trains on the precise
+    rows the offline A/B (``dfbench --pr8``) judges it against — and the
+    rank column is the supervision a learning-to-rank variant needs.
+    """
+    decisions: dict[str, dict] = {}
+    for row in rows:
+        if row.get("kind") == "decision" and row.get("decision_id"):
+            decisions[row["decision_id"]] = row
+    stats: dict[tuple, list] = {}
+    for row in rows:
+        if row.get("kind") != "piece" or not row.get("decision_id"):
+            continue
+        if row["decision_id"] not in decisions:
+            continue
+        key = (row["decision_id"], row.get("parent_peer_id", ""))
+        agg = stats.setdefault(key, [0, 0.0])
+        agg[0] += 1
+        agg[1] += float(row.get("label") or 0.0)
+    out: list[dict] = []
+    for (did, parent_id), (n, label_sum) in stats.items():
+        decision = decisions[did]
+        cand = next((c for c in decision.get("candidates") or []
+                     if c.get("peer_id") == parent_id), None)
+        if cand is None or len(cand.get("features") or []) != FEATURE_DIM:
+            continue
+        out.append({
+            "decision_id": did,
+            "task_id": decision.get("task_id", ""),
+            "peer_id": decision.get("peer_id", ""),
+            "parent_peer_id": parent_id,
+            "features": [float(v) for v in cand["features"]],
+            "label": label_sum / n,
+            "rank": cand.get("rank"),
+            "pieces": n,
+        })
+    return out
+
+
 def _bucket(n: int, buckets: tuple[int, ...]) -> int:
     for b in buckets:
         if n <= b:

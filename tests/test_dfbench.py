@@ -223,6 +223,73 @@ class TestPr6Podscope:
         assert committed["amplification"]["baseline"] == pytest.approx(1.0)
 
 
+class TestPr8Decisions:
+    """PR-8 point: the decision ledger must be PURE OBSERVATION (arming
+    it never moves the schedule digest) and the counterfactual replay
+    must be deterministic (same seed => byte-identical decision_digest)."""
+
+    def test_decision_collection_never_moves_the_digest(self):
+        a = run_bench(seed=7, daemons=6, pieces=24)
+        b = run_bench(seed=7, daemons=6, pieces=24, collect_decisions=True)
+        assert a["schedule_digest"] == b["schedule_digest"]
+        rows = b["decisions"]
+        assert rows, "a scheduler-driven sim must log rulings"
+        assert all(r["kind"] == "decision" for r in rows)
+        # decision ids are deterministic (seq-based, no wall clock) and
+        # chosen parents reproduce the logged ranking
+        assert rows == run_bench(seed=7, daemons=6, pieces=24,
+                                 collect_decisions=True)["decisions"]
+
+    def test_replay_deterministic_same_seed(self):
+        from dragonfly2_tpu.scheduler.decision_ledger import replay_decisions
+        rows = run_bench(seed=7, daemons=6, pieces=24,
+                         collect_decisions=True)["decisions"]
+        a = replay_decisions(rows)
+        b = replay_decisions(rows)
+        assert a["decision_digest"] == b["decision_digest"]
+        # the default replay rebuilds the live ruling exactly
+        assert a["logged_choice_agreement"]["default"] == 1.0
+        other = replay_decisions(run_bench(
+            seed=11, daemons=6, pieces=24,
+            collect_decisions=True)["decisions"])
+        assert other["decision_digest"] != a["decision_digest"]
+
+    def test_pr8_matches_committed_baselines(self, tmp_path):
+        """The committed trajectory gate: a default-size --pr8 run must
+        carry the BENCH_pr3 schedule digest (the ledger perturbed
+        nothing), report ledger_pure, and reproduce the committed
+        decision_digest byte-for-byte."""
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr8", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads((tmp_path / "BENCH_pr8.json").read_text())
+        assert r["bench"] == "dfbench-decisions"
+        assert r["ledger_pure"] is True
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["logged_choice_agreement"]["default"] == 1.0
+        committed = json.loads(
+            open(os.path.join(REPO, "BENCH_pr8.json")).read())
+        assert r["decision_digest"] == committed["decision_digest"]
+        assert committed["schedule_digest"] == pr3["schedule_digest"]
+
+    def test_pr8_smoke_stdout_only(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr8", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-decisions"
+        assert set(r["cross_evaluator"]) == {"default_vs_nt",
+                                             "default_vs_ml", "nt_vs_ml"}
+        assert not list(tmp_path.iterdir())      # stdout only
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(

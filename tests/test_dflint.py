@@ -468,6 +468,47 @@ class TestDF006FlightVocabulary:
         assert codes(lint_file(str(mod), repo_root=str(tmp_path))) == []
 
 
+class TestDF006DecisionVocabulary:
+    def _lint(self, tmp_path, src, obs=""):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text(obs)
+        mod = tmp_path / "scheduler"
+        mod.mkdir(exist_ok=True)
+        f = mod / "scheduling.py"
+        f.write_text(textwrap.dedent(src))
+        return lint_file(str(f), repo_root=str(tmp_path))
+
+    def test_registered_fired_documented_is_clean(self, tmp_path):
+        fs = self._lint(tmp_path, """
+            EXCLUSION_REASONS = ("no-slots",)
+            class S:
+                def f(self, child, parent, excluded):
+                    self._trace(child, parent, "no-slots", excluded)
+        """, obs="reasons: `no-slots`")
+        assert codes(fs) == []
+
+    def test_undocumented_dead_and_unregistered_flag(self, tmp_path):
+        fs = self._lint(tmp_path, """
+            EXCLUSION_REASONS = ("no-slots", "ghost-reason")
+            class S:
+                def f(self, child, parent, excluded):
+                    self._trace(child, parent, "no-slots", excluded)
+                    self._trace(child, parent, "rogue", excluded)
+        """, obs="reasons: `no-slots`")
+        msgs = " ".join(f.message for f in fs)
+        assert codes(fs) == ["DF006", "DF006", "DF006"]
+        assert "'ghost-reason' is registered" in msgs          # dead
+        assert "'ghost-reason' is not documented" in msgs      # undoc'd
+        assert "'rogue' but it is not in the EXCLUSION_REASONS" in msgs
+
+    def test_other_modules_are_not_decision_vocabulary(self, tmp_path):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text("")
+        mod = tmp_path / "other.py"
+        mod.write_text('EXCLUSION_REASONS = ("whatever",)\n')
+        assert codes(lint_file(str(mod), repo_root=str(tmp_path))) == []
+
+
 class TestDF006Faultgate:
     def _tree(self, tmp_path, *, sites, fired, res_doc):
         (tmp_path / "docs").mkdir(exist_ok=True)

@@ -20,6 +20,11 @@ stress tool can arm with deterministic scripts:
     pex.gossip      daemon/pex.py gossip round, keyed by the target peer
                     address ('corrupt' flips an envelope byte so the
                     receiver's digest verify rejects it)
+    relay.stall     daemon/upload_server.py streaming relay wait, keyed
+                    by the task id: a parent whose landing watermark
+                    stops advancing mid-relay ('hang' parks the serve so
+                    the child's piece deadline fires and the piece is
+                    re-pulled from another holder)
 
 Script syntax (one clause per site, ';'-separated)::
 
@@ -68,6 +73,7 @@ SITES = frozenset({
     "hbm.ingest",
     "sched.register",
     "pex.gossip",
+    "relay.stall",
 })
 
 KINDS = frozenset({"fail", "error", "delay", "hang", "corrupt"})

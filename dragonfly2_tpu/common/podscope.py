@@ -182,10 +182,11 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
                 "src_peer": r.get("parent") or "",
                 "dst_peer": flight.get("peer_id") or "",
                 "bytes": 0, "pieces": 0, "wire_ms": 0.0,
-                "confirmed": False})
+                "ttfb_ms": 0.0, "confirmed": False})
             e["bytes"] += r.get("bytes", 0)
             e["pieces"] += 1
             e["wire_ms"] += r.get("wire_ms", 0.0)
+            e["ttfb_ms"] += r.get("ttfb_ms", 0.0)
         # parent-side serve rows (the upload journal): keyed by peer ids —
         # resolved against the child edges below
         my_peer = flight.get("peer_id") or ""
@@ -193,11 +194,13 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
             skey = (my_peer, srv.get("peer") or srv.get("addr") or "")
             s = serve_by_peers.setdefault(skey, {
                 "bytes": 0, "pieces": 0, "serve_ms": 0.0, "wait_ms": 0.0,
-                "src": addr})
+                "relayed_pieces": 0, "src": addr})
             s["bytes"] += srv.get("bytes", 0)
             s["pieces"] += srv.get("pieces", 1)
             s["serve_ms"] += srv.get("serve_ms", 0.0)
             s["wait_ms"] += srv.get("wait_ms", 0.0)
+            if srv.get("relayed"):
+                s["relayed_pieces"] += srv.get("pieces", 1)
 
     # stitch: a child edge (src_peer -> dst_peer) confirmed by the
     # parent's serve journal carries the parent-side timings too
@@ -207,6 +210,11 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
         e["wait_ms"] = round(s["wait_ms"], 3)
         e["serve_bps"] = (round(s["bytes"] / (s["serve_ms"] / 1e3))
                           if s["serve_ms"] > 0 else 0)
+        if s.get("relayed_pieces"):
+            # the parent streamed (part of) this edge against its landing
+            # watermark: a cut-through edge of the distribution tree
+            e["relayed"] = True
+            e["relayed_pieces"] = s["relayed_pieces"]
 
     used_serves: set[tuple[str, str]] = set()
     for e in edges.values():
@@ -219,6 +227,7 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
             used_serves.add((e["src_peer"], e["dst_peer"]))
             _attach(e, s)
         e["wire_ms"] = round(e["wire_ms"], 3)
+        e["ttfb_ms"] = round(e["ttfb_ms"], 3)
         e["bandwidth_bps"] = (round(e["bytes"] / (e["wire_ms"] / 1e3))
                               if e["wire_ms"] > 0 else 0)
     # fallback stitch: a parent that never downloaded the task here (a
@@ -265,6 +274,38 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
         return d
 
     depth = max((depth_of(n) for n in nodes), default=0)
+
+    # relay view: the cut-through sub-tree — how deep the pipelined
+    # chains ran and what each hop added in first-byte latency (the
+    # per-hop tax a relay chain pays instead of a full store-and-forward
+    # piece time)
+    relay = None
+    relay_edges = [e for e in edges.values() if e.get("relayed")]
+    if relay_edges:
+        ekey = {(e["src"], e["dst"]): e for e in edges.values()}
+        rdepth_memo: dict[str, int] = {}
+
+        def relay_depth_of(node: str, seen: frozenset = frozenset()) -> int:
+            """Consecutive relayed tree edges above ``node``."""
+            if node in rdepth_memo:
+                return rdepth_memo[node]
+            if node in seen:
+                return 0
+            parent = tree.get(node)
+            e = ekey.get((parent, node)) if parent is not None else None
+            d = (relay_depth_of(parent, seen | {node}) + 1
+                 if e is not None and e.get("relayed") else 0)
+            rdepth_memo[node] = d
+            return d
+
+        relay = {
+            "edges": len(relay_edges),
+            "pieces": sum(e.get("relayed_pieces", 0) for e in relay_edges),
+            "depth": max((relay_depth_of(n) for n in nodes), default=0),
+            "per_hop_added_ms": _pctl(
+                [e["ttfb_ms"] / max(e["pieces"], 1)
+                 for e in relay_edges], 0.5),
+        }
 
     # seed uplink: the heaviest server and what it sustained. The serve
     # journal's rate is preferred, but only over the bytes it actually
@@ -336,6 +377,7 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
         "edges": sorted(edges.values(),
                         key=lambda e: (e["src"], e["dst"])),
         "tree": tree,
+        "relay": relay,
         "bottleneck": bottleneck,
         "seed_uplink": seed_uplink,
         "slo_breaches": slo,
@@ -431,6 +473,7 @@ def bench_summary(task_report: dict) -> dict:
                          "p95": _pctl(wires, 0.95)},
         "seed_uplink": task_report["seed_uplink"],
         "bottleneck": task_report["bottleneck"],
+        "relay": task_report.get("relay"),
     }
 
 
@@ -511,6 +554,8 @@ def render_pod(report: dict, *, max_edges_per_node: int = 8) -> str:
                 last = i == len(shown) - 1
                 tick = "└─ " if last else "├─ "
                 mark = ""
+                if e.get("relayed"):
+                    mark += "  [relay]"
                 if e.get("confirmed"):
                     mark += "  [confirmed]"
                 if (b and e["src"] == b.get("src")
@@ -551,6 +596,13 @@ def render_pod(report: dict, *, max_edges_per_node: int = 8) -> str:
         if cross > 0:
             out.append(f"  (+{cross} cross edge(s) beyond the tree — "
                        "full DAG in --json)")
+        rl = t.get("relay")
+        if rl:
+            out.append(
+                f"  relay: {rl['edges']} cut-through edge(s), "
+                f"{rl['pieces']}pc streamed mid-landing, chain depth "
+                f"{rl['depth']}, ~{rl['per_hop_added_ms']:.1f}ms added "
+                "per hop")
         su = t.get("seed_uplink")
         if su:
             out.append(

@@ -55,7 +55,8 @@ class PeerTaskConductor:
                  ordered: bool = False,
                  trace: Any = None,
                  flight: Any = None,
-                 pex: Any = None):
+                 pex: Any = None,
+                 relay: Any = None):
         self.task_id = task_id
         self.peer_id = peer_id
         self.url = url
@@ -74,6 +75,8 @@ class PeerTaskConductor:
         self.trace = trace
         self.flight = flight         # TaskFlight journal (None = disabled)
         self.pex = pex               # PexGossiper (None = plane disabled)
+        self.relay = relay           # RelayHub (None = cut-through off)
+        self._relay_tracked = False
         # True when register failed at the TRANSPORT level (every ring
         # member unreachable) rather than by scheduler verdict — only then
         # may the pex rung second-guess the missing control plane
@@ -178,6 +181,11 @@ class PeerTaskConductor:
                 await self._session.close(success=self.state == self.SUCCESS)
             if self.shaper is not None:
                 self.shaper.unregister(self.task_id)
+            if self._relay_tracked:
+                # wakes any streaming serve parked on this task's progress
+                # so it winds down now instead of riding out its deadline
+                self._relay_tracked = False
+                self.relay.untrack(self.task_id)
 
     async def _register(self):
         """Register with the scheduler; None means "go to origin" (the
@@ -224,6 +232,12 @@ class PeerTaskConductor:
             piece_size=self.piece_size, digest=self.url_meta.digest,
             priority=self.resolved_priority)
         self.storage = self.storage_mgr.register_task(md)
+        if self.relay is not None and not self._relay_tracked:
+            # cut-through: from here until finish, the upload server may
+            # serve this task's bytes up to the landing watermark
+            self._relay_tracked = True
+            self.relay.track(self.task_id, total_pieces=self.total_pieces,
+                             on_open=self._on_relay_span)
         if (self.device_sink_factory is not None and effective_len > 0
                 and self.device_ingest is None):
             try:
@@ -231,6 +245,14 @@ class PeerTaskConductor:
             except Exception:  # device sink is best-effort
                 self.log.exception("device sink init failed; continuing to disk")
         return self.piece_size
+
+    def _on_relay_span(self, span) -> None:
+        """A new in-flight span opened for this task: publish its piece
+        numbers so the rpcserver's sync streams can announce-ahead —
+        children may begin pulling these pieces NOW and the upload
+        server's streaming path serves them to the watermark."""
+        self._publish({"type": "relay",
+                       "nums": [p.piece_num for p in span.pieces]})
 
     async def on_piece_from_source(self, num: int, offset: int, data: bytes,
                                    cost_ms: int) -> None:
@@ -423,6 +445,9 @@ class PeerTaskConductor:
                                "completed": self.completed_length,
                                "total": self.content_length})
             self._piece_cond.notify_all()
+        if self._relay_tracked:
+            # landed bytes are now disk-covered: move relay readers along
+            self.relay.pulse(self.task_id)
         for ev in events:
             self._publish(ev)
         return counted, corrupt, raced
@@ -473,6 +498,8 @@ class PeerTaskConductor:
             self.ready.add(num)
             self.completed_length += len(data)
             self._piece_cond.notify_all()
+        if self._relay_tracked:
+            self.relay.pulse(self.task_id)
         self._publish({"type": "piece", "num": num, "size": len(data),
                        "completed": self.completed_length,
                        "total": self.content_length})

@@ -142,6 +142,16 @@ class DownloadConfig:
     # TLS trust for https origins (private registries / custom CAs)
     source_ca: str = ""                    # extra CA bundle path
     source_insecure: bool = False          # disable verification (tests)
+    # cut-through relay (daemon/relay.py): serve a piece while it is still
+    # arriving. ON by default — disarmed it costs one attribute store per
+    # downloaded chunk; off restores strict store-and-forward (the upload
+    # server then 416s incomplete ranges exactly as before)
+    relay_enabled: bool = True
+    # how long a streaming serve waits for the landing watermark to move
+    # before giving up (per wait, reset on every advance) — bounds a serve
+    # whose upstream wedged so the child's own piece deadline, not a
+    # leaked upload slot, decides the requeue
+    relay_stall_s: float = 10.0
 
 
 @dataclass

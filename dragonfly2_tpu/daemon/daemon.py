@@ -74,6 +74,13 @@ class Daemon:
         # PEX gossip plane (daemon/pex.py): swarm index + gossiper exist
         # before the upload server so its routes mount at start; ports and
         # topology resolve lazily through host_info()
+        # cut-through relay hub (daemon/relay.py): in-flight landing spans
+        # the upload server serves to the watermark; exists before the
+        # upload server and the engine factory so both share it
+        self.relay = None
+        if cfg.download.relay_enabled:
+            from .relay import RelayHub
+            self.relay = RelayHub()
         self.pex = None
         if cfg.pex.enabled:
             from .pex import PexGossiper
@@ -84,14 +91,15 @@ class Daemon:
                 index=SwarmIndex(ttl_s=cfg.pex.ttl_s),
                 interval_s=cfg.pex.interval_s, fanout=cfg.pex.fanout,
                 max_digest_tasks=cfg.pex.max_digest_tasks,
-                bootstrap=cfg.pex.bootstrap)
+                bootstrap=cfg.pex.bootstrap, relay=self.relay)
         self.upload_server = UploadServer(
             self.storage_mgr, port=cfg.upload.port,
             rate_limit_bps=cfg.upload.rate_limit_bps,
             debug_endpoints=cfg.upload.debug_endpoints,
             concurrent_limit=cfg.upload.concurrent_limit,
             host=cfg.listen_ip, flight_recorder=self.flight_recorder,
-            pex=self.pex)
+            pex=self.pex, relay=self.relay,
+            relay_stall_s=cfg.download.relay_stall_s)
         self._scheduler_factory = scheduler_factory
         self._p2p_engine_factory = p2p_engine_factory
         self.scheduler: Any = None
@@ -274,7 +282,8 @@ class Daemon:
                     slice_name=(self.topology.slice_name
                                 if self.topology else ""),
                     peer_observer=(self.pex.observe_parent
-                                   if self.pex is not None else None))
+                                   if self.pex is not None else None),
+                    relay=self.relay)
         if self.pex is not None:
             # the pex rung builds a FRESH engine per pull (the scheduler
             # path may already have consumed the conductor's), and gossip
@@ -290,7 +299,8 @@ class Daemon:
             device_sink_builder=self.device_sink_builder,
             is_seed=self.cfg.is_seed, shaper=self.shaper,
             prefetch_whole_file=self.cfg.download.prefetch_whole_file,
-            flight_recorder=self.flight_recorder, pex=self.pex)
+            flight_recorder=self.flight_recorder, pex=self.pex,
+            relay=self.relay)
         svc = DaemonService(self.ptm,
                             upload_addr=f"{self.host_ip}:{self.upload_server.port}")
         # fleet mTLS: enroll with the manager, serve the peer RPC port with

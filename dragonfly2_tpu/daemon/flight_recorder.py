@@ -135,7 +135,8 @@ class TaskFlight:
 
     def serve(self, *, peer: str, addr: str = "", piece: int = -1,
               nbytes: int = 0, serve_ms: float = 0.0,
-              wait_ms: float = 0.0, pieces: int = 1) -> None:
+              wait_ms: float = 0.0, pieces: int = 1,
+              relayed: bool = False) -> None:
         """Journal one range served to a child (the UPLOAD edge row).
 
         ``peer`` is the requesting child's peer id (the ?peerId= on the
@@ -144,10 +145,13 @@ class TaskFlight:
         hold time), ``wait_ms`` the limiter share of it. ``piece`` is the
         FIRST piece of the range and ``pieces`` how many it spans — a
         grouped span GET is one row, but the parent-side piece count must
-        still agree with the child's per-piece rows. One deque append —
-        same hot-path overhead contract as event()."""
+        still agree with the child's per-piece rows. ``relayed`` marks a
+        cut-through serve (the range streamed against the landing
+        watermark, daemon/relay.py) so podscope can surface relay edges
+        and their depth. One deque append — same hot-path overhead
+        contract as event()."""
         self.serves.append((self.now_ms(), peer, addr, piece, nbytes,
-                            serve_ms, wait_ms, pieces))
+                            serve_ms, wait_ms, pieces, relayed))
         _serve_rows.inc()
 
     def hbm_spans(self, spans: list) -> None:
@@ -173,9 +177,10 @@ class TaskFlight:
                         "addr": addr, "piece": piece, "pieces": pieces,
                         "bytes": nbytes,
                         "serve_ms": round(serve, 3),
-                        "wait_ms": round(wait, 3)}
+                        "wait_ms": round(wait, 3),
+                        "relayed": relayed}
                        for t, peer, addr, piece, nbytes, serve, wait,
-                       pieces in self.serves],
+                       pieces, relayed in self.serves],
         }
 
     def summarize(self) -> dict:
@@ -284,15 +289,17 @@ class TaskFlight:
         # half of every transfer edge (podscope joins this against the
         # child's piece rows to confirm the edge from both ends)
         uploads: dict[str, dict] = {}
-        for _t, peer, addr, _piece, nbytes, serve, wait, npieces in \
-                self.serves:
+        for _t, peer, addr, _piece, nbytes, serve, wait, npieces, \
+                relayed in self.serves:
             up = uploads.setdefault(peer or addr, {
                 "addr": addr, "bytes": 0, "pieces": 0,
-                "serve_ms": 0.0, "wait_ms": 0.0})
+                "serve_ms": 0.0, "wait_ms": 0.0, "relayed_pieces": 0})
             up["bytes"] += nbytes
             up["pieces"] += npieces
             up["serve_ms"] += serve
             up["wait_ms"] += wait
+            if relayed:
+                up["relayed_pieces"] += npieces
         for up in uploads.values():
             ms = up["serve_ms"]
             up["serve_ms"] = round(ms, 3)

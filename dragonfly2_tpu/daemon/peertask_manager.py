@@ -30,7 +30,8 @@ class PeerTaskManager:
                  p2p_engine_factory: Any = None,
                  device_sink_builder: Any = None, is_seed: bool = False,
                  shaper: Any = None, prefetch_whole_file: bool = False,
-                 flight_recorder: Any = None, pex: Any = None):
+                 flight_recorder: Any = None, pex: Any = None,
+                 relay: Any = None):
         self.storage_mgr = storage_mgr
         self.piece_mgr = piece_mgr
         self.hostname = hostname
@@ -43,6 +44,7 @@ class PeerTaskManager:
         self.prefetch_whole_file = prefetch_whole_file
         self.flight_recorder = flight_recorder
         self.pex = pex
+        self.relay = relay            # RelayHub (None = cut-through off)
         self._conductors: dict[str, PeerTaskConductor] = {}
         self._prefetching: set[str] = set()
         # strong refs: the loop only weak-refs tasks, and a GC'd prefetch
@@ -88,7 +90,7 @@ class PeerTaskManager:
                 content_range=content_range,
                 disable_back_source=disable_back_source, task_type=task_type,
                 device_sink_factory=device_sink_factory, ordered=ordered,
-                flight=flight, pex=self.pex)
+                flight=flight, pex=self.pex, relay=self.relay)
             if self.p2p_engine_factory is not None:
                 conductor.set_p2p_engine(self.p2p_engine_factory())
             if self.shaper is not None:

@@ -168,8 +168,10 @@ class PexGossiper:
                  tls: tuple[str, str, str] | None = None,
                  scheduler: Any = None,
                  engine_factory: Callable[[], Any] | None = None,
+                 relay: Any = None,
                  rng: random.Random | None = None):
         self.storage_mgr = storage_mgr
+        self.relay = relay               # RelayHub: watermark in digests
         self.host_info = host_info       # lazy: ports resolve after bind
         self.index = index if index is not None else SwarmIndex()
         self.interval_s = interval_s
@@ -299,6 +301,16 @@ class PexGossiper:
                      "done": done}
             if not done:
                 entry["pieces"] = sorted(md.pieces)
+                if self.relay is not None:
+                    # the advertised landing watermark: pieces arriving
+                    # on this daemon NOW — cut-through-servable, counted
+                    # toward coverage only while the watermark stays
+                    # fresh (SwarmEntry.progress_fresh)
+                    wm = sorted({i.piece_num for i in
+                                 self.relay.inflight_infos(md.task_id)}
+                                - set(md.pieces))
+                    if wm:
+                        entry["relay"] = wm
             tasks.append(entry)
             if len(tasks) >= self.max_digest_tasks:
                 break
@@ -358,12 +370,16 @@ class PexGossiper:
                 done = bool(t.get("done"))
                 pieces = (None if done
                           else {int(n) for n in t.get("pieces") or []})
-                if not done and not pieces:
+                relay_pieces = (None if done
+                                else {int(n) for n in t.get("relay") or []}
+                                or None)
+                if not done and not pieces and not relay_pieces:
                     continue
                 entries.append((task_id, SwarmEntry(
                     host_id=host_id or f"{ip}:{download_port}", ip=ip,
                     rpc_port=rpc_port, download_port=download_port,
                     is_seed=is_seed, topology=topo, pieces=pieces,
+                    relay_pieces=relay_pieces,
                     total_pieces=int(t.get("total", -1)),
                     content_length=int(t.get("content_length", -1)),
                     piece_size=int(t.get("piece_size", 0)), done=done)))
@@ -549,8 +565,7 @@ class PexGossiper:
         session.packets.put_nowait(packet)
         _primes.inc()
 
-    @staticmethod
-    def _covers_task(entries, conductor) -> bool:
+    def _covers_task(self, entries, conductor) -> bool:
         """Coverage gate for the pex rung: there is no scheduler behind a
         pex pull, so nobody rescues it if the gossip-known holders turn
         out not to have the whole task — the engine would land the covered
@@ -560,7 +575,14 @@ class PexGossiper:
         Proceed only when some holder is complete, or the partial holders'
         piece sets collectively cover every piece this conductor still
         needs; otherwise decline and let the ladder continue to
-        back_source."""
+        back_source.
+
+        In-flight watermark claims (``relay_pieces``) count toward
+        coverage ONLY while the holder's watermark is fresh
+        (``progress_fresh`` within the index's progress TTL): a stale
+        watermark is a download that died mid-flight — counting its
+        abandoned pieces would re-open the exact parked-forever hole this
+        gate closed (the PR 5 seed-restart fix)."""
         if any(e.done or e.pieces is None for e in entries):
             return True
         total = max((e.total_pieces for e in entries), default=-1)
@@ -568,9 +590,13 @@ class PexGossiper:
             # nobody is complete and nobody knows the geometry: the pull
             # could not even tell how much is missing
             return False
+        now = time.monotonic()
+        ttl = self.index.progress_ttl_s
         union: set[int] = set()
         for e in entries:
             union |= e.pieces or set()
+            if e.relay_pieces and e.progress_fresh(now, ttl):
+                union |= e.relay_pieces
         need = set(range(total)) - set(conductor.ready)
         return need <= union
 

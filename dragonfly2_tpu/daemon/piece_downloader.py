@@ -71,7 +71,7 @@ class PieceDownloader:
 
     @staticmethod
     async def _read_body(resp, size: int, what: str,
-                         on_first=None) -> bytearray:
+                         on_first=None, relay_open=None) -> bytearray:
         """Stream the body into ONE pooled buffer. Replaces
         ``resp.read()``: no chunk-list join copy, and — unlike the PR 3/4
         shape — NO digest folding here: hashing a 4-16 MiB piece on the
@@ -81,7 +81,12 @@ class PieceDownloader:
         comes from the process buffer pool; ownership passes to the
         caller (released back to the pool after landing), and is returned
         to the pool here on every failure path. ``on_first`` fires once
-        when the first body chunk lands (flight-recorder ttfb)."""
+        when the first body chunk lands (flight-recorder ttfb).
+        ``relay_open(buf)`` (daemon/relay.py) registers the buffer as an
+        in-flight relay span once acquired; the per-chunk watermark
+        advance is one attribute store, and a failed read retires the
+        span HERE, before the buffer returns to the pool — a relay
+        reader must never copy from recycled memory."""
         if faultgate.ARMED:
             # inside the request's timeout window: a 'hang' script parks
             # here until the per-piece deadline cancels the read, exactly
@@ -89,6 +94,7 @@ class PieceDownloader:
             # byte BEFORE landing so digest verification trips downstream
             await faultgate.fire("piece.wire", key=what)
         buf = POOL.acquire(size)
+        span = relay_open(buf) if relay_open is not None else None
         try:
             mv = memoryview(buf)
             try:
@@ -107,6 +113,8 @@ class PieceDownloader:
                             f"{what}: long read {off + n} > {size}")
                     mv[off:off + n] = chunk
                     off += n
+                    if span is not None:
+                        span.advance(off)
                 if off != size:
                     raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                                   f"{what}: short read {off}/{size}")
@@ -114,13 +122,15 @@ class PieceDownloader:
                 # drop the export before any release() probes it
                 mv.release()
         except BaseException:
+            if span is not None:
+                span.close()
             POOL.release(buf)
             raise
         return buf
 
     async def download_piece(self, *, dst_addr: str, task_id: str,
                              src_peer_id: str, piece: PieceInfo,
-                             on_first_byte=None,
+                             on_first_byte=None, relay_open=None,
                              ) -> tuple[bytearray, int]:
         """Fetch one piece from a parent. Returns (data, cost_ms); ``data``
         is a POOLED buffer the caller owns (release to ``bufpool.POOL``
@@ -160,7 +170,8 @@ class PieceDownloader:
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                         f"{what}: HTTP {resp.status}")
                 return await self._read_body(resp, size, what,
-                                             on_first=on_first_byte)
+                                             on_first=on_first_byte,
+                                             relay_open=relay_open)
 
         try:
             # hard per-piece deadline OUTSIDE aiohttp: the session's total
@@ -182,7 +193,7 @@ class PieceDownloader:
 
     async def download_span(self, *, dst_addr: str, task_id: str,
                             src_peer_id: str, pieces: list[PieceInfo],
-                            on_first_byte=None,
+                            on_first_byte=None, relay_open=None,
                             ) -> tuple[bytearray, int]:
         """Fetch CONTIGUOUS pieces in one ranged GET.
 
@@ -199,7 +210,7 @@ class PieceDownloader:
             return await self.download_piece(
                 dst_addr=dst_addr, task_id=task_id,
                 src_peer_id=src_peer_id, piece=pieces[0],
-                on_first_byte=on_first_byte)
+                on_first_byte=on_first_byte, relay_open=relay_open)
         url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start = pieces[0].range_start
         size = sum(p.range_size for p in pieces)
@@ -228,7 +239,8 @@ class PieceDownloader:
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
                         f"{what}: HTTP {resp.status}")
                 return await self._read_body(resp, size, what,
-                                             on_first=on_first_byte)
+                                             on_first=on_first_byte,
+                                             relay_open=relay_open)
 
         try:
             # same hard per-span deadline as download_piece (see there)

@@ -140,6 +140,35 @@ class _Synchronizer:
             self.task.cancel()
 
 
+class _SpanHandle:
+    """Engine-side relay-span lifecycle: called by the downloader with the
+    pooled buffer once acquired (registers the in-flight span), retired by
+    the engine once the span's pieces have landed — always before the
+    buffer returns to the pool. A no-op when the relay plane is off."""
+
+    __slots__ = ("relay", "task_id", "pieces", "span")
+
+    def __init__(self, relay, task_id: str, pieces: list[PieceInfo]):
+        self.relay = relay
+        self.task_id = task_id
+        self.pieces = pieces
+        self.span = None
+
+    def __call__(self, buf):
+        if self.relay is None:
+            return None
+        base = self.pieces[0].range_start
+        size = sum(p.range_size for p in self.pieces)
+        self.span = self.relay.open_span(self.task_id, base, size, buf,
+                                         self.pieces)
+        return self.span
+
+    def retire(self) -> None:
+        if self.span is not None and self.relay is not None:
+            self.relay.retire(self.span)
+            self.span = None
+
+
 class PieceEngine:
     def __init__(self, *, parallelism: int = 4,
                  schedule_timeout_s: float = 30.0,
@@ -147,12 +176,17 @@ class PieceEngine:
                  downloader: PieceDownloader | None = None,
                  channel_pool: ChannelPool | None = None,
                  slice_name: str = "",
-                 peer_observer=None):
+                 peer_observer=None,
+                 relay=None):
         self.parallelism = parallelism
         self.slice_name = slice_name    # advertised to super-seeding parents
         # PEX membership hook (daemon/pex.py): every parent the scheduler
         # assigns is observed so the gossip plane knows the mesh
         self.peer_observer = peer_observer
+        # cut-through relay hub (daemon/relay.py): every in-flight span
+        # this engine downloads becomes readable by the upload server's
+        # streaming range path while its bytes are still arriving
+        self.relay = relay
         self.schedule_timeout_s = schedule_timeout_s
         self.piece_timeout_s = piece_timeout_s
         self.downloader = downloader or PieceDownloader(timeout_s=piece_timeout_s)
@@ -177,6 +211,9 @@ class PieceEngine:
 
     def peer_client(self, addr: str) -> ServiceClient:
         return ServiceClient(self._channels.get(addr), DAEMON_SERVICE)
+
+    def _relay_opener(self, conductor, pieces: list[PieceInfo]) -> _SpanHandle:
+        return _SpanHandle(self.relay, conductor.task_id, pieces)
 
     # ------------------------------------------------------------------
 
@@ -220,6 +257,7 @@ class PieceEngine:
 
             def on_first(_num=info.piece_num, _pid=single.dst_peer_id):
                 flight.event(fr.FIRST_BYTE, _num, _pid)
+        span = self._relay_opener(conductor, [info])
         try:
             with health.PLANE.watchdog.section(
                     "piece.wire", health.PLANE.slo.section_deadline_s(),
@@ -227,7 +265,7 @@ class PieceEngine:
                 data, cost = await self.downloader.download_piece(
                     dst_addr=single.dst_addr, task_id=conductor.task_id,
                     src_peer_id=conductor.peer_id, piece=info,
-                    on_first_byte=on_first)
+                    on_first_byte=on_first, relay_open=span)
         except DFError as exc:
             _p2p_pieces.labels("fail").inc()
             await session.report_piece(self._piece_result(
@@ -239,6 +277,9 @@ class PieceEngine:
             placed, corrupt, raced = await conductor.on_span_from_peer(
                 single.dst_peer_id, [info], data, cost)
         finally:
+            # retire BEFORE the pool release: a relay reader must never
+            # copy from a recycled buffer (landed bytes serve from disk)
+            span.retire()
             POOL.release(data)
         if corrupt:
             self._note_corrupt(conductor, info, single.dst_peer_id)
@@ -538,10 +579,11 @@ class PieceEngine:
                         "piece.wire",
                         health.PLANE.slo.section_deadline_s(len(d.pieces)),
                         stage="wire"):
+                    span = self._relay_opener(conductor, d.pieces)
                     buf, cost = await self.downloader.download_span(
                         dst_addr=d.parent.addr, task_id=conductor.task_id,
                         src_peer_id=conductor.peer_id, pieces=d.pieces,
-                        on_first_byte=on_first)
+                        on_first_byte=on_first, relay_open=span)
         except DFError as exc:
             if exc.code == Code.CLIENT_PEER_BUSY:
                 # backpressure, not failure: requeue; no scheduler report
@@ -582,7 +624,10 @@ class PieceEngine:
         finally:
             # landing (including the sink's staging memcpy) has completed:
             # the buffer is recyclable — this kills the 4-16 MiB
-            # alloc/free churn per download at fan-out
+            # alloc/free churn per download at fan-out. The relay span is
+            # retired FIRST: its bytes now serve from storage (or, if a
+            # piece failed verification, stop being servable at all)
+            span.retire()
             POOL.release(buf)
         placed_set, corrupt_set = set(placed), set(corrupt)
         raced_set = set(raced)

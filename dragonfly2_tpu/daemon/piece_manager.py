@@ -21,6 +21,7 @@ from ..common.piece import (INGEST_DMA_UNIT_BYTES, Range, compute_piece_size,
                             piece_count, piece_range)
 from ..common.rate import TokenBucket
 from ..common.retry import Retrier, RetryPolicy
+from ..idl.messages import PieceInfo
 from ..source import SourceRequest, client_for
 from ..source import download as source_download
 from .config import DownloadConfig
@@ -50,6 +51,91 @@ async def _open_source(req: SourceRequest):
     stay correct without double-counting."""
     return await Retrier(_SOURCE_RETRY).run(
         lambda: source_download(req), retryable=_transient_source)
+
+
+def _relay_for(conductor):
+    """The relay hub when the conductor registered with it — origin bytes
+    then serve onward while the piece is still arriving (the seed hop of
+    a cut-through chain, daemon/relay.py)."""
+    if getattr(conductor, "_relay_tracked", False):
+        return conductor.relay
+    return None
+
+
+class _PieceCutter:
+    """Cuts an origin byte stream into per-piece buffers, each registered
+    as an in-flight relay span while it fills (one buffer per piece, not
+    one rolling bytearray, so the span's watermark maps 1:1 onto the
+    landing buffer). Shared by the single-stream and piece-group
+    back-source paths — the span lifecycle (open → advance → land →
+    retire, retire-on-death in ``close``) lives in exactly one place.
+
+    ``want(num, rel)`` returns the next piece's size; <= 0 stops
+    consuming (origin over-delivery, or the group bound). Spans carry no
+    digest (none is known until landing) — a child landing a relayed
+    origin piece gets the same trust it would fetching the origin
+    itself."""
+
+    def __init__(self, conductor, *, start_num: int, start_rel: int, want):
+        self.conductor = conductor
+        self.relay = _relay_for(conductor)
+        self.want = want
+        self.num = start_num
+        self.rel = start_rel
+        self.cur: bytearray | None = None
+        self.span = None
+        self.filled = 0
+        self.t0 = time.monotonic()
+
+    async def feed(self, chunk) -> None:
+        coff = 0
+        while coff < len(chunk):
+            if self.cur is None:
+                want = self.want(self.num, self.rel)
+                if want <= 0:
+                    return
+                self.cur = bytearray(want)
+                self.filled = 0
+                if self.relay is not None:
+                    self.span = self.relay.open_span(
+                        self.conductor.task_id, self.rel, want, self.cur,
+                        [PieceInfo(piece_num=self.num,
+                                   range_start=self.rel,
+                                   range_size=want)])
+            take = min(len(self.cur) - self.filled, len(chunk) - coff)
+            self.cur[self.filled:self.filled + take] = \
+                chunk[coff:coff + take]
+            self.filled += take
+            coff += take
+            if self.span is not None:
+                self.span.advance(self.filled)
+            if self.filled == len(self.cur):
+                await self._land(bytes(self.cur))
+                self.cur = None
+
+    async def _land(self, data: bytes) -> None:
+        cost = int((time.monotonic() - self.t0) * 1000)
+        await self.conductor.on_piece_from_source(self.num, self.rel,
+                                                  data, cost)
+        if self.relay is not None:
+            self.relay.retire(self.span)   # landed: serves from disk
+        self.span = None
+        self.num += 1
+        self.rel += len(data)
+        self.t0 = time.monotonic()
+
+    async def flush_tail(self) -> None:
+        """Origin ended short of the expected piece size: land what came
+        (single-stream semantics; group streams treat short as an error)."""
+        if self.cur is not None and self.filled:
+            await self._land(bytes(self.cur[:self.filled]))
+            self.cur = None
+
+    def close(self) -> None:
+        """Stream died mid-piece: retire the leftover span."""
+        if self.relay is not None and self.span is not None:
+            self.relay.retire(self.span)
+            self.span = None
 
 
 class PieceManager:
@@ -109,29 +195,27 @@ class PieceManager:
 
     async def _download_stream(self, conductor, req: SourceRequest,
                                piece_size: int, start_piece: int) -> None:
-        """One origin stream, cut into pieces as bytes arrive."""
+        """One origin stream, cut into pieces as bytes arrive — each
+        in-progress piece is an in-flight relay span (``_PieceCutter``):
+        children may pull it from this daemon's upload server up to the
+        watermark while the origin is still delivering it."""
         resp = await _open_source(req)
-        num = start_piece
-        buf = bytearray()
-        rel = 0  # offsets are range-relative: the task stores just its range
-        t0 = time.monotonic()
+        total = conductor.content_length
         assert resp.chunks is not None
         limiter = self._limiter(conductor)
-        async for chunk in resp.chunks:
-            await limiter.acquire(len(chunk))
-            buf.extend(chunk)
-            while len(buf) >= piece_size:
-                data = bytes(buf[:piece_size])
-                del buf[:piece_size]
-                cost = int((time.monotonic() - t0) * 1000)
-                await conductor.on_piece_from_source(num, rel, data, cost)
-                num += 1
-                rel += len(data)
-                t0 = time.monotonic()
-        if buf:
-            cost = int((time.monotonic() - t0) * 1000)
-            await conductor.on_piece_from_source(num, rel, bytes(buf), cost)
-            rel += len(buf)
+        # offsets are range-relative: the task stores just its range
+        cutter = _PieceCutter(
+            conductor, start_num=start_piece, start_rel=0,
+            want=lambda _num, rel: (piece_size if total < 0
+                                    else min(piece_size, total - rel)))
+        try:
+            async for chunk in resp.chunks:
+                await limiter.acquire(len(chunk))
+                await cutter.feed(chunk)
+            # origin ended short of the expected size: land what came
+            await cutter.flush_tail()
+        finally:
+            cutter.close()   # stream died mid-piece
 
     async def _download_piece_groups(self, conductor, req: SourceRequest,
                                      total: int, piece_size: int, n: int) -> None:
@@ -173,30 +257,25 @@ class PieceManager:
             sub = SourceRequest(url=req.url, header=dict(req.header),
                                range=g_range, timeout_s=req.timeout_s)
             resp = await _open_source(sub)
-            num = first
-            rel = g_off
-            buf = bytearray()
-            t0 = time.monotonic()
             assert resp.chunks is not None
             limiter = self._limiter(conductor)
-            async for chunk in resp.chunks:
-                await limiter.acquire(len(chunk))
-                buf.extend(chunk)
-                while num < last:
-                    _, want = piece_range(num, piece_size, content_len)
-                    if len(buf) < want:
-                        break
-                    data = bytes(buf[:want])
-                    del buf[:want]
-                    cost = int((time.monotonic() - t0) * 1000)
-                    await conductor.on_piece_from_source(num, rel, data, cost)
-                    num += 1
-                    rel += want
-                    t0 = time.monotonic()
-            if num != last:
+            # per-piece buffer + relay span, like _download_stream: each
+            # in-progress piece of every group is cut-through servable
+            cutter = _PieceCutter(
+                conductor, start_num=first, start_rel=g_off,
+                want=lambda num, _rel: (piece_range(num, piece_size,
+                                                    content_len)[1]
+                                        if num < last else 0))
+            try:
+                async for chunk in resp.chunks:
+                    await limiter.acquire(len(chunk))
+                    await cutter.feed(chunk)
+            finally:
+                cutter.close()   # group stream died mid-piece
+            if cutter.num != last:
                 raise DFError(Code.CLIENT_BACK_SOURCE_ERROR,
                               f"short origin range read: group stopped at "
-                              f"piece {num}/{last}")
+                              f"piece {cutter.num}/{last}")
 
         async def worker() -> None:
             while queue:

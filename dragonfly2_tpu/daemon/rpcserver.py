@@ -290,6 +290,18 @@ class DaemonService:
 
     # -- peer API ------------------------------------------------------
 
+    def _relay_ahead(self, task_id: str, known: set[int],
+                     start_num: int = 0) -> list:
+        """Announce-ahead infos: pieces IN-FLIGHT on this daemon right now
+        (daemon/relay.py spans). A child that pulls one is served to the
+        landing watermark by the upload server's streaming path — this is
+        the control-plane half of cut-through relay."""
+        relay = getattr(self.ptm, "relay", None)
+        if relay is None:
+            return []
+        return [i for i in relay.inflight_infos(task_id)
+                if i.piece_num not in known and i.piece_num >= start_num]
+
     async def get_piece_tasks(self, request: PieceTaskRequest, context) -> PiecePacket:
         ts = self.ptm.storage_mgr.get(request.task_id)
         conductor = self.ptm.conductor(request.task_id)
@@ -299,11 +311,19 @@ class DaemonService:
             raise DFError(Code.NOT_FOUND, f"task {request.task_id[:12]} unknown")
         infos = [p.to_info() for p in ts.piece_infos(request.start_num, request.limit)]
         md = ts.md
+        ahead = self._relay_ahead(request.task_id,
+                                  {p.piece_num for p in infos}
+                                  | set(md.pieces),
+                                  request.start_num)
         return PiecePacket(task_id=request.task_id, dst_peer_id=request.dst_peer_id,
-                           dst_addr=self.upload_addr, piece_infos=infos,
+                           dst_addr=self.upload_addr,
+                           piece_infos=infos + ahead,
                            total_piece_count=md.total_piece_count,
                            content_length=md.content_length,
-                           piece_size=md.piece_size)
+                           piece_size=md.piece_size,
+                           progress=len(md.pieces),
+                           relay_nums=([i.piece_num for i in ahead]
+                                       or None))
 
     def _storage_for(self, task_id: str, conductor):
         ts = self.ptm.storage_mgr.get(task_id)
@@ -312,8 +332,11 @@ class DaemonService:
         return ts
 
     def _packet_for_nums(self, request: PieceTaskRequest, conductor,
-                         nums: list[int]) -> PiecePacket | None:
-        """Announcement packet carrying exactly ``nums`` (batch push)."""
+                         nums: list[int],
+                         relay_nums: list[int] | None = None,
+                         ) -> PiecePacket | None:
+        """Announcement packet carrying exactly ``nums`` (batch push) plus
+        any still-in-flight ``relay_nums`` (announce-ahead)."""
         ts = self._storage_for(request.task_id, conductor)
         if ts is None:
             return None
@@ -324,13 +347,32 @@ class DaemonService:
             p = ts.md.pieces.get(n)
             if p is not None:
                 infos.append(p.to_info())
+        ahead = []
+        if relay_nums:
+            live = {i.piece_num: i
+                    for i in self._relay_ahead(request.task_id,
+                                               set(ts.md.pieces))}
+            for n in relay_nums:
+                p = ts.md.pieces.get(n)
+                if p is not None:
+                    infos.append(p.to_info())   # landed while queued
+                elif n in live:
+                    ahead.append(live[n])
+                # else: the span died between the event and this packet
+                # (failed transfer / corrupt landing) — dropped from the
+                # packet; the caller un-marks it as sent so the eventual
+                # landing re-announces it with a digest
         md = ts.md
         return PiecePacket(task_id=request.task_id,
                            dst_peer_id=request.dst_peer_id,
-                           dst_addr=self.upload_addr, piece_infos=infos,
+                           dst_addr=self.upload_addr,
+                           piece_infos=infos + ahead,
                            total_piece_count=md.total_piece_count,
                            content_length=md.content_length,
-                           piece_size=md.piece_size)
+                           piece_size=md.piece_size,
+                           progress=len(md.pieces),
+                           relay_nums=([i.piece_num for i in ahead]
+                                       or None))
 
     @staticmethod
     def _drain(q: asyncio.Queue, first) -> list:
@@ -380,18 +422,34 @@ class DaemonService:
                 while not done:
                     events = self._drain(q, await q.get())
                     nums: list[int] = []
+                    relay_nums: list[int] = []
                     for event in events:
                         if (event["type"] == "piece"
                                 and event["num"] not in sent):
                             sent.add(event["num"])
                             nums.append(event["num"])
+                        elif event["type"] == "relay":
+                            # announce-ahead: these pieces are arriving on
+                            # this daemon NOW — a child may begin pulling
+                            # them against the landing watermark
+                            for nn in event["nums"]:
+                                if nn not in sent:
+                                    sent.add(nn)
+                                    relay_nums.append(nn)
                         elif event["type"] == "done":
                             done = True
-                    if nums and not done:
-                        refreshed = self._packet_for_nums(request, conductor,
-                                                          nums)
+                    if (nums or relay_nums) and not done:
+                        refreshed = self._packet_for_nums(
+                            request, conductor, nums,
+                            relay_nums=relay_nums)
                         if refreshed is not None:
-                            yield refreshed
+                            announced = {p.piece_num for p in
+                                         refreshed.piece_infos or []}
+                            for nn in relay_nums:
+                                if nn not in announced:
+                                    sent.discard(nn)
+                            if refreshed.piece_infos:
+                                yield refreshed
                     elif done:
                         yield await self.get_piece_tasks(PieceTaskRequest(
                             task_id=request.task_id,

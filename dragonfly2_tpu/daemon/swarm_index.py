@@ -36,13 +36,16 @@ class SwarmEntry:
     """One remote host's advertised availability for one task."""
 
     __slots__ = ("host_id", "ip", "rpc_port", "download_port", "is_seed",
-                 "topology", "pieces", "total_pieces", "content_length",
-                 "piece_size", "done", "expires_at")
+                 "topology", "pieces", "relay_pieces", "total_pieces",
+                 "content_length", "piece_size", "done", "expires_at",
+                 "progress_at")
 
     def __init__(self, *, host_id: str, ip: str, rpc_port: int,
                  download_port: int, is_seed: bool = False,
                  topology: TopologyInfo | None = None,
-                 pieces: set[int] | None = None, total_pieces: int = -1,
+                 pieces: set[int] | None = None,
+                 relay_pieces: set[int] | None = None,
+                 total_pieces: int = -1,
                  content_length: int = -1, piece_size: int = 0,
                  done: bool = False, expires_at: float = 0.0):
         self.host_id = host_id
@@ -52,11 +55,21 @@ class SwarmEntry:
         self.is_seed = is_seed
         self.topology = topology
         self.pieces = pieces          # None = complete (all pieces)
+        # the advertised landing watermark (daemon/relay.py): pieces
+        # IN-FLIGHT at the holder when it gossiped — usable for parent
+        # ordering and (while FRESH, see progress_at) for the pex rung's
+        # coverage gate; a watermark that stopped advancing is a claim,
+        # not a holding
+        self.relay_pieces = relay_pieces
         self.total_pieces = total_pieces
         self.content_length = content_length
         self.piece_size = piece_size
         self.done = done
         self.expires_at = expires_at
+        # when this holder's advertised piece/watermark set last GREW
+        # (maintained by SwarmIndex.update): the freshness the coverage
+        # gate checks before trusting relay_pieces
+        self.progress_at = 0.0
 
     @property
     def addr(self) -> str:
@@ -67,12 +80,25 @@ class SwarmEntry:
             return self.total_pieces if self.total_pieces >= 0 else 1 << 30
         return len(self.pieces)
 
+    def advertised_count(self) -> int:
+        """Landed + in-flight — the growth signal progress_at tracks."""
+        return self.piece_count() + len(self.relay_pieces or ())
+
+    def progress_fresh(self, now: float, ttl_s: float) -> bool:
+        """True while the holder's watermark advanced within ``ttl_s`` —
+        only then may its in-flight claims count as coverage."""
+        return self.done or self.pieces is None \
+            or now - self.progress_at <= ttl_s
+
     def describe(self) -> dict:
         return {"host_id": self.host_id, "addr": self.addr,
                 "rpc_port": self.rpc_port, "is_seed": self.is_seed,
                 "done": self.done, "pieces": self.piece_count(),
+                "relay_pieces": len(self.relay_pieces or ()),
                 "total_pieces": self.total_pieces,
                 "content_length": self.content_length,
+                "progress_age_s": round(
+                    max(time.monotonic() - self.progress_at, 0.0), 1),
                 "expires_in_s": round(max(self.expires_at - time.monotonic(),
                                           0.0), 1)}
 
@@ -81,10 +107,16 @@ class SwarmIndex:
     """task_id -> {host_id -> SwarmEntry}, TTL'd and size-capped."""
 
     def __init__(self, *, ttl_s: float = 60.0, max_tasks: int = 512,
-                 max_holders_per_task: int = 64):
+                 max_holders_per_task: int = 64,
+                 progress_ttl_s: float = 15.0):
         self.ttl_s = ttl_s
         self.max_tasks = max_tasks
         self.max_holders_per_task = max_holders_per_task
+        # how long a partial holder's watermark may sit still before its
+        # in-flight claims stop counting as coverage (pex._covers_task) —
+        # a few gossip intervals: one missed round is jitter, three is a
+        # download that died
+        self.progress_ttl_s = progress_ttl_s
         self._tasks: dict[str, dict[str, SwarmEntry]] = {}
 
     # -- ingest --------------------------------------------------------
@@ -93,6 +125,20 @@ class SwarmIndex:
                *, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
         entry.expires_at = now + self.ttl_s
+        prev = self._tasks.get(task_id, {}).get(entry.host_id)
+        if prev is None or entry.piece_count() > prev.piece_count() \
+                or entry.advertised_count() > prev.advertised_count() \
+                or (entry.done and not prev.done):
+            # first sighting, or the watermark moved: the holder is alive
+            # AND landing — only growth refreshes progress (re-gossiping
+            # the same stuck set forever must not). The LANDED count is
+            # checked on its own: in a download's tail each landing
+            # converts an in-flight piece to a landed one one-for-one,
+            # so the sum stays flat while the holder is demonstrably
+            # still making progress
+            entry.progress_at = now
+        else:
+            entry.progress_at = prev.progress_at
         holders = self._tasks.get(task_id)
         if holders is None:
             if len(self._tasks) >= self.max_tasks:
@@ -152,7 +198,12 @@ class SwarmIndex:
             hops = (ici_hops(self_topology, e.topology)
                     if self_topology is not None and e.topology is not None
                     else 1 << 16)
-            return (not e.done, int(lt), hops, -e.piece_count(), e.host_id)
+            # stale-watermark partials rank behind fresh ones: a holder
+            # whose advertised progress stopped moving is likelier to be
+            # a dead download than a busy one
+            stale = not e.progress_fresh(now, self.progress_ttl_s)
+            return (not e.done, stale, int(lt), hops, -e.piece_count(),
+                    e.host_id)
 
         return sorted(live, key=key)
 

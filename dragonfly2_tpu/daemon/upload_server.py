@@ -14,7 +14,7 @@ from collections import deque
 
 from aiohttp import web
 
-from ..common import tracing
+from ..common import faultgate, tracing
 from ..common.aiohttp_util import resolve_port
 from ..common.errors import DFError
 from ..common.metrics import BYTES_BUCKETS, REGISTRY
@@ -44,6 +44,20 @@ _upload_serve_secs = REGISTRY.histogram(
 _upload_wait_secs = REGISTRY.histogram(
     "df_upload_limiter_wait_seconds",
     "rate-limiter wait per served range")
+# cut-through relay serving (daemon/relay.py): ranges streamed against the
+# landing watermark instead of 416ing on an incomplete piece
+_relay_serves = REGISTRY.counter(
+    "df_relay_serves_total",
+    "streaming relay range serves", ("result",))
+_relay_bytes = REGISTRY.counter(
+    "df_relay_bytes_total",
+    "bytes served by the streaming relay path", ("src",))
+_relay_stalls = REGISTRY.counter(
+    "df_relay_stalls_total",
+    "relay serves aborted because the landing watermark stopped advancing")
+_relay_wait_secs = REGISTRY.histogram(
+    "df_relay_wait_seconds",
+    "time a streaming relay serve spent awaiting landing progress")
 
 
 class _Slot:
@@ -151,13 +165,20 @@ class UploadServer:
     # how long a request may queue for a slot before 503ing (see the gate)
     SLOT_WAIT_S = 0.2
 
+    # max bytes moved per streaming-relay write: bounds the on-loop copy
+    # from a live span's buffer and keeps the limiter granular
+    RELAY_CHUNK = 1 << 20
+
     def __init__(self, storage_mgr: StorageManager, *, port: int = 0,
                  rate_limit_bps: int = 0, concurrent_limit: int = 0,
                  host: str = "0.0.0.0", debug_endpoints: bool = False,
-                 flight_recorder=None, pex=None):
+                 flight_recorder=None, pex=None, relay=None,
+                 relay_stall_s: float = 10.0):
         self.storage_mgr = storage_mgr
         self.flight_recorder = flight_recorder
         self.pex = pex
+        self.relay = relay                  # RelayHub (None = store-and-forward)
+        self.relay_stall_s = relay_stall_s  # per-wait watermark deadline
         self.host = host
         self.port = port
         self.tls: tuple[str, str, str] | None = None   # (cert, key, ca)
@@ -272,8 +293,22 @@ class UploadServer:
         if self.mux is not None:
             self.mux.cleanup_backend_files()
 
+    @staticmethod
+    def _progress_headers(ts) -> dict:
+        """The advertised landing watermark (``X-DF-Piece-Progress``):
+        pieces landed / total, on every piece response — the wire half of
+        the piece-progress signal (a child sees how complete the holder
+        it is pulling from is)."""
+        md = getattr(ts, "md", None)
+        pieces = getattr(md, "pieces", None)
+        if pieces is None:
+            return {}
+        total = getattr(md, "total_piece_count", -1)
+        return {"X-DF-Piece-Progress": f"{len(pieces)}/{total}"}
+
     def _arm_serve_journal(self, slot: _Slot, request: web.Request, ts,
-                           rng, *, wait_ms: float) -> None:
+                           rng, *, wait_ms: float,
+                           relayed: bool = False) -> None:
         """Arm the slot to journal this serve once the body is fully sent:
         one UPLOAD edge row (requesting peer, piece idx, bytes, slot-hold
         serve ms, limiter-wait ms) on the task's flight — the parent half
@@ -306,7 +341,8 @@ class UploadServer:
                 if flight is not None:
                     flight.serve(peer=peer_id, addr=addr, piece=piece,
                                  nbytes=nbytes, serve_ms=held_ms,
-                                 wait_ms=wait_ms, pieces=span)
+                                 wait_ms=wait_ms, pieces=span,
+                                 relayed=relayed)
 
         slot.on_release = journal
 
@@ -343,10 +379,18 @@ class UploadServer:
             _upload_reqs.labels("416").inc()
             raise web.HTTPRequestRangeNotSatisfiable(text=str(exc))
         has = getattr(ts, "has_range", None)
+        streaming = False
         if has is not None and not has(rng.start, rng.length):
-            _upload_reqs.labels("416").inc()
-            raise web.HTTPRequestRangeNotSatisfiable(
-                text=f"bytes {rng.start}+{rng.length} not stored yet")
+            if self.relay is not None and self.relay.active(task_id):
+                # cut-through relay: the task is mid-landing on this
+                # daemon — stream the range against the landing watermark
+                # (serve what has arrived, await the rest with a bounded
+                # deadline) instead of 416ing on an incomplete piece
+                streaming = True
+            else:
+                _upload_reqs.labels("416").inc()
+                raise web.HTTPRequestRangeNotSatisfiable(
+                    text=f"bytes {rng.start}+{rng.length} not stored yet")
         slot = None
         if self._active >= self.concurrent_limit or self._slot_waiters:
             # bounded slot wait BEFORE 503ing: when the gate is full but
@@ -402,6 +446,9 @@ class UploadServer:
         if slot is None:
             slot = _Slot(self)   # held until the BODY is sent (slot classes)
         try:
+            if streaming:
+                return await self._serve_relay(request, ts, rng, slot,
+                                               task_id)
             # whole-file tasks: serve via sendfile (FileResponse honors
             # Range) so piece bytes never enter Python — the upload path is
             # the hottest loop on a seed peer.
@@ -415,7 +462,8 @@ class UploadServer:
                 self._arm_serve_journal(
                     slot, request, ts, rng,
                     wait_ms=(time.monotonic() - wait_t0) * 1000.0)
-                return _SlotFileResponse(data_path(), slot)
+                return _SlotFileResponse(data_path(), slot,
+                                         headers=self._progress_headers(ts))
             # acquire BEFORE the read, matching the sendfile branch: a
             # rate-limited seed must not buffer a multi-MiB range it then
             # sits on for the whole token wait (the bytes pin memory and
@@ -457,11 +505,171 @@ class UploadServer:
                 slot, status=206, body=data,
                 headers={"Content-Range":
                          f"bytes {rng.start}-{rng.end - 1}/{total}",
-                         "Content-Type": "application/octet-stream"})
+                         "Content-Type": "application/octet-stream",
+                         **self._progress_headers(ts)})
         except BaseException:
             # never reached the transfer: give the slot back here (the
             # response's own release only runs once it is being sent)
             slot.release()
             raise
+
+    async def _serve_relay(self, request: web.Request, ts, rng,
+                           slot: _Slot, task_id: str) -> web.StreamResponse:
+        """Cut-through range serve: stream bytes up to the landing
+        frontier (verified pieces on disk + the live span's watermark),
+        awaiting further progress with a bounded per-wait deadline.
+
+        Outcomes: complete (the whole range streamed — possibly before
+        this daemon itself finished the piece, which IS the point);
+        stalled-before-first-byte (503 with a retry hint — the child
+        requeues without a strike, like any busy parent); stalled or
+        evicted mid-stream (connection aborted — the child's short read
+        requeues the piece against another holder). Limiter tokens are
+        acquired per chunk just before the write and refunded when that
+        chunk's bytes never moved (eviction/cancel), the same contract as
+        the 404 path."""
+        relay = self.relay
+        total = ts.md.content_length
+        landed, total_pieces = relay.progress(task_id, ts)
+        resp = web.StreamResponse(
+            status=206,
+            headers={"Content-Range":
+                     f"bytes {rng.start}-{rng.end - 1}/"
+                     f"{total if total >= 0 else '*'}",
+                     "Content-Type": "application/octet-stream",
+                     "X-DF-Piece-Progress": f"{landed}/{total_pieces}",
+                     "X-DF-Relay": "1"})
+        resp.content_length = rng.length
+        pos = rng.start
+        wait_s = 0.0
+        limiter_ms = 0.0
+        # "aborted" covers exits that never set a verdict (client
+        # disconnect/cancel mid-stream) — they must not count as "ok"
+        result = "aborted"
+        # the stall deadline re-arms ONLY when THIS reader's frontier
+        # moves: task-wide progress pulses wake the wait, but a serve
+        # parked at an offset that never advances must still expire in
+        # relay_stall_s even while other pieces keep landing — otherwise
+        # a dead announce-ahead piece holds an upload slot for the rest
+        # of the task's lifetime
+        stall_at = time.monotonic() + self.relay_stall_s
+        last_avail = pos
+        try:
+            while pos < rng.end:
+                if faultgate.ARMED:
+                    # 'hang' models an upstream whose watermark stopped
+                    # advancing — bounded by the SAME stall deadline a
+                    # real dead watermark gets, so the serve degrades
+                    # (503/abort, slot released) instead of wedging; the
+                    # child's per-piece deadline usually fires first
+                    try:
+                        await asyncio.wait_for(
+                            faultgate.fire("relay.stall", key=task_id),
+                            self.relay_stall_s)
+                    except asyncio.TimeoutError:
+                        result = "stall"
+                        _relay_stalls.inc()
+                        break
+                avail = relay.available_end(task_id, ts, pos, rng.end)
+                if avail > last_avail:
+                    last_avail = avail
+                    stall_at = time.monotonic() + self.relay_stall_s
+                if avail <= pos:
+                    if not relay.active(task_id):
+                        # task finished under us without covering the
+                        # rest (failed / piece rejected at landing)
+                        result = "abandoned"
+                        break
+                    remaining = stall_at - time.monotonic()
+                    if remaining <= 0:
+                        result = "stall"
+                        _relay_stalls.inc()
+                        break
+                    w0 = time.monotonic()
+                    await relay.wait_progress(task_id, remaining)
+                    wait_s += time.monotonic() - w0
+                    continue
+                n = min(self.RELAY_CHUNK, avail - pos)
+                try:
+                    chunk = relay.read_span(task_id, pos, n)
+                    src = "span"
+                    if chunk is None:
+                        # landed region: read the verified bytes from
+                        # disk — clamped to what the piece table says is
+                        # ACTUALLY on disk at ``pos`` (the frontier may
+                        # extend into a live span whose base is past
+                        # pos; pread there would return unwritten file
+                        # space and serve it as content)
+                        covered = getattr(ts, "covered_prefix", None)
+                        hi = (covered(pos, pos + n) if covered is not None
+                              else pos + n)
+                        if hi <= pos:
+                            # raced: the span retired/landed between the
+                            # avail check and the read — re-check
+                            await relay.wait_progress(task_id, 0.05)
+                            continue
+                        chunk = await run_io(ts.read_range, pos, hi - pos)
+                        src = "storage"
+                except (DFError, OSError):
+                    # task evicted mid-stream: abort (no tokens held —
+                    # they are acquired below, for bytes that move)
+                    result = "evicted"
+                    break
+                if not chunk:
+                    # short disk read (frontier raced): re-check, no spin
+                    await relay.wait_progress(task_id, 0.05)
+                    continue
+                # tokens for EXACTLY the bytes about to move (a span read
+                # clamps at its watermark, a disk read at the covered
+                # frontier — charging the pre-clamp size would leak
+                # reserved bandwidth on every boundary chunk)
+                l0 = time.monotonic()
+                await self.limiter.acquire(len(chunk))
+                limiter_ms += (time.monotonic() - l0) * 1000.0
+                try:
+                    if resp.prepared is False:
+                        await resp.prepare(request)
+                    await resp.write(chunk)
+                except BaseException:
+                    # the write never completed: refund (PR 5 contract)
+                    self.limiter.refund(len(chunk))
+                    raise
+                _relay_bytes.labels(src).inc(len(chunk))
+                _upload_bytes.inc(len(chunk))
+                pos += len(chunk)
+            if pos >= rng.end:
+                # eof INSIDE the try, BEFORE the journal fires: a child
+                # that disconnected on the last chunk makes write_eof
+                # raise, and the serve must then journal as aborted —
+                # not as a completed transfer (the _Slot.ok contract)
+                await resp.write_eof()
+                result = "ok"
+        finally:
+            _relay_wait_secs.observe(wait_s)
+            _relay_serves.labels(result).inc()
+            if result == "ok":
+                _upload_reqs.labels("206").inc()
+                _upload_piece_bytes.observe(rng.length)
+                self._arm_serve_journal(slot, request, ts, rng,
+                                        wait_ms=limiter_ms, relayed=True)
+                slot.ok = True
+            slot.release()
+        if result == "ok":
+            return resp
+        if not resp.prepared:
+            # nothing sent yet: a clean 503 with the stall as the hint —
+            # the child backs off and requeues without a failure strike
+            _upload_reqs.labels("503").inc()
+            raise web.HTTPServiceUnavailable(
+                text=f"relay {result}: watermark not advancing",
+                headers={"Retry-After": "1",
+                         "X-Retry-After-Ms": "500"})
+        # mid-stream stall/eviction: abort the connection so the child
+        # sees a short read (CLIENT_PIECE_DOWNLOAD_FAIL -> requeue against
+        # another holder) instead of a clean-looking EOF
+        transport = request.transport
+        if transport is not None:
+            transport.close()
+        raise ConnectionResetError(f"relay serve aborted: {result}")
 
 

@@ -160,6 +160,15 @@ class PiecePacket:
     content_length: int = -1
     piece_size: int = 0
     extend_attribute: dict | None = None
+    # the holder's advertised landing watermark: pieces landed so far
+    # (-1 = not reported). Rides every announcement so a child can see
+    # how complete the partial holder it is pulling from is.
+    progress: int = -1
+    # cut-through announce-ahead (daemon/relay.py): piece numbers in
+    # ``piece_infos`` that are IN-FLIGHT at the holder right now — the
+    # upload server serves them to the landing watermark, so a child may
+    # begin pulling before the holder finishes receiving them
+    relay_nums: list[int] | None = None
 
 
 @message

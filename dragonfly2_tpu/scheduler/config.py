@@ -56,6 +56,15 @@ class SchedulerConfig:
     # node of the distribution tree (see resource.Host)
     peer_upload_limit: int = 0             # 0 -> Host.DEFAULT_PEER_UPLOAD_LIMIT
     seed_upload_limit: int = 0             # 0 -> Host.DEFAULT_SEED_UPLOAD_LIMIT
+    # relay-tree shaping (0 = off, the exact pre-relay scoring path —
+    # dfbench's baseline schedule_digest stays byte-identical). When > 0,
+    # a parent already feeding this many direct children in the task DAG
+    # is demoted behind under-cap candidates, so a cold fan-out forms
+    # ICI-near relay CHAINS of depth ~log_fanout(N) instead of a star on
+    # the seed whose one uplink then sets the pod's cold-start makespan
+    # (see Scheduling._relay_shape; cut-through serving makes the chain
+    # hops overlap, daemon/relay.py).
+    relay_fanout: int = 0
     retry_limit: int = RETRY_LIMIT
     retry_back_source_limit: int = RETRY_BACK_SOURCE_LIMIT
     back_source_concurrent: int = DEFAULT_BACK_SOURCE_CONCURRENT

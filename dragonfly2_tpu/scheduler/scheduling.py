@@ -133,6 +133,39 @@ class Scheduling:
             return top
         return [*top[:-1], holder] if top else [holder]
 
+    def _relay_shape(self, child: Peer,
+                     scored: list[Peer]) -> tuple[list[Peer], dict | None]:
+        """Relay-chain shaping (``cfg.relay_fanout`` > 0): demote parents
+        already feeding ``relay_fanout`` direct children behind under-cap
+        candidates. Score order — which already prefers ICI-near hosts
+        via the evaluator's locality term (tpu/topology.py distance) —
+        is preserved WITHIN each partition, so the choice among legal
+        relays stays the evaluator's; only the fan-out cap is imposed on
+        top. Parents this child already holds keep their edge (the same
+        stickiness rationale as the no-slots filter): the cap shapes NEW
+        edges, it never tears down working ones. Returns the reshaped
+        order plus the ledger annotation (None when nothing was capped)
+        so every relay ruling stays explainable in the decision row."""
+        fanout = self.cfg.relay_fanout
+        dag = child.task.dag
+        mine = child.last_offer_ids
+        under: list[Peer] = []
+        over: list[Peer] = []
+        counts: dict[str, int] = {}
+        for p in scored:
+            n = len(dag.children(p.id)) if p.id in dag else 0
+            counts[p.id] = n
+            if n >= fanout and p.id not in mine:
+                over.append(p)
+            else:
+                under.append(p)
+        if not over:
+            return scored, None
+        note = {"fanout": fanout,
+                "capped": [p.id for p in over],
+                "child_counts": {p.id: counts[p.id] for p in over}}
+        return under + over, note
+
     def find_parents(self, child: Peer) -> list[Peer]:
         return self._decide(child, "find")
 
@@ -157,6 +190,7 @@ class Scheduling:
         candidates = self.filter_candidates(child, excluded)
         total = child.task.total_piece_count
         explained: list[tuple[Peer, dict]] = []
+        relay_note: dict | None = None
         prev_offer = set(child.last_offer_ids)
         if not candidates:
             offer: list[Peer] = []
@@ -173,6 +207,8 @@ class Scheduling:
                     for p in candidates]
                 explained.sort(key=lambda pe: pe[1]["total"], reverse=True)
                 scored = [p for p, _ in explained]
+            if self.cfg.relay_fanout > 0:
+                scored, relay_note = self._relay_shape(child, scored)
             if decision_kind == "refresh":
                 kept = [p for p in scored if p.id in prev_offer]
                 fresh = [p for p in scored if p.id not in prev_offer]
@@ -183,12 +219,14 @@ class Scheduling:
                     scored, scored[:self.cfg.candidate_parent_limit])
         if sink is not None:
             self._emit_decision(child, decision_kind, explained,
-                                excluded or [], offer, prev_offer, total)
+                                excluded or [], offer, prev_offer, total,
+                                relay_note=relay_note)
         return offer
 
     def _emit_decision(self, child: Peer, decision_kind: str,
                        explained: list, excluded: list, offer: list[Peer],
-                       prev_offer: set, total: int) -> None:
+                       prev_offer: set, total: int,
+                       relay_note: dict | None = None) -> None:
         self._decision_seq += 1
         decision_id = f"d{self._decision_seq:08d}.{child.id[-12:]}"
         candidates = []
@@ -234,6 +272,12 @@ class Scheduling:
                           "reason": reason} for p, reason in excluded],
             "chosen": [p.id for p in offer],
         }
+        if relay_note is not None:
+            # relay-tree shaping ruling: which candidates the fan-out cap
+            # demoted and their DAG child counts — the relay analog of
+            # the excluded[] reasons, so "why isn't the seed my parent"
+            # is answerable from the row alone
+            row["relay"] = relay_note
         if decision_kind == "refresh":
             # sticky attribution of the final offer: which slots the
             # stickiness held vs which the newcomers won

@@ -19,6 +19,7 @@ Python fallback) and one write syscall chain instead of N of each.
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import logging
 import os
@@ -70,6 +71,8 @@ class TaskStorage:
         self._fd: int | None = None        # cached O_RDWR fd (lazy)
         self._fd_users = 0                 # leases out via _data_fd()
         self._fd_close_deferred = False    # close() arrived mid-lease
+        # covered_prefix memo: (piece_count, merged [start, end) spans)
+        self._cover_cache: tuple[int, list[list[int]]] | None = None
         self._data_path = os.path.join(task_dir, DATA_FILE)
         os.makedirs(task_dir, exist_ok=True)
         if not os.path.exists(self._data_path):
@@ -357,6 +360,39 @@ class TaskStorage:
             raise DFError(Code.CLIENT_STORAGE_ERROR,
                           f"range read @{start}+{length} failed: "
                           f"{exc}") from None
+
+    def covered_prefix(self, start: int, end: int) -> int:
+        """How far recorded (verified) pieces contiguously cover from
+        ``start``, clipped to ``end`` — the landed half of the relay
+        plane's progress watermark (daemon/relay.py). Returns ``start``
+        when the byte at ``start`` is not stored.
+
+        Called per served chunk AND per progress wake by the streaming
+        relay path, on the event loop — so the merged coverage spans are
+        cached and rebuilt only when a piece lands (the piece table only
+        ever grows, so the count is a valid cache key), making each call
+        one bisect instead of an O(P log P) sort."""
+        if end <= start:
+            return start
+        with self._lock:
+            key = len(self.md.pieces)
+            cache = self._cover_cache
+            if cache is None or cache[0] != key:
+                merged: list[list[int]] = []
+                for s, e in sorted((p.start, p.start + p.size)
+                                   for p in self.md.pieces.values()):
+                    if merged and s <= merged[-1][1]:
+                        if e > merged[-1][1]:
+                            merged[-1][1] = e
+                    else:
+                        merged.append([s, e])
+                cache = (key, merged)
+                self._cover_cache = cache
+        spans = cache[1]
+        i = bisect.bisect_right(spans, [start, 1 << 62]) - 1
+        if i < 0 or spans[i][1] <= start:
+            return start
+        return min(spans[i][1], end)
 
     def has_range(self, start: int, length: int) -> bool:
         """True if stored pieces fully cover [start, start+length)."""

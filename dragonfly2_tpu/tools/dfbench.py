@@ -71,6 +71,18 @@ POLL_MS = 5.0                    # starved-worker re-poll (virtual)
 PEX_CONVERGE_MS = 40.0           # modeled gossip round trip to membership
 
 SCENARIOS = ("baseline", "scheds_down_no_pex", "scheds_down_pex")
+# PR-9 cold start (ROADMAP item 2): every daemon joins within COLD_JOIN_MS
+# of t=0 against ONE pre-seeded host. ``cold_pull`` is strict
+# store-and-forward (a piece must fully land on a parent before a child
+# may fetch it — the pre-relay fabric); ``cold_relay`` is cut-through:
+# a dispatched piece is announce-ahead pullable from its receiver, a
+# child's first byte rides one hop-RTT behind the parent's, and the
+# scheduler shapes the tree with the relay fan-out cap
+# (SchedulerConfig.relay_fanout -> Scheduling._relay_shape).
+COLD_SCENARIOS = ("cold_pull", "cold_relay")
+COLD_JOIN_MS = 2.0               # cold herd: all joins inside this window
+COLD_REFRESH_MS = 25.0           # starvation-refresh throttle (cold sizes)
+RELAY_FANOUT = 4                 # tree cap the cold_relay scheduler applies
 
 STAGES = ("schedule", "first_byte", "wire", "hbm", "total")
 _ROW_KEY = {"schedule": "queue_ms", "first_byte": "ttfb_ms",
@@ -84,7 +96,8 @@ from ..daemon.flight_recorder import _pctl  # noqa: E402
 class _Leecher:
     __slots__ = ("peer", "flight", "done", "inflight", "parents",
                  "schedule", "landed_at", "joined_ms", "done_ms",
-                 "since_refresh", "pex_at", "timeline")
+                 "since_refresh", "pex_at", "timeline", "arrive",
+                 "last_refresh", "relay_pulls")
 
     def __init__(self, peer, flight, joined_ms: float):
         self.peer = peer
@@ -101,6 +114,12 @@ class _Leecher:
         # (t_wire_done, wire_ms, size) per landed piece — feeds the PR-5
         # data-plane replay (collect_timeline); never in the rng path
         self.timeline: list[tuple[float, float, int]] = []
+        # cut-through bookkeeping (cold_relay): per dispatched piece, when
+        # ITS first byte and last byte land here — a child relaying off
+        # this leecher pipelines one hop-RTT behind these moments
+        self.arrive: dict[int, tuple[float, float]] = {}
+        self.last_refresh = -1e9           # starvation-refresh throttle
+        self.relay_pulls = 0               # pieces pulled cut-through
 
 
 # pseudo-parent id for back-source fetches in the scheds-down scenario
@@ -128,10 +147,12 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     never touches the rng, so the digest cannot move (gated in
     tests/test_dfbench.py); these rows feed the --pr8 counterfactual
     replay."""
-    if scenario not in SCENARIOS:
+    if scenario not in SCENARIOS + COLD_SCENARIOS:
         raise ValueError(f"unknown scenario {scenario!r} "
-                         f"(known: {SCENARIOS})")
-    scheds_up = scenario == "baseline"
+                         f"(known: {SCENARIOS + COLD_SCENARIOS})")
+    cold = scenario in COLD_SCENARIOS
+    relay_mode = scenario == "cold_relay"
+    scheds_up = scenario == "baseline" or cold
     pex = scenario == "scheds_down_pex"
     from ..daemon import flight_recorder as fr
     from ..daemon.flight_recorder import TaskFlight
@@ -151,7 +172,12 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     res = Resource()
     task = Task("bench" + "0" * 59, "bench://blob")
     task.set_content_info(pieces * piece_size, piece_size, pieces)
-    sched = Scheduling(SchedulerConfig(), make_evaluator("default"))
+    # cold_relay drives the REAL relay-tree shaping: the same
+    # Scheduling._relay_shape ruling a live scheduler applies (relay off =
+    # the exact baseline scoring path, so the PR-3 digest cannot move)
+    sched = Scheduling(
+        SchedulerConfig(relay_fanout=RELAY_FANOUT if relay_mode else 0),
+        make_evaluator("default"))
     decision_rows: list[dict] = []
     if collect_decisions:
         sched.decision_sink = decision_rows.append
@@ -190,7 +216,13 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
         idx = i // 2
         peer = mk_peer(f"s{s}w{idx}", f"slice-{s}", idx % 2, idx // 2,
                        register=False)
-        joined = i * 20.0 * rng.uniform(0.9, 1.1)
+        if cold:
+            # cold herd: the whole pod joins within COLD_JOIN_MS of t=0 —
+            # the 1-seed fan-out regime the relay work exists for
+            joined = (i * COLD_JOIN_MS / max(daemons, 1)) \
+                * rng.uniform(0.8, 1.2)
+        else:
+            joined = i * 20.0 * rng.uniform(0.9, 1.1)
         # ring sized to the run: the recorder's 4096 default would silently
         # drop the earliest events past ~800 pieces and corrupt the numbers
         flight = TaskFlight(task.id, peer.id, url="bench://blob",
@@ -206,6 +238,13 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
 
     by_peer_id = {lc.peer.id: lc for lc in leechers}
     active: dict[str, int] = {}        # parent peer id -> live transfers
+    # distinct children each parent has ever served (cold scenarios): the
+    # demand-side half of the relay fan-out cap — a parent already feeding
+    # RELAY_FANOUT other children ranks behind under-cap holders, so the
+    # distribution tree fills breadth-first (depth ~log_F N, the shape
+    # Scheduling._relay_shape rules for) instead of chaining on whichever
+    # joiner is newest
+    served_children: dict[str, set[str]] = {}
 
     def refresh_parents(lc: _Leecher, now: float = 0.0) -> None:
         if scheds_up:
@@ -232,6 +271,20 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
         if src is None:
             return False
         t = src.landed_at.get(piece)
+        if t is not None and t <= now:
+            return True
+        # cut-through: a piece the parent has DISPATCHED is announce-ahead
+        # requestable; the child's transfer pipelines one hop-RTT behind
+        # the parent's (the dispatch-time max() below)
+        return relay_mode and piece in src.arrive
+
+    def landed_now(parent, piece: int, now: float) -> bool:
+        if parent is seed_peer:
+            return True
+        src = by_peer_id.get(parent.id)
+        if src is None:
+            return False
+        t = src.landed_at.get(piece)
         return t is not None and t <= now
 
     def pick(lc: _Leecher, now: float):
@@ -251,8 +304,41 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
                 continue
             lt = {p.id: link_type(lc.peer.host.msg.topology,
                                   p.host.msg.topology) for p in holders}
-            holders.sort(key=lambda p: (active.get(p.id, 0),
-                                        int(lt[p.id]), p.id))
+            if cold:
+                # the engine dispatcher's actual rank (ParentState.rank):
+                # seeds STRICTLY last — the seed uplink is the scarce
+                # resource a cold fan-out exists to conserve — then (for
+                # cut-through) holders whose bytes are ready over ones
+                # still receiving, then load and link like the base rule
+                def is_seed(p) -> int:
+                    return 1 if p is seed_peer \
+                        or p.host.msg.type != HostType.NORMAL else 0
+
+                def capped(p) -> int:
+                    kids = served_children.get(p.id)
+                    if kids is None or lc.peer.id in kids:
+                        return 0           # adopted children keep their edge
+                    return 1 if len(kids) >= RELAY_FANOUT else 0
+
+                def avail_ms(p) -> float:
+                    # when this holder's copy of the piece is (or will
+                    # be) fully landed: 0 = ready now; an in-flight
+                    # holder k hops down a chain lands k hop-RTTs later,
+                    # so preferring EARLIER copies fills the tree
+                    # breadth-first — the cap then spills overflow one
+                    # level down instead of chaining on the newest joiner
+                    if landed_now(p, piece, now):
+                        return 0.0
+                    up = by_peer_id[p.id].arrive.get(piece)
+                    return up[1] if up is not None else 1e12
+                holders.sort(key=lambda p: (
+                    is_seed(p),
+                    capped(p) if relay_mode else 0,
+                    avail_ms(p) if relay_mode else 0.0,
+                    active.get(p.id, 0), int(lt[p.id]), p.id))
+            else:
+                holders.sort(key=lambda p: (active.get(p.id, 0),
+                                            int(lt[p.id]), p.id))
             return piece, holders[0]
         return None
 
@@ -310,8 +396,14 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
         got = pick(lc, now)
         if got is None:
             # starved: refresh the offer (the scheduler's re-offer path)
-            # and re-poll — content lands in virtual time, not wall time
-            refresh_parents(lc, now)
+            # and re-poll — content lands in virtual time, not wall time.
+            # Cold sizes throttle the refresh (COLD_REFRESH_MS): 256
+            # daemons x 4 starved workers re-scoring the whole pool every
+            # poll tick is a scheduler stampede the real fabric's packet
+            # cadence doesn't have
+            if not cold or now - lc.last_refresh >= COLD_REFRESH_MS:
+                lc.last_refresh = now
+                refresh_parents(lc, now)
             push(now + POLL_MS, "worker", i)
             continue
         piece, parent = got
@@ -344,6 +436,8 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
             push(t_hbm, "worker", i)
             continue
         lc.schedule.append([piece, parent.id])
+        if cold:
+            served_children.setdefault(parent.id, set()).add(lc.peer.id)
         lt = link_type(lc.peer.host.msg.topology, parent.host.msg.topology)
         load = active.get(parent.id, 0)
         active[parent.id] = load + 1
@@ -356,7 +450,20 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
         t_disp = now + queue_ms
         t_first = t_disp + ttfb_ms
         t_wire = t_first + wire_ms
+        if relay_mode and parent is not seed_peer \
+                and not landed_now(parent, piece, now):
+            # cut-through hop: the child's stream rides one hop-RTT behind
+            # the parent's own landing watermark — first byte follows the
+            # parent's first byte, last byte its last, never faster than
+            # the child's own modeled wire time
+            up = by_peer_id[parent.id].arrive.get(piece)
+            if up is not None:
+                hop = LINK_RTT_MS[lt]
+                t_first = max(t_first, up[0] + hop)
+                t_wire = max(t_first + wire_ms, up[1] + hop)
+                lc.relay_pulls += 1
         t_hbm = t_wire + hbm_ms
+        lc.arrive[piece] = (t_first, t_wire)
         ev = lc.flight.events.append
         ev((now, fr.SCHEDULED, piece, parent.id, 0, 0.0))
         ev((t_disp, fr.DISPATCHED, piece, parent.id, 0, 0.0))
@@ -372,6 +479,9 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     result = _summarize(leechers, seed=seed, daemons=daemons, pieces=pieces,
                         piece_size=piece_size, parallelism=parallelism,
                         scenario=scenario)
+    if cold:
+        result["relay_pulled_pieces"] = sum(lc.relay_pulls
+                                            for lc in leechers)
     if collect_timeline:
         result["timeline"] = {lc.peer.id: sorted(lc.timeline)
                               for lc in leechers}
@@ -705,6 +815,75 @@ def _run_pr8(args) -> dict:
     }
 
 
+def _run_pr9(args) -> dict:
+    """The PR-9 trajectory point: cold-start makespan vs pod size,
+    pull-only vs cut-through relay. One seed, the pod scaled across
+    ``pod_sizes`` for both cold scenarios (real Scheduling stack; the
+    relay run arms ``SchedulerConfig.relay_fanout`` so the actual
+    tree-shaping ruling is what gets measured), each run aggregated
+    through podscope for the distribution-tree depth. A plain baseline
+    run rides along as the relay-disabled digest gate: byte-identical to
+    BENCH_pr3 (tests/test_dfbench.py). Acceptance: relay makespan grows
+    SUB-LINEARLY in pod size (growth_factor < pod_growth_factor), beats
+    pull-only at every size, and tree depth stays ~log(N), not N."""
+    import math
+
+    from ..common import podscope
+    sizes = [8, 16] if args.smoke else [64, 128, 256]
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    scenarios: dict[str, dict] = {sc: {} for sc in COLD_SCENARIOS}
+    for sc in COLD_SCENARIOS:
+        for n in sizes:
+            r = run_bench(seed=args.seed, daemons=n, pieces=args.pieces,
+                          piece_size=args.piece_size,
+                          parallelism=args.parallelism, scenario=sc,
+                          collect_podscope=True)
+            report = podscope.aggregate(r.pop("podscope_snapshots"))
+            task_report = next(iter(report["tasks"].values()))
+            scenarios[sc][str(n)] = {
+                "wall_ms": r["wall_ms"],
+                "makespan_ms": task_report["makespan_ms"],
+                "depth": task_report["depth"],
+                "seed_served_ratio": r["seed_served_ratio"],
+                "relay_pulled_pieces": r.get("relay_pulled_pieces", 0),
+                "edges": len(task_report["edges"]),
+                "schedule_digest": r["schedule_digest"],
+            }
+    mk = {sc: {str(n): scenarios[sc][str(n)]["makespan_ms"]
+               for n in sizes} for sc in COLD_SCENARIOS}
+    depth = {sc: {str(n): scenarios[sc][str(n)]["depth"]
+                  for n in sizes} for sc in COLD_SCENARIOS}
+    pod_growth = sizes[-1] / sizes[0]
+    growth = {sc: round(mk[sc][str(sizes[-1])]
+                        / max(mk[sc][str(sizes[0])], 1e-9), 3)
+              for sc in COLD_SCENARIOS}
+    return {
+        "bench": "dfbench-coldstart",
+        "seed": args.seed,
+        "pieces": args.pieces,
+        "piece_size": args.piece_size,
+        "parallelism": args.parallelism,
+        "pod_sizes": sizes,
+        # relay disabled == the plain baseline scheduler path: digest
+        # byte-identical to BENCH_pr3 (the tier-1 gate)
+        "schedule_digest": base["schedule_digest"],
+        "scenarios": scenarios,
+        "cold_makespan_ms": mk,
+        "tree_depth": depth,
+        "pod_growth_factor": pod_growth,
+        # makespan(maxN)/makespan(minN) while the pod grew pod_growth x:
+        # < pod_growth is the sub-linear acceptance bar
+        "growth_factor": growth,
+        "sublinear": growth["cold_relay"] < pod_growth,
+        "relay_beats_pull": all(
+            mk["cold_relay"][str(n)] < mk["cold_pull"][str(n)]
+            for n in sizes),
+        "log2_max_pod": round(math.log2(sizes[-1]), 2),
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -713,9 +892,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pieces", type=int, default=64)
     p.add_argument("--piece-size", type=int, default=4 << 20)
     p.add_argument("--parallelism", type=int, default=4)
-    p.add_argument("--scenario", default="baseline", choices=SCENARIOS,
+    p.add_argument("--scenario", default="baseline",
+                   choices=SCENARIOS + COLD_SCENARIOS,
                    help="discovery model (scheds_down_* = every scheduler "
-                   "unreachable, with/without the PEX gossip rung)")
+                   "unreachable, with/without the PEX gossip rung; "
+                   "cold_* = whole-pod cold start, store-and-forward vs "
+                   "cut-through relay)")
     p.add_argument("--pr4", action="store_true",
                    help="run baseline + both scheds-down scenarios and "
                    "write the PR-4 trajectory point (BENCH_pr4.json)")
@@ -730,6 +912,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "amplification, per-edge p95) and write the PR-6 "
                    "trajectory point (BENCH_pr6.json); the baseline "
                    "schedule digest stays byte-identical to BENCH_pr3")
+    p.add_argument("--pr9", action="store_true",
+                   help="scale the fakepod across pod sizes for the two "
+                   "cold-start scenarios (pull-only vs cut-through relay "
+                   "over relay-fanout-shaped trees) and write the PR-9 "
+                   "trajectory point (BENCH_pr9.json): cold-start "
+                   "makespan vs pod size, podscope tree depth, and the "
+                   "relay-disabled digest gate against BENCH_pr3")
     p.add_argument("--pr8", action="store_true",
                    help="replay the baseline run's decision-ledger rows "
                    "through every offline evaluator (default/nt/ml) and "
@@ -774,7 +963,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr8:
+        if args.pr9:
+            args.out = "BENCH_pr9.json"
+        elif args.pr8:
             args.out = "BENCH_pr8.json"
         elif args.pr6:
             args.out = "BENCH_pr6.json"
@@ -788,7 +979,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr8:
+    if args.pr9:
+        result = _run_pr9(args)
+    elif args.pr8:
         result = _run_pr8(args)
     elif args.pr6:
         result = _run_pr6(args)
@@ -805,7 +998,18 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr8:
+        if args.pr9:
+            mk = result["cold_makespan_ms"]
+            sizes = [str(n) for n in result["pod_sizes"]]
+            print(f"dfbench: wrote {args.out} (cold makespan pull/relay: "
+                  + ", ".join(
+                      f"N={s} {mk['cold_pull'][s]:.0f}/"
+                      f"{mk['cold_relay'][s]:.0f}ms" for s in sizes)
+                  + f", relay growth x{result['growth_factor']['cold_relay']}"
+                  f" over x{result['pod_growth_factor']} pod, "
+                  f"depth {result['tree_depth']['cold_relay'][sizes[-1]]}, "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.pr8:
             cross = result["cross_evaluator"]
             print(f"dfbench: wrote {args.out} "
                   f"({result['decision_rows']} decision rows, ledger "

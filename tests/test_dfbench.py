@@ -290,6 +290,71 @@ class TestPr8Decisions:
         assert not list(tmp_path.iterdir())      # stdout only
 
 
+class TestPr9ColdStart:
+    """PR-9 point: O(log N) cold start. The cold scenarios must be
+    deterministic, must never touch the baseline rng path (digest
+    unmoved), and cut-through relay must beat pull-only with a
+    log-shaped (never N-deep, never flat-star-only) distribution tree."""
+
+    def test_cold_scenarios_deterministic(self):
+        a = run_bench(seed=7, daemons=8, pieces=16, scenario="cold_relay")
+        b = run_bench(seed=7, daemons=8, pieces=16, scenario="cold_relay")
+        assert a["schedule_digest"] == b["schedule_digest"]
+        assert a["relay_pulled_pieces"] == b["relay_pulled_pieces"]
+        assert a["relay_pulled_pieces"] > 0
+
+    def test_cold_scenarios_keep_baseline_digest(self):
+        # the relay knob and cold plumbing must not perturb the baseline
+        # rng sequence — BENCH_pr3 stays comparable
+        base = run_bench(seed=7, daemons=6, pieces=24)
+        run_bench(seed=7, daemons=6, pieces=24, scenario="cold_pull")
+        again = run_bench(seed=7, daemons=6, pieces=24)
+        assert base["schedule_digest"] == again["schedule_digest"]
+
+    def test_relay_beats_pull_and_pipelines(self):
+        pull = run_bench(seed=7, daemons=12, pieces=16,
+                         scenario="cold_pull")
+        relay = run_bench(seed=7, daemons=12, pieces=16,
+                          scenario="cold_relay")
+        assert relay["wall_ms"] < pull["wall_ms"]
+        assert relay["relay_pulled_pieces"] > 0
+        # pull-only never moves a byte cut-through by construction
+        assert pull["relay_pulled_pieces"] == 0
+
+    def test_pr9_smoke_stdout_only(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr9", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-coldstart"
+        assert r["relay_beats_pull"] is True
+        assert r["sublinear"] is True
+        # the relay tree must be a tree, not a star and not a chain
+        biggest = str(r["pod_sizes"][-1])
+        assert 1 < r["tree_depth"]["cold_relay"][biggest] \
+            < r["pod_sizes"][-1]
+        assert not list(tmp_path.iterdir())      # stdout only
+
+    def test_pr9_committed_matches_pr3_digest(self):
+        """The committed trajectory gate: BENCH_pr9's relay-disabled
+        baseline digest is byte-identical to BENCH_pr3, and the headline
+        acceptance flags are stamped true at 64->256 daemons."""
+        r = json.loads(open(os.path.join(REPO, "BENCH_pr9.json")).read())
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["sublinear"] is True
+        assert r["relay_beats_pull"] is True
+        assert r["pod_sizes"] == [64, 128, 256]
+        # makespan grew sub-linearly while the pod grew 4x...
+        assert r["growth_factor"]["cold_relay"] < r["pod_growth_factor"]
+        # ...and the relay tree depth stays log-shaped at every size
+        for n, depth in r["tree_depth"]["cold_relay"].items():
+            assert 1 < depth <= 16, (n, depth)
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(

@@ -1,0 +1,858 @@
+"""PR-9 cut-through relay: the streaming range path, the relay hub, the
+swarm watermark freshness gate, the relay.stall chaos shape, and the
+4-daemon chain e2e (origin -> seed -> r1 -> r2) proving a downstream
+daemon's first byte lands before its upstream parent finishes the piece.
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+import aiohttp
+import pytest
+
+from dragonfly2_tpu.common import digest as digestlib
+from dragonfly2_tpu.common import faultgate
+from dragonfly2_tpu.common.piece import piece_range
+from dragonfly2_tpu.daemon.relay import RelayHub
+from dragonfly2_tpu.daemon.swarm_index import SwarmEntry, SwarmIndex
+from dragonfly2_tpu.daemon.upload_server import UploadServer
+from dragonfly2_tpu.idl.messages import PieceInfo
+from dragonfly2_tpu.storage.manager import StorageConfig, StorageManager
+from dragonfly2_tpu.storage.metadata import TaskMetadata
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faultgate.reset()
+    yield
+    faultgate.reset()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+TASK = "r" * 64
+PIECE = 256 * 1024
+TOTAL = 4 * PIECE
+
+
+def make_task(tmp_path):
+    mgr = StorageManager(StorageConfig(data_dir=str(tmp_path / "data")))
+    ts = mgr.register_task(TaskMetadata(
+        task_id=TASK, url="http://o/blob", content_length=TOTAL,
+        total_piece_count=4, piece_size=PIECE))
+    return mgr, ts
+
+
+def info(num: int, data: bytes) -> PieceInfo:
+    return PieceInfo(piece_num=num, range_start=num * PIECE,
+                     range_size=len(data),
+                     digest=digestlib.for_bytes("crc32c", data))
+
+
+# ---------------------------------------------------------------- hub
+
+
+class TestRelayHub:
+    def test_covered_prefix_walks_contiguous_pieces(self, tmp_path):
+        _mgr, ts = make_task(tmp_path)
+        a, b = os.urandom(PIECE), os.urandom(PIECE)
+        ts.write_piece(0, 0, a)
+        ts.write_piece(2, 2 * PIECE, b)     # gap at piece 1
+        assert ts.covered_prefix(0, TOTAL) == PIECE
+        assert ts.covered_prefix(PIECE, TOTAL) == PIECE      # hole
+        assert ts.covered_prefix(2 * PIECE, TOTAL) == 3 * PIECE
+        assert ts.covered_prefix(5, PIECE - 5) == PIECE - 5  # clipped
+
+    def test_available_end_combines_storage_and_span(self, tmp_path):
+        _mgr, ts = make_task(tmp_path)
+        ts.write_piece(0, 0, os.urandom(PIECE))
+        hub = RelayHub()
+        hub.track(TASK, total_pieces=4)
+        buf = bytearray(PIECE)
+        span = hub.open_span(TASK, PIECE, PIECE, buf,
+                             [PieceInfo(piece_num=1, range_start=PIECE,
+                                        range_size=PIECE)])
+        # storage covers piece 0 only
+        assert hub.available_end(TASK, ts, 0, TOTAL) == PIECE
+        span.advance(1000)
+        # frontier extends through the landed piece INTO the live span
+        assert hub.available_end(TASK, ts, 0, TOTAL) == PIECE + 1000
+        assert hub.read_span(TASK, PIECE, 4096) == bytes(buf[:1000])[:4096]
+        hub.retire(span)
+        assert hub.read_span(TASK, PIECE, 4096) is None
+        assert hub.available_end(TASK, ts, 0, TOTAL) == PIECE
+
+    def test_wait_progress_pulse_and_untrack_wake(self):
+        hub = RelayHub()
+        hub.track(TASK)
+
+        async def go():
+            async def waiter():
+                return await hub.wait_progress(TASK, 5.0)
+            t = asyncio.create_task(waiter())
+            await asyncio.sleep(0.01)
+            hub.pulse(TASK)
+            assert await t is True
+            t2 = asyncio.create_task(waiter())
+            await asyncio.sleep(0.01)
+            hub.untrack(TASK)          # final wake: conductor finished
+            assert await t2 is True
+            assert not hub.active(TASK)
+            assert await hub.wait_progress(TASK, 0.1) is False
+        run(go())
+
+    def test_inflight_infos_and_on_open_hook(self):
+        hub = RelayHub()
+        opened = []
+        hub.track(TASK, on_open=opened.append)
+        pi = PieceInfo(piece_num=3, range_start=3 * PIECE, range_size=PIECE)
+        span = hub.open_span(TASK, 3 * PIECE, PIECE, bytearray(4), [pi])
+        assert [i.piece_num for i in hub.inflight_infos(TASK)] == [3]
+        assert opened == [span]
+        hub.retire(span)
+        assert hub.inflight_infos(TASK) == []
+
+
+# ------------------------------------------------- streaming range path
+
+
+async def start_server(mgr, hub, **kw):
+    srv = UploadServer(mgr, host="127.0.0.1", relay=hub,
+                       relay_stall_s=kw.pop("relay_stall_s", 0.4), **kw)
+    await srv.start()
+    return srv
+
+
+def url(srv):
+    return f"http://127.0.0.1:{srv.port}/download/{TASK[:3]}/{TASK}"
+
+
+class TestStreamingRange:
+    def test_read_at_watermark_serves_live_span_bytes(self, tmp_path):
+        """A range whose tail piece is mid-landing streams: the stored
+        piece from disk, the in-flight piece straight off the live span
+        buffer — no 416, first byte before the piece exists on disk."""
+        async def go():
+            mgr, ts = make_task(tmp_path)
+            p0, p1 = os.urandom(PIECE), os.urandom(PIECE)
+            ts.write_piece(0, 0, p0)
+            hub = RelayHub()
+            hub.track(TASK, total_pieces=4)
+            buf = bytearray(p1)                     # fully arrived...
+            span = hub.open_span(TASK, PIECE, PIECE, buf, [info(1, p1)])
+            span.advance(PIECE)                     # ...but NOT landed
+            srv = await start_server(mgr, hub)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url(srv), headers={
+                            "Range": f"bytes=0-{2 * PIECE - 1}"}) as r:
+                        assert r.status == 206
+                        assert r.headers.get("X-DF-Relay") == "1"
+                        assert r.headers.get(
+                            "X-DF-Piece-Progress") == "1/4"
+                        body = await r.read()
+                assert body == p0 + p1
+            finally:
+                await srv.stop()
+        run(go())
+
+    def test_await_past_watermark_until_bytes_arrive(self, tmp_path):
+        """The serve parks past the watermark and resumes as the span
+        advances — the child's first byte arrives while the parent is
+        still receiving the piece (the cut-through acceptance shape)."""
+        async def go():
+            mgr, ts = make_task(tmp_path)
+            p0, p1 = os.urandom(PIECE), os.urandom(PIECE)
+            ts.write_piece(0, 0, p0)
+            hub = RelayHub()
+            hub.track(TASK, total_pieces=4)
+            buf = bytearray(PIECE)
+            span = hub.open_span(TASK, PIECE, PIECE, buf, [info(1, p1)])
+            srv = await start_server(mgr, hub)
+
+            async def feed():
+                for lo in range(0, PIECE, PIECE // 4):
+                    await asyncio.sleep(0.05)
+                    hi = lo + PIECE // 4
+                    buf[lo:hi] = p1[lo:hi]
+                    span.advance(hi)
+                ts.write_piece(1, PIECE, p1)
+                hub.retire(span)
+            feeder = asyncio.create_task(feed())
+            try:
+                t0 = time.monotonic()
+                first_byte_at = None
+                got = bytearray()
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url(srv), headers={
+                            "Range": f"bytes=0-{2 * PIECE - 1}"}) as r:
+                        assert r.status == 206
+                        async for chunk in r.content.iter_any():
+                            if first_byte_at is None:
+                                first_byte_at = time.monotonic()
+                            got.extend(chunk)
+                await feeder
+                assert bytes(got) == p0 + p1
+                # first byte flowed while the span was still filling
+                # (the feeder takes ~0.2s to finish)
+                assert first_byte_at - t0 < 0.15
+            finally:
+                feeder.cancel()
+                await srv.stop()
+        run(go())
+
+    def test_deadline_expiry_503_with_stall_counter(self, tmp_path):
+        """No progress past relay_stall_s and nothing sent: a clean 503
+        (busy-shaped — the child requeues without a strike) and the
+        stall counter moves; the slot is not leaked."""
+        async def go():
+            mgr, ts = make_task(tmp_path)
+            ts.write_piece(0, 0, os.urandom(PIECE))
+            hub = RelayHub()
+            hub.track(TASK, total_pieces=4)
+            srv = await start_server(mgr, hub, relay_stall_s=0.2)
+            from dragonfly2_tpu.daemon.upload_server import _relay_stalls
+            before = _relay_stalls.value()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url(srv), headers={
+                            "Range": f"bytes={2 * PIECE}-"
+                                     f"{3 * PIECE - 1}"}) as r:
+                        assert r.status == 503
+                        assert "Retry-After" in r.headers
+                assert _relay_stalls.value() == before + 1
+                assert srv._active == 0
+            finally:
+                await srv.stop()
+        run(go())
+
+    def test_stall_deadline_not_rearmed_by_unrelated_progress(
+            self, tmp_path):
+        """A serve parked at an offset that never advances must expire in
+        ~relay_stall_s even while OTHER pieces of the task keep landing
+        and pulsing — otherwise a dead announce-ahead piece holds an
+        upload slot for the rest of the task's lifetime."""
+        async def go():
+            mgr, ts = make_task(tmp_path)
+            hub = RelayHub()
+            hub.track(TASK, total_pieces=4)
+            srv = await start_server(mgr, hub, relay_stall_s=0.3)
+
+            async def noisy_pulses():
+                while True:
+                    await asyncio.sleep(0.05)
+                    hub.pulse(TASK)     # unrelated task-wide progress
+            noise = asyncio.create_task(noisy_pulses())
+            try:
+                t0 = time.monotonic()
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url(srv), headers={
+                            "Range": f"bytes={3 * PIECE}-"
+                                     f"{4 * PIECE - 1}"}) as r:
+                        assert r.status == 503
+                assert time.monotonic() - t0 < 1.5
+                assert srv._active == 0
+            finally:
+                noise.cancel()
+                await srv.stop()
+        run(go())
+
+    def test_eviction_mid_stream_charges_only_moved_bytes(self, tmp_path):
+        """Task evicted under the serve: the stream aborts mid-body and
+        the limiter was only ever charged for bytes that actually moved
+        (the PR 5 404-path contract, strengthened — tokens are acquired
+        per chunk AFTER the read clamps, so an eviction never strands a
+        reservation and boundary chunks never over-charge)."""
+        async def go():
+            mgr, ts = make_task(tmp_path)
+            p0, p1 = os.urandom(PIECE), os.urandom(PIECE)
+            ts.write_piece(0, 0, p0)
+            ts.write_piece(1, PIECE, p1)
+            hub = RelayHub()
+            hub.track(TASK, total_pieces=4)
+            srv = await start_server(mgr, hub, relay_stall_s=2.0)
+
+            acquired, refunded = [], []
+
+            class Recorder:
+                async def acquire(self, n):
+                    acquired.append(n)
+
+                def refund(self, n):
+                    refunded.append(n)
+            srv.limiter = Recorder()
+            # the first disk read (pieces 0-1 in one chunk) succeeds;
+            # the read after piece 2 lands fails = evicted mid-stream
+            real_read = ts.read_range
+            reads = []
+
+            def flaky_read(start, length):
+                reads.append((start, length))
+                if len(reads) > 1:
+                    raise OSError("evicted")
+                return real_read(start, length)
+            ts.read_range = flaky_read
+
+            async def land_piece2():
+                await asyncio.sleep(0.1)
+                p2 = os.urandom(PIECE)
+                ts.write_piece(2, 2 * PIECE, p2)
+                hub.pulse(TASK)
+            lander = asyncio.create_task(land_piece2())
+            try:
+                got = bytearray()
+                with pytest.raises(aiohttp.ClientPayloadError):
+                    async with aiohttp.ClientSession() as s:
+                        async with s.get(url(srv), headers={
+                                "Range": f"bytes=0-{3 * PIECE - 1}"}) as r:
+                            assert r.status == 206
+                            async for chunk in r.content.iter_any():
+                                got.extend(chunk)
+                await lander
+                # everything delivered before the eviction is bit-exact,
+                # and the limiter saw exactly those bytes — no more
+                assert bytes(got) == p0 + p1
+                assert sum(acquired) == len(got)
+                assert refunded == []
+                assert srv._active == 0
+            finally:
+                lander.cancel()
+                await srv.stop()
+        run(go())
+
+    def test_incomplete_range_still_416_when_relay_off(self, tmp_path):
+        """relay=None (or untracked task) preserves the pre-relay 416."""
+        async def go():
+            mgr, ts = make_task(tmp_path)
+            ts.write_piece(0, 0, os.urandom(PIECE))
+            srv = await start_server(mgr, None)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url(srv), headers={
+                            "Range": f"bytes=0-{2 * PIECE - 1}"}) as r:
+                        assert r.status == 416
+            finally:
+                await srv.stop()
+        run(go())
+
+
+# ------------------------------------------- swarm watermark freshness
+
+
+class TestSwarmWatermarkFreshness:
+    def _entry(self, pieces, relay, host="h1"):
+        return SwarmEntry(host_id=host, ip="10.0.0.9", rpc_port=1,
+                          download_port=2, pieces=set(pieces),
+                          relay_pieces=set(relay) or None, total_pieces=4)
+
+    def test_update_tracks_watermark_growth(self):
+        idx = SwarmIndex(progress_ttl_s=10.0)
+        idx.update("t", self._entry([0], [1]), now=100.0)
+        e = idx.parents_for("t", now=101.0)[0]
+        assert e.progress_at == 100.0
+        # same advertisement re-gossiped: progress does NOT refresh
+        idx.update("t", self._entry([0], [1]), now=150.0)
+        e = idx.parents_for("t", now=151.0)[0]
+        assert e.progress_at == 100.0
+        # the watermark grew: fresh again
+        idx.update("t", self._entry([0, 1], [2]), now=160.0)
+        e = idx.parents_for("t", now=161.0)[0]
+        assert e.progress_at == 160.0
+
+    def test_coverage_gate_ignores_stale_watermark(self):
+        """The seed-restart regression shape: a partial holder that died
+        mid-download keeps re-gossiping the same landed+in-flight sets;
+        its in-flight CLAIMS must stop counting as coverage once stale,
+        or the pex rung parks a puller on pieces nobody will ever hold
+        (the exact PR 5 deadlock the coverage gate exists to prevent)."""
+        from dragonfly2_tpu.daemon.pex import PexGossiper
+
+        gossiper = PexGossiper(storage_mgr=None, host_info=lambda: None,
+                               index=SwarmIndex(progress_ttl_s=10.0))
+
+        class C:
+            ready = set()
+        now = time.monotonic()
+        # holder landed {0,1} and claims {2,3} in flight
+        fresh = self._entry([0, 1], [2, 3])
+        gossiper.index.update("t", fresh, now=now)
+        entries = gossiper.index.parents_for("t", now=now + 1)
+        assert gossiper._covers_task(entries, C()) is True
+        # same advertisement, watermark never moves: past the progress
+        # TTL the claims are abandoned pieces — coverage must fail
+        # (_covers_task reads the real monotonic clock, so age the
+        # entry's progress stamp directly)
+        stale = self._entry([0, 1], [2, 3])
+        gossiper.index.update("t", stale, now=now)
+        entries = gossiper.index.parents_for("t", now=now + 1)
+        entries[0].progress_at = now - 20.0     # 20 s of no growth
+        assert gossiper._covers_task(entries, C()) is False
+        # landed pieces alone never go stale: a DONE holder covers
+        done = SwarmEntry(host_id="h2", ip="10.0.0.8", rpc_port=1,
+                          download_port=2, pieces=None, done=True)
+        gossiper.index.update("t", done, now=now)
+        entries = gossiper.index.parents_for("t", now=now + 1)
+        assert gossiper._covers_task(entries, C()) is True
+
+
+# ------------------------------------------------------- chain e2e
+
+
+class TestCutThroughChain:
+    def test_chain_first_byte_before_upstream_finishes(self, tmp_path):
+        """origin -> seed -> r1 -> r2 over real daemons: r2's first byte
+        of a piece lands BEFORE its upstream parent (r1) finishes
+        receiving that piece — store-and-forward would forbid this.
+        Also asserts the relayed serve journal and podscope's relay
+        surfacing on the same run."""
+        from test_p2p import ScriptedScheduler, ScriptedSession, parent_addr
+
+        from dragonfly2_tpu.common import podscope
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import (DownloadRequest, PeerPacket,
+                                                 RegisterResult, SizeScope)
+        from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+        from test_daemon_e2e import daemon_config
+
+        data = os.urandom(12 * 1024 * 1024)     # 3 pieces at 4 MiB
+
+        async def go():
+            # trickled origin: the seed's back-source takes ~0.5 s, so
+            # the whole chain overlaps the origin transfer
+            from aiohttp import web
+
+            async def handle(request):
+                rng = request.headers.get("Range")
+                body = data
+                status = 200
+                headers = {"Accept-Ranges": "bytes"}
+                if rng:
+                    from dragonfly2_tpu.common.piece import parse_http_range
+                    r = parse_http_range(rng, len(data))
+                    body = data[r.start:r.end]
+                    status = 206
+                    headers["Content-Range"] = \
+                        f"bytes {r.start}-{r.end - 1}/{len(data)}"
+                resp = web.StreamResponse(status=status, headers=headers)
+                resp.content_length = len(body)
+                await resp.prepare(request)
+                for i in range(0, len(body), 512 * 1024):
+                    await resp.write(body[i:i + 512 * 1024])
+                    await asyncio.sleep(0.025)
+                return resp
+
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handle)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = None
+            for s in runner.sites:
+                server = getattr(s, "_server", None)
+                if server and server.sockets:
+                    port = server.sockets[0].getsockname()[1]
+            origin_url = f"http://127.0.0.1:{port}/w.bin"
+
+            # every daemon is STARTED before the first byte moves: the
+            # chain's joins must land while the origin is still
+            # trickling, not after daemon-boot serialization ate the
+            # overlap window. The seed takes ONE origin stream (no
+            # parallel piece groups) so pieces land in order, paced.
+            cfg_seed = daemon_config(tmp_path, "ch-seed")
+            cfg_seed.download.back_source_group_min_bytes = 1 << 30
+            seed = Daemon(cfg_seed)
+            await seed.start()
+            daemons = [seed]
+            chans = []
+
+            def chain_sched(upstream):
+                def make_session(conductor):
+                    # resolved lazily AT REGISTER TIME: the upstream's
+                    # conductor exists by then (kicked just before)
+                    up_peer = upstream.ptm.conductor(
+                        conductor.task_id).peer_id
+                    packet = PeerPacket(
+                        task_id=conductor.task_id,
+                        src_peer_id=conductor.peer_id,
+                        main_peer=parent_addr(upstream, up_peer))
+                    return ScriptedSession(RegisterResult(
+                        task_id=conductor.task_id,
+                        size_scope=SizeScope.NORMAL), [packet])
+                return ScriptedScheduler(make_session)
+
+            try:
+                r1 = Daemon(daemon_config(tmp_path, "ch-r1"))
+                r1._scheduler_factory = lambda _d, s=chain_sched(seed): s
+                await r1.start()
+                daemons.append(r1)
+                r2 = Daemon(daemon_config(tmp_path, "ch-r2"))
+                r2._scheduler_factory = lambda _d, s=chain_sched(r1): s
+                await r2.start()
+                daemons.append(r2)
+
+                async def kick(d, **kw):
+                    ch = Channel(f"unix:{d.unix_sock}")
+                    chans.append(ch)
+                    client = ServiceClient(ch, "df.daemon.Daemon")
+                    return client.unary_stream("Download", DownloadRequest(
+                        url=origin_url, timeout_s=60.0, **kw))
+
+                stream_s = await kick(seed)
+                first = await stream_s.read()
+                task_id = first.task_id
+                stream_1 = await kick(r1, disable_back_source=True)
+                for _ in range(200):
+                    if r1.ptm.conductor(task_id) is not None:
+                        break
+                    await asyncio.sleep(0.01)
+                stream_2 = await kick(r2, disable_back_source=True)
+
+                async def drain(stream):
+                    while True:
+                        resp = await stream.read()
+                        if resp is None or resp.done:
+                            return resp
+                done2, done1, dones = await asyncio.gather(
+                    drain(stream_2), drain(stream_1), drain(stream_s))
+                assert done2 is not None and done2.code == 0, done2
+                assert dones is not None and dones.code == 0
+
+                # every hop got the full, correct content
+                for d in (r1, r2):
+                    c = d.ptm.conductor(task_id)
+                    assert c.completed_length == len(data)
+                    assert c.traffic_p2p == len(data)
+
+                def stages(daemon, stage):
+                    f = daemon.flight_recorder.get(task_id)
+                    out = {}
+                    for t_ms, st, piece, _p, _b, _d in f.events:
+                        if st == stage and piece >= 0:
+                            abs_t = f.started_at + t_ms / 1000.0
+                            out.setdefault(piece, abs_t)
+                    return out
+
+                from dragonfly2_tpu.daemon import flight_recorder as fr
+                r1_done = stages(r1, fr.WIRE_DONE)
+                r2_first = stages(r2, fr.FIRST_BYTE)
+                overlapped = [p for p in r2_first
+                              if p in r1_done and r2_first[p] < r1_done[p]]
+                assert overlapped, (
+                    "cut-through never happened: r2's first byte always "
+                    f"waited for r1 to finish (r1={r1_done}, "
+                    f"r2={r2_first})")
+
+                # the relay serve journal: r1 streamed ranges to r2
+                # against its own landing watermark
+                f1 = r1.flight_recorder.get(task_id)
+                ups = f1.summarize()["uploads"]
+                assert any(u.get("relayed_pieces", 0) > 0
+                           for u in ups.values()), ups
+
+                # podscope stitches + surfaces the relay edges
+                snaps = []
+                for d in (seed, r1, r2):
+                    f = d.flight_recorder.get(task_id)
+                    dump = f.timeline()
+                    dump["summary"] = f.summarize()
+                    snaps.append({"addr": d.hostname,
+                                  "flights": {task_id: dump}})
+                report = podscope.aggregate(snaps)
+                trep = report["tasks"][task_id]
+                assert trep["relay"] is not None
+                assert trep["relay"]["edges"] >= 1
+                assert trep["relay"]["pieces"] >= 1
+                assert trep["relay"]["per_hop_added_ms"] >= 0.0
+                rendered = podscope.render_pod(report)
+                assert "[relay]" in rendered
+                assert "relay:" in rendered
+            finally:
+                for ch in chans:
+                    await ch.close()
+                for d in reversed(daemons):
+                    await d.stop()
+                await runner.cleanup()
+
+        run(go())
+
+
+# ------------------------------------------------------ relay.stall chaos
+
+
+class TestRelayStallChaos:
+    def test_stalled_relay_degrades_to_other_holder(self, tmp_path):
+        """A parent whose watermark stops advancing mid-relay
+        (faultgate `relay.stall` hang) must not wedge the child: the
+        child's piece deadline fires, the piece is re-pulled from the
+        other holder, the task completes, the ladder journal names the
+        rung, and no upload slot leaks on the stalled parent."""
+        from test_daemon_e2e import daemon_config, start_origin
+        from test_p2p import ScriptedScheduler, ScriptedSession, parent_addr
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import (DownloadRequest, PeerPacket,
+                                                 RegisterResult, SizeScope)
+        from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+
+        data = os.urandom(12 * 1024 * 1024)     # 3 pieces
+
+        async def go():
+            origin, base = await start_origin({"w.bin": data})
+            url_ = f"{base}/w.bin"
+            # B: a complete holder, upload-throttled so A stays
+            # mid-download for the whole test window
+            cfg_b = daemon_config(tmp_path, "st-b")
+            b = Daemon(cfg_b)
+            await b.start()
+            daemons = [b]
+            chans = []
+            try:
+                ch_b = Channel(f"unix:{b.unix_sock}")
+                chans.append(ch_b)
+                client_b = ServiceClient(ch_b, "df.daemon.Daemon")
+                async for resp in client_b.unary_stream(
+                        "Download", DownloadRequest(url=url_)):
+                    if resp.done:
+                        task_id = resp.task_id
+                b_peer = b.ptm.conductor(task_id).peer_id
+                await origin.cleanup()
+                origin = None
+                # throttle B's uplink so A's pull stays in flight
+                b.upload_server.limiter.set_rate(3 * 1024 * 1024,
+                                                 burst=1024 * 1024)
+                b.upload_server.limiter._tokens = 0.0
+
+                def sched_for(parents):
+                    def make_session(conductor):
+                        addrs = [parent_addr(d, p) for d, p in parents]
+                        packet = PeerPacket(
+                            task_id=conductor.task_id,
+                            src_peer_id=conductor.peer_id,
+                            main_peer=addrs[0],
+                            candidate_peers=addrs[1:])
+                        return ScriptedSession(RegisterResult(
+                            task_id=conductor.task_id,
+                            size_scope=SizeScope.NORMAL), [packet])
+                    return ScriptedScheduler(make_session)
+
+                # A: mid-download leecher pulling from throttled B; a
+                # short stall deadline so hung serves wind down fast
+                cfg_a = daemon_config(tmp_path, "st-a")
+                cfg_a.download.relay_stall_s = 1.0
+                a = Daemon(cfg_a)
+                a._scheduler_factory = \
+                    lambda _d, s=sched_for([(b, b_peer)]): s
+                await a.start()
+                daemons.append(a)
+                ch_a = Channel(f"unix:{a.unix_sock}")
+                chans.append(ch_a)
+                client_a = ServiceClient(ch_a, "df.daemon.Daemon")
+                stream_a = client_a.unary_stream(
+                    "Download", DownloadRequest(
+                        url=url_, disable_back_source=True,
+                        timeout_s=120.0))
+                assert await stream_a.read() is not None
+                a_peer = a.ptm.conductor(task_id).peer_id
+
+                # every relay serve on A now hangs: the watermark "stops"
+                faultgate.arm("relay.stall", "hang", key=task_id[:8], n=-1)
+
+                # C: child with BOTH holders; A (announce-ahead relays)
+                # ranks before B (marked seed => dispatcher ranks last),
+                # and a short piece deadline breaks stalled pulls fast
+                cfg_c = daemon_config(tmp_path, "st-c")
+                cfg_c.download.piece_timeout_s = 2.0
+                c = Daemon(cfg_c)
+
+                def make_session_c(conductor):
+                    pa = parent_addr(a, a_peer)
+                    pb = parent_addr(b, b_peer)
+                    pb.is_seed = True
+                    packet = PeerPacket(
+                        task_id=conductor.task_id,
+                        src_peer_id=conductor.peer_id,
+                        main_peer=pa, candidate_peers=[pb])
+                    return ScriptedSession(RegisterResult(
+                        task_id=conductor.task_id,
+                        size_scope=SizeScope.NORMAL), [packet])
+                c._scheduler_factory = \
+                    lambda _d: ScriptedScheduler(make_session_c)
+                await c.start()
+                daemons.append(c)
+                ch_c = Channel(f"unix:{c.unix_sock}")
+                chans.append(ch_c)
+                client_c = ServiceClient(ch_c, "df.daemon.Daemon")
+                done = []
+                async for resp in client_c.unary_stream(
+                        "Download", DownloadRequest(
+                            url=url_, disable_back_source=True,
+                            timeout_s=120.0)):
+                    if resp.done:
+                        done.append(resp)
+                assert done and done[0].code == 0, done
+                cc = c.ptm.conductor(task_id)
+                assert cc.completed_length == len(data)
+                assert cc.traffic_p2p == len(data)
+                # the ladder journaled the rung trail (p2p served it)
+                summary = c.flight_recorder.get(task_id).summarize()
+                assert summary["served_rung"] == "p2p"
+                # drain A's own (slow) download so teardown is clean
+                faultgate.reset()
+                while True:
+                    resp = await stream_a.read()
+                    if resp is None or resp.done:
+                        break
+                # zero wedged tasks / leaked slots on the stalled parent
+                for _ in range(100):
+                    if a.upload_server._active == 0:
+                        break
+                    await asyncio.sleep(0.05)
+                assert a.upload_server._active == 0
+            finally:
+                faultgate.reset()
+                for ch in chans:
+                    await ch.close()
+                for d in reversed(daemons):
+                    await d.stop()
+                if origin is not None:
+                    await origin.cleanup()
+
+        run(go())
+
+
+class TestCorruptRelayedPiece:
+    def test_corrupt_relayed_piece_requeued_never_served_onward(
+            self, tmp_path):
+        """A relayed-but-corrupt piece is caught exactly where PR 5
+        catches every corrupt piece — digest verification at the CHILD's
+        landing — requeued against another holder, and never recorded
+        (so never served onward): the task still completes bit-exact."""
+        from test_daemon_e2e import daemon_config, start_origin
+        from test_p2p import ScriptedScheduler, ScriptedSession, parent_addr
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import (DownloadRequest, PeerPacket,
+                                                 RegisterResult, SizeScope)
+        from dragonfly2_tpu.rpc.client import Channel, ServiceClient
+
+        data = os.urandom(12 * 1024 * 1024)
+
+        async def go():
+            origin, base = await start_origin({"w.bin": data})
+            url_ = f"{base}/w.bin"
+            b = Daemon(daemon_config(tmp_path, "cr-b"))
+            await b.start()
+            daemons = [b]
+            chans = []
+            try:
+                ch_b = Channel(f"unix:{b.unix_sock}")
+                chans.append(ch_b)
+                client_b = ServiceClient(ch_b, "df.daemon.Daemon")
+                async for resp in client_b.unary_stream(
+                        "Download", DownloadRequest(url=url_)):
+                    if resp.done:
+                        task_id = resp.task_id
+                b_peer = b.ptm.conductor(task_id).peer_id
+                await origin.cleanup()
+                origin = None
+                b.upload_server.limiter.set_rate(4 * 1024 * 1024,
+                                                 burst=1024 * 1024)
+                b.upload_server.limiter._tokens = 0.0
+
+                a = Daemon(daemon_config(tmp_path, "cr-a"))
+
+                def make_session_a(conductor):
+                    packet = PeerPacket(
+                        task_id=conductor.task_id,
+                        src_peer_id=conductor.peer_id,
+                        main_peer=parent_addr(b, b_peer))
+                    return ScriptedSession(RegisterResult(
+                        task_id=conductor.task_id,
+                        size_scope=SizeScope.NORMAL), [packet])
+                a._scheduler_factory = \
+                    lambda _d: ScriptedScheduler(make_session_a)
+                await a.start()
+                daemons.append(a)
+                ch_a = Channel(f"unix:{a.unix_sock}")
+                chans.append(ch_a)
+                client_a = ServiceClient(ch_a, "df.daemon.Daemon")
+                stream_a = client_a.unary_stream(
+                    "Download", DownloadRequest(
+                        url=url_, disable_back_source=True,
+                        timeout_s=120.0))
+                assert await stream_a.read() is not None
+                a_peer = a.ptm.conductor(task_id).peer_id
+                a_addr = f"127.0.0.1:{a.upload_server.port}"
+
+                # corrupt ONE transfer from A (C's wire): the relayed
+                # bytes flip, the announced digest catches it at landing
+                faultgate.arm("piece.wire", "corrupt",
+                              key=f"parent {a_addr}", n=1)
+
+                c = Daemon(daemon_config(tmp_path, "cr-c"))
+
+                def make_session_c(conductor):
+                    pa = parent_addr(a, a_peer)
+                    pb = parent_addr(b, b_peer)
+                    pb.is_seed = True       # dispatcher prefers A
+                    packet = PeerPacket(
+                        task_id=conductor.task_id,
+                        src_peer_id=conductor.peer_id,
+                        main_peer=pa, candidate_peers=[pb])
+                    return ScriptedSession(RegisterResult(
+                        task_id=conductor.task_id,
+                        size_scope=SizeScope.NORMAL), [packet])
+                c._scheduler_factory = \
+                    lambda _d: ScriptedScheduler(make_session_c)
+                await c.start()
+                daemons.append(c)
+                ch_c = Channel(f"unix:{c.unix_sock}")
+                chans.append(ch_c)
+                client_c = ServiceClient(ch_c, "df.daemon.Daemon")
+                out = tmp_path / "cr.out"
+                done = []
+                async for resp in client_c.unary_stream(
+                        "Download", DownloadRequest(
+                            url=url_, output=str(out),
+                            disable_back_source=True, timeout_s=120.0)):
+                    if resp.done:
+                        done.append(resp)
+                assert done and done[0].code == 0, done
+                # bit-exact content despite the corrupted relay transfer
+                assert out.read_bytes() == data
+                # the corruption was SEEN and journaled against A...
+                summary = c.flight_recorder.get(task_id).summarize()
+                assert summary["corrupt_pieces"].get(a_peer, 0) >= 1, \
+                    summary["corrupt_pieces"]
+                # ...and the corrupt copy was never recorded: every piece
+                # C now serves verifies against the whole-content bytes
+                cs = c.storage_mgr.get(task_id)
+                got = b"".join(cs.read_piece(p.num)
+                               for p in cs.piece_infos())
+                assert got == data
+                faultgate.reset()
+                while True:
+                    resp = await stream_a.read()
+                    if resp is None or resp.done:
+                        break
+            finally:
+                faultgate.reset()
+                for ch in chans:
+                    await ch.close()
+                for d in reversed(daemons):
+                    await d.stop()
+                if origin is not None:
+                    await origin.cleanup()
+
+        run(go())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

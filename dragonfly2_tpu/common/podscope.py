@@ -150,6 +150,7 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
     serve_by_peers: dict[tuple[str, str], dict] = {}
     content = 0
     origin_bytes = 0
+    placed_bytes = 0
     starts: list[float] = []
     ends: list[float] = []
     complete = 0
@@ -160,15 +161,20 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
         summary = _flight_summary(flight)
         rows = summary.get("piece_rows") or []
         dl_bytes = (summary.get("bytes_p2p", 0)
-                    + summary.get("bytes_source", 0))
+                    + summary.get("bytes_source", 0)
+                    + summary.get("bytes_placed", 0))
         content = max(content, dl_bytes)
         origin_bytes += summary.get("bytes_source", 0)
+        placed_bytes += summary.get("bytes_placed", 0)
         for stage, n in (summary.get("slo_breaches") or {}).items():
             slo[stage] = slo.get(stage, 0) + n
         served_rung = summary.get("served_rung") or ""
         if served_rung:
             rungs[served_rung] = rungs.get(served_rung, 0) + 1
-        if rows:
+        if rows or summary.get("placed_pieces"):
+            # placement-only flights (whole-content adoption, full warm
+            # restart) have no wire rows but ARE download activity — not
+            # counting them would read the healthiest pod as incomplete
             downloaders += 1
             t0, t1 = _flight_times(flight, summary)
             starts.append(t0)
@@ -356,7 +362,14 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
                           and worst["bandwidth_bps"]
                           * BOTTLENECK_FACTOR < med)}
 
-    if origin_bytes == 0 and content > 0:
+    if origin_bytes == 0 and placed_bytes > 0:
+        # dedupe-served: the pod moved nothing across the origin uplink
+        # because the bytes were already held (content store placements /
+        # warm restart) — 0.0 with this note is the HEALTHY reading, not
+        # a blind observation window
+        amplification, amp_note = 0.0, "healthy-warm: dedupe-served " \
+            "from the content store"
+    elif origin_bytes == 0 and content > 0:
         amplification, amp_note = 1.0, "seeded before observation"
     else:
         amplification = (round(origin_bytes / content, 4) if content
@@ -372,6 +385,7 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
         "makespan_ms": makespan_ms,
         "depth": depth,
         "origin_bytes": origin_bytes,
+        "placed_bytes": placed_bytes,
         "amplification": amplification,
         "amplification_note": amp_note,
         "edges": sorted(edges.values(),
@@ -465,6 +479,7 @@ def bench_summary(task_report: dict) -> dict:
         "depth": task_report["depth"],
         "amplification": task_report["amplification"],
         "origin_bytes": task_report["origin_bytes"],
+        "placed_bytes": task_report.get("placed_bytes", 0),
         "edges": len(task_report["edges"]),
         "edge_bandwidth_bps": {"p5": _pctl(bws, 0.05),
                                "p50": _pctl(bws, 0.50),
@@ -530,8 +545,10 @@ def render_pod(report: dict, *, max_edges_per_node: int = 8) -> str:
     for addr, err in sorted((report.get("unreachable") or {}).items()):
         out.append(f"UNREACHABLE {addr}: {err}")
     for tid, t in (report.get("tasks") or {}).items():
+        note = t["amplification_note"]
         amp = (f"{t['amplification']:.2f}"
-               + (" (seeded)" if t["amplification_note"] else ""))
+               + (" (warm)" if note.startswith("healthy-warm")
+                  else " (seeded)" if note else ""))
         out.append(
             f"task {tid[:24]}  content={_fmt_bytes(t['content_length'])}  "
             f"daemons={t['complete']}/{t['daemons']} complete  "
@@ -632,6 +649,12 @@ def pod_verdict(report: dict) -> str:
             trail = ", ".join(f"{r}x{n}" for r, n in
                               sorted(t["rungs"].items()))
             parts.append(f"task {tid[:12]}: served by rungs {trail}")
+        if t.get("placed_bytes"):
+            # name the dedupe explicitly so "no origin bytes at all"
+            # reads as a warm content store, not a blind window
+            parts.append(
+                f"task {tid[:12]}: {_fmt_bytes(t['placed_bytes'])} "
+                "dedupe-served from the content store (healthy-warm)")
     breaches = report.get("breaches") or []
     if breaches:
         parts.append("BREACH " + "; BREACH ".join(breaches))

@@ -91,6 +91,8 @@ class PeerTaskConductor:
         self.completed_length = 0
         self.traffic_p2p = 0          # bytes from peers (for egress-saved stats)
         self.traffic_source = 0       # bytes from origin
+        self.traffic_placed = 0       # bytes placed from the content store
+        self._adopted = False         # whole task materialized by digest
         self.start_ms = int(time.time() * 1000)
 
         self.storage: TaskStorage | None = None
@@ -133,6 +135,12 @@ class PeerTaskConductor:
     async def _run_traced(self, sp) -> None:
         try:
             used_p2p = False
+            if await self._try_adopt_content():
+                # the whole task's bytes were already on disk under another
+                # task id (content-digest hit): placed, not transferred —
+                # no scheduler, no parents, no origin
+                await self._finish_success()
+                return
             if self.scheduler is not None:
                 self._session = await self._register()
                 if self.flight is not None and self._session is not None:
@@ -207,6 +215,161 @@ class PeerTaskConductor:
             self._sched_unreachable = True
             self.log.warning("scheduler unreachable (%s); falling back", exc)
             return None
+
+    def _ingest_to_device(self, num: int, offset: int, data) -> None:
+        """Stage one piece into the device sink; a failure disables the
+        sink for the rest of the task (best-effort contract). The ONE
+        copy of the write/journal/disable sequence — landing, adoption,
+        and placement all stage through here."""
+        if self.device_ingest is None:
+            return
+        try:
+            self.device_ingest.write(offset, data)
+            if self.flight is not None:
+                self.flight.event(fr.HBM_DONE, num, nbytes=len(data))
+        except Exception:
+            self.log.exception("device ingest write failed; disabling sink")
+            self.device_ingest.close()
+            self.device_ingest = None
+
+    # ------------------------------------------------------------------
+    # content-addressed dedupe (storage/castore.py)
+    # ------------------------------------------------------------------
+
+    async def _try_adopt_content(self) -> bool:
+        """Whole-task dedupe: when the request names a content digest the
+        store already holds complete, materialize this task as a hardlink
+        of the canonical copy (zero transfers, shared bytes on disk) and
+        adopt its piece table. False = no hit; the normal ladder runs."""
+        if (not self.url_meta.digest or self.content_range is not None
+                or self.url_meta.range
+                # url_meta.range is checked SEPARATELY from content_range:
+                # a ranged request's content_range is still None here (it
+                # resolves against the origin's real total later, in
+                # download_source) — adopting on the raw flag alone would
+                # materialize the WHOLE file under the ranged task id
+                or getattr(self.storage_mgr, "castore", None) is None):
+            return False
+        md = TaskMetadata(
+            task_id=self.task_id, task_type=self.task_type, url=self.url,
+            tag=self.url_meta.tag, application=self.url_meta.application,
+            digest=self.url_meta.digest, priority=self.resolved_priority)
+        ts = await run_io(self.storage_mgr.adopt_content, md)
+        if ts is None or not (ts.md.done and ts.md.success):
+            return False
+        self._adopted = True
+        self.storage = ts
+        self.content_length = ts.md.content_length
+        self.piece_size = ts.md.piece_size
+        self.total_pieces = ts.md.total_piece_count
+        self.storage_mgr.castore.note_hit("content", ts.md.content_length)
+        if (self.device_sink_factory is not None
+                and self.content_length > 0 and self.device_ingest is None):
+            try:
+                self.device_ingest = self.device_sink_factory(
+                    self.content_length)
+            except Exception:  # device sink is best-effort
+                self.log.exception("device sink init failed; continuing "
+                                   "to disk")
+        for num in sorted(ts.md.pieces):
+            p = ts.md.pieces[num]
+            if self.device_ingest is not None:
+                self._ingest_to_device(
+                    num, p.start, await run_io(self.storage.read_piece, num))
+            async with self._piece_cond:
+                self.ready.add(num)
+                self.completed_length += p.size
+                self._piece_cond.notify_all()
+            self.traffic_placed += p.size
+            if self.flight is not None:
+                self.flight.event(fr.PLACED, num, "cas", p.size)
+            self._publish({"type": "piece", "num": num, "size": p.size,
+                           "completed": self.completed_length,
+                           "total": self.content_length})
+        self.log.info("content dedupe: task adopted from the store "
+                      "(%d pieces, %d bytes, zero transferred)",
+                      len(ts.md.pieces), self.completed_length)
+        return True
+
+    async def place_from_store(self, infos: list[PieceInfo]) -> set[int]:
+        """Piece-level dedupe: land any of ``infos`` whose bytes are
+        already on disk — recorded under THIS task (warm restart / retry
+        over surviving storage) or under any task sharing the digest
+        (cross-task placement via the content store) — without touching
+        the wire. Returns the piece numbers landed so the engine never
+        dispatches a pull for them."""
+        if self.storage is None:
+            return set()
+        castore = getattr(self.storage_mgr, "castore", None)
+        placed: set[int] = set()
+        reports: list = []
+        for info in infos:
+            num = info.piece_num
+            if num in self.ready or num in self._landing:
+                continue
+            meta = self.storage.md.pieces.get(num)
+            if meta is None and (castore is None or not info.digest
+                                 or castore.find_piece(
+                                     info.digest, info.range_size,
+                                     exclude_task=self.task_id) is None):
+                continue
+            self._landing.add(num)
+            try:
+                if meta is not None:
+                    # verified at its original landing (or at the boot
+                    # re-verify): adopt in place, no copy
+                    offset, size, landed = meta.start, meta.size, True
+                    if castore is not None:
+                        castore.note_hit("task", size)
+                else:
+                    offset, size = info.range_start, info.range_size
+                    landed = await run_io(
+                        castore.place_piece, self.storage, num,
+                        offset, size, info.digest)
+            finally:
+                self._landing.discard(num)
+            if not landed or num in self.ready:
+                continue
+            if self.device_ingest is not None:
+                self._ingest_to_device(
+                    num, offset, await run_io(self.storage.read_piece, num))
+            async with self._piece_cond:
+                if num in self.ready:
+                    continue
+                self.ready.add(num)
+                self.completed_length += size
+                self._piece_cond.notify_all()
+            self.traffic_placed += size
+            placed.add(num)
+            if self.flight is not None:
+                self.flight.event(fr.PLACED, num, "cas", size)
+            if self._relay_tracked:
+                self.relay.pulse(self.task_id)
+            self._publish({"type": "piece", "num": num, "size": size,
+                           "completed": self.completed_length,
+                           "total": self.content_length})
+            if self._session is not None:
+                # announce the placement so the scheduler counts this
+                # daemon a holder — same shape as a back-source landing
+                # (dst ""): the bytes came off no peer's upload slot.
+                # Collected and fired CONCURRENTLY below — a warm restart
+                # adopts hundreds of pieces, and one sequential RPC round
+                # trip per piece would stall the hole-filling download
+                # behind pieces x RTT of scheduler chatter
+                from ..idl.messages import PieceResult
+                now = int(time.time() * 1000)
+                reports.append(PieceResult(
+                    task_id=self.task_id, src_peer_id=self.peer_id,
+                    dst_peer_id="", success=True,
+                    piece_info=PieceInfo(piece_num=num, range_start=offset,
+                                         range_size=size,
+                                         digest=info.digest),
+                    begin_ms=now, end_ms=now,
+                    finished_count=len(self.ready)))
+        if reports:
+            await asyncio.gather(*(self._session.report_piece(r)
+                                   for r in reports))
+        return placed
 
     # ------------------------------------------------------------------
     # content metadata + piece arrival (called by piece manager / engine)
@@ -478,20 +641,12 @@ class PeerTaskConductor:
             self._landing.discard(num)
         if num in self.ready:     # lost a race decided elsewhere
             return False
-        if self.device_ingest is not None:
-            # write() is a ~1ms memcpy + transfer-queue enqueue — the DMA
-            # itself runs on the sink's own thread and is never awaited
-            # here. Called inline: routing it through to_thread would queue
-            # the memcpy behind multi-ms piece-hashing jobs in the shared
-            # executor and serialize ingest with storage writes.
-            try:
-                self.device_ingest.write(offset, data)
-                if self.flight is not None:
-                    self.flight.event(fr.HBM_DONE, num, nbytes=len(data))
-            except Exception:
-                self.log.exception("device ingest write failed; disabling sink")
-                self.device_ingest.close()
-                self.device_ingest = None
+        # write() is a ~1ms memcpy + transfer-queue enqueue — the DMA
+        # itself runs on the sink's own thread and is never awaited here.
+        # Called inline: routing it through to_thread would queue the
+        # memcpy behind multi-ms piece-hashing jobs in the shared executor
+        # and serialize ingest with storage writes.
+        self._ingest_to_device(num, offset, data)
         if self.shaper is not None:
             self.shaper.record(self.task_id, len(data))
         async with self._piece_cond:
@@ -519,6 +674,11 @@ class PeerTaskConductor:
 
     async def _verify_digest(self) -> None:
         if not self.url_meta.digest or self.storage is None:
+            return
+        if self._adopted:
+            # the canonical copy verified this digest when IT completed,
+            # and adoption is a hardlink of that same inode — a second
+            # full-content hash here would re-pay the cost dedupe removed
             return
         if self.content_range is not None:
             # the digest describes the whole file; a sub-range can't check it
@@ -588,9 +748,10 @@ class PeerTaskConductor:
         self.done_event.set()
         async with self._piece_cond:
             self._piece_cond.notify_all()
-        self.log.info("task success: %d bytes, %d pieces (p2p=%d src=%d)",
-                      self.completed_length, len(self.ready),
-                      self.traffic_p2p, self.traffic_source)
+        self.log.info("task success: %d bytes, %d pieces (p2p=%d src=%d "
+                      "placed=%d)", self.completed_length, len(self.ready),
+                      self.traffic_p2p, self.traffic_source,
+                      self.traffic_placed)
 
     async def _finish_fail(self, code: Code, message: str) -> None:
         if self.state in (self.SUCCESS, self.FAILED):

@@ -169,6 +169,16 @@ class StorageSection:
     disk_gc_low_ratio: float = 0.80
     capacity_bytes: int = 0
     gc_interval_s: float = 60.0
+    # content-addressed store (storage/castore.py): cross-task dedupe
+    # (a piece already held under any task is placed, not transferred;
+    # identical completed content hardlink-coalesces to one inode). Off
+    # restores strict task-id-keyed storage.
+    dedupe_enabled: bool = True
+    # crc32c re-verification of reloaded pieces at boot (off-loop) before
+    # the warm state is advertised to the swarm
+    reload_verify: bool = True
+    # serve-popularity decay half-life feeding GC eviction order
+    popularity_halflife_s: float = 600.0
 
 
 @dataclass

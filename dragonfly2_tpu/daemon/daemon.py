@@ -61,7 +61,10 @@ class Daemon:
             disk_gc_high_ratio=cfg.storage.disk_gc_high_ratio,
             disk_gc_low_ratio=cfg.storage.disk_gc_low_ratio,
             capacity_bytes=cfg.storage.capacity_bytes,
-            gc_interval_s=cfg.storage.gc_interval_s))
+            gc_interval_s=cfg.storage.gc_interval_s,
+            dedupe_enabled=cfg.storage.dedupe_enabled,
+            reload_verify=cfg.storage.reload_verify,
+            popularity_halflife_s=cfg.storage.popularity_halflife_s))
         self.piece_mgr = PieceManager(cfg.download)
         self.shaper = TrafficShaper(
             total_rate_bps=cfg.download.total_rate_limit_bps,
@@ -229,6 +232,17 @@ class Daemon:
         if self.cfg.plugin_dir:
             from ..common.plugins import load_source_plugins
             load_source_plugins(self.cfg.plugin_dir)
+        if self.storage_mgr.reloaded_tasks:
+            # warm restart: re-verify the reloaded pieces (crc32c, fanned
+            # across the storage pool — never this loop) BEFORE anything
+            # serves or advertises them; what fails verification is
+            # dropped here, so the swarm only ever hears bytes that
+            # re-hashed
+            stats = await self.storage_mgr.verify_reloaded_async()
+            log.info("warm restart: %d task(s) reloaded, %d piece(s) "
+                     "verified, %d dropped", self.storage_mgr.reloaded_tasks,
+                     stats.get("pieces_ok", 0),
+                     stats.get("pieces_dropped", 0))
         if self.cfg.tracing.enabled:
             from ..common import tracing
             tracing.configure(
@@ -350,7 +364,12 @@ class Daemon:
         await self._wire_scheduler_extras()
         if self.pex is not None:
             self.pex.scheduler = self.scheduler
-            await self.pex.start()
+            # a warm-restarted daemon re-seeds its PEX digests from disk
+            # NOW (one immediate push-pull round against bootstrap/known
+            # peers) instead of after the first jittered interval — the
+            # swarm learns the holder is back within one gossip round
+            await self.pex.start(
+                initial_round=bool(self.storage_mgr.reloaded_tasks))
         # counted only after everything above succeeded, consumed exactly
         # once by stop(): a failed start() or a double stop() must neither
         # strand the count high (leak fix disabled) nor drive it to zero

@@ -56,6 +56,11 @@ CORRUPT = "corrupt"          # digest mismatch at landing (parent = sender):
 # the piece was requeued; repeated corrupt events from one parent are the
 # dfdiag fingerprint of a corrupting peer (bad NIC/disk), and the summary
 # counts them per parent so the verdict can name it
+PLACED = "placed"            # dedupe hit (parent = "cas"): the piece's
+# bytes were already on disk under another task's digest and were placed
+# locally by the content store — zero wire bytes moved; the summary
+# carries these as bytes_placed so podscope can tell a warm pod (origin
+# bytes 0 because nothing needed transferring) from a blind one
 # task-level stages
 REGISTERED = "registered"    # scheduler register returned
 HBM_SHARD = "hbm_shard"      # one device DMA completed (piece = shard idx)
@@ -206,9 +211,17 @@ class TaskFlight:
         rungs: list[str] = []
         corrupt: dict[str, int] = {}
         hbm_dma_ms = 0.0
+        placed_pieces = 0
+        bytes_placed = 0
         for t, stage, piece, parent, nbytes, dur in self.events:
             if stage == HBM_SHARD:
                 hbm_dma_ms += dur
+                continue
+            if stage == PLACED:
+                # content-store placements moved zero wire bytes: counted
+                # apart from p2p/source so origin accounting stays honest
+                placed_pieces += 1
+                bytes_placed += nbytes
                 continue
             if stage == CORRUPT:
                 corrupt[parent] = corrupt.get(parent, 0) + 1
@@ -317,6 +330,8 @@ class TaskFlight:
                              if r["source"] == "p2p"),
             "bytes_source": sum(r["bytes"] for r in piece_rows
                                 if r["source"] == "origin"),
+            "bytes_placed": bytes_placed,
+            "placed_pieces": placed_pieces,
             "per_parent": parents,
             "uploads": uploads,
             "bytes_served": sum(u["bytes"] for u in uploads.values()),

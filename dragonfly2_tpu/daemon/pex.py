@@ -498,7 +498,18 @@ class PexGossiper:
         except Exception as exc:  # noqa: BLE001 - probe is best-effort
             log.debug("scheduler probe failed: %s", exc)
 
-    async def _loop(self) -> None:
+    async def _loop(self, *, initial_round: bool = False) -> None:
+        if initial_round:
+            # warm-restart re-seed: push the reloaded-from-disk digest to
+            # the bootstrap/known peers immediately so the swarm re-learns
+            # this holder within one round, not one jittered interval —
+            # the PR 4/5 seed-restart scenario's cold window closed
+            try:
+                await self.round()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - keep the ticker alive
+                log.exception("pex initial round failed")
         while True:
             # jittered so a pod's daemons never gossip in phase
             await asyncio.sleep(self.interval_s *
@@ -510,9 +521,10 @@ class PexGossiper:
             except Exception:  # noqa: BLE001 - keep the ticker alive
                 log.exception("pex round failed")
 
-    async def start(self) -> None:
+    async def start(self, *, initial_round: bool = False) -> None:
         if self._task is None:
-            self._task = asyncio.get_running_loop().create_task(self._loop())
+            self._task = asyncio.get_running_loop().create_task(
+                self._loop(initial_round=initial_round))
 
     async def stop(self) -> None:
         if self._task is not None:

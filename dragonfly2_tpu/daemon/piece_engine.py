@@ -133,6 +133,14 @@ class _Synchronizer:
         infos = [p for p in (packet.piece_infos or [])
                  if p.piece_num not in self.conductor.ready]
         if infos:
+            # content-store consult BEFORE dispatch: announced pieces whose
+            # digests are already on disk (this task's surviving pieces, or
+            # any task's under the same digest) are placed locally — the
+            # dispatcher never even queues a pull for them
+            placed = await self.conductor.place_from_store(infos)
+            if placed:
+                infos = [p for p in infos if p.piece_num not in placed]
+        if infos:
             await self.engine.dispatcher.announce(self.parent.peer_id, infos)
 
     def stop(self) -> None:

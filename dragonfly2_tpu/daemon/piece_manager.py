@@ -184,10 +184,26 @@ class PieceManager:
 
         piece_size = conductor.set_content_info(effective)
         n = piece_count(effective, piece_size)
-        if (ranged and effective >= self.cfg.back_source_group_min_bytes
-                and self.cfg.back_source_parallelism > 1):
+        # warm adoption BEFORE any origin byte moves: pieces this task
+        # already holds on disk (surviving storage from a restart, or a
+        # retry over an earlier attempt) land as content-store placements,
+        # and the origin is only asked for the holes
+        if conductor.storage is not None and conductor.storage.md.pieces:
+            await conductor.place_from_store(
+                [m.to_info() for m in
+                 list(conductor.storage.md.pieces.values())])
+        missing = [i for i in range(n) if i not in conductor.ready]
+        if not missing:
+            conductor.on_source_complete(effective)
+            return
+        partial = len(missing) < n
+        if (ranged and self.cfg.back_source_parallelism > 1
+                and (partial
+                     or effective >= self.cfg.back_source_group_min_bytes)):
+            # the piece-group path also serves the hole-filling case: its
+            # range reads skip everything already on disk
             await self._download_piece_groups(conductor, req, effective,
-                                              piece_size, n)
+                                              piece_size, missing)
         else:
             await self._download_stream(conductor, req, piece_size,
                                         start_piece=0)
@@ -218,9 +234,12 @@ class PieceManager:
             cutter.close()   # stream died mid-piece
 
     async def _download_piece_groups(self, conductor, req: SourceRequest,
-                                     total: int, piece_size: int, n: int) -> None:
-        """Work-queue of contiguous piece groups: each worker streams the
-        next unclaimed group (parallel GCS/HTTP range reads).
+                                     total: int, piece_size: int,
+                                     missing: list[int] | int) -> None:
+        """Work-queue of contiguous piece groups over the MISSING pieces:
+        each worker streams the next unclaimed group (parallel GCS/HTTP
+        range reads). A warm task's already-held pieces split the runs, so
+        the origin only ever serves the holes.
 
         Dynamic claiming instead of a static per-worker partition does two
         things: a faster origin stream takes more groups (no straggler owns
@@ -229,7 +248,10 @@ class PieceManager:
         transfers overlap the download — with static quarters every worker
         finished at once and every DMA fired after the last byte (the r04
         bench measured 0% ingest overlap that way)."""
-        workers = min(self.cfg.back_source_parallelism, n)
+        if isinstance(missing, int):     # piece count: nothing held yet
+            missing = list(range(missing))
+        m = len(missing)
+        workers = min(self.cfg.back_source_parallelism, m)
         # one DMA unit per group: big enough that per-request origin overhead
         # is noise, small enough that groups never span ingest shards. The
         # tail stretch (last ~2 rounds of the worker pool) halves the group
@@ -237,15 +259,21 @@ class PieceManager:
         # and the final ingest shards all ship after the last byte — smaller
         # tail groups stagger the finishes so the tail DMA overlaps too.
         group_pieces = max(1, min(INGEST_DMA_UNIT_BYTES // piece_size,
-                                  -(-n // workers)))
+                                  -(-m // workers)))
         bounds: list[tuple[int, int]] = []
-        i = 0
-        while i < n:
+        idx = 0
+        while idx < m:
             size = group_pieces
-            if n - i <= 2 * workers * group_pieces and group_pieces > 1:
+            if m - idx <= 2 * workers * group_pieces and group_pieces > 1:
                 size = max(1, group_pieces // 2)
-            bounds.append((i, min(n, i + size)))
-            i += size
+            # clip the group to the contiguous run starting here: a group
+            # must be one origin Range, and held pieces break the run
+            end = idx + 1
+            while end < min(idx + size, m) \
+                    and missing[end] == missing[end - 1] + 1:
+                end += 1
+            bounds.append((missing[idx], missing[end - 1] + 1))
+            idx = end
         queue = collections.deque(bounds)
         base = req.range.start if req.range else 0
         content_len = req.range.length if req.range else total

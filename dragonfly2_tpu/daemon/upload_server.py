@@ -332,6 +332,17 @@ class UploadServer:
             if not slot.ok:
                 return     # transmit aborted: the child never got the range
             _upload_serve_secs.observe(held_ms / 1000.0)
+            # popularity feed for the storage GC (castore.py): what this
+            # daemon actually serves is what eviction should keep. Ranged
+            # sub-task views credit their PARENT — eviction is decided by
+            # parent task id, and crediting the subtask id would leave the
+            # hottest ranged content scoring 0.0 at the GC
+            castore = getattr(self.storage_mgr, "castore", None)
+            if castore is not None:
+                parent = getattr(ts, "parent", None)
+                md = getattr(parent, "md", None) or getattr(ts, "md", None)
+                castore.record_serve(getattr(md, "task_id", task_id),
+                                     nbytes)
             # flight resolved only NOW, once the transmit is known good:
             # serving() may have to evict another serve-only flight to
             # admit this task, and an aborted transfer must not pay that

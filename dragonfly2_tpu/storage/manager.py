@@ -2,12 +2,27 @@
 
 Role parity: reference ``client/daemon/storage/storage_manager.go`` —
 ``RegisterTask`` (:239), piece IO dispatch (:293-344),
-``ReloadPersistentTask`` (:674), ``TryGC`` (:804) with reclaim marks driven
-by TTL and disk high/low watermarks; persistent (dfcache) tasks are pinned.
+``ReloadPersistentTask`` (:674), ``TryGC`` (:804) — extended with the
+content-addressed layer (castore.py):
+
+* every task shares one daemon-wide ``CAStore``, so pieces land indexed
+  by digest and identical completed content coalesces onto one inode;
+* **warm restart**: ``reload()`` re-indexes EVERY task whose metadata
+  loads — completed AND partial (their per-piece crc32c records make the
+  pieces trustworthy after re-verification, unlike the reference, which
+  discards partial downloads wholesale). ``verify_reloaded()`` re-hashes
+  the recorded pieces off-loop (crc32c via the native path) and drops
+  only what actually fails — a restarted daemon rejoins the swarm as a
+  holder instead of a cold leecher;
+* **popularity-aware GC**: eviction orders by priority, then the
+  CAStore's decayed serve-popularity, then recency — and the capacity
+  watermarks act on PHYSICAL bytes (inode-deduped), so digest-shared
+  content is neither double-counted nor double-"reclaimed".
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 import shutil
@@ -15,12 +30,28 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..common import digest as digestlib
 from ..common.errors import Code, DFError
+from ..common.metrics import REGISTRY
 from ..idl.messages import TaskType
+from .castore import CAStore
 from .metadata import METADATA_FILE, TaskMetadata
 from .store import SubTaskStorage, TaskStorage
 
 log = logging.getLogger("df.storage.manager")
+
+_logical_gauge = REGISTRY.gauge(
+    "df_storage_logical_bytes",
+    "bytes the store's tasks occupy before digest-sharing (sum of "
+    "per-task content)")
+_physical_gauge = REGISTRY.gauge(
+    "df_storage_physical_bytes",
+    "bytes the store's tasks actually occupy on disk (hardlink-shared "
+    "inodes counted once)")
+_reload_pieces = REGISTRY.counter(
+    "df_store_reload_pieces_total",
+    "pieces re-indexed from disk at boot, by re-verification outcome",
+    ("result",))
 
 
 @dataclass
@@ -32,6 +63,13 @@ class StorageConfig:
     disk_gc_low_ratio: float = 0.80
     capacity_bytes: int = 0          # 0: use the filesystem's capacity
     gc_interval_s: float = 60.0
+    # content-addressed dedupe (castore.py): cross-task piece placement +
+    # completed-content hardlink coalescing
+    dedupe_enabled: bool = True
+    # crc-verify reloaded pieces before trusting them (verify_reloaded)
+    reload_verify: bool = True
+    # serve-popularity decay half-life feeding GC eviction order
+    popularity_halflife_s: float = 600.0
 
     def validate(self) -> None:
         if not (0 < self.disk_gc_low_ratio <= self.disk_gc_high_ratio <= 1):
@@ -46,6 +84,12 @@ class StorageManager:
         self._lock = threading.Lock()
         self._tasks: dict[str, TaskStorage] = {}
         self._subtasks: dict[str, SubTaskStorage] = {}
+        self.castore = CAStore(
+            resolve=self._tasks.get,
+            popularity_halflife_s=cfg.popularity_halflife_s) \
+            if cfg.dedupe_enabled else None
+        self.reloaded_tasks = 0       # tasks re-indexed by the last reload
+        self.last_gc_stats: dict = {}
         self.reload()
 
     # -- registration --------------------------------------------------
@@ -58,7 +102,8 @@ class StorageManager:
             ts = self._tasks.get(md.task_id)
             if ts is not None:
                 return ts
-            ts = TaskStorage(self._task_dir(md.task_id), md)
+            ts = TaskStorage(self._task_dir(md.task_id), md,
+                             castore=self.castore)
             self._tasks[md.task_id] = ts
             return ts
 
@@ -102,6 +147,37 @@ class StorageManager:
             return ts
         return None
 
+    def adopt_content(self, md: TaskMetadata) -> TaskStorage | None:
+        """Materialize a whole task from already-held identical content:
+        when ``md.digest`` names content a completed task holds, the new
+        task is built as a HARDLINK of the canonical data file plus a
+        copy of its piece table — done before a single byte is pulled.
+        BLOCKING (file ops): run on the storage executor. None = no hit.
+        """
+        if self.castore is None or not md.digest:
+            return None
+        src_tid = self.castore.find_content(md.digest)
+        src = self._tasks.get(src_tid) if src_tid else None
+        if src is None or not (src.md.done and src.md.success):
+            return None
+        if src.md.task_id == md.task_id:
+            return src
+        ts = self.register_task(md)
+        if ts.md.done and ts.md.success:
+            return ts                  # already materialized earlier
+        try:
+            if not CAStore.link_shared(src, ts):
+                return None
+        except OSError:
+            return None
+        ts.adopt_from(src)
+        ts.mark_done(success=True,
+                     content_length=src.md.content_length,
+                     total_piece_count=src.md.total_piece_count)
+        self.castore.record_serve(src.md.task_id, src.md.content_length,
+                                  weight=0.5)
+        return ts
+
     def tasks(self) -> list[TaskStorage]:
         with self._lock:
             return list(self._tasks.values())
@@ -112,17 +188,21 @@ class StorageManager:
             self._subtasks.pop(task_id, None)
         if ts is None:
             return False
+        if self.castore is not None:
+            self.castore.drop_task(task_id)
         ts.destroy()
         return True
 
     # -- restart recovery ---------------------------------------------
 
     def reload(self) -> int:
-        """Re-index completed tasks from disk; drop invalid/partial ones.
-
-        Partial downloads are discarded (their piece table can't be trusted
-        against a crashed writer) — same policy as the reference
-        (``storage_manager.go:662 IsInvalid``).
+        """Re-index tasks from disk: completed ones AND partials that
+        recorded verified pieces — their per-piece digests make the bytes
+        re-checkable, so a restarted daemon keeps its working set instead
+        of re-pulling it (the reference's IsInvalid discard threw the
+        whole fleet's warm state away on every rolling restart). Torn or
+        digest-less metadata is still discarded; actual byte verification
+        happens in ``verify_reloaded`` (off-loop).
         """
         n = 0
         root = self.cfg.data_dir
@@ -139,40 +219,169 @@ class StorageManager:
                 try:
                     md = TaskMetadata.load(tdir)
                 except (OSError, ValueError, KeyError, TypeError):
+                    # torn metadata: with crash-safe persist this means
+                    # real corruption, not a mid-write crash — discard
                     shutil.rmtree(tdir, ignore_errors=True)
                     continue
-                if not (md.done and md.success):
+                complete = md.done and md.success
+                # a partial is only as good as its piece records: keep it
+                # when every recorded piece carries a digest to re-verify
+                warm = (md.pieces
+                        and all(p.digest for p in md.pieces.values()))
+                if not complete and not warm:
                     shutil.rmtree(tdir, ignore_errors=True)
                     continue
+                ts = TaskStorage(tdir, md, castore=self.castore)
                 with self._lock:
-                    self._tasks[md.task_id] = TaskStorage(tdir, md)
+                    self._tasks[md.task_id] = ts
+                if self.castore is not None:
+                    self.castore.add_task(ts)
                 n += 1
+        self.reloaded_tasks = n
         if n:
-            log.info("reloaded %d completed tasks", n)
+            log.info("reloaded %d tasks (completed + warm partials)", n)
         return n
+
+    def _verify_task(self, ts: TaskStorage) -> tuple[int, int, bool]:
+        """Re-hash one reloaded task's recorded pieces against their
+        metadata digests (crc32c rides the native path). BLOCKING — one
+        unit of storage-executor work. Returns (pieces_ok,
+        pieces_dropped, task_dropped); a task that loses pieces is
+        demoted to partial (the next conductor re-pulls just the holes),
+        one that loses everything is deleted."""
+        md = ts.md
+        bad: list[int] = []
+        n_ok = 0
+        for num, p in sorted(md.pieces.items()):
+            ok = False
+            if p.digest:
+                try:
+                    data = ts.read_range(p.start, p.size)
+                    ok = (len(data) == p.size
+                          and digestlib.verify(p.digest, data))
+                except (DFError, OSError, ValueError):
+                    ok = False
+            if ok:
+                n_ok += 1
+                _reload_pieces.labels("ok").inc()
+            else:
+                bad.append(num)
+                _reload_pieces.labels("dropped").inc()
+        if not bad:
+            return n_ok, 0, False
+        if len(bad) == len(md.pieces):
+            self.delete_task(md.task_id)
+            return n_ok, len(bad), True
+        with ts._lock:
+            for num in bad:
+                del md.pieces[num]
+            # holes mean the task is no longer complete: demote so
+            # find_completed_task stops offering it whole and the
+            # next conductor re-pulls exactly the missing pieces
+            md.done = md.success = False
+            md.save(ts.dir)
+        if self.castore is not None:
+            self.castore.drop_task(md.task_id)
+            self.castore.add_task(ts)
+        return n_ok, len(bad), False
+
+    def verify_reloaded(self) -> dict:
+        """Re-verification of reloaded pieces — a crashed writer's torn
+        piece (the data file is not fsynced per write, unlike metadata)
+        must never be served or counted as held. BLOCKING; boot runs the
+        async form below, which fans the per-task work across the whole
+        storage pool instead of serializing a cache-sized scan on one
+        thread."""
+        stats = {"tasks": 0, "pieces_ok": 0, "pieces_dropped": 0,
+                 "tasks_dropped": 0}
+        if not self.cfg.reload_verify:
+            return stats
+        for ts in self.tasks():
+            if not ts.md.pieces:
+                continue
+            stats["tasks"] += 1
+            ok, dropped, gone = self._verify_task(ts)
+            stats["pieces_ok"] += ok
+            stats["pieces_dropped"] += dropped
+            stats["tasks_dropped"] += 1 if gone else 0
+        if stats["pieces_dropped"] or stats["tasks_dropped"]:
+            log.warning("reload verification dropped %d piece(s), "
+                        "%d task(s)", stats["pieces_dropped"],
+                        stats["tasks_dropped"])
+        return stats
+
+    async def verify_reloaded_async(self) -> dict:
+        """Boot-time form: one storage-executor job PER TASK, gathered —
+        the re-hash parallelizes across the pool's workers, so a large
+        warm cache costs cache_bytes / (pool x crc32c_rate), not a
+        single-threaded scan, before the daemon starts serving."""
+        from .io_executor import run_io
+        stats = {"tasks": 0, "pieces_ok": 0, "pieces_dropped": 0,
+                 "tasks_dropped": 0}
+        if not self.cfg.reload_verify:
+            return stats
+        pending = [ts for ts in self.tasks() if ts.md.pieces]
+        stats["tasks"] = len(pending)
+        results = await asyncio.gather(
+            *(run_io(self._verify_task, ts) for ts in pending))
+        for ok, dropped, gone in results:
+            stats["pieces_ok"] += ok
+            stats["pieces_dropped"] += dropped
+            stats["tasks_dropped"] += 1 if gone else 0
+        if stats["pieces_dropped"] or stats["tasks_dropped"]:
+            log.warning("reload verification dropped %d piece(s), "
+                        "%d task(s)", stats["pieces_dropped"],
+                        stats["tasks_dropped"])
+        return stats
 
     # -- GC ------------------------------------------------------------
 
+    def usage(self) -> tuple[int, int]:
+        """(logical_bytes, physical_bytes): per-task sum vs inode-deduped
+        disk footprint — digest-shared content counts once in physical."""
+        logical = 0
+        physical = 0
+        seen: set[tuple[int, int]] = set()
+        for ts in self.tasks():
+            sz = ts.disk_usage()
+            logical += sz
+            ino = ts.inode()
+            if ino is None or ino not in seen:
+                physical += sz
+                if ino is not None:
+                    seen.add(ino)
+        _logical_gauge.set(logical)
+        _physical_gauge.set(physical)
+        if self.castore is not None:
+            self.castore.update_shared_gauge(logical, physical)
+        return logical, physical
+
     def _usage(self) -> tuple[int, int]:
-        """(used_bytes_by_store, capacity_bytes)."""
-        used = sum(ts.disk_usage() for ts in self.tasks())
+        """(physical_used_bytes, capacity_bytes) for the GC watermarks."""
+        _logical, physical = self.usage()
         if self.cfg.capacity_bytes:
-            return used, self.cfg.capacity_bytes
+            return physical, self.cfg.capacity_bytes
         try:
             stat = shutil.disk_usage(self.cfg.data_dir)
-            return used, stat.total
+            return physical, stat.total
         except OSError:
-            return used, 0
+            return physical, 0
 
     def try_gc(self) -> int:
-        """TTL sweep + usage-driven eviction, oldest-access first.
+        """TTL sweep + usage-driven eviction, least-popular first.
 
         Not-done tasks are treated as active while their access_time is
         fresh (pieces still landing); once stale past the TTL they are
-        abandoned downloads and reclaimed too. Sub-task views whose parent
-        is gone (or stale) are dropped with them.
+        abandoned downloads and reclaimed too. Capacity eviction orders by
+        download priority, then the CAStore's decayed serve-popularity
+        (cold content leaves before the pod's hot model), then oldest
+        access. Reclaim accounting is honest about sharing: deleting one
+        alias of hardlink-shared content frees ~0 physical bytes, so the
+        sweep keeps going until the PHYSICAL watermark is met.
         """
         reclaimed = 0
+        logical_freed = 0
+        physical_freed = 0
         now = time.time()
         candidates: list[TaskStorage] = []
         for ts in self.tasks():
@@ -182,8 +391,13 @@ class StorageManager:
             if not ts.md.done and not stale:
                 continue  # active download
             if stale:
+                sz = ts.disk_usage()
+                shared = ts.nlink() > 1
                 if self.delete_task(ts.md.task_id):
                     reclaimed += 1
+                    logical_freed += sz
+                    if not shared:
+                        physical_freed += sz
             else:
                 candidates.append(ts)
         with self._lock:
@@ -195,14 +409,32 @@ class StorageManager:
         used, cap = self._usage()
         if cap and used / cap > self.cfg.disk_gc_high_ratio:
             target = int(cap * self.cfg.disk_gc_low_ratio)
-            # eviction order: lowest download priority first (numeric
-            # DESC — LEVEL6 before LEVEL0), then oldest access
-            candidates.sort(key=lambda t: (-t.md.priority, t.md.access_time))
+            mono = time.monotonic()
+
+            def evict_key(t: TaskStorage):
+                pop = (self.castore.popularity(t.md.task_id, now=mono)
+                       if self.castore is not None else 0.0)
+                # lowest download priority first (numeric DESC — LEVEL6
+                # before LEVEL0), then coldest by serve-popularity, then
+                # oldest access
+                return (-t.md.priority, pop, t.md.access_time)
+
+            candidates.sort(key=evict_key)
             for ts in candidates:
                 if used <= target:
                     break
                 sz = ts.disk_usage()
+                # the last hardlink to an inode frees bytes; an alias of
+                # still-referenced content frees only its metadata
+                freed = sz if ts.nlink() <= 1 else 0
                 if self.delete_task(ts.md.task_id):
-                    used -= sz
+                    used -= freed
+                    logical_freed += sz
+                    physical_freed += freed
                     reclaimed += 1
+        self.last_gc_stats = {
+            "reclaimed_tasks": reclaimed,
+            "logical_bytes_freed": logical_freed,
+            "physical_bytes_freed": physical_freed,
+        }
         return reclaimed

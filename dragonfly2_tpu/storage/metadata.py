@@ -86,10 +86,26 @@ class TaskMetadata:
         return md
 
     def save(self, task_dir: str) -> None:
+        """Crash-safe persist: tmp file + fsync + atomic rename + directory
+        fsync. A daemon killed mid-persist must never boot with torn
+        metadata — the reader sees either the old complete file or the new
+        complete file, and the rename itself survives a crash because the
+        directory entry is flushed too. Callers run this off-loop
+        (mark_done/persist ride the storage executor)."""
         tmp = os.path.join(task_dir, METADATA_FILE + ".tmp")
         with open(tmp, "w") as f:
             f.write(self.to_json())
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(task_dir, METADATA_FILE))
+        try:
+            dfd = os.open(task_dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass                    # fs without dir-fsync: best effort
 
     @staticmethod
     def load(task_dir: str) -> "TaskMetadata":

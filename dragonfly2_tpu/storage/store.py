@@ -64,9 +64,14 @@ def _pwrite_all(fd: int, data, offset: int) -> None:
 class TaskStorage:
     """One task's on-disk state. Thread-safe for concurrent piece writes."""
 
-    def __init__(self, task_dir: str, metadata: TaskMetadata):
+    def __init__(self, task_dir: str, metadata: TaskMetadata,
+                 castore=None):
         self.dir = task_dir
         self.md = metadata
+        # content-addressed index (storage/castore.py): every verified
+        # piece this task lands is registered by digest so other tasks
+        # can place (not transfer) identical bytes; None = dedupe off
+        self.castore = castore
         self._lock = threading.Lock()
         self._fd: int | None = None        # cached O_RDWR fd (lazy)
         self._fd_users = 0                 # leases out via _data_fd()
@@ -222,6 +227,9 @@ class TaskStorage:
         with self._lock:
             self.md.pieces[num] = meta
             self.md.access_time = time.time()
+        if self.castore is not None:
+            self.castore.add_piece(self.md.task_id, num, offset,
+                                   len(data), piece_digest)
         return meta
 
     def write_span(self, pieces: list[tuple[int, int, int, str]], data,
@@ -310,7 +318,28 @@ class TaskStorage:
             for meta in metas:
                 self.md.pieces.setdefault(meta.num, meta)
             self.md.access_time = time.time()
+        if self.castore is not None:
+            for meta in metas:
+                self.castore.add_piece(self.md.task_id, meta.num,
+                                       meta.start, meta.size, meta.digest)
         return metas, corrupt, ("native" if used_native else "python")
+
+    def adopt_from(self, src: "TaskStorage") -> None:
+        """Adopt ``src``'s geometry + piece table — used when this task's
+        data file has just become a hardlink of ``src``'s (content-
+        identical, both immutable). Lives here so the lock discipline and
+        the coverage-cache invalidation stay TaskStorage's own business:
+        the piece table is replaced wholesale, and the covered_prefix
+        memo (keyed on piece COUNT) would otherwise serve stale spans."""
+        with self._lock:
+            self.md.pieces = {
+                num: PieceMeta(num=p.num, start=p.start, size=p.size,
+                               digest=p.digest, source="cas")
+                for num, p in src.md.pieces.items()}
+            self.md.content_length = src.md.content_length
+            self.md.total_piece_count = src.md.total_piece_count
+            self.md.piece_size = src.md.piece_size
+            self._cover_cache = None
 
     def mark_done(self, *, success: bool, content_length: int | None = None,
                   total_piece_count: int | None = None, digest: str = "") -> None:
@@ -324,6 +353,12 @@ class TaskStorage:
             self.md.done = True
             self.md.success = success
             self.md.save(self.dir)
+        if success and self.castore is not None:
+            # content-identity dedupe: an identical completed task already
+            # on disk absorbs this one's bytes via hardlink (castore.py);
+            # runs here because mark_done already rides the storage
+            # executor — never the event loop
+            self.castore.on_task_complete(self)
 
     def persist(self) -> None:
         with self._lock:
@@ -467,8 +502,27 @@ class TaskStorage:
         return self._data_path
 
     def disk_usage(self) -> int:
+        """LOGICAL bytes: what this task's content occupies from its own
+        point of view. Digest-shared (hardlinked) data counts once per
+        task here; StorageManager.usage() dedupes by inode for the
+        physical number GC watermarks act on."""
         try:
             return os.path.getsize(self._data_path)
+        except OSError:
+            return 0
+
+    def inode(self) -> tuple[int, int] | None:
+        """(st_dev, st_ino) of the data file — the physical identity
+        shared pieces coalesce on. None when the file is gone."""
+        try:
+            st = os.stat(self._data_path)
+            return st.st_dev, st.st_ino
+        except OSError:
+            return None
+
+    def nlink(self) -> int:
+        try:
+            return os.stat(self._data_path).st_nlink
         except OSError:
             return 0
 

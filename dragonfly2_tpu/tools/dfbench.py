@@ -884,6 +884,216 @@ def _run_pr9(args) -> dict:
     }
 
 
+# --------------------------------------------------------------- PR-10
+# Content-store churn harness: rolling-restart churn + repeated hot-model
+# pulls under ALIAS URLs (same content, different task ids), driven through
+# the REAL storage stack — StorageManager, CAStore, TaskStorage, the
+# warm-reload + crc re-verify path — in a throwaway tempdir. No virtual
+# clock needed: the measured quantities are BYTES (origin / p2p / placed /
+# disk), which are deterministic functions of the seeded content and the
+# deterministic pull order, so the run digests byte-identically.
+
+CHURN_RETAIN_EPOCHS = 2     # task turnover: aliases older than this leave
+
+
+def run_churn_bench(*, seed: int = 7, daemons: int = 4, epochs: int = 4,
+                    pieces: int = 8, piece_size: int = 64 << 10,
+                    restart_fraction: float = 0.34,
+                    dedupe: bool = True) -> dict:
+    """One churn run; returns per-epoch byte accounting + disk curves.
+
+    Epoch model: one hot model (seeded content) is pulled by every daemon
+    each epoch under a FRESH alias URL (new task id, same bytes). Between
+    epochs a rotating third of the daemons restart — their StorageManager
+    is rebuilt over the surviving directory, riding the real reload +
+    ``verify_reloaded`` path. Pulls resolve pieces in a fixed order:
+    local content store first (``placed``), then any daemon already
+    holding the bytes this epoch or on disk (``p2p``), else ``origin``.
+    With ``dedupe=False`` the store runs task-id-keyed (the pre-CAS
+    fabric): every alias re-transfers and every copy occupies its own
+    disk — the baseline the headline numbers are judged against.
+    """
+    import random as _random
+    import tempfile
+
+    from ..common import digest as digestlib
+    from ..storage.manager import StorageConfig, StorageManager
+    from ..storage.metadata import TaskMetadata
+
+    rng = _random.Random(seed)
+    content = rng.randbytes(pieces * piece_size)
+    algo = digestlib.preferred_piece_algo()
+    piece_digests = [
+        digestlib.for_bytes(algo, content[i * piece_size:(i + 1) * piece_size])
+        for i in range(pieces)]
+    content_digest = "sha256:" + hashlib.sha256(content).hexdigest()
+
+    def task_id(epoch: int) -> str:
+        # alias URL per epoch -> distinct task id over identical bytes
+        return hashlib.sha256(
+            f"churn://model?epoch={epoch}&seed={seed}".encode()).hexdigest()
+
+    epoch_rows: list[dict] = []
+    with tempfile.TemporaryDirectory(prefix="dfbench-pr10-") as root:
+        def make_mgr(i: int) -> StorageManager:
+            return StorageManager(StorageConfig(
+                data_dir=f"{root}/d{i}", gc_interval_s=3600,
+                dedupe_enabled=dedupe, reload_verify=True))
+
+        mgrs = [make_mgr(i) for i in range(daemons)]
+        n_restart = max(1, int(daemons * restart_fraction))
+        for epoch in range(epochs):
+            restarted: list[int] = []
+            if epoch > 0:
+                # rolling restart: a rotating subset loses its process
+                # state; disk survives and the real reload re-indexes it
+                for k in range(n_restart):
+                    i = (epoch * n_restart + k) % daemons
+                    restarted.append(i)
+                    mgrs[i] = make_mgr(i)
+                    mgrs[i].verify_reloaded()
+            tid = task_id(epoch)
+            origin_b = p2p_b = placed_b = 0
+            alias_transfer_b = 0
+            for i in range(daemons):
+                mgr = mgrs[i]
+                md = TaskMetadata(
+                    task_id=tid, url=f"churn://model?epoch={epoch}",
+                    content_length=len(content),
+                    total_piece_count=pieces, piece_size=piece_size,
+                    digest=content_digest)
+                ts = mgr.register_task(md)
+                for num in range(pieces):
+                    if num in ts.md.pieces:
+                        continue
+                    off = num * piece_size
+                    dg = piece_digests[num]
+                    if mgr.castore is not None and mgr.castore.place_piece(
+                            ts, num, off, piece_size, dg):
+                        placed_b += piece_size
+                        continue
+                    data = content[off:off + piece_size]
+                    holder = next(
+                        (j for j in range(daemons) if j != i
+                         and (mgrs[j].castore is not None
+                              and mgrs[j].castore.find_piece(
+                                  dg, piece_size) is not None
+                              or tid in {t.md.task_id
+                                         for t in mgrs[j].tasks()
+                                         if num in t.md.pieces})),
+                        None)
+                    ts.write_piece(num, off, data, dg)
+                    if holder is not None:
+                        p2p_b += piece_size
+                    else:
+                        origin_b += piece_size
+                    if epoch > 0:
+                        alias_transfer_b += piece_size
+                ts.mark_done(success=True, digest=content_digest)
+            # task turnover: aliases beyond the retention window leave —
+            # hardlink refcounts must keep shared bytes alive exactly
+            # until the LAST alias goes
+            if epoch >= CHURN_RETAIN_EPOCHS:
+                old = task_id(epoch - CHURN_RETAIN_EPOCHS)
+                for mgr in mgrs:
+                    mgr.delete_task(old)
+            logical = physical = 0
+            for mgr in mgrs:
+                lo, ph = mgr.usage()
+                logical += lo
+                physical += ph
+            epoch_rows.append({
+                "epoch": epoch,
+                "restarted": restarted,
+                "origin_bytes": origin_b,
+                "p2p_bytes": p2p_b,
+                "placed_bytes": placed_b,
+                "alias_transfer_bytes": alias_transfer_b,
+                "logical_bytes": logical,
+                "physical_bytes": physical,
+            })
+    content_size = len(content)
+    # the digest covers the seeded CONTENT identity too: byte accounting
+    # alone is seed-invariant (counts, not bytes), and a determinism gate
+    # that can't tell seeds apart gates nothing
+    digest = hashlib.sha256(json.dumps(
+        {"content": content_digest, "rows": epoch_rows},
+        sort_keys=True).encode()).hexdigest()
+    return {
+        "seed": seed,
+        "daemons": daemons,
+        "epochs": epochs,
+        "pieces": pieces,
+        "piece_size": piece_size,
+        "content_bytes": content_size,
+        "dedupe": dedupe,
+        "per_epoch": epoch_rows,
+        "origin_bytes_total": sum(r["origin_bytes"] for r in epoch_rows),
+        "origin_bytes_after_first_epoch": sum(
+            r["origin_bytes"] for r in epoch_rows if r["epoch"] > 0),
+        "alias_transfer_bytes": sum(
+            r["alias_transfer_bytes"] for r in epoch_rows),
+        "max_physical_bytes_per_daemon": max(
+            r["physical_bytes"] for r in epoch_rows) // daemons,
+        "max_logical_bytes_per_daemon": max(
+            r["logical_bytes"] for r in epoch_rows) // daemons,
+        "churn_digest": digest,
+    }
+
+
+def _run_pr10(args) -> dict:
+    """The PR-10 trajectory point: content-addressed storage under
+    rolling-restart churn + hot-model alias pulls, CAS vs the task-id-
+    keyed baseline, through the REAL storage stack. A plain baseline sim
+    rides along as the digest gate (byte-identical to BENCH_pr3 — the
+    storage refactor must not move the scheduler). Acceptance: origin
+    bytes == 0 after the first epoch, alias pulls transfer 0 bytes, and
+    physical disk stays ~1x content per daemon under task turnover while
+    the baseline holds every alias copy."""
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    shape = dict(seed=args.seed,
+                 daemons=3 if args.smoke else 4,
+                 epochs=2 if args.smoke else 4,
+                 pieces=4 if args.smoke else 8,
+                 piece_size=(16 << 10) if args.smoke else (64 << 10))
+    cas = run_churn_bench(**shape, dedupe=True)
+    cold = run_churn_bench(**shape, dedupe=False)
+    content = cas["content_bytes"]
+    return {
+        "bench": "dfbench-castore",
+        "seed": args.seed,
+        "daemons": shape["daemons"],
+        "epochs": shape["epochs"],
+        "pieces": shape["pieces"],
+        "piece_size": shape["piece_size"],
+        "content_bytes": content,
+        # the scheduler sim never touched: digest gate vs BENCH_pr3
+        "schedule_digest": base["schedule_digest"],
+        "cas": cas,
+        "baseline": cold,
+        # headline acceptance flags (tests/test_dfbench.py gates these)
+        "origin_bytes_after_first_epoch":
+            cas["origin_bytes_after_first_epoch"],
+        "alias_transfer_bytes": cas["alias_transfer_bytes"],
+        "warm_restart_zero_origin":
+            cas["origin_bytes_after_first_epoch"] == 0,
+        "alias_pull_zero_transfer": cas["alias_transfer_bytes"] == 0,
+        # bounded: shared inodes keep each daemon at ~1x content even
+        # with CHURN_RETAIN_EPOCHS aliases alive; the baseline pays one
+        # full copy per retained alias
+        "disk_bounded": cas["max_physical_bytes_per_daemon"]
+            <= int(content * 1.25),
+        "disk_saving_vs_baseline": round(
+            1.0 - cas["max_physical_bytes_per_daemon"]
+            / max(cold["max_physical_bytes_per_daemon"], 1), 4),
+        "baseline_origin_bytes_after_first_epoch":
+            cold["origin_bytes_after_first_epoch"],
+        "churn_digest": cas["churn_digest"],
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -919,6 +1129,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "trajectory point (BENCH_pr9.json): cold-start "
                    "makespan vs pod size, podscope tree depth, and the "
                    "relay-disabled digest gate against BENCH_pr3")
+    p.add_argument("--pr10", action="store_true",
+                   help="drive the REAL content-addressed storage stack "
+                   "through rolling-restart churn + hot-model alias pulls "
+                   "(CAS vs task-id-keyed baseline) and write the PR-10 "
+                   "trajectory point (BENCH_pr10.json): origin bytes after "
+                   "the first epoch, alias transfer bytes, disk "
+                   "boundedness, and the scheduler digest gate against "
+                   "BENCH_pr3")
     p.add_argument("--pr8", action="store_true",
                    help="replay the baseline run's decision-ledger rows "
                    "through every offline evaluator (default/nt/ml) and "
@@ -963,7 +1181,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr9:
+        if args.pr10:
+            args.out = "BENCH_pr10.json"
+        elif args.pr9:
             args.out = "BENCH_pr9.json"
         elif args.pr8:
             args.out = "BENCH_pr8.json"
@@ -979,7 +1199,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr9:
+    if args.pr10:
+        result = _run_pr10(args)
+    elif args.pr9:
         result = _run_pr9(args)
     elif args.pr8:
         result = _run_pr8(args)
@@ -998,7 +1220,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr9:
+        if args.pr10:
+            print(f"dfbench: wrote {args.out} (origin after epoch 0: "
+                  f"{result['origin_bytes_after_first_epoch']} B vs "
+                  f"baseline "
+                  f"{result['baseline_origin_bytes_after_first_epoch']} B, "
+                  f"alias transfer {result['alias_transfer_bytes']} B, "
+                  f"disk bounded={result['disk_bounded']} (saving "
+                  f"{result['disk_saving_vs_baseline']:.0%}), "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.pr9:
             mk = result["cold_makespan_ms"]
             sizes = [str(n) for n in result["pod_sizes"]]
             print(f"dfbench: wrote {args.out} (cold makespan pull/relay: "

@@ -1,0 +1,484 @@
+"""Content-addressed store (storage/castore.py): cross-task dedupe,
+crash-safe warm restart, popularity-aware eviction, shared-disk
+accounting — plus the daemon-level placement paths (conductor/engine
+consult the store before a single wire byte moves)."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from dragonfly2_tpu.common import digest as digestlib
+from dragonfly2_tpu.common.piece import (compute_piece_size, piece_count,
+                                         piece_range)
+from dragonfly2_tpu.idl.messages import TaskType
+from dragonfly2_tpu.storage.castore import content_key
+from dragonfly2_tpu.storage.manager import StorageConfig, StorageManager
+from dragonfly2_tpu.storage.metadata import METADATA_FILE, TaskMetadata
+
+
+def make_manager(tmp_path, **kw):
+    return StorageManager(StorageConfig(data_dir=str(tmp_path / "data"), **kw))
+
+
+def fill_task(mgr, task_id: str, content: bytes, *, url: str = "",
+              digest: str = "", task_type=TaskType.STANDARD,
+              pieces_only: int | None = None, piece_size: int = 0):
+    """Land ``content`` (optionally just the first N pieces) with per-piece
+    digests recorded — the shape every CAS feature keys on."""
+    size = piece_size or compute_piece_size(len(content))
+    n = piece_count(len(content), size)
+    algo = digestlib.preferred_piece_algo()
+    ts = mgr.register_task(TaskMetadata(
+        task_id=task_id, task_type=task_type,
+        url=url or f"http://o/{task_id[:8]}",
+        content_length=len(content), total_piece_count=n, piece_size=size,
+        digest=digest))
+    for i in range(n if pieces_only is None else pieces_only):
+        off, ln = piece_range(i, size, len(content))
+        ts.write_piece(i, off, content[off:off + ln],
+                       digestlib.for_bytes(algo, content[off:off + ln]))
+    if pieces_only is None:
+        ts.mark_done(success=True, digest=digest)
+    else:
+        ts.persist()
+    return ts
+
+
+class TestContentKey:
+    def test_complete_task_keys_on_geometry_and_digests(self, tmp_path):
+        mgr = make_manager(tmp_path)
+        content = os.urandom(300_000)
+        a = fill_task(mgr, "a" * 64, content)
+        b = fill_task(mgr, "b" * 64, content)
+        assert content_key(a.md) == content_key(b.md)
+        other = fill_task(mgr, "c" * 64, os.urandom(300_000))
+        assert content_key(other.md) != content_key(a.md)
+
+    def test_incomplete_or_digestless_has_no_key(self, tmp_path):
+        mgr = make_manager(tmp_path)
+        partial = fill_task(mgr, "d" * 64, os.urandom(300_000),
+                            pieces_only=1)
+        assert content_key(partial.md) is None
+        bare = mgr.register_task(TaskMetadata(task_id="e" * 64))
+        assert content_key(bare.md) is None
+
+
+class TestPieceIndex:
+    def test_place_piece_copies_and_verifies(self, tmp_path):
+        mgr = make_manager(tmp_path)
+        content = os.urandom(300_000)
+        src = fill_task(mgr, "a" * 64, content)
+        meta0 = src.md.pieces[0]
+        dst = mgr.register_task(TaskMetadata(
+            task_id="b" * 64, content_length=len(content),
+            total_piece_count=src.md.total_piece_count,
+            piece_size=src.md.piece_size))
+        assert mgr.castore.place_piece(dst, 0, 0, meta0.size, meta0.digest)
+        assert dst.read_piece(0) == content[:meta0.size]
+        assert dst.md.pieces[0].source == "cas"
+
+    def test_place_refuses_corrupt_holder_and_drops_loc(self, tmp_path):
+        """Bit-rot on the holder's disk must fail the placement (the
+        copy re-verifies) and un-index the lying location."""
+        mgr = make_manager(tmp_path)
+        content = os.urandom(300_000)
+        src = fill_task(mgr, "a" * 64, content)
+        meta0 = src.md.pieces[0]
+        with open(src.data_path(), "r+b") as f:   # rot piece 0 in place
+            f.seek(3)
+            f.write(b"\xff\xff\xff")
+        dst = mgr.register_task(TaskMetadata(task_id="b" * 64))
+        assert not mgr.castore.place_piece(dst, 0, 0, meta0.size,
+                                           meta0.digest)
+        assert mgr.castore.find_piece(meta0.digest, meta0.size) is None
+
+    def test_drop_task_unindexes(self, tmp_path):
+        mgr = make_manager(tmp_path)
+        content = os.urandom(120_000)
+        src = fill_task(mgr, "a" * 64, content)
+        dg = src.md.pieces[0].digest
+        assert mgr.castore.find_piece(dg, src.md.pieces[0].size)
+        mgr.delete_task("a" * 64)
+        assert mgr.castore.find_piece(dg, src.md.pieces[0].size) is None
+
+    def test_dedupe_disabled_runs_task_keyed(self, tmp_path):
+        mgr = make_manager(tmp_path, dedupe_enabled=False)
+        assert mgr.castore is None
+        content = os.urandom(120_000)
+        a = fill_task(mgr, "a" * 64, content)
+        b = fill_task(mgr, "b" * 64, content)
+        assert a.inode() != b.inode()      # every copy pays its own disk
+
+
+class TestContentDedupe:
+    def test_identical_completed_tasks_share_one_inode(self, tmp_path):
+        mgr = make_manager(tmp_path)
+        content = os.urandom(300_000)
+        a = fill_task(mgr, "a" * 64, content)
+        b = fill_task(mgr, "b" * 64, content)
+        assert a.inode() == b.inode()
+        assert a.nlink() >= 2
+        # both aliases still read their own task id
+        assert b.read_piece(0) == content[:b.md.pieces[0].size]
+        logical, physical = mgr.usage()
+        assert logical == 2 * len(content) if len(content) == a.disk_usage() \
+            else logical == 2 * a.disk_usage()
+        assert physical == a.disk_usage()
+
+    def test_canonical_eviction_promotes_next_holder(self, tmp_path):
+        """Deleting the canonical alias must neither orphan the shared
+        bytes nor make the NEXT alias pay for its own copy."""
+        mgr = make_manager(tmp_path)
+        content = os.urandom(300_000)
+        a = fill_task(mgr, "a" * 64, content)
+        b = fill_task(mgr, "b" * 64, content)
+        mgr.delete_task("a" * 64)
+        assert b.read_piece(0) == content[:b.md.pieces[0].size]
+        c = fill_task(mgr, "c" * 64, content)
+        assert c.inode() == b.inode()      # promoted holder absorbed it
+
+    def test_adopt_content_by_digest(self, tmp_path):
+        mgr = make_manager(tmp_path)
+        content = os.urandom(300_000)
+        dg = digestlib.for_bytes("sha256", content)
+        src = fill_task(mgr, "a" * 64, content, digest=dg)
+        ts = mgr.adopt_content(TaskMetadata(task_id="b" * 64, digest=dg))
+        assert ts is not None and ts.md.done and ts.md.success
+        assert ts.inode() == src.inode()
+        assert len(ts.md.pieces) == len(src.md.pieces)
+        got = b"".join(ts.read_piece(p.num) for p in ts.piece_infos())
+        assert got == content
+        # unknown digest: no hit
+        assert mgr.adopt_content(TaskMetadata(
+            task_id="c" * 64, digest="sha256:" + "0" * 64)) is None
+
+
+class TestWarmReload:
+    def test_partial_task_survives_restart_with_verified_pieces(
+            self, tmp_path):
+        mgr = make_manager(tmp_path)
+        content = os.urandom(600_000)
+        fill_task(mgr, "a" * 64, content, pieces_only=2,
+                  piece_size=200_000)
+
+        mgr2 = make_manager(tmp_path)
+        ts = mgr2.get("a" * 64)
+        assert ts is not None and not ts.md.done
+        assert sorted(ts.md.pieces) == [0, 1]
+        stats = mgr2.verify_reloaded()
+        assert stats["pieces_ok"] == 2 and stats["pieces_dropped"] == 0
+        # the reloaded pieces are CAS-indexed: a second task places them
+        meta0 = ts.md.pieces[0]
+        dst = mgr2.register_task(TaskMetadata(task_id="b" * 64))
+        assert mgr2.castore.place_piece(dst, 0, 0, meta0.size, meta0.digest)
+
+    def test_verify_drops_rotted_piece_and_demotes_task(self, tmp_path):
+        mgr = make_manager(tmp_path)
+        content = os.urandom(600_000)
+        ts = fill_task(mgr, "a" * 64, content, piece_size=200_000)
+        p1 = ts.md.pieces[1]
+        with open(ts.data_path(), "r+b") as f:
+            f.seek(p1.start + 5)
+            f.write(b"\x00\x11\x22\x33")
+
+        mgr2 = make_manager(tmp_path)
+        stats = mgr2.verify_reloaded()
+        assert stats["pieces_dropped"] == 1
+        ts2 = mgr2.get("a" * 64)
+        assert ts2 is not None
+        assert 1 not in ts2.md.pieces          # the hole, not the task
+        assert not ts2.md.done                 # demoted: re-pull the hole
+        assert mgr2.find_completed_task("a" * 64) is None
+        # the demotion persisted: a THIRD boot sees the same partial
+        mgr3 = make_manager(tmp_path)
+        assert not mgr3.get("a" * 64).md.done
+
+    def test_all_rotten_task_dropped(self, tmp_path):
+        mgr = make_manager(tmp_path)
+        ts = fill_task(mgr, "a" * 64, os.urandom(100_000))
+        with open(ts.data_path(), "r+b") as f:
+            f.write(os.urandom(100_000))       # total rot
+
+        mgr2 = make_manager(tmp_path)
+        stats = mgr2.verify_reloaded()
+        assert stats["tasks_dropped"] == 1
+        assert mgr2.get("a" * 64) is None
+
+    def test_digestless_partial_discarded(self, tmp_path):
+        """A partial whose pieces carry no digests cannot be re-verified
+        — reload must discard it (the pre-CAS policy)."""
+        mgr = make_manager(tmp_path)
+        ts = mgr.register_task(TaskMetadata(task_id="a" * 64))
+        ts.write_piece(0, 0, b"x" * 1000)
+        ts.md.pieces[0].digest = ""            # simulate legacy metadata
+        ts.persist()
+        mgr2 = make_manager(tmp_path)
+        assert mgr2.get("a" * 64) is None
+
+
+class TestCrashSafeMetadata:
+    def test_save_leaves_no_tmp_and_replaces_atomically(self, tmp_path):
+        mgr = make_manager(tmp_path)
+        ts = fill_task(mgr, "a" * 64, os.urandom(50_000))
+        files = os.listdir(ts.dir)
+        assert METADATA_FILE in files
+        assert not [f for f in files if f.endswith(".tmp")]
+
+    def test_truncated_metadata_never_boots(self, tmp_path):
+        """A torn metadata file (the crash this satellite exists for) is
+        rejected at load and the task discarded at reload — never half-
+        parsed into a task with a lying piece table."""
+        mgr = make_manager(tmp_path)
+        ts = fill_task(mgr, "a" * 64, os.urandom(50_000))
+        mpath = os.path.join(ts.dir, METADATA_FILE)
+        raw = open(mpath, "rb").read()
+        with open(mpath, "wb") as f:
+            f.write(raw[:len(raw) // 2])       # torn mid-write
+        with pytest.raises((ValueError, KeyError)):
+            TaskMetadata.load(ts.dir)
+        mgr2 = make_manager(tmp_path)
+        assert mgr2.get("a" * 64) is None
+        assert not os.path.isdir(ts.dir)
+
+
+class TestPopularityEviction:
+    def test_hot_task_outlives_cold_at_capacity(self, tmp_path):
+        mgr = make_manager(tmp_path, capacity_bytes=10_000,
+                          disk_gc_high_ratio=0.5, disk_gc_low_ratio=0.45)
+        cold = fill_task(mgr, "1" * 64, os.urandom(4000))
+        hot = fill_task(mgr, "2" * 64, os.urandom(4000))
+        # make the HOT one the older-accessed of the two: without the
+        # popularity signal the old ordering would evict it first
+        hot.md.access_time -= 1000
+        for _ in range(5):
+            mgr.castore.record_serve("2" * 64, 4000)
+        assert mgr.try_gc() >= 1
+        assert mgr.get("2" * 64) is not None   # popularity saved it
+        assert mgr.get("1" * 64) is None
+
+    def test_gc_reports_logical_vs_physical_for_shared_bytes(self, tmp_path):
+        """Evicting one alias of hardlink-shared content frees logical
+        bytes but ~0 physical — the accounting must say so, and the sweep
+        must keep going until the PHYSICAL watermark is met."""
+        mgr = make_manager(tmp_path, capacity_bytes=10_000,
+                          disk_gc_high_ratio=0.5, disk_gc_low_ratio=0.45)
+        content = os.urandom(6000)
+        a = fill_task(mgr, "1" * 64, content)
+        b = fill_task(mgr, "2" * 64, content)
+        assert a.inode() == b.inode()          # shared: physical 6000
+        logical, physical = mgr.usage()
+        assert (logical, physical) == (12000, 6000)
+        a.md.access_time -= 100
+        reclaimed = mgr.try_gc()               # 6000/10000 > 0.5
+        assert reclaimed >= 1
+        stats = mgr.last_gc_stats
+        assert stats["logical_bytes_freed"] >= 6000
+        # at least one evicted alias shared its inode: physical < logical
+        assert stats["physical_bytes_freed"] < stats["logical_bytes_freed"]
+
+    def test_ttl_eviction_still_spares_persistent(self, tmp_path):
+        mgr = make_manager(tmp_path, task_ttl_s=0.0)
+        fill_task(mgr, "1" * 64, b"x" * 1000)
+        fill_task(mgr, "2" * 64, b"y" * 1000,
+                  task_type=TaskType.PERSISTENT)
+        import time
+        time.sleep(0.01)
+        assert mgr.try_gc() == 1
+        assert mgr.get("2" * 64) is not None
+
+
+class TestDaemonPlacement:
+    """The tentpole's daemon half: announced pieces whose digests are
+    already held land as placements — never dispatched to the wire."""
+
+    def test_alias_pull_adopts_whole_content(self, tmp_path):
+        """Same bytes under two URLs (distinct task ids): the second pull
+        must move ZERO bytes from anywhere — whole-content adoption."""
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest, UrlMeta
+
+        data = os.urandom(5 << 20)
+        dg = "sha256:" + __import__("hashlib").sha256(data).hexdigest()
+
+        async def go():
+            origin, base = await start_origin({"m1.bin": data,
+                                               "m2.bin": data})
+            daemon = Daemon(daemon_config(tmp_path, "d1"))
+            await daemon.start()
+            try:
+                tids = []
+                for name in ("m1.bin", "m2.bin"):
+                    async for resp in daemon.ptm.start_file_task(
+                            DownloadRequest(
+                                url=f"{base}/{name}",
+                                output=str(tmp_path / ("out-" + name)),
+                                url_meta=UrlMeta(digest=dg),
+                                timeout_s=60.0)):
+                        tid = resp.task_id or None
+                    tids.append(tid)
+                assert (tmp_path / "out-m2.bin").read_bytes() == data
+                c1 = daemon.ptm.conductor(tids[0])
+                c2 = daemon.ptm.conductor(tids[1])
+                assert c1.traffic_source == len(data)
+                # the alias pull: zero origin, zero p2p, all placed
+                assert c2.traffic_source == 0
+                assert c2.traffic_p2p == 0
+                assert c2.traffic_placed == len(data)
+                ts1 = daemon.storage_mgr.get(tids[0])
+                ts2 = daemon.storage_mgr.get(tids[1])
+                assert ts1.inode() == ts2.inode()   # shared on disk
+                summary = daemon.flight_recorder.get(tids[1]).summarize()
+                assert summary["bytes_placed"] == len(data)
+                assert summary["bytes_source"] == 0
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+    def test_ranged_request_never_adopts_whole_content(self, tmp_path):
+        """A ranged request carrying a whole-file digest must NOT be
+        short-circuited by whole-content adoption (content_range is still
+        unresolved when the conductor starts): the client gets exactly
+        its range, not the full file under the ranged task id."""
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest, UrlMeta
+
+        data = os.urandom(2 << 20)
+        dg = "sha256:" + __import__("hashlib").sha256(data).hexdigest()
+
+        async def go():
+            origin, base = await start_origin({"m.bin": data})
+            daemon = Daemon(daemon_config(tmp_path, "d1"))
+            await daemon.start()
+            try:
+                # the full content is held complete under the digest
+                async for _ in daemon.ptm.start_file_task(DownloadRequest(
+                        url=f"{base}/m.bin", url_meta=UrlMeta(digest=dg),
+                        timeout_s=60.0)):
+                    pass
+                out = tmp_path / "range.bin"
+                async for _ in daemon.ptm.start_file_task(DownloadRequest(
+                        url=f"{base}/m.bin", output=str(out),
+                        url_meta=UrlMeta(digest=dg,
+                                         range="bytes=100-299"),
+                        timeout_s=60.0)):
+                    pass
+                assert out.read_bytes() == data[100:300]
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+    def test_engine_places_announced_digests_instead_of_pulling(
+            self, tmp_path):
+        """P2P path: a leecher that already holds the announced digests
+        under ANOTHER task id places them locally — the parent's upload
+        port never serves a byte for the alias task."""
+        from test_daemon_e2e import daemon_config
+        from test_p2p import (ScriptedScheduler, ScriptedSession,
+                              parent_addr, seed_daemon_with)
+
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import (DownloadRequest, PeerPacket,
+                                                 RegisterResult, SizeScope,
+                                                 UrlMeta)
+
+        data = os.urandom((9 << 20) + 333)     # 3 pieces
+        dg = "sha256:" + __import__("hashlib").sha256(data).hexdigest()
+
+        async def go():
+            seed, origin, url, task_id, seed_peer = await seed_daemon_with(
+                tmp_path, data)
+            # the seed also completes the ALIAS task (adoption by digest:
+            # instant, no transfer) so it can announce it to the leecher
+            async for _ in seed.ptm.start_file_task(DownloadRequest(
+                    url=url + "?alias=2", url_meta=UrlMeta(digest=dg),
+                    timeout_s=60.0)):
+                pass
+            await origin.cleanup()
+
+            cfg = daemon_config(tmp_path, "leech")
+            leech = Daemon(cfg, scheduler_factory=lambda d: ScriptedScheduler(
+                lambda conductor: ScriptedSession(
+                    RegisterResult(task_id=conductor.task_id,
+                                   size_scope=SizeScope.NORMAL,
+                                   content_length=len(data)),
+                    [PeerPacket(task_id=conductor.task_id,
+                                main_peer=parent_addr(seed, seed_peer))])))
+            await leech.start()
+            try:
+                # first pull rides the mesh for real
+                async for _ in leech.ptm.start_file_task(DownloadRequest(
+                        url=url, disable_back_source=True,
+                        timeout_s=60.0)):
+                    pass
+                c1 = leech.ptm.conductor(task_id)
+                assert c1.traffic_p2p == len(data)
+                served_before = seed.flight_recorder.get(task_id)
+                # alias pull (same url_meta as the seed's, so the task ids
+                # agree): the seed announces the same piece digests — every
+                # piece places from the leecher's own disk. The content-
+                # digest adoption does NOT fire here (the first pull never
+                # recorded a whole-content digest), so this exercises the
+                # per-piece engine consult, not the whole-task shortcut.
+                async for resp in leech.ptm.start_file_task(DownloadRequest(
+                        url=url + "?alias=2", url_meta=UrlMeta(digest=dg),
+                        disable_back_source=True, timeout_s=60.0)):
+                    alias_tid = resp.task_id or None
+                c2 = leech.ptm.conductor(alias_tid)
+                assert c2.state == c2.SUCCESS
+                assert c2.traffic_p2p == 0
+                assert c2.traffic_placed == len(data)
+                alias_flight = seed.flight_recorder.get(alias_tid)
+                assert alias_flight is None or not alias_flight.serves
+                assert served_before is not None   # task1 DID serve
+            finally:
+                await leech.stop()
+                await seed.stop()
+
+        asyncio.run(go())
+
+
+class TestPlacedObservability:
+    def test_summary_counts_placed_bytes_and_podscope_reads_warm(self):
+        from dragonfly2_tpu.common import podscope
+        from dragonfly2_tpu.daemon import flight_recorder as fr
+
+        flight = fr.TaskFlight("t" * 64, "peer-1")
+        flight.event(fr.PLACED, 0, "cas", 4096)
+        flight.event(fr.PLACED, 1, "cas", 4096)
+        flight.state = "success"
+        s = flight.summarize()
+        assert s["bytes_placed"] == 8192
+        assert s["placed_pieces"] == 2
+        assert s["bytes_source"] == 0
+
+        snap = {"addr": "d1:1", "flights": {
+            "t" * 64: {"peer_id": "peer-1", "state": "success",
+                       "started_at": 0.0, "summary": s,
+                       "events": [], "serves": []}}}
+        report = podscope.aggregate([snap])
+        task = report["tasks"]["t" * 64]
+        assert task["placed_bytes"] == 8192
+        assert task["amplification"] == 0.0
+        assert task["amplification_note"].startswith("healthy-warm")
+        # a placement-only flight IS download activity: the healthiest
+        # pod must never read as incomplete (or shrink the makespan set)
+        assert task["daemons"] == 1
+        assert task["complete"] == 1
+        assert not [b for b in report["breaches"]
+                    if "amplification" in b]
+        rendered = podscope.render_pod(report)
+        assert "(warm)" in rendered
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

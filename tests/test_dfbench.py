@@ -355,6 +355,91 @@ class TestPr9ColdStart:
             assert 1 < depth <= 16, (n, depth)
 
 
+class TestPr10Store:
+    """PR-10 point: the content-addressed store under rolling-restart
+    churn + hot-model alias pulls, through the REAL storage stack. The
+    run must be deterministic (byte accounting digests identically), the
+    scheduler sim untouched (digest == BENCH_pr3), and the headline
+    acceptance — origin ≈ 0 after epoch 0, alias transfer 0, bounded
+    disk — must hold both live and in the committed artifact."""
+
+    def test_churn_deterministic(self):
+        from dragonfly2_tpu.tools.dfbench import run_churn_bench
+        a = run_churn_bench(seed=7, daemons=3, epochs=3, pieces=4,
+                            piece_size=16 << 10)
+        b = run_churn_bench(seed=7, daemons=3, epochs=3, pieces=4,
+                            piece_size=16 << 10)
+        assert a["churn_digest"] == b["churn_digest"]
+        assert a == b
+        c = run_churn_bench(seed=11, daemons=3, epochs=3, pieces=4,
+                            piece_size=16 << 10)
+        assert c["churn_digest"] != a["churn_digest"]
+
+    def test_cas_acceptance_vs_taskid_baseline(self):
+        from dragonfly2_tpu.tools.dfbench import run_churn_bench
+        cas = run_churn_bench(seed=7, daemons=3, epochs=3, pieces=4,
+                              piece_size=16 << 10, dedupe=True)
+        cold = run_churn_bench(seed=7, daemons=3, epochs=3, pieces=4,
+                               piece_size=16 << 10, dedupe=False)
+        content = cas["content_bytes"]
+        # epoch 0 is a real cold start: the content crosses the origin
+        # uplink exactly once either way
+        assert cas["per_epoch"][0]["origin_bytes"] == content
+        # after that the CAS pod never asks the origin again and never
+        # re-transfers an alias; the task-id-keyed baseline does both
+        assert cas["origin_bytes_after_first_epoch"] == 0
+        assert cas["alias_transfer_bytes"] == 0
+        assert cold["origin_bytes_after_first_epoch"] > 0
+        assert cold["alias_transfer_bytes"] > 0
+        # disk: hardlink sharing holds each CAS daemon at ~1x content
+        # while the baseline pays one copy per retained alias
+        assert cas["max_physical_bytes_per_daemon"] <= int(content * 1.25)
+        assert cold["max_physical_bytes_per_daemon"] >= 2 * content
+        # logical accounting still sees every alias (the ledger the GC
+        # reports against physical)
+        assert cas["max_logical_bytes_per_daemon"] >= 2 * content
+
+    def test_pr10_matches_committed_baselines(self, tmp_path):
+        """The committed trajectory gate: a default-size --pr10 run must
+        reproduce the committed churn_digest byte-for-byte, carry the
+        BENCH_pr3 schedule digest (storage refactor moved no scheduling),
+        and stamp every acceptance flag true."""
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr10", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads((tmp_path / "BENCH_pr10.json").read_text())
+        assert r["bench"] == "dfbench-castore"
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["warm_restart_zero_origin"] is True
+        assert r["alias_pull_zero_transfer"] is True
+        assert r["disk_bounded"] is True
+        committed = json.loads(
+            open(os.path.join(REPO, "BENCH_pr10.json")).read())
+        assert r["churn_digest"] == committed["churn_digest"]
+        assert committed["schedule_digest"] == pr3["schedule_digest"]
+        assert committed["warm_restart_zero_origin"] is True
+        assert committed["alias_pull_zero_transfer"] is True
+        assert committed["disk_bounded"] is True
+
+    def test_pr10_smoke_stdout_only(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr10", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-castore"
+        assert r["warm_restart_zero_origin"] is True
+        assert r["alias_pull_zero_transfer"] is True
+        assert r["disk_bounded"] is True
+        assert not list(tmp_path.iterdir())      # stdout only
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(

@@ -151,8 +151,11 @@ class TestGCOrdering:
             data_dir=str(tmp_path), capacity_bytes=3_000_000,
             disk_gc_high_ratio=0.5, disk_gc_low_ratio=0.4,
             task_ttl_s=3600))
-        payload = b"z" * 1_000_000
+        # DISTINCT payloads: identical bytes would hardlink-coalesce in
+        # the content store (physical usage 1 MB, under the watermark) and
+        # nothing would need evicting — this test is about priority ORDER
         for i, prio in enumerate([0, 6]):
+            payload = bytes([ord("a") + i]) * 1_000_000
             md = TaskMetadata(task_id=f"{i:064x}", url=f"http://o/{i}",
                               content_length=len(payload),
                               total_piece_count=1, piece_size=len(payload),

@@ -1,0 +1,179 @@
+"""Warm restart e2e: a seed is killed and restarted mid-swarm. The
+restarted daemon must re-index its on-disk pieces (crc-verified), re-seed
+its PEX digests within ONE gossip round, and serve the swarm WITHOUT
+re-downloading a byte — the PR 4/5 seed-restart scenario made trivial by
+the content-addressed store's crash-safe reload."""
+
+import asyncio
+import os
+
+import pytest
+
+# real daemons + full pulls + gossip rounds: seconds of wall time by
+# design — tier-1 excludes it (ROADMAP -m 'not slow')
+pytestmark = pytest.mark.slow
+
+from test_daemon_e2e import daemon_config
+from test_p2p import seed_daemon_with
+
+from dragonfly2_tpu.daemon.config import SchedulerConfig as DaemonSchedCfg
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.idl.messages import DownloadRequest
+
+
+async def _await_holder(index, task_id: str, timeout_s: float = 5.0) -> None:
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while asyncio.get_running_loop().time() < deadline:
+        if index.parents_for(task_id):
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"no swarm holder for {task_id[:12]} within "
+                         f"{timeout_s}s")
+
+
+def test_seed_restart_rejoins_as_holder_with_zero_redownload(tmp_path):
+    """Kill + restart a seed mid-swarm: the restart must (a) reload its
+    pieces from disk with zero origin traffic and zero re-downloads, (b)
+    push its reloaded digests to the swarm in its FIRST gossip round (the
+    boot-time initial round — no leecher action required), and (c) serve
+    a fresh leecher the whole task byte-identical with the origin gone."""
+
+    async def go():
+        data = os.urandom((9 << 20) + 333)           # 3 pieces
+        seed, origin, url, task_id, _peer = await seed_daemon_with(
+            tmp_path, data)
+        await origin.cleanup()      # from here, bytes exist ONLY on disk
+
+        # the swarm: one live leecher that knows the seed via gossip
+        leech_cfg = daemon_config(tmp_path, "leech")
+        leech_cfg.scheduler = DaemonSchedCfg(addresses=[])   # pex-only pod
+        leech_cfg.probe_enabled = False
+        leech_cfg.pex.bootstrap = [f"127.0.0.1:{seed.upload_server.port}"]
+        leech_cfg.pex.interval_s = 3600.0    # rounds driven explicitly
+        leech = Daemon(leech_cfg)
+        await leech.start()
+        try:
+            assert await leech.pex.round() == 1
+            assert len(leech.pex.index.parents_for(task_id)) == 1
+
+            # ---- kill the seed mid-swarm, restart over the same workdir
+            seed_port = seed.upload_server.port
+            await seed.stop()
+            leech.pex.index.forget_host(next(iter(
+                leech.pex.index._tasks[task_id])))   # swarm saw it die
+            assert not leech.pex.index.parents_for(task_id)
+
+            seed2_cfg = daemon_config(tmp_path, "seed")
+            seed2_cfg.scheduler = DaemonSchedCfg(addresses=[])
+            seed2_cfg.probe_enabled = False
+            # the restarted seed knows only its bootstrap peer; its BOOT
+            # round must push the reloaded digests there unprompted
+            seed2_cfg.pex.bootstrap = [
+                f"127.0.0.1:{leech.upload_server.port}"]
+            seed2_cfg.pex.interval_s = 3600.0
+            seed2 = Daemon(seed2_cfg)
+            await seed2.start()
+            try:
+                # (a) reloaded, verified, NOT re-downloaded: the storage
+                # holds the task as complete, yet no conductor ever ran
+                # (and the origin is long gone, so a re-pull would fail)
+                assert seed2.storage_mgr.reloaded_tasks >= 1
+                ts = seed2.storage_mgr.find_completed_task(task_id)
+                assert ts is not None and len(ts.md.pieces) == 3
+                assert seed2.ptm.conductor(task_id) is None
+
+                # (b) PEX holder within one gossip round — the initial
+                # boot round already pushed; no leecher round needed
+                await _await_holder(leech.pex.index, task_id)
+                entry = leech.pex.index.parents_for(task_id)[0]
+                assert entry.done
+                assert entry.download_port == seed2.upload_server.port
+
+                # (c) a fresh leecher joins the swarm and pulls the task
+                # entirely from the restarted seed (origin is gone)
+                l2_cfg = daemon_config(tmp_path, "leech2")
+                l2_cfg.scheduler = DaemonSchedCfg(addresses=[])
+                l2_cfg.probe_enabled = False
+                l2_cfg.pex.bootstrap = [
+                    f"127.0.0.1:{seed2.upload_server.port}"]
+                l2_cfg.pex.interval_s = 3600.0
+                leech2 = Daemon(l2_cfg)
+                await leech2.start()
+                try:
+                    assert await leech2.pex.round() >= 1
+                    out = tmp_path / "restart.bin"
+                    async for _ in leech2.ptm.start_file_task(
+                            DownloadRequest(url=url, output=str(out),
+                                            disable_back_source=True,
+                                            timeout_s=60.0)):
+                        pass
+                    assert out.read_bytes() == data
+                    c = leech2.ptm.conductor(task_id)
+                    assert c.state == c.SUCCESS
+                    assert c.traffic_source == 0     # zero origin bytes
+                    assert c.traffic_p2p == len(data)
+                    # the seed served from its RELOADED storage: its serve
+                    # journal has rows, its download journal has none
+                    seed_flight = seed2.flight_recorder.get(task_id)
+                    assert seed_flight is not None
+                    assert seed_flight.state == "serving"
+                    assert seed_flight.serves
+                finally:
+                    await leech2.stop()
+
+                # the restarted seed's upload port may have moved — assert
+                # the swarm learned the NEW address, not a stale ghost
+                assert seed2.upload_server.port != 0
+                assert seed_port != 0
+            finally:
+                await seed2.stop()
+        finally:
+            await leech.stop()
+
+    asyncio.run(go())
+
+
+def test_restart_with_torn_piece_refills_only_the_hole(tmp_path):
+    """Crash-rot on one piece: the boot verify drops exactly that piece,
+    the task demotes to partial, and the next pull re-fetches ONLY the
+    hole from origin (the surviving pieces land as placements)."""
+
+    async def go():
+        data = os.urandom((9 << 20) + 333)           # 3 pieces
+        seed, origin, url, task_id, _peer = await seed_daemon_with(
+            tmp_path, data)
+        ts = seed.storage_mgr.get(task_id)
+        p1 = ts.md.pieces[1]
+        await seed.stop()
+
+        # rot piece 1 on disk while the daemon is down
+        with open(ts.data_path(), "r+b") as f:
+            f.seek(p1.start + 7)
+            f.write(b"\xde\xad\xbe\xef")
+
+        seed2 = Daemon(daemon_config(tmp_path, "seed"))
+        await seed2.start()
+        try:
+            ts2 = seed2.storage_mgr.get(task_id)
+            assert ts2 is not None
+            assert sorted(ts2.md.pieces) == [0, 2]   # the hole, verified
+            assert not ts2.md.done
+            out = tmp_path / "refill.bin"
+            async for _ in seed2.ptm.start_file_task(DownloadRequest(
+                    url=url, output=str(out), timeout_s=60.0)):
+                pass
+            assert out.read_bytes() == data
+            c = seed2.ptm.conductor(task_id)
+            assert c.state == c.SUCCESS
+            # only the rotted piece crossed the origin uplink
+            assert c.traffic_source == p1.size
+            assert c.traffic_placed == len(data) - p1.size
+        finally:
+            await seed2.stop()
+            await origin.cleanup()
+
+    asyncio.run(go())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

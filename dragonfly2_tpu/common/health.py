@@ -69,6 +69,11 @@ _overruns = REGISTRY.counter(
 _slo_breaches = REGISTRY.counter(
     "df_slo_breach_total", "per-stage latency budget breaches",
     ("stage", "rung"))
+_qos_slo_breaches = REGISTRY.counter(
+    "df_qos_slo_breach_total",
+    "per-stage latency budget breaches by QoS class (budgets scaled by "
+    "CLASS_SLO_MULTIPLIERS: critical answers to tighter budgets, bulk "
+    "gets brownout headroom)", ("cls", "stage"))
 
 
 @dataclass
@@ -145,6 +150,16 @@ def format_await_chain(task: asyncio.Task, *, max_depth: int = 16) -> str:
 
 # ---------------------------------------------------------------- SLO
 
+# per-class SLO budget multipliers (multi-tenant QoS): a flight summary
+# carrying ``qos_class`` is judged against its class's scaled budgets —
+# ``critical`` work answers to HALF the configured budgets (it exists to
+# hold a tight tail), ``bulk`` gets 4x headroom (being throttled under
+# brownout is its contract, not a breach). ``standard`` and classless
+# ("" — every pre-QoS caller) stay exactly on the configured budgets.
+CLASS_SLO_MULTIPLIERS = {"critical": 0.5, "standard": 1.0, "bulk": 4.0,
+                         "": 1.0}
+
+
 class SLOEngine:
     """Per-stage latency budgets over flight-recorder timestamps.
 
@@ -187,15 +202,17 @@ class SLOEngine:
         (``health.enabled: false`` must really mean off)."""
         if not self.enabled:
             return summary
+        mult = CLASS_SLO_MULTIPLIERS.get(
+            summary.get("qos_class", ""), 1.0)
         breaches: dict[str, int] = {}
         for row in summary.get("piece_rows") or []:
             for key, stage in STAGE_KEYS:
-                budget = self.budgets_ms.get(stage, 0.0)
+                budget = self.budgets_ms.get(stage, 0.0) * mult
                 if budget > 0 and row.get(key, 0.0) > budget:
                     breaches[stage] = breaches.get(stage, 0) + 1
         summary["slo_breaches"] = breaches
         summary["slo_budgets_ms"] = {
-            k: v for k, v in self.budgets_ms.items() if v > 0}
+            k: v * mult for k, v in self.budgets_ms.items() if v > 0}
         return summary
 
     def observe_summary(self, summary: dict) -> dict[str, int]:
@@ -207,8 +224,13 @@ class SLOEngine:
         if breaches is None:
             breaches = self.annotate(summary)["slo_breaches"]
         rung = summary.get("served_rung") or "p2p"
+        cls = summary.get("qos_class") or "standard"
         for stage, n in breaches.items():
             self._count(stage, rung, n)
+            # per-class breach accounting (QoS): the per-class SLO budget
+            # verdict operators alert on — a critical-class breach pages,
+            # a bulk-class one is the brownout working as designed
+            _qos_slo_breaches.labels(cls, stage).inc(n)
         return breaches
 
     def breach(self, stage: str, rung: str = "p2p", n: int = 1) -> None:

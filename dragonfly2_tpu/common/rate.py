@@ -78,3 +78,23 @@ class TokenBucket:
                 # the bytes were never moved: hand the tokens back
                 self._unreserve(n)
                 raise
+
+
+def class_shares(total: float, weights: dict[str, float],
+                 demand: dict[str, float]) -> dict[str, float]:
+    """Split ``total`` across service classes by weight, counting only
+    classes with live demand — idle classes' capacity is borrowed by the
+    active ones, so a lone ``bulk`` task gets the full pipe and loses it
+    the moment ``critical`` traffic appears. Pure function: the shaper's
+    retune and the dfbench QoS model both call exactly this math, which is
+    what makes the bench's contended numbers a claim about the shipped
+    code rather than a parallel reimplementation. Returns bytes/s per
+    class (every class gets a row; idle ones get 0.0)."""
+    active = {c: w for c, w in weights.items() if demand.get(c, 0.0) > 0}
+    out = {c: 0.0 for c in weights}
+    if total <= 0 or not active:
+        return out
+    wsum = sum(active.values())
+    for c, w in active.items():
+        out[c] = total * w / wsum
+    return out

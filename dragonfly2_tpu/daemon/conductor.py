@@ -64,6 +64,15 @@ class PeerTaskConductor:
         # scheduler may refine this at register (application-table lookup);
         # storage GC eviction ordering reads the refined value
         self.resolved_priority = int(self.url_meta.priority)
+        # multi-tenant QoS: the service class rides the whole download —
+        # shaper registration, piece GETs (upload-slot admission at the
+        # parent), storage metadata (class-weighted eviction), the flight
+        # summary (per-class SLO budgets) — on EVERY rung including
+        # back-source and the scheduler-less pex path, because it lives on
+        # the conductor rather than any one session
+        from ..idl.messages import resolve_class
+        self.qos_class = resolve_class(self.url_meta.qos_class)
+        self.tenant = self.url_meta.tenant
         self.storage_mgr = storage_mgr
         self.piece_mgr = piece_mgr
         self.scheduler = scheduler
@@ -95,6 +104,10 @@ class PeerTaskConductor:
         self._adopted = False         # whole task materialized by digest
         self.start_ms = int(time.time() * 1000)
 
+        # QoS admission release hook (PeerTaskManager): fired exactly once
+        # when the run ends, success or failure — an unreleased admission
+        # would wedge the bulk gate shut for the rest of the process
+        self.qos_release: Any = None
         self.storage: TaskStorage | None = None
         self.device_ingest: Any = None
         self.ready: set[int] = set()          # piece numbers landed
@@ -124,7 +137,8 @@ class PeerTaskConductor:
 
     def attach_shaper(self, shaper: Any) -> None:
         self.shaper = shaper
-        self.rate_limiter = shaper.register(self.task_id)
+        self.rate_limiter = shaper.register(
+            self.task_id, qos_class=self.qos_class, tenant=self.tenant)
 
     async def _run(self) -> None:
         from ..common import tracing
@@ -189,6 +203,9 @@ class PeerTaskConductor:
                 await self._session.close(success=self.state == self.SUCCESS)
             if self.shaper is not None:
                 self.shaper.unregister(self.task_id)
+            if self.qos_release is not None:
+                release, self.qos_release = self.qos_release, None
+                release()
             if self._relay_tracked:
                 # wakes any streaming serve parked on this task's progress
                 # so it winds down now instead of riding out its deadline
@@ -253,7 +270,8 @@ class PeerTaskConductor:
         md = TaskMetadata(
             task_id=self.task_id, task_type=self.task_type, url=self.url,
             tag=self.url_meta.tag, application=self.url_meta.application,
-            digest=self.url_meta.digest, priority=self.resolved_priority)
+            digest=self.url_meta.digest, priority=self.resolved_priority,
+            qos_class=self.qos_class)
         ts = await run_io(self.storage_mgr.adopt_content, md)
         if ts is None or not (ts.md.done and ts.md.success):
             return False
@@ -393,7 +411,7 @@ class PeerTaskConductor:
             tag=self.url_meta.tag, application=self.url_meta.application,
             content_length=effective_len, total_piece_count=self.total_pieces,
             piece_size=self.piece_size, digest=self.url_meta.digest,
-            priority=self.resolved_priority)
+            priority=self.resolved_priority, qos_class=self.qos_class)
         self.storage = self.storage_mgr.register_task(md)
         if self.relay is not None and not self._relay_tracked:
             # cut-through: from here until finish, the upload server may

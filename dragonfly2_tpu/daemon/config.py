@@ -9,6 +9,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..common.unit import MiB
+from .qos import QosSection
 
 
 @dataclass
@@ -160,6 +161,11 @@ class UploadConfig:
     rate_limit_bps: int = 0
     concurrent_limit: int = 0              # 0 = scheduler's per-type default
     debug_endpoints: bool = False          # /debug/{stacks,profile} (pprof)
+    # upload slots a `bulk`-class child may hold at once (QoS): the
+    # remainder stays reserved for critical/standard children, so a bulk
+    # herd can saturate its share of the gate without ever 503ing the
+    # foreground. 0 = derive (concurrent limit minus two, floor 1).
+    bulk_concurrent_limit: int = 0
 
 
 @dataclass
@@ -240,6 +246,9 @@ class DaemonConfig:
     security: SecurityConfig = field(default_factory=SecurityConfig)
     proxy: ProxyConfig = field(default_factory=ProxyConfig)
     object_storage: ObjectStorageConfig = field(default_factory=ObjectStorageConfig)
+    # multi-tenant QoS admission + brownout (daemon/qos.py; see
+    # docs/RESILIENCE.md "QoS and graceful brownout")
+    qos: QosSection = field(default_factory=QosSection)
     announce_interval_s: float = 30.0
     probe_enabled: bool = True             # RTT probing via SyncProbes
     metrics_port: int = 0                  # 0 = disabled

@@ -69,6 +69,11 @@ class Daemon:
         self.shaper = TrafficShaper(
             total_rate_bps=cfg.download.total_rate_limit_bps,
             kind=cfg.download.traffic_shaper_kind)
+        # multi-tenant QoS: class-aware admission + brownout shed
+        # (daemon/qos.py); the shaper rides along for /debug/qos's
+        # per-class rate readout
+        from .qos import QosGovernor
+        self.qos = QosGovernor(cfg.qos, shaper=self.shaper)
         from .flight_recorder import FlightRecorder
         self.flight_recorder = FlightRecorder(
             enabled=cfg.flight.enabled, max_tasks=cfg.flight.max_tasks,
@@ -100,9 +105,10 @@ class Daemon:
             rate_limit_bps=cfg.upload.rate_limit_bps,
             debug_endpoints=cfg.upload.debug_endpoints,
             concurrent_limit=cfg.upload.concurrent_limit,
+            bulk_concurrent_limit=cfg.upload.bulk_concurrent_limit,
             host=cfg.listen_ip, flight_recorder=self.flight_recorder,
             pex=self.pex, relay=self.relay,
-            relay_stall_s=cfg.download.relay_stall_s)
+            relay_stall_s=cfg.download.relay_stall_s, qos=self.qos)
         self._scheduler_factory = scheduler_factory
         self._p2p_engine_factory = p2p_engine_factory
         self.scheduler: Any = None
@@ -314,7 +320,7 @@ class Daemon:
             is_seed=self.cfg.is_seed, shaper=self.shaper,
             prefetch_whole_file=self.cfg.download.prefetch_whole_file,
             flight_recorder=self.flight_recorder, pex=self.pex,
-            relay=self.relay)
+            relay=self.relay, qos=self.qos)
         svc = DaemonService(self.ptm,
                             upload_addr=f"{self.host_ip}:{self.upload_server.port}")
         # fleet mTLS: enroll with the manager, serve the peer RPC port with

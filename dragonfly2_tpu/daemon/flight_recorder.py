@@ -66,6 +66,10 @@ REGISTERED = "registered"    # scheduler register returned
 HBM_SHARD = "hbm_shard"      # one device DMA completed (piece = shard idx)
 DONE = "done"                # task reached a terminal state
 RUNG = "rung"                # degradation-ladder transition (parent = rung)
+QOS = "qos"                  # QoS admission ruling (parent = governor
+# state the task was admitted under: a bulk task that rode the brownout
+# queue carries a qos/brownout event, so "why did this pull start late"
+# is answerable from the journal — the admission-side analog of a rung)
 UPLOAD = "upload"            # serve-side edge row (TaskFlight.serve ring):
 # a piece/range THIS daemon served to a child, journaled by the upload
 # server so every transfer edge is observed from both ends — podscope
@@ -91,13 +95,19 @@ class TaskFlight:
 
     __slots__ = ("task_id", "peer_id", "started_at", "_m0", "events",
                  "serves", "state", "url", "report_drops", "_sum_key",
-                 "_sum_cache")
+                 "_sum_cache", "qos_class", "tenant")
 
     def __init__(self, task_id: str, peer_id: str, *, url: str = "",
-                 max_events: int = 4096, max_serves: int = 1024):
+                 max_events: int = 4096, max_serves: int = 1024,
+                 qos_class: str = "", tenant: str = ""):
         self.task_id = task_id
         self.peer_id = peer_id
         self.url = url
+        # QoS attribution: the class rides the summary so the SLO engine
+        # can judge this flight against ITS class's budgets and podscope
+        # can attribute contention to the tenant that caused it
+        self.qos_class = qos_class
+        self.tenant = tenant
         self.started_at = time.time()
         self._m0 = time.monotonic()
         self.events: deque = deque(maxlen=max_events)
@@ -344,6 +354,10 @@ class TaskFlight:
             # never needs log spelunking
             "rungs": rungs,
             "served_rung": rungs[-1] if rungs else "",
+            # QoS attribution ("" = pre-QoS / classless): the SLO engine
+            # scales stage budgets by this class, dfdiag names it
+            "qos_class": self.qos_class,
+            "tenant": self.tenant,
             "report_drops": self.report_drops,
             # digest-mismatched transfers per sending parent (the piece
             # itself was requeued and its eventual row credits whoever
@@ -407,8 +421,8 @@ class FlightRecorder:
         self.evicted = 0
         self._tasks: OrderedDict[str, TaskFlight] = OrderedDict()
 
-    def begin(self, task_id: str, peer_id: str,
-              url: str = "") -> TaskFlight | None:
+    def begin(self, task_id: str, peer_id: str, url: str = "",
+              qos_class: str = "", tenant: str = "") -> TaskFlight | None:
         """Open (or reopen) a flight; None while disabled so callers hold
         a None and the hot path never calls back in."""
         if not self.enabled:
@@ -418,7 +432,8 @@ class FlightRecorder:
         # before the URL becomes queryable debug state
         flight = TaskFlight(task_id, peer_id, url=url.split("?", 1)[0],
                             max_events=self.max_events,
-                            max_serves=self.max_serves)
+                            max_serves=self.max_serves,
+                            qos_class=qos_class, tenant=tenant)
         self._tasks[task_id] = flight
         self._tasks.move_to_end(task_id)
         while len(self._tasks) > self.max_tasks:

@@ -187,10 +187,23 @@ class ObjectGateway:
         except DFError as exc:
             _obj_reqs.labels("get", "404").inc()
             return web.json_response({"error": exc.message}, status=404)
-        meta = UrlMeta(tag="objstore")
+        # multi-tenant QoS: class + tenant ride request headers, same
+        # contract as the proxy surface
+        meta = UrlMeta(
+            tag="objstore",
+            tenant=request.headers.get("X-Dragonfly-Tenant", ""),
+            qos_class=request.headers.get("X-Dragonfly-Class", ""))
         try:
             task_id, chunks = await self.daemon.ptm.stream_task(url, meta)
         except DFError as exc:
+            if exc.code == Code.RESOURCE_EXHAUSTED:
+                # QoS shed / tenant quota: the 429 + Retry-After contract
+                _obj_reqs.labels("get", "shed").inc()
+                retry_ms = getattr(exc, "retry_after_ms", 0) or 1000
+                return web.json_response(
+                    {"error": exc.message}, status=429,
+                    headers={"Retry-After": str(-(-retry_ms // 1000)),
+                             "X-Retry-After-Ms": str(retry_ms)})
             _obj_reqs.labels("get", "err").inc()
             return web.json_response({"error": exc.message}, status=502)
         conductor = self.daemon.ptm.conductor(task_id)

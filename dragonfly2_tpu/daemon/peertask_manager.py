@@ -31,7 +31,7 @@ class PeerTaskManager:
                  device_sink_builder: Any = None, is_seed: bool = False,
                  shaper: Any = None, prefetch_whole_file: bool = False,
                  flight_recorder: Any = None, pex: Any = None,
-                 relay: Any = None):
+                 relay: Any = None, qos: Any = None):
         self.storage_mgr = storage_mgr
         self.piece_mgr = piece_mgr
         self.hostname = hostname
@@ -45,6 +45,7 @@ class PeerTaskManager:
         self.flight_recorder = flight_recorder
         self.pex = pex
         self.relay = relay            # RelayHub (None = cut-through off)
+        self.qos = qos                # QosGovernor (None = admission off)
         self._conductors: dict[str, PeerTaskConductor] = {}
         self._prefetching: set[str] = set()
         # strong refs: the loop only weak-refs tasks, and a GC'd prefetch
@@ -68,21 +69,40 @@ class PeerTaskManager:
             ordered: bool = False) -> PeerTaskConductor:
         task_id = self._task_id(url, meta)
         content_range: Range | None = None
+        existing = await self._join_existing(task_id, ordered)
+        if existing is not None:
+            return existing
+        # QoS admission happens OUTSIDE the manager lock: a bulk request
+        # riding the brownout queue must never hold the lock critical
+        # traffic needs to create ITS conductor (priority inversion by
+        # lock). May raise RESOURCE_EXHAUSTED (+retry_after_ms) — the
+        # 429-shaped shed the proxy/gateway/rpc surfaces forward.
+        from ..idl.messages import resolve_class
+        qos_cls = qos_ruling = None
+        if self.qos is not None:
+            qos_cls, qos_ruling = await self.qos.admit(
+                resolve_class(meta.qos_class), meta.tenant)
+        # the class stored on the flight is CLAMPED ("" stays classless):
+        # it becomes a df_qos_slo_breach_total label via observe_summary,
+        # and a raw wire string there would be unbounded client-
+        # controlled metric cardinality
+        flight_cls = resolve_class(meta.qos_class) if meta.qos_class \
+            else ""
         async with self._lock:
             conductor = self._conductors.get(task_id)
-            if conductor is not None and conductor.state != PeerTaskConductor.FAILED:
-                if ordered and not conductor.ordered:
-                    # a stream consumer joined a running file task: switch to
-                    # in-order fetching so read_ordered() doesn't stall
-                    conductor.ordered = True
-                    engine = conductor._p2p_engine
-                    if engine is not None:
-                        engine.dispatcher.ordered = True
+            if (conductor is not None
+                    and conductor.state != PeerTaskConductor.FAILED):
+                # lost the creation race while queued at admission: the
+                # winner's admission is the accounted one
+                if qos_cls is not None:
+                    self.qos.release(qos_cls)
                 return conductor
             peer_id = ids.peer_id(self.hostname, self.host_ip,
                                   seed=self.is_seed)
-            flight = (self.flight_recorder.begin(task_id, peer_id, url=url)
-                      if self.flight_recorder is not None else None)
+            flight = (self.flight_recorder.begin(
+                task_id, peer_id, url=url,
+                qos_class=flight_cls, tenant=meta.tenant)
+                if self.flight_recorder is not None else None)
             conductor = PeerTaskConductor(
                 task_id=task_id, peer_id=peer_id,
                 url=url, url_meta=meta, storage_mgr=self.storage_mgr,
@@ -91,12 +111,41 @@ class PeerTaskManager:
                 disable_back_source=disable_back_source, task_type=task_type,
                 device_sink_factory=device_sink_factory, ordered=ordered,
                 flight=flight, pex=self.pex, relay=self.relay)
+            if qos_cls is not None:
+                conductor.qos_release = (
+                    lambda c=qos_cls: self.qos.release(c))
+                if flight is not None:
+                    # journal the admission ruling: a bulk task that rode
+                    # the brownout queue carries the wait in its journal
+                    from . import flight_recorder as fr
+                    flight.event(fr.QOS, parent=(
+                        "brownout" if qos_ruling == "queued"
+                        else self.qos.state))
             if self.p2p_engine_factory is not None:
                 conductor.set_p2p_engine(self.p2p_engine_factory())
             if self.shaper is not None:
                 conductor.attach_shaper(self.shaper)
             self._conductors[task_id] = conductor
             conductor.start()
+            return conductor
+
+    async def _join_existing(self, task_id: str,
+                             ordered: bool) -> PeerTaskConductor | None:
+        """Join a live conductor for this task if one exists (subscribers
+        share one download — joining costs no QoS admission; the original
+        admission already accounts the work)."""
+        async with self._lock:
+            conductor = self._conductors.get(task_id)
+            if conductor is None \
+                    or conductor.state == PeerTaskConductor.FAILED:
+                return None
+            if ordered and not conductor.ordered:
+                # a stream consumer joined a running file task: switch to
+                # in-order fetching so read_ordered() doesn't stall
+                conductor.ordered = True
+                engine = conductor._p2p_engine
+                if engine is not None:
+                    engine.dispatcher.ordered = True
             return conductor
 
     def conductor(self, task_id: str) -> PeerTaskConductor | None:

@@ -131,6 +131,7 @@ class PieceDownloader:
     async def download_piece(self, *, dst_addr: str, task_id: str,
                              src_peer_id: str, piece: PieceInfo,
                              on_first_byte=None, relay_open=None,
+                             qos_class: str = "",
                              ) -> tuple[bytearray, int]:
         """Fetch one piece from a parent. Returns (data, cost_ms); ``data``
         is a POOLED buffer the caller owns (release to ``bufpool.POOL``
@@ -138,6 +139,8 @@ class PieceDownloader:
         happens off-loop in the storage landing pass (the caller treats a
         landing-time mismatch as retry-on-another-parent, same as the
         transport errors raised here as CLIENT_PIECE_DOWNLOAD_FAIL).
+        ``qos_class`` rides the GET as ``?cls=`` so the parent's upload
+        server can admit the transfer under the right class gate.
         """
         url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start, size = piece.range_start, piece.range_size
@@ -145,13 +148,15 @@ class PieceDownloader:
         tp = tracing.traceparent()
         if tp:   # trace ctx rides the piece request (ref piece_downloader.go:227)
             headers["traceparent"] = tp
+        params = {"peerId": src_peer_id}
+        if qos_class:
+            params["cls"] = qos_class
         what = f"parent {dst_addr} piece {piece.piece_num}"
         t0 = time.monotonic()
 
         async def fetch():
             async with self._get_session().get(
-                    url, headers=headers,
-                    params={"peerId": src_peer_id}) as resp:
+                    url, headers=headers, params=params) as resp:
                 if resp.status == 503:
                     # upload-slot backpressure: the parent is at its
                     # concurrency limit, not broken — the dispatcher reroutes
@@ -194,6 +199,7 @@ class PieceDownloader:
     async def download_span(self, *, dst_addr: str, task_id: str,
                             src_peer_id: str, pieces: list[PieceInfo],
                             on_first_byte=None, relay_open=None,
+                            qos_class: str = "",
                             ) -> tuple[bytearray, int]:
         """Fetch CONTIGUOUS pieces in one ranged GET.
 
@@ -210,7 +216,8 @@ class PieceDownloader:
             return await self.download_piece(
                 dst_addr=dst_addr, task_id=task_id,
                 src_peer_id=src_peer_id, piece=pieces[0],
-                on_first_byte=on_first_byte, relay_open=relay_open)
+                on_first_byte=on_first_byte, relay_open=relay_open,
+                qos_class=qos_class)
         url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start = pieces[0].range_start
         size = sum(p.range_size for p in pieces)
@@ -218,13 +225,15 @@ class PieceDownloader:
         tp = tracing.traceparent()
         if tp:
             headers["traceparent"] = tp
+        params = {"peerId": src_peer_id}
+        if qos_class:
+            params["cls"] = qos_class
         what = f"parent {dst_addr} span @{start}+{size}"
         t0 = time.monotonic()
 
         async def fetch():
             async with self._get_session().get(
-                    url, headers=headers,
-                    params={"peerId": src_peer_id}) as resp:
+                    url, headers=headers, params=params) as resp:
                 if resp.status == 503:
                     err = DFError(Code.CLIENT_PEER_BUSY,
                                   f"parent {dst_addr} busy")

@@ -273,7 +273,8 @@ class PieceEngine:
                 data, cost = await self.downloader.download_piece(
                     dst_addr=single.dst_addr, task_id=conductor.task_id,
                     src_peer_id=conductor.peer_id, piece=info,
-                    on_first_byte=on_first, relay_open=span)
+                    on_first_byte=on_first, relay_open=span,
+                    qos_class=getattr(conductor, "qos_class", ""))
         except DFError as exc:
             _p2p_pieces.labels("fail").inc()
             await session.report_piece(self._piece_result(
@@ -591,7 +592,8 @@ class PieceEngine:
                     buf, cost = await self.downloader.download_span(
                         dst_addr=d.parent.addr, task_id=conductor.task_id,
                         src_peer_id=conductor.peer_id, pieces=d.pieces,
-                        on_first_byte=on_first, relay_open=span)
+                        on_first_byte=on_first, relay_open=span,
+                        qos_class=getattr(conductor, "qos_class", ""))
         except DFError as exc:
             if exc.code == Code.CLIENT_PEER_BUSY:
                 # backpressure, not failure: requeue; no scheduler report

@@ -27,6 +27,7 @@ from urllib.parse import urlsplit
 
 import aiohttp
 
+from ..common.errors import Code, DFError
 from ..common.metrics import REGISTRY
 from ..idl.messages import UrlMeta
 from .config import ProxyConfig
@@ -311,9 +312,34 @@ class ProxyServer:
         _proxy_reqs.labels("p2p").inc()
         fwd = {k: v for k, v in headers.items()
                if k in ("authorization", "accept", "user-agent")}
-        meta = UrlMeta(header=fwd or None, tag="proxy")
+        # multi-tenant QoS: the tenant and service class ride standard
+        # request headers so any HTTP client (containerd, curl) can tag
+        # its traffic without a dragonfly-aware SDK
+        meta = UrlMeta(header=fwd or None, tag="proxy",
+                       tenant=headers.get("x-dragonfly-tenant", ""),
+                       qos_class=headers.get("x-dragonfly-class", ""))
         try:
             task_id, chunks = await self.daemon.ptm.stream_task(url, meta)
+        except DFError as exc:
+            if exc.code == Code.RESOURCE_EXHAUSTED:
+                # QoS shed (brownout) or tenant quota: the 429 contract —
+                # Retry-After carries the governor's hint, and the
+                # common/retry.py ladder in dragonfly-aware clients (plus
+                # any well-behaved HTTP client) backs off instead of
+                # hammering the browned-out daemon
+                _proxy_reqs.labels("shed").inc()
+                retry_ms = getattr(exc, "retry_after_ms", 0) or 1000
+                writer.write(
+                    b"HTTP/1.1 429 Too Many Requests\r\n"
+                    b"Retry-After: " + str(-(-retry_ms // 1000)).encode()
+                    + b"\r\nX-Retry-After-Ms: " + str(retry_ms).encode()
+                    + b"\r\nConnection: close\r\n\r\n")
+                await writer.drain()
+                return False
+            log.warning("p2p stream for %s failed: %s", url, exc.message)
+            writer.write(b"HTTP/1.1 502 Bad Gateway\r\n\r\n")
+            await writer.drain()
+            return False
         except Exception as exc:  # noqa: BLE001 - task setup failed
             log.warning("p2p stream for %s failed: %s", url, exc)
             writer.write(b"HTTP/1.1 502 Bad Gateway\r\n\r\n")

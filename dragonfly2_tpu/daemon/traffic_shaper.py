@@ -5,6 +5,15 @@ Role parity: reference ``client/daemon/peer/traffic_shaper.go`` — types
 task's observed consumption, re-sampled on an interval). Tasks get their
 own TokenBucket whose rate the shaper retunes; the engine and back-source
 path acquire from it per piece.
+
+Multi-tenant QoS (PR 11): the split is hierarchical. The total budget is
+first divided across the PRIORITY_CLASSES service classes by weight over
+the classes with live demand (``common/rate.class_shares`` — a lone
+``bulk`` herd gets the whole pipe, and loses most of it the moment a
+``critical`` task registers), then within each class across its tasks by
+the original plain/sampling rule. A ``bulk`` tenant can therefore never
+starve ``critical`` traffic of more than its weighted share of the NIC,
+no matter how many tasks it floods in.
 """
 
 from __future__ import annotations
@@ -14,12 +23,18 @@ import logging
 import time
 
 from ..common.metrics import REGISTRY
-from ..common.rate import TokenBucket
+from ..common.rate import TokenBucket, class_shares
+from ..idl.messages import DEFAULT_PRIORITY_CLASS, PRIORITY_CLASSES
 
 log = logging.getLogger("df.flow.shaper")
 
 SAMPLE_INTERVAL_S = 1.0
-MIN_SHARE_RATIO = 0.05     # no running task starves below 5% of total
+MIN_SHARE_RATIO = 0.05     # no running task starves below 5% of its class
+
+# class weights for the hierarchical split: under full contention
+# ``critical`` holds ~73% of the pipe, ``bulk`` degrades to ~9% — the
+# graceful-brownout ratio the contended dfbench scenario measures
+CLASS_WEIGHTS = {"critical": 8.0, "standard": 3.0, "bulk": 1.0}
 
 _shaper_rate = REGISTRY.gauge(
     "df_shaper_rate_bps", "total download budget the shaper splits "
@@ -31,16 +46,25 @@ _shaper_bytes = REGISTRY.counter(
     "bytes recorded through shaper-governed tasks")
 _shaper_retunes = REGISTRY.counter(
     "df_shaper_retunes_total", "per-task rate redistributions applied")
+_qos_class_rate = REGISTRY.gauge(
+    "df_qos_class_rate_bps",
+    "download budget currently granted to each QoS class by the "
+    "hierarchical shaper split (0 while the class is idle or the shaper "
+    "is unlimited)", ("cls",))
 
 
 class _TaskEntry:
-    __slots__ = ("bucket", "consumed", "last_consumed", "rate")
+    __slots__ = ("bucket", "consumed", "last_consumed", "rate", "cls",
+                 "tenant")
 
-    def __init__(self) -> None:
+    def __init__(self, cls: str = DEFAULT_PRIORITY_CLASS,
+                 tenant: str = "") -> None:
         self.bucket = TokenBucket(0)     # unlimited until first retune
         self.consumed = 0
         self.last_consumed = 0
         self.rate = 0.0
+        self.cls = cls
+        self.tenant = tenant
 
 
 class TrafficShaper:
@@ -66,10 +90,14 @@ class TrafficShaper:
 
     # ------------------------------------------------------------------
 
-    def register(self, task_id: str) -> TokenBucket:
+    def register(self, task_id: str, *,
+                 qos_class: str = DEFAULT_PRIORITY_CLASS,
+                 tenant: str = "") -> TokenBucket:
         entry = self._tasks.get(task_id)
         if entry is None:
-            entry = _TaskEntry()
+            entry = _TaskEntry(
+                qos_class if qos_class in PRIORITY_CLASSES
+                else DEFAULT_PRIORITY_CLASS, tenant)
             self._tasks[task_id] = entry
             _shaper_tasks.set(len(self._tasks))
             self._retune()
@@ -90,6 +118,24 @@ class TrafficShaper:
                 # already counted by the transfer-path metrics
                 _shaper_bytes.inc(nbytes)
 
+    def class_snapshot(self) -> dict:
+        """Per-class registration/consumption/rate readout for
+        GET /debug/qos and dfdiag --qos (pure observation)."""
+        out: dict[str, dict] = {
+            c: {"tasks": 0, "rate_bps": 0.0, "consumed_bytes": 0,
+                "tenants": {}} for c in PRIORITY_CLASSES}
+        for entry in self._tasks.values():
+            row = out[entry.cls]
+            row["tasks"] += 1
+            row["rate_bps"] += entry.rate
+            row["consumed_bytes"] += entry.consumed
+            if entry.tenant:
+                t = row["tenants"].setdefault(
+                    entry.tenant, {"tasks": 0, "consumed_bytes": 0})
+                t["tasks"] += 1
+                t["consumed_bytes"] += entry.consumed
+        return out
+
     # ------------------------------------------------------------------
 
     async def _retune_loop(self) -> None:
@@ -102,30 +148,45 @@ class TrafficShaper:
         if self.total_rate_bps <= 0 or not self._tasks:
             return
         _shaper_retunes.inc()
-        n = len(self._tasks)
-        if self.kind == "plain":
-            share = self.total_rate_bps / n
-            for entry in self._tasks.values():
-                entry.rate = share
-                entry.bucket.set_rate(share)
-            return
-        # sampling: weight by bytes consumed since the last retune, with a
-        # floor so idle-but-running tasks can ramp back up
-        deltas = {}
-        total_delta = 0
+        # level 1: class shares over live demand. Demand = bytes consumed
+        # since the last retune, floored at 1 for any class with a
+        # registered task (a just-registered task has consumed nothing
+        # yet but must not be scored idle — it would start at the
+        # trickle rate and ramp one retune late)
+        deltas: dict[str, int] = {}
+        class_demand: dict[str, float] = {}
         for tid, entry in self._tasks.items():
             d = max(0, entry.consumed - entry.last_consumed)
             entry.last_consumed = entry.consumed
             deltas[tid] = d
-            total_delta += d
-        floor = self.total_rate_bps * MIN_SHARE_RATIO
-        distributable = self.total_rate_bps - floor * n
-        if distributable <= 0 or total_delta == 0:
-            share = self.total_rate_bps / n
-            for entry in self._tasks.values():
-                entry.rate = share
-                entry.bucket.set_rate(share)
-            return
-        for tid, entry in self._tasks.items():
-            entry.rate = floor + distributable * deltas[tid] / total_delta
-            entry.bucket.set_rate(entry.rate)
+            class_demand[entry.cls] = class_demand.get(entry.cls, 0.0) \
+                + max(d, 1)
+        shares = class_shares(self.total_rate_bps, CLASS_WEIGHTS,
+                              class_demand)
+        for cls in PRIORITY_CLASSES:
+            _qos_class_rate.labels(cls).set(shares.get(cls, 0.0))
+        # level 2: the original plain/sampling rule, within each class
+        for cls, budget in shares.items():
+            members = {tid: e for tid, e in self._tasks.items()
+                       if e.cls == cls}
+            if not members or budget <= 0:
+                continue
+            n = len(members)
+            if self.kind == "plain":
+                share = budget / n
+                for entry in members.values():
+                    entry.rate = share
+                    entry.bucket.set_rate(share)
+                continue
+            total_delta = sum(deltas[tid] for tid in members)
+            floor = budget * MIN_SHARE_RATIO
+            distributable = budget - floor * n
+            if distributable <= 0 or total_delta == 0:
+                share = budget / n
+                for entry in members.values():
+                    entry.rate = share
+                    entry.bucket.set_rate(share)
+                continue
+            for tid, entry in members.items():
+                entry.rate = floor + distributable * deltas[tid] / total_delta
+                entry.bucket.set_rate(entry.rate)

@@ -58,6 +58,15 @@ _relay_stalls = REGISTRY.counter(
 _relay_wait_secs = REGISTRY.histogram(
     "df_relay_wait_seconds",
     "time a streaming relay serve spent awaiting landing progress")
+# class-aware upload admission (multi-tenant QoS): bulk-class piece GETs
+# are capped below the total concurrency gate so a bulk herd can never
+# occupy every slot a critical child needs
+_qos_upload_active = REGISTRY.gauge(
+    "df_qos_upload_active", "upload slots currently held, by requesting "
+    "class", ("cls",))
+_qos_upload_shed = REGISTRY.counter(
+    "df_qos_upload_shed_total",
+    "piece requests 503-shed at the class-aware upload gate", ("cls",))
 
 
 class _Slot:
@@ -68,14 +77,19 @@ class _Slot:
     path (the round-3 defect: with rate_limit_bps=0 the slot was held for
     microseconds and the 503 backpressure never engaged)."""
 
-    __slots__ = ("server", "released", "t0", "on_release", "ok")
+    __slots__ = ("server", "released", "t0", "on_release", "ok", "cls")
 
-    def __init__(self, server: "UploadServer", *, adopted: bool = False):
+    def __init__(self, server: "UploadServer", *, adopted: bool = False,
+                 cls: str = "standard"):
         """``adopted``: this slot's capacity was transferred from a
         releasing transfer (queued-request handoff) — _active already
-        counts it."""
+        counts it. The per-CLASS count is maintained here either way:
+        class attribution never transfers with the slot."""
         self.server = server
         self.released = False
+        self.cls = cls
+        server._active_cls[cls] = server._active_cls.get(cls, 0) + 1
+        _qos_upload_active.labels(cls).set(server._active_cls[cls])
         self.t0 = time.monotonic()
         # armed just before the response is handed off (serve journal):
         # fires with the measured hold time once the body is fully sent,
@@ -94,6 +108,10 @@ class _Slot:
         if not self.released:
             self.released = True
             srv = self.server
+            srv._active_cls[self.cls] = max(
+                0, srv._active_cls.get(self.cls, 0) - 1)
+            _qos_upload_active.labels(self.cls).set(
+                srv._active_cls[self.cls])
             # feed the busy-hint EWMA with the observed hold time
             held_ms = (time.monotonic() - self.t0) * 1000.0
             srv._transfer_ms = (0.8 * srv._transfer_ms + 0.2 * held_ms
@@ -171,14 +189,16 @@ class UploadServer:
 
     def __init__(self, storage_mgr: StorageManager, *, port: int = 0,
                  rate_limit_bps: int = 0, concurrent_limit: int = 0,
+                 bulk_concurrent_limit: int = 0,
                  host: str = "0.0.0.0", debug_endpoints: bool = False,
                  flight_recorder=None, pex=None, relay=None,
-                 relay_stall_s: float = 10.0):
+                 relay_stall_s: float = 10.0, qos=None):
         self.storage_mgr = storage_mgr
         self.flight_recorder = flight_recorder
         self.pex = pex
         self.relay = relay                  # RelayHub (None = store-and-forward)
         self.relay_stall_s = relay_stall_s  # per-wait watermark deadline
+        self.qos = qos                      # QosGovernor (GET /debug/qos)
         self.host = host
         self.port = port
         self.tls: tuple[str, str, str] | None = None   # (cert, key, ca)
@@ -186,11 +206,19 @@ class UploadServer:
         self.mux = None                # MuxListener when rollout-muxing
         self.limiter = TokenBucket(rate_limit_bps or 0)
         self.concurrent_limit = concurrent_limit or self.DEFAULT_CONCURRENT_LIMIT
+        # class-aware admission (QoS): bulk-class GETs may hold at most
+        # this many of the slots; the remainder stays reserved for
+        # critical/standard children, so a bulk herd saturates its share
+        # of the gate without ever starving the foreground of a slot
+        self.bulk_limit = bulk_concurrent_limit \
+            or max(1, self.concurrent_limit - 2)
         self.debug_endpoints = debug_endpoints
         self._active = 0
+        self._active_cls: dict[str, int] = {}
         self._transfer_ms = 0.0     # EWMA slot-hold time -> 503 retry hint
         self._transfer_ms_at = 0.0  # when the EWMA last saw a real transfer
         self._slot_waiters: deque = deque()
+        self._bulk_waiters: deque = deque()   # bulk queues behind ALL others
         self._runner: web.AppRunner | None = None
 
     def _pass_on_slot(self) -> None:
@@ -198,12 +226,20 @@ class UploadServer:
         return it to capacity. Cancelled futures (timed-out or disconnected
         waiters) are skipped — setting a result on one would strand the
         slot forever (the r04 leak: seed gate stuck at 5/6 after one
-        client disconnected while queued)."""
+        client disconnected while queued). Non-bulk waiters always wake
+        first; a bulk waiter only when the bulk cap has headroom — the
+        class-aware half of the gate."""
         while self._slot_waiters:
             fut = self._slot_waiters.popleft()
             if not fut.done():
                 fut.set_result(None)
                 return
+        if self._active_cls.get("bulk", 0) < self.bulk_limit:
+            while self._bulk_waiters:
+                fut = self._bulk_waiters.popleft()
+                if not fut.done():
+                    fut.set_result(None)
+                    return
         self._active -= 1
         _upload_active.set(self._active)
 
@@ -228,6 +264,13 @@ class UploadServer:
         # health surface existing only behind a flag defeats its purpose
         from ..common.health import add_health_routes
         add_health_routes(app.router)
+        if self.qos is not None:
+            # QoS plane readout (degradation state, per-class admission /
+            # shed counters, per-tenant attribution) — read-only, always
+            # on for the same reason as /debug/health: a browned-out
+            # daemon must be diagnosable (dfdiag --qos)
+            from .qos import add_qos_routes
+            add_qos_routes(app.router, self.qos)
         if self.pex is not None:
             # PEX gossip exchange + swarm debug view (GET/POST /pex/digest,
             # GET /debug/pex): mesh-internal like the piece routes, so it
@@ -402,8 +445,22 @@ class UploadServer:
                 _upload_reqs.labels("416").inc()
                 raise web.HTTPRequestRangeNotSatisfiable(
                     text=f"bytes {rng.start}+{rng.length} not stored yet")
+        # the requesting child's QoS class rides the GET (?cls=, from
+        # piece_downloader): bulk is additionally capped at bulk_limit
+        # slots and queues behind every non-bulk waiter
+        cls = request.query.get("cls", "")
+        if cls not in ("critical", "standard", "bulk"):
+            cls = "standard"
+        is_bulk = cls == "bulk"
+        waiters = self._bulk_waiters if is_bulk else self._slot_waiters
+        gate_closed = (self._active >= self.concurrent_limit
+                       or self._slot_waiters
+                       or (is_bulk
+                           and (self._bulk_waiters
+                                or self._active_cls.get("bulk", 0)
+                                >= self.bulk_limit)))
         slot = None
-        if self._active >= self.concurrent_limit or self._slot_waiters:
+        if gate_closed:
             # bounded slot wait BEFORE 503ing: when the gate is full but
             # moving, queueing ~one transfer-time is far cheaper than the
             # client's error round-trip + re-dispatch. Only a gate that
@@ -418,6 +475,7 @@ class UploadServer:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     _upload_reqs.labels("503").inc()
+                    _qos_upload_shed.labels(cls).inc()
                     # a congested-era EWMA must not dictate backoffs after
                     # the burst has passed (one bad wave would slow every
                     # later one): hints older than ~10 transfer-times decay
@@ -432,13 +490,13 @@ class UploadServer:
                         headers={"Retry-After": str(-(-hint_ms // 1000)),
                                  "X-Retry-After-Ms": str(hint_ms)})
                 fut = asyncio.get_running_loop().create_future()
-                self._slot_waiters.append(fut)
+                waiters.append(fut)
                 try:
                     await asyncio.wait_for(fut, remaining)
                 except asyncio.TimeoutError:
                     if fut.done() and not fut.cancelled():
                         # transfer landed exactly at the deadline: take it
-                        slot = _Slot(self, adopted=True)
+                        slot = _Slot(self, adopted=True, cls=cls)
                         break
                     continue   # loop re-checks the deadline and 503s
                 except BaseException:
@@ -452,10 +510,11 @@ class UploadServer:
                     raise
                 # a releasing transfer handed us its slot (ownership
                 # transfer — _active already counts it)
-                slot = _Slot(self, adopted=True)
+                slot = _Slot(self, adopted=True, cls=cls)
                 break
         if slot is None:
-            slot = _Slot(self)   # held until the BODY is sent (slot classes)
+            # held until the BODY is sent (slot classes)
+            slot = _Slot(self, cls=cls)
         try:
             if streaming:
                 return await self._serve_relay(request, ts, rng, slot,

@@ -40,6 +40,37 @@ class Priority(enum.IntEnum):
     LEVEL6 = 6  # lowest
 
 
+# The multi-tenant QoS service-class vocabulary, pinned here the way
+# ``EXCLUSION_REASONS`` pins the scheduling filter's reasons: every surface
+# that carries a class (dfget/proxy/object-gateway requests, shaper/upload
+# admission, scheduler rulings, ``df_qos_*`` metric labels) must use one of
+# these strings, and each must be backticked in docs/RESILIENCE.md /
+# docs/OBSERVABILITY.md (dflint DF006 priority-class-vocabulary).
+#
+#   ``critical`` — latency-sensitive foreground (a serving host pulling a
+#                  hot model): holds its SLO under contention, may preempt
+#                  ``bulk`` dispatch slots;
+#   ``standard`` — the default class; everything pre-QoS behaved as;
+#   ``bulk``     — background batch (dataset prefetch, image layers):
+#                  first to be throttled, queued, and shed under brownout.
+PRIORITY_CLASSES = ("critical", "standard", "bulk")
+DEFAULT_PRIORITY_CLASS = "standard"
+
+# numeric Priority a class resolves to when the request carries none:
+# ``bulk`` sinks to LEVEL6 so priority-ordered surfaces that predate the
+# class vocabulary (storage GC eviction, the per-class back-source budget)
+# order it behind foreground traffic without any new plumbing
+CLASS_DEFAULT_PRIORITY = {"critical": 0, "standard": 0, "bulk": 6}
+
+
+def resolve_class(qos_class: str) -> str:
+    """Clamp a wire-supplied class onto the pinned vocabulary ("" and
+    unknown strings resolve to the default class, never an error — an old
+    client must keep working against a QoS-aware pod)."""
+    return qos_class if qos_class in PRIORITY_CLASSES \
+        else DEFAULT_PRIORITY_CLASS
+
+
 class HostType(enum.IntEnum):
     NORMAL = 0       # ordinary peer
     SUPER_SEED = 1   # seed peer, first to back-source
@@ -69,6 +100,13 @@ class UrlMeta:
     header: dict | None = None       # extra origin request headers
     application: str = ""
     priority: Priority = Priority.LEVEL0
+    # multi-tenant QoS: who this request belongs to and which service
+    # class it rides (PRIORITY_CLASSES; "" = standard). NOT part of the
+    # task id — two tenants pulling the same URL share the task and the
+    # content store dedupes across them; what differs is admission,
+    # shaping, and eviction treatment.
+    tenant: str = ""
+    qos_class: str = ""
 
 
 @message
@@ -664,6 +702,29 @@ class ApplicationEntry:
 @message
 class ListApplicationsResponse:
     applications: list[ApplicationEntry] | None = None
+
+
+@message
+class TenantEntry:
+    """One manager-registered tenant with its quota and default service
+    class — the per-tenant half of the QoS plane. Schedulers pull this
+    table over dynconfig (``ListTenants``, same cadence as applications)
+    and enforce ``max_running`` at register with a 429-shaped
+    RESOURCE_EXHAUSTED + retry-after that the common/retry.py ladder
+    already honors."""
+
+    name: str = ""
+    qos_class: str = ""              # default class for the tenant's
+                                     # requests that carry none
+    max_running: int = 0             # concurrent running downloads
+                                     # cluster-wide (0 = unlimited)
+    shed_retry_after_ms: int = 0     # hint stamped on quota sheds
+                                     # (0 = scheduler default)
+
+
+@message
+class ListTenantsResponse:
+    tenants: list[TenantEntry] | None = None
 
 
 @message

@@ -54,8 +54,19 @@ def _unb64(data: str) -> bytes:
 
 
 class Authenticator:
-    def __init__(self, store, *, secret_path: str = ""):
+    def __init__(self, store, *, secret_path: str = "",
+                 rest_quota_rps: float = 0.0,
+                 rest_quota_burst: float = 0.0):
+        """``rest_quota_rps``: per-authenticated-identity request-rate
+        quota on the REST surface (0 = off, the pre-QoS behavior). Over
+        quota answers 429 + Retry-After — the same shed contract as the
+        scheduler's tenant quota, so one noisy tenant's dashboard poller
+        or CI loop cannot monopolize the manager's sqlite thread."""
         self.store = store
+        self.rest_quota_rps = float(rest_quota_rps)
+        self.rest_quota_burst = float(rest_quota_burst) \
+            or max(self.rest_quota_rps * 2, 1.0)
+        self._quota_buckets: dict = {}
         if secret_path and os.path.exists(secret_path):
             with open(secret_path, "rb") as f:
                 self._secret = f.read()
@@ -156,6 +167,28 @@ class Authenticator:
             return False
         return self.store.consume_oauth_nonce(payload.get("n", ""))
 
+    def check_quota(self, user: dict) -> float:
+        """0.0 = admitted; > 0 = over the per-identity REST quota, value
+        is the Retry-After seconds. Sync token-bucket math (rate.py
+        TokenBucket.try_acquire) — no await, so the middleware can never
+        queue requests behind a throttled tenant."""
+        if self.rest_quota_rps <= 0:
+            return 0.0
+        from ..common.rate import TokenBucket
+        bucket = self._quota_buckets.get(user["name"])
+        if bucket is None:
+            if len(self._quota_buckets) > 4096:
+                # cap against unauthenticated-name floods via forged PATs:
+                # resetting everyone's bucket is strictly safer than
+                # unbounded growth
+                self._quota_buckets.clear()
+            bucket = TokenBucket(self.rest_quota_rps,
+                                 self.rest_quota_burst)
+            self._quota_buckets[user["name"]] = bucket
+        if bucket.try_acquire(1.0):
+            return 0.0
+        return max(1.0 / self.rest_quota_rps, 1.0)
+
     def middleware(self):
         @web.middleware
         async def auth_middleware(request: web.Request, handler):
@@ -168,6 +201,14 @@ class Authenticator:
                                          status=401)
             if not self.allowed(user, request.method):
                 return web.json_response({"error": "forbidden"}, status=403)
+            retry_s = self.check_quota(user)
+            if retry_s > 0:
+                # the 429 contract (docs/RESILIENCE.md): Retry-After so
+                # common/retry.py-shaped clients back off instead of
+                # hammering
+                return web.json_response(
+                    {"error": "quota exceeded"}, status=429,
+                    headers={"Retry-After": str(int(retry_s))})
             request["user"] = user
             return await handler(request)
         return auth_middleware

@@ -49,6 +49,8 @@ class RestAPI:
         r.add_get("/api/v1/seed-peers", self._list_seed_peers)
         r.add_get("/api/v1/applications", self._list_applications)
         r.add_post("/api/v1/applications", self._create_application)
+        r.add_get("/api/v1/tenants", self._list_tenants)
+        r.add_post("/api/v1/tenants", self._create_tenant)
         r.add_post("/api/v1/jobs", self._create_job)
         r.add_get("/api/v1/jobs", self._list_jobs)
         r.add_get("/api/v1/jobs/{id}", self._get_job)
@@ -124,6 +126,34 @@ class RestAPI:
                 body["name"], url=body.get("url", ""),
                 priority=body.get("priority")))
         return web.json_response({"id": app_id}, status=201)
+
+    async def _list_tenants(self, _r: web.Request) -> web.Response:
+        return web.json_response(
+            await asyncio.to_thread(self.store.tenants))
+
+    async def _create_tenant(self, request: web.Request) -> web.Response:
+        """Tenant quota row (multi-tenant QoS): validated against the
+        pinned class vocabulary at the WRITE — a typo'd class must fail
+        the operator's POST, not silently lose its default at the
+        scheduler's enforcement point."""
+        from ..idl.messages import PRIORITY_CLASSES
+        body = await request.json()
+        if not body.get("name"):
+            return web.json_response({"error": "name required"},
+                                     status=400)
+        cls = body.get("qos_class", "")
+        if cls and cls not in PRIORITY_CLASSES:
+            return web.json_response(
+                {"error": f"unknown qos_class {cls!r} "
+                          f"(known: {list(PRIORITY_CLASSES)})"},
+                status=400)
+        tenant_id = await asyncio.to_thread(
+            lambda: self.store.upsert_tenant(
+                body["name"], qos_class=cls,
+                max_running=int(body.get("max_running", 0) or 0),
+                shed_retry_after_ms=int(
+                    body.get("shed_retry_after_ms", 0) or 0)))
+        return web.json_response({"id": tenant_id}, status=201)
 
     async def _create_job(self, request: web.Request) -> web.Response:
         body = await request.json()

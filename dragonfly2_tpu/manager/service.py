@@ -89,6 +89,28 @@ class ManagerService:
                 priority=Priority(prio)))
         return ListApplicationsResponse(applications=out)
 
+    async def list_tenants(self, req, context):
+        """Tenant quota table for scheduler dynconfig (the QoS analog of
+        ListApplications): per-tenant default class + max_running quota,
+        refreshed on the same cadence so quota edits reach every
+        scheduler without a restart. Classes are clamped onto the pinned
+        vocabulary here — a typo'd row must degrade to 'no default
+        class', never to an unknown label at the enforcement point."""
+        from ..idl.messages import (ListTenantsResponse, PRIORITY_CLASSES,
+                                    TenantEntry)
+        rows = await asyncio.to_thread(self.store.tenants)
+        out = []
+        for r in rows:
+            cls = r.get("qos_class") or ""
+            if cls not in PRIORITY_CLASSES:
+                cls = ""
+            out.append(TenantEntry(
+                name=r["name"], qos_class=cls,
+                max_running=int(r.get("max_running") or 0),
+                shed_retry_after_ms=int(r.get("shed_retry_after_ms")
+                                        or 0)))
+        return ListTenantsResponse(tenants=out)
+
     async def register_scheduler(self, req: RegisterSchedulerRequest,
                                  context) -> Empty:
         cluster_id = req.scheduler_cluster_id or \
@@ -196,6 +218,7 @@ def build_service(svc: ManagerService) -> ServiceDef:
     d.unary_unary("GetSchedulers", svc.get_schedulers)
     d.unary_unary("GetSeedPeers", svc.get_seed_peers)
     d.unary_unary("ListApplications", svc.list_applications)
+    d.unary_unary("ListTenants", svc.list_tenants)
     d.unary_unary("RegisterScheduler", svc.register_scheduler)
     d.unary_unary("RegisterSeedPeer", svc.register_seed_peer)
     d.stream_unary("KeepAlive", svc.keep_alive)

@@ -65,6 +65,14 @@ CREATE TABLE IF NOT EXISTS applications (
   priority TEXT NOT NULL DEFAULT '{}',
   created_at REAL, updated_at REAL
 );
+CREATE TABLE IF NOT EXISTS tenants (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT UNIQUE NOT NULL,
+  qos_class TEXT NOT NULL DEFAULT '',
+  max_running INTEGER NOT NULL DEFAULT 0,
+  shed_retry_after_ms INTEGER NOT NULL DEFAULT 0,
+  created_at REAL, updated_at REAL
+);
 CREATE TABLE IF NOT EXISTS jobs (
   id INTEGER PRIMARY KEY AUTOINCREMENT,
   type TEXT NOT NULL,
@@ -359,6 +367,28 @@ class Store:
     def applications(self) -> list[dict]:
         return [dict(r) for r in self._rows(
             "SELECT * FROM applications ORDER BY id")]
+
+    # -- tenants (multi-tenant QoS quotas) -----------------------------
+
+    def upsert_tenant(self, name: str, *, qos_class: str = "",
+                      max_running: int = 0,
+                      shed_retry_after_ms: int = 0) -> int:
+        self._exec(
+            "INSERT INTO tenants(name, qos_class, max_running,"
+            " shed_retry_after_ms, created_at, updated_at)"
+            " VALUES (?,?,?,?,?,?)"
+            " ON CONFLICT(name) DO UPDATE SET qos_class=excluded.qos_class,"
+            " max_running=excluded.max_running,"
+            " shed_retry_after_ms=excluded.shed_retry_after_ms,"
+            " updated_at=excluded.updated_at",
+            (name, qos_class, int(max_running), int(shed_retry_after_ms),
+             _now(), _now()))
+        return int(self._rows("SELECT id FROM tenants WHERE name=?",
+                              (name,))[0]["id"])
+
+    def tenants(self) -> list[dict]:
+        return [dict(r) for r in self._rows(
+            "SELECT * FROM tenants ORDER BY id")]
 
     def create_job(self, type_: str, args: dict) -> int:
         cur = self._exec(

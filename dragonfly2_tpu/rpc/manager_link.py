@@ -75,6 +75,10 @@ class ManagerLink:
         from ..idl.messages import Empty
         return await self._unary("ListApplications", Empty())
 
+    async def list_tenants(self):
+        from ..idl.messages import Empty
+        return await self._unary("ListTenants", Empty())
+
     async def create_model(self, req) -> None:
         await self._unary("CreateModel", req, timeout=60.0)
 

@@ -65,6 +65,19 @@ class SchedulerConfig:
     # (see Scheduling._relay_shape; cut-through serving makes the chain
     # hops overlap, daemon/relay.py).
     relay_fanout: int = 0
+    # per-class relay fan-out slot caps (QoS, active only while
+    # relay_fanout > 0): how many of a parent's relay-tree child slots a
+    # child of each class may claim. Unlisted classes use relay_fanout
+    # itself. The default caps ``bulk`` at half the fan-out (floor 1), so
+    # a bulk herd's cold start builds a NARROWER, deeper tree and leaves
+    # breadth slots — the low-latency positions near the seed — for
+    # critical/standard children.
+    class_fanout_caps: dict = field(default_factory=dict)
+    # bulk-dispatch preemption (QoS): a waiting ``critical`` child with no
+    # legal parent may evict one ``bulk`` child's edge from a slot-full
+    # content holder (Scheduling.preempt_for; the ruling rides the
+    # decision ledger). Off = the exact pre-QoS patience path.
+    qos_preemption: bool = True
     retry_limit: int = RETRY_LIMIT
     retry_back_source_limit: int = RETRY_BACK_SOURCE_LIMIT
     back_source_concurrent: int = DEFAULT_BACK_SOURCE_CONCURRENT

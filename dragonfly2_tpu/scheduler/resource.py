@@ -145,6 +145,12 @@ class Peer:
         # Set at register: explicit request value, else the manager-fed
         # application table, else LEVEL0 (reference Peer.CalculatePriority)
         self.priority = 0
+        # multi-tenant QoS (set at register from UrlMeta): the service
+        # class rides every scheduling ruling (decision-ledger rows, the
+        # per-class relay fan-out cap, bulk-dispatch preemption) and the
+        # tenant is the quota/attribution key
+        self.qos_class = "standard"
+        self.tenant = ""
         # report stream broke while the peer was mid-download: very likely
         # a dead process. Not a removal — completion can land via a late
         # unary report, and a live peer re-opens a stream (both clear it) —

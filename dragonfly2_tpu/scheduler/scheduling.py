@@ -24,6 +24,11 @@ log = logging.getLogger("df.sched.core")
 _filter_excluded = REGISTRY.counter(
     "df_sched_filter_excluded_total",
     "candidate parents excluded by the scheduling filter", ("reason",))
+_preemptions = REGISTRY.counter(
+    "df_sched_preempt_total",
+    "bulk-class parent edges evicted so a waiting critical child could "
+    "be scheduled (QoS preemption; each ruling rides the decision "
+    "ledger)", ("cls",))
 
 # The filter's exclusion-reason vocabulary. Every reason ``_trace`` fires
 # must be registered here and documented in docs/OBSERVABILITY.md — a pod
@@ -147,6 +152,14 @@ class Scheduling:
         order plus the ledger annotation (None when nothing was capped)
         so every relay ruling stays explainable in the decision row."""
         fanout = self.cfg.relay_fanout
+        # per-class slot cap (QoS): bulk children claim fewer of a
+        # parent's relay slots, leaving breadth near the seed for
+        # foreground classes; default caps bulk at half the fan-out
+        cls = getattr(child, "qos_class", "standard")
+        if self.cfg.class_fanout_caps:
+            fanout = int(self.cfg.class_fanout_caps.get(cls, fanout))
+        elif cls == "bulk":
+            fanout = max(1, fanout // 2)
         dag = child.task.dag
         mine = child.last_offer_ids
         under: list[Peer] = []
@@ -165,6 +178,76 @@ class Scheduling:
                 "capped": [p.id for p in over],
                 "child_counts": {p.id: counts[p.id] for p in over}}
         return under + over, note
+
+    def preempt_for(self, child: Peer) -> Peer | None:
+        """Bulk-dispatch preemption: a waiting ``critical`` child found no
+        legal parent because every content holder's upload slots are
+        taken — evict ONE ``bulk`` child's edge from the best such holder
+        so the next find_parents sees a free slot. The evicted bulk child
+        keeps its remaining parents (and its pieces; nothing downloaded is
+        lost) and the scheduler's next refresh re-offers it whatever is
+        legal then — degradation, not starvation. The ruling is emitted as
+        a ``kind=decision`` row (decision_kind="preempt") carrying both
+        peers and the freed parent, so fairness stays offline-replayable
+        via dfsched. Returns the evicted bulk peer (the caller pushes it
+        a fresh packet so its engine actually drops the edge — a
+        preemption the daemon never hears about would free nothing) or
+        None when no preemptable edge exists."""
+        if not self.cfg.qos_preemption \
+                or getattr(child, "qos_class", "standard") != "critical":
+            return None
+        task = child.task
+        dag = task.dag
+        # holders whose slots are exhausted (the no-slots exclusion the
+        # filter just fired), best victim edge = a bulk child that joined
+        # the parent most recently (it has sunk the least into this edge)
+        for parent in task.peers.values():
+            if (parent.id == child.id or not parent.has_content()
+                    or parent.host.free_upload_slots() > 0
+                    or parent.id not in dag):
+                continue
+            victims = [
+                task.peers[cid] for cid in dag.children(parent.id)
+                if cid in task.peers
+                and getattr(task.peers[cid], "qos_class",
+                            "standard") == "bulk"
+                and not task.peers[cid].is_done()]
+            if not victims:
+                continue
+            victim = max(victims, key=lambda p: p.created_at)
+            keep = [pid for pid in dag.parents(victim.id)
+                    if pid != parent.id]
+            task.set_parents(victim.id, keep)
+            victim.last_offer_ids = set(keep)
+            _preemptions.labels("bulk").inc()
+            log.info("preempt: bulk child %s lost parent %s so critical "
+                     "%s can schedule", victim.id[-12:], parent.id[-12:],
+                     child.id[-12:])
+            if self.decision_sink is not None:
+                self._decision_seq += 1
+                self.decision_sink({
+                    "kind": "decision",
+                    "decision_id": (f"d{self._decision_seq:08d}."
+                                    f"{child.id[-12:]}"),
+                    "decision_kind": "preempt",
+                    "task_id": task.id,
+                    "peer_id": child.id,
+                    "host_id": child.host.id,
+                    "qos_class": getattr(child, "qos_class", "standard"),
+                    "tenant": getattr(child, "tenant", ""),
+                    "candidates": [],
+                    "excluded": [],
+                    "chosen": [],
+                    "preempted": {
+                        "victim_peer_id": victim.id,
+                        "victim_class": "bulk",
+                        "victim_tenant": getattr(victim, "tenant", ""),
+                        "parent_id": parent.id,
+                        "victim_parents_kept": keep,
+                    },
+                })
+            return victim
+        return None
 
     def find_parents(self, child: Peer) -> list[Peer]:
         return self._decide(child, "find")
@@ -265,6 +348,11 @@ class Scheduling:
             "task_id": child.task.id,
             "peer_id": child.id,
             "host_id": child.host.id,
+            # QoS attribution on every ruling: replaying the ledger can
+            # audit class fairness (who got which slots, what the
+            # fan-out caps demoted, which preemptions fired) offline
+            "qos_class": getattr(child, "qos_class", "standard"),
+            "tenant": getattr(child, "tenant", ""),
             "total_piece_count": total,
             "evaluator": type(self.evaluator).__name__,
             "candidates": candidates,

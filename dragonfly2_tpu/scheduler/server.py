@@ -158,10 +158,23 @@ class Scheduler:
 
     async def _refresh_applications(self) -> None:
         """Pull the application priority table into the service (reference
-        dynconfig.GetApplications feeding Peer.CalculatePriority)."""
+        dynconfig.GetApplications feeding Peer.CalculatePriority), plus
+        the tenant quota table (multi-tenant QoS) on the same cadence —
+        both optional verbs, each failing independently so an older
+        manager serving only applications still feeds them."""
         resp = await self.manager.list_applications()
         self.service.applications = {
             e.name: int(e.priority) for e in (resp.applications or [])}
+        try:
+            tresp = await self.manager.list_tenants()
+        except Exception as exc:  # noqa: BLE001 - older manager: no verb
+            log.debug("tenant refresh failed: %s", exc)
+            return
+        self.service.tenants = {
+            t.name: {"qos_class": t.qos_class,
+                     "max_running": int(t.max_running),
+                     "shed_retry_after_ms": int(t.shed_retry_after_ms)}
+            for t in (tresp.tenants or [])}
 
     async def _app_refresh_loop(self) -> None:
         while True:

@@ -19,7 +19,8 @@ from typing import AsyncIterator
 
 from ..common.errors import Code, DFError
 from ..common.metrics import REGISTRY
-from ..idl.messages import (AnnounceHostRequest, Empty, LeaveHostRequest,
+from ..idl.messages import (AnnounceHostRequest, Empty, HostType,
+                            LeaveHostRequest,
                             LeavePeerRequest, PeerPacket, PeerResult,
                             PieceResult, Priority, RegisterPeerTaskRequest,
                             RegisterResult, SinglePiece, SizeScope,
@@ -43,6 +44,11 @@ _schedules = REGISTRY.counter("df_sched_schedule_total",
                               "scheduling decisions", ("kind",))
 _piece_reports = REGISTRY.counter("df_sched_piece_report_total",
                                   "piece results received", ("result",))
+_quota_sheds = REGISTRY.counter(
+    "df_qos_quota_shed_total",
+    "registers rejected by a tenant's max_running quota "
+    "(RESOURCE_EXHAUSTED + retry-after; HTTP surfaces answer 429)",
+    ("tenant",))
 
 SCHEDULE_RETRY_INTERVAL_S = 0.25
 SCHEDULE_PATIENCE_S = 10.0
@@ -73,6 +79,10 @@ class SchedulerService:
         # applications table (reference dynconfig.GetApplications); consulted
         # when a request carries no explicit priority
         self.applications: dict[str, int] = {}
+        # tenant name -> quota row ({"qos_class", "max_running",
+        # "shed_retry_after_ms"}), fed from the manager's tenants table
+        # over the same dynconfig cadence; enforced at register
+        self.tenants: dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     # RegisterPeerTask
@@ -99,16 +109,31 @@ class SchedulerService:
             task.transit(TaskState.RUNNING)
         elif task.state == TaskState.PENDING:
             task.transit(TaskState.RUNNING)
-        resolved_priority = self._resolve_priority(req.url_meta)
+        qos_class, tenant = self._resolve_class(req.url_meta)
+        resolved_priority = self._resolve_priority(req.url_meta,
+                                                   qos_class=qos_class)
         if resolved_priority == int(Priority.LEVEL1):
             # reference service_v2.go: LEVEL1 = download forbidden. Checked
             # BEFORE peer creation: a forbidden client retrying in a loop
             # must not grow a PENDING peer per attempt until the 24h TTL
             raise DFError(Code.SCHED_FORBIDDEN,
                           "download forbidden by priority (LEVEL1)")
+        # manager-enforced per-tenant quota, checked BEFORE peer creation
+        # for the same reason as LEVEL1: a quota-storming tenant must not
+        # grow a PENDING peer per shed. Raises RESOURCE_EXHAUSTED with a
+        # retry-after hint — the common/retry.py ladder honors it and the
+        # proxy/gateway surface it as HTTP 429 + Retry-After. Seed hosts
+        # are EXEMPT: the seed's ObtainSeeds register replays the
+        # client's UrlMeta (tenant included), and infrastructure
+        # injection billed to the tenant would shed the very pull that
+        # lets the admitted download complete P2P.
+        if req.peer_host.type == HostType.NORMAL:
+            self._enforce_tenant_quota(tenant)
         host = self.resource.store_host(req.peer_host)
         peer = self.resource.get_or_create_peer(req.peer_id, task, host)
         peer.priority = resolved_priority
+        peer.qos_class = qos_class
+        peer.tenant = tenant
         if peer.state == PeerState.PENDING:
             peer.transit(PeerState.RUNNING)
 
@@ -245,19 +270,88 @@ class SchedulerService:
                 return
             self._maybe_retrigger_seed(peer.task)
             await self._refresh_parents(peer)
+            if (peer.qos_class == "critical" and peer.last_offer_ids
+                    and not any(
+                        p is not None and p.has_content()
+                        for p in (peer.task.peers.get(pid)
+                                  for pid in peer.last_offer_ids))):
+                # mid-download starvation (every offered parent is a
+                # pieceless sibling while content holders sit slot-full
+                # behind bulk edges): same preemption rule as the
+                # patience loop, on the refresh cadence
+                victim = self.scheduling.preempt_for(peer)
+                if victim is not None:
+                    await self._push_victim_packet(victim)
+                    await self._refresh_parents(peer)
 
-    def _resolve_priority(self, url_meta) -> int:
+    def _resolve_priority(self, url_meta, *,
+                          qos_class: str = "standard") -> int:
         """Reference ``Peer.CalculatePriority``: an explicit request value
         wins; LEVEL0 (the unset default) falls through to the manager's
-        application table; unknown applications resolve LEVEL0 (= the best
-        service class, like the reference's LEVEL6/LEVEL0 switch arm)."""
+        application table, then to the QoS class's default (``bulk``
+        sinks to LEVEL6 so priority-ordered surfaces — storage GC, the
+        per-class back-source budget — order it behind foreground without
+        new plumbing); unknown applications resolve the class default
+        (LEVEL0 for standard, like the reference's LEVEL6/LEVEL0 arm)."""
+        from ..idl.messages import CLASS_DEFAULT_PRIORITY
         if url_meta is not None and int(url_meta.priority) != int(Priority.LEVEL0):
             return int(url_meta.priority)
         if url_meta is not None and url_meta.application:
             prio = self.applications.get(url_meta.application)
             if prio is not None:
                 return int(prio)
-        return int(Priority.LEVEL0)
+        return CLASS_DEFAULT_PRIORITY.get(qos_class, int(Priority.LEVEL0))
+
+    def _resolve_class(self, url_meta) -> tuple[str, str]:
+        """(qos_class, tenant) for a register: the request's explicit
+        class wins; a classless request from a known tenant inherits the
+        tenant's default class; everything else is ``standard``."""
+        from ..idl.messages import PRIORITY_CLASSES, resolve_class
+        tenant = url_meta.tenant if url_meta is not None else ""
+        raw = url_meta.qos_class if url_meta is not None else ""
+        if raw in PRIORITY_CLASSES:
+            return raw, tenant
+        row = self.tenants.get(tenant) if tenant else None
+        if row and row.get("qos_class") in PRIORITY_CLASSES:
+            return row["qos_class"], tenant
+        return resolve_class(raw), tenant
+
+    TENANT_SHED_RETRY_MS = 2000
+
+    def _enforce_tenant_quota(self, tenant: str) -> None:
+        """max_running quota: live (non-terminal, non-stale) peers this
+        tenant already has across every task. Computed on demand — a
+        register is not hot-path, and a counter maintained across peer
+        GC/stream-death edges would drift exactly when it matters."""
+        row = self.tenants.get(tenant) if tenant else None
+        if not row:
+            return
+        limit = int(row.get("max_running") or 0)
+        if limit <= 0:
+            return
+        import time as _time
+        stale_after = _time.time() - 300.0
+        running = 0
+        for task in self.resource.tasks.values():
+            for p in task.peers.values():
+                if p.tenant != tenant or p.is_done() \
+                        or p.host.msg.type != HostType.NORMAL:
+                    continue
+                # a crashed peer's stream is gone and its clock stops;
+                # it must not occupy quota until the 24h TTL
+                if p.stream_gone or p.updated_at < stale_after:
+                    continue
+                running += 1
+                if running >= limit:
+                    _quota_sheds.labels(tenant).inc()
+                    exc = DFError(
+                        Code.RESOURCE_EXHAUSTED,
+                        f"tenant {tenant!r} at max_running={limit}; "
+                        f"retry later")
+                    exc.retry_after_ms = int(
+                        row.get("shed_retry_after_ms") or 0) \
+                        or self.TENANT_SHED_RETRY_MS
+                    raise exc
 
     async def _schedule_with_patience(self, peer: Peer,
                                       sink: asyncio.Queue) -> None:
@@ -275,6 +369,16 @@ class SchedulerService:
             if peer.is_done() or peer.state == PeerState.BACK_SOURCE:
                 return
             parents = self.scheduling.find_parents(peer)
+            if parents and not any(p.has_content() for p in parents):
+                # holderless offer (pieceless siblings only — the filter
+                # keeps them for their sync streams): a critical child
+                # starving because every content holder is slot-full may
+                # evict one bulk edge and re-rule NOW, instead of
+                # subscribing to siblings who have nothing to announce
+                victim = self.scheduling.preempt_for(peer)
+                if victim is not None:
+                    await self._push_victim_packet(victim)
+                    continue
             if parents:
                 peer.schedule_count += 1
                 peer.last_offer_ids = {p.id for p in parents}
@@ -284,6 +388,11 @@ class SchedulerService:
                           [p.id[-12:] for p in parents])
                 sink.put_nowait(self.scheduling.build_packet(peer, parents))
                 return
+            # QoS preemption, empty-offer form: no legal parent at all
+            victim = self.scheduling.preempt_for(peer)
+            if victim is not None:
+                await self._push_victim_packet(victim)
+                continue
             now = asyncio.get_running_loop().time()
             self._maybe_retrigger_seed(peer.task)
             seed_pending = (peer.task.seed_job is not None
@@ -478,12 +587,30 @@ class SchedulerService:
                   [p.id[-12:] for p in parents])
         peer.packet_sink.put_nowait(self.scheduling.build_packet(peer, parents))
 
+    async def _push_victim_packet(self, victim: Peer) -> None:
+        """Deliver a preempted bulk child its SHRUNK parent set so its
+        engine actually tears down the evicted edge (and the in-flight
+        pieces on it requeue against the remaining parents — preemption
+        re-dispatches work, it never orphans it)."""
+        if victim.packet_sink is None:
+            return
+        parents = [victim.task.peers[pid]
+                   for pid in victim.last_offer_ids
+                   if pid in victim.task.peers]
+        victim.packet_sink.put_nowait(
+            self.scheduling.build_packet(victim, parents))
+
     async def _reschedule(self, peer: Peer) -> None:
         if peer.packet_sink is None or peer.is_done():
             return
         if peer.state == PeerState.BACK_SOURCE:
             return
         parents = self.scheduling.find_parents(peer)
+        if not parents:
+            victim = self.scheduling.preempt_for(peer)
+            if victim is not None:
+                await self._push_victim_packet(victim)
+                parents = self.scheduling.find_parents(peer)
         if parents:
             peer.schedule_count += 1
             peer.last_offer_ids = {p.id for p in parents}

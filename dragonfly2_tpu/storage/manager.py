@@ -40,6 +40,13 @@ from .store import SubTaskStorage, TaskStorage
 
 log = logging.getLogger("df.storage.manager")
 
+# QoS class multipliers on serve-popularity at capacity eviction
+# (StorageManager.try_gc): scores the same observed serve rate 4x higher
+# for critical content and 4x lower for bulk ("" = pre-QoS tasks score
+# unweighted). Priority stays the primary key; the weight breaks
+# popularity ties WITHIN a priority band.
+CLASS_EVICT_WEIGHTS = {"critical": 4.0, "standard": 1.0, "bulk": 0.25}
+
 _logical_gauge = REGISTRY.gauge(
     "df_storage_logical_bytes",
     "bytes the store's tasks occupy before digest-sharing (sum of "
@@ -414,9 +421,15 @@ class StorageManager:
             def evict_key(t: TaskStorage):
                 pop = (self.castore.popularity(t.md.task_id, now=mono)
                        if self.castore is not None else 0.0)
+                # class-weighted popularity (QoS): a bulk tenant's content
+                # must out-earn critical content 16:1 in observed serves
+                # before eviction prefers keeping it — a churning bulk
+                # herd cannot launder the pod's hot critical model out of
+                # the store just by being recently busy
+                pop *= CLASS_EVICT_WEIGHTS.get(t.md.qos_class, 1.0)
                 # lowest download priority first (numeric DESC — LEVEL6
-                # before LEVEL0), then coldest by serve-popularity, then
-                # oldest access
+                # before LEVEL0), then coldest by class-weighted
+                # serve-popularity, then oldest access
                 return (-t.md.priority, pop, t.md.access_time)
 
             candidates.sort(key=evict_key)

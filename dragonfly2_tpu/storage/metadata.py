@@ -60,6 +60,11 @@ class TaskMetadata:
     # content first (reference storage GC orders eviction by application
     # priority before recency)
     priority: int = 0
+    # QoS service class this task was downloaded under ("" = pre-QoS):
+    # capacity eviction weights serve-popularity by class, so a bulk
+    # tenant's churn cannot evict the pod's hot critical model (see
+    # StorageManager.try_gc)
+    qos_class: str = ""
 
     @property
     def stored_bytes(self) -> int:

@@ -884,6 +884,249 @@ def _run_pr9(args) -> dict:
     }
 
 
+# --------------------------------------------------------------- PR-11
+# Multi-tenant QoS contended harness: a latency-sensitive ``critical``
+# foreground pull sharing one feeder uplink (the link class PAPERS.md's
+# concurrency-limits paper says saturates first) with a ``bulk`` herd.
+# Fluid-flow event sim on a virtual clock: between events every active
+# transfer progresses at its granted rate; the grant comes from the REAL
+# hierarchical split the daemon shaper ships (``common/rate.class_shares``
+# over ``traffic_shaper.CLASS_WEIGHTS``) when QoS is on, and from the
+# plain per-transfer fair share when it is off — so the contended numbers
+# are a claim about the shipped arithmetic, not a parallel model. Bulk
+# admission mirrors the daemon governor's ladder (``daemon/qos.py``):
+# ``bulk_active_limit`` concurrent, bounded queue, bounded wait, shed
+# with retry — queued/shed counts ride the result.
+
+QOS_UPLINK_BPS = 1.5e9          # the shared DCN feeder link
+QOS_BULK_ACTIVE_LIMIT = 4       # governor gate in the modeled daemon
+QOS_QUEUE_LIMIT = 8
+QOS_QUEUE_WAIT_MS = 400.0
+QOS_SHED_RETRY_MS = 250.0
+QOS_FG_THINK_MS = (1.0, 3.0)    # foreground inter-piece think (jittered)
+
+
+def run_qos_bench(*, seed: int = 7, fg_pieces: int = 32,
+                  bulk_workers: int = 12, piece_size: int = 4 << 20,
+                  qos: bool = True, contended: bool = True) -> dict:
+    """One contended (or solo-foreground) run; returns per-class piece
+    latencies + shed/queue accounting. Pure function of its arguments —
+    virtual clock, seeded rng, no globals."""
+    from ..common.rate import class_shares
+    from ..daemon.traffic_shaper import CLASS_WEIGHTS
+
+    rng = random.Random(seed)
+    # transfer: [cls, remaining_bytes, size, t_start, worker]
+    active: list[list] = []
+    fg_latencies: list[float] = []
+    bulk_latencies: list[float] = []
+    bulk_done_bytes = 0
+    counters = {"queued": 0, "shed": 0, "bulk_started": 0}
+    fg_started = 0
+    t = 0.0
+
+    def rates() -> dict[int, float]:
+        """bytes/ms granted to each active transfer at this instant."""
+        if not active:
+            return {}
+        if not qos:
+            share = QOS_UPLINK_BPS / len(active) / 1000.0
+            return {id(tr): share for tr in active}
+        demand: dict[str, float] = {}
+        for tr in active:
+            demand[tr[0]] = demand.get(tr[0], 0.0) + 1.0
+        shares = class_shares(QOS_UPLINK_BPS, CLASS_WEIGHTS, demand)
+        return {id(tr): shares[tr[0]] / demand[tr[0]] / 1000.0
+                for tr in active}
+
+    # event heap: (t_ms, seq, kind, payload)
+    events: list[tuple] = []
+    seq = 0
+
+    def push(at: float, kind: str, payload=None) -> None:
+        nonlocal seq
+        heapq.heappush(events, (at, seq, kind, payload))
+        seq += 1
+
+    bulk_queue: list[tuple[float, int]] = []   # (enqueued_at, worker)
+
+    def bulk_size() -> int:
+        return int(piece_size * rng.uniform(0.9, 1.1))
+
+    def try_start_bulk(worker: int, now: float) -> None:
+        counters_active = sum(1 for tr in active if tr[0] == "bulk")
+        if qos and counters_active >= QOS_BULK_ACTIVE_LIMIT:
+            if len(bulk_queue) >= QOS_QUEUE_LIMIT:
+                # shed: the worker backs off for the governor's hint
+                counters["shed"] += 1
+                push(now + QOS_SHED_RETRY_MS, "bulk_want", worker)
+                return
+            counters["queued"] += 1
+            bulk_queue.append((now, worker))
+            push(now + QOS_QUEUE_WAIT_MS, "bulk_deadline", worker)
+            return
+        size = bulk_size()
+        counters["bulk_started"] += 1
+        active.append(["bulk", float(size), size, now, worker])
+
+    def drain_bulk_queue(now: float) -> None:
+        while bulk_queue and sum(
+                1 for tr in active if tr[0] == "bulk") \
+                < QOS_BULK_ACTIVE_LIMIT:
+            enq, worker = bulk_queue.pop(0)
+            if now - enq > QOS_QUEUE_WAIT_MS:
+                counters["shed"] += 1
+                push(now + QOS_SHED_RETRY_MS, "bulk_want", worker)
+                continue
+            size = bulk_size()
+            counters["bulk_started"] += 1
+            active.append(["bulk", float(size), size, now, worker])
+
+    push(0.0, "fg_want", None)
+    if contended:
+        for w in range(bulk_workers):
+            push(rng.uniform(0.0, 2.0), "bulk_want", w)
+
+    SAFETY_MS = 600_000.0
+    while fg_started < fg_pieces or any(tr[0] == "critical"
+                                        for tr in active):
+        if t > SAFETY_MS:
+            break
+        # next discrete event vs next transfer completion under current
+        # rates (fluid advance between events)
+        grant = rates()
+        next_done = None
+        for tr in active:
+            r = grant[id(tr)]
+            eta = t + (tr[1] / r if r > 0 else SAFETY_MS)
+            if next_done is None or eta < next_done[0]:
+                next_done = (eta, tr)
+        next_event = events[0][0] if events else None
+        if next_done is not None and (next_event is None
+                                      or next_done[0] <= next_event):
+            # advance the fluid to the completion moment
+            dt = next_done[0] - t
+            for tr in active:
+                tr[1] = max(0.0, tr[1] - grant[id(tr)] * dt)
+            t = next_done[0]
+            tr = next_done[1]
+            active.remove(tr)
+            cls, _rem, size, t0, worker = tr
+            if cls == "critical":
+                fg_latencies.append(t - t0)
+                if fg_started < fg_pieces:
+                    push(t + rng.uniform(*QOS_FG_THINK_MS),
+                         "fg_want", None)
+            else:
+                bulk_latencies.append(t - t0)
+                bulk_done_bytes += size
+                if contended:
+                    push(t, "bulk_want", worker)
+            drain_bulk_queue(t)
+            continue
+        if next_event is None:
+            break
+        # advance the fluid to the event moment, then apply it
+        dt = next_event - t
+        for tr in active:
+            tr[1] = max(0.0, tr[1] - grant.get(id(tr), 0.0) * dt)
+        t = next_event
+        _at, _s, kind, payload = heapq.heappop(events)
+        if kind == "fg_want":
+            if fg_started < fg_pieces:
+                fg_started += 1
+                size = int(piece_size * rng.uniform(0.95, 1.05))
+                active.append(["critical", float(size), size, t, -1])
+        elif kind == "bulk_want":
+            try_start_bulk(payload, t)
+        elif kind == "bulk_deadline":
+            # a queued admission whose bounded wait expired: shed
+            for i, (enq, worker) in enumerate(bulk_queue):
+                if worker == payload and t - enq >= QOS_QUEUE_WAIT_MS:
+                    bulk_queue.pop(i)
+                    counters["shed"] += 1
+                    push(t + QOS_SHED_RETRY_MS, "bulk_want", worker)
+                    break
+
+    fg_sorted = sorted(fg_latencies)
+    bulk_sorted = sorted(bulk_latencies)
+    makespan = t
+    return {
+        "qos": qos,
+        "contended": contended,
+        "fg_pieces_done": len(fg_latencies),
+        "fg_pieces_requested": fg_pieces,
+        "fg_latency_ms": {"p50": _pctl(fg_sorted, 0.50),
+                          "p99": _pctl(fg_sorted, 0.99)},
+        "bulk_latency_ms": {"p50": _pctl(bulk_sorted, 0.50),
+                            "p99": _pctl(bulk_sorted, 0.99)},
+        "bulk_pieces_done": len(bulk_latencies),
+        "bulk_throughput_bps": (round(bulk_done_bytes
+                                      / (makespan / 1000.0))
+                                if makespan > 0 else 0),
+        "bulk_queued": counters["queued"],
+        "bulk_shed": counters["shed"],
+        "makespan_ms": round(makespan, 3),
+        # zero starved foreground pieces is the no-deadlock acceptance
+        "fg_starved": fg_pieces - len(fg_latencies),
+    }
+
+
+def _run_pr11(args) -> dict:
+    """The PR-11 trajectory point: multi-tenant QoS under contention. A
+    plain baseline sim rides along as the QoS-disabled digest gate
+    (byte-identical to BENCH_pr3 — arming none of the class machinery
+    must leave the scheduler untouched). Acceptance
+    (tests/test_dfbench.py): foreground `critical` p99 with QoS on stays
+    within 1.5x of its UNCONTENDED baseline while the same herd without
+    QoS blows it out by an order of magnitude; bulk throughput DEGRADES
+    (lower than the no-QoS free-for-all) instead of the pod deadlocking
+    (zero starved foreground pieces, sheds counted not wedged)."""
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    # full shape over-subscribes the governor gate (16 workers against
+    # 4 active + 8 queued slots) so the committed point exercises the
+    # WHOLE ladder including shed; smoke stays inside the queue
+    shape = dict(seed=args.seed,
+                 fg_pieces=8 if args.smoke else 32,
+                 bulk_workers=6 if args.smoke else 16,
+                 piece_size=(256 << 10) if args.smoke else (4 << 20))
+    uncontended = run_qos_bench(**shape, qos=True, contended=False)
+    contended_no_qos = run_qos_bench(**shape, qos=False, contended=True)
+    contended_qos = run_qos_bench(**shape, qos=True, contended=True)
+    base_p99 = max(uncontended["fg_latency_ms"]["p99"], 1e-9)
+    ratio_qos = round(contended_qos["fg_latency_ms"]["p99"] / base_p99, 4)
+    ratio_no_qos = round(
+        contended_no_qos["fg_latency_ms"]["p99"] / base_p99, 4)
+    scenarios = {"uncontended": uncontended,
+                 "contended_no_qos": contended_no_qos,
+                 "contended_qos": contended_qos}
+    qos_digest = hashlib.sha256(json.dumps(
+        scenarios, sort_keys=True).encode()).hexdigest()
+    return {
+        "bench": "dfbench-qos",
+        "seed": args.seed,
+        "fg_pieces": shape["fg_pieces"],
+        "bulk_workers": shape["bulk_workers"],
+        "piece_size": shape["piece_size"],
+        "uplink_bps": QOS_UPLINK_BPS,
+        # the scheduler sim never touched by the QoS plane: digest gate
+        # vs BENCH_pr3 (QoS disabled == byte-identical schedule)
+        "schedule_digest": base["schedule_digest"],
+        "scenarios": scenarios,
+        "fg_p99_ratio_qos": ratio_qos,
+        "fg_p99_ratio_no_qos": ratio_no_qos,
+        "fg_holds_slo": ratio_qos <= 1.5,
+        "bulk_degrades": (contended_qos["bulk_throughput_bps"]
+                          < contended_no_qos["bulk_throughput_bps"]),
+        "bulk_shed": contended_qos["bulk_shed"],
+        "bulk_queued": contended_qos["bulk_queued"],
+        "fg_starved": contended_qos["fg_starved"],
+        "qos_digest": qos_digest,
+    }
+
+
 # --------------------------------------------------------------- PR-10
 # Content-store churn harness: rolling-restart churn + repeated hot-model
 # pulls under ALIAS URLs (same content, different task ids), driven through
@@ -1137,6 +1380,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "the first epoch, alias transfer bytes, disk "
                    "boundedness, and the scheduler digest gate against "
                    "BENCH_pr3")
+    p.add_argument("--pr11", action="store_true",
+                   help="drive the multi-tenant QoS contended scenario "
+                   "(critical foreground vs bulk herd on one feeder "
+                   "uplink, real class-share arithmetic) and write the "
+                   "PR-11 trajectory point (BENCH_pr11.json): per-class "
+                   "p50/p99, foreground p99 vs its uncontended baseline, "
+                   "bulk degradation + shed counts, and the QoS-disabled "
+                   "digest gate against BENCH_pr3")
     p.add_argument("--pr8", action="store_true",
                    help="replay the baseline run's decision-ledger rows "
                    "through every offline evaluator (default/nt/ml) and "
@@ -1181,7 +1432,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr10:
+        if args.pr11:
+            args.out = "BENCH_pr11.json"
+        elif args.pr10:
             args.out = "BENCH_pr10.json"
         elif args.pr9:
             args.out = "BENCH_pr9.json"
@@ -1199,7 +1452,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr10:
+    if args.pr11:
+        result = _run_pr11(args)
+    elif args.pr10:
         result = _run_pr10(args)
     elif args.pr9:
         result = _run_pr9(args)
@@ -1220,7 +1475,17 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr10:
+        if args.pr11:
+            print(f"dfbench: wrote {args.out} (fg p99 ratio: "
+                  f"qos={result['fg_p99_ratio_qos']}x vs "
+                  f"no_qos={result['fg_p99_ratio_no_qos']}x of "
+                  f"uncontended; holds_slo={result['fg_holds_slo']}, "
+                  f"bulk degrades={result['bulk_degrades']} "
+                  f"(shed {result['bulk_shed']}, queued "
+                  f"{result['bulk_queued']}), starved fg="
+                  f"{result['fg_starved']}, "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.pr10:
             print(f"dfbench: wrote {args.out} (origin after epoch 0: "
                   f"{result['origin_bytes_after_first_epoch']} B vs "
                   f"baseline "

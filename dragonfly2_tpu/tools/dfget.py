@@ -36,6 +36,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--priority", type=int, default=0, choices=range(7),
                    help="download priority LEVEL0 (highest) .. LEVEL6; "
                    "0 also means 'resolve via the application table'")
+    p.add_argument("--tenant", default="",
+                   help="tenant this download is accounted to "
+                   "(quotas, per-tenant QoS attribution)")
+    p.add_argument("--qos-class", default="", dest="qos_class",
+                   choices=("", "critical", "standard", "bulk"),
+                   help="QoS service class: critical (latency-sensitive "
+                   "foreground), standard (default), bulk (background — "
+                   "throttled/queued/shed first under brownout)")
     p.add_argument("--header", action="append", default=[],
                    help="extra origin header K:V (repeatable)")
     p.add_argument("--filter", action="append", default=[],
@@ -61,7 +69,9 @@ def _meta(args) -> UrlMeta:
     return UrlMeta(digest=args.digest, tag=args.tag, range=args.range_,
                    application=args.application, header=header or None,
                    filtered_query_params=args.filter or None,
-                   priority=Priority(args.priority))
+                   priority=Priority(args.priority),
+                   tenant=getattr(args, "tenant", ""),
+                   qos_class=getattr(args, "qos_class", ""))
 
 
 async def _daemon_alive(sock: str) -> bool:

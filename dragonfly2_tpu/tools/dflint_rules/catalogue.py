@@ -242,6 +242,99 @@ class DecisionVocabulary(Rule):
                     f"(line {declared_line})")
 
 
+_CLASS_USE_RES = (
+    # qos_class == / != / = "x"  (comparisons, assignments, kwargs)
+    re.compile(r"qos_class\s*(?:==|!=|=)\s*[\"']([a-z_]+)[\"']"),
+    # getattr(x, "qos_class", "x") / d.get("qos_class", "x") defaults
+    re.compile(r"(?:getattr\([^)]*|\.get\(\s*)"
+               r"[\"']qos_class[\"']\s*,\s*[\"']([a-z_]+)[\"']"),
+    # cls == / != "x"  (the short-name form the hot paths use)
+    re.compile(r"\bcls\s*(?:==|!=)\s*[\"']([a-z_]+)[\"']"),
+)
+
+
+@register
+class PriorityClassVocabulary(Rule):
+    """DF006 (QoS classes): the multi-tenant service-class vocabulary
+    must stay closed and documented — the ``PRIORITY_CLASSES`` registry
+    in ``idl/messages.py``, every class literal any surface binds or
+    compares to a ``qos_class``/``cls`` (admission gates, shaper splits,
+    scheduler rulings, metric labels), and the backticked vocabulary in
+    docs/OBSERVABILITY.md / docs/RESILIENCE.md must agree. Same contract
+    as the exclusion-reason lint: an unregistered class is an invisible
+    metric label and an unenforceable quota row; an undocumented one is
+    a ``df_qos_*`` dimension operators cannot read.
+
+    Incident (PR 11): the QoS plane threads one class string through
+    eleven surfaces across four services — one typo'd literal at any of
+    them would silently route traffic as ``standard`` (resolve_class
+    clamps unknowns by design) and the brownout would never engage for
+    it.
+    """
+
+    code = "DF006"
+    name = "priority-class-vocabulary"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if not ctx.rel.replace(os.sep, "/").endswith("idl/messages.py"):
+            return
+        declared: dict[str, int] = {}
+        declared_line = 1
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "PRIORITY_CLASSES"
+                            for t in node.targets)):
+                continue
+            declared_line = node.lineno
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) \
+                        and isinstance(const.value, str):
+                    declared[const.value] = const.lineno
+        if not declared:
+            return
+        # package-wide surface sweep, rooted at the package holding this
+        # file (…/idl/messages.py -> …/) so fixtures self-contain
+        pkg_root = os.path.dirname(os.path.dirname(ctx.path))
+        used: dict[str, str] = {}
+        for dirpath, dirs, files in os.walk(pkg_root):
+            # the analyzer's own rule definitions carry the patterns as
+            # examples — sweeping them would lint the linter
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", "dflint_rules")]
+            for name in files:
+                if not name.endswith(".py") or name == "messages.py":
+                    continue
+                fpath = os.path.join(dirpath, name)
+                try:
+                    with open(fpath, encoding="utf-8") as f:
+                        text = f.read()
+                except OSError:
+                    continue
+                for rx in _CLASS_USE_RES:
+                    for m in rx.finditer(text):
+                        used.setdefault(m.group(1), fpath)
+        docs = _ticked(ctx, "OBSERVABILITY.md") \
+            | _ticked(ctx, "RESILIENCE.md")
+        for cls, line in sorted(declared.items()):
+            if cls not in docs:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"priority class {cls!r} is not backticked in "
+                    f"docs/OBSERVABILITY.md or docs/RESILIENCE.md — a "
+                    f"service class operators cannot read about cannot "
+                    f"be operated")
+        for cls in sorted(set(used) - set(declared)):
+            yield Finding(
+                self.code, ctx.rel, declared_line, 0,
+                f"class literal {cls!r} is bound/compared to a "
+                f"qos_class surface in "
+                f"{os.path.relpath(used[cls], pkg_root)} but is not in "
+                f"the PRIORITY_CLASSES registry — resolve_class would "
+                f"silently clamp it to 'standard' and the QoS plane "
+                f"would never engage for it")
+
+
 @register
 class FaultgateSites(Rule):
     """DF006 (faultgate): the site registry, the ``faultgate.fire(…)``

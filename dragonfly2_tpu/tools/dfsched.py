@@ -118,6 +118,15 @@ def render_decision(d: dict, *, max_candidates: int = 10) -> str:
         if len(cands) > max_candidates:
             out.append(f"     … +{len(cands) - max_candidates} more "
                        f"candidates")
+    elif d.get("decision_kind") == "preempt":
+        pre = d.get("preempted") or {}
+        out.append(
+            f"  preempted: {pre.get('victim_class', '?')} child "
+            f"{pre.get('victim_peer_id', '?')[-16:]}"
+            + (f" (tenant {pre['victim_tenant']})"
+               if pre.get("victim_tenant") else "")
+            + f" lost parent {pre.get('parent_id', '?')[-16:]} so this "
+            f"{d.get('qos_class', 'critical')} child could schedule")
     else:
         out.append("  (no legal candidates — every parent filtered)")
     excl = d.get("excluded") or []

@@ -40,31 +40,83 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def parse_class_mix(specs: list[str], concurrency: int) -> list[tuple]:
+    """``--priority`` specs -> per-class worker allocation.
+
+    Each spec is ``class`` or ``class:workers`` (class from the pinned
+    PRIORITY_CLASSES vocabulary). With no spec every worker runs
+    classless, the pre-QoS behavior. Workers left unallocated by
+    explicit counts run as ``standard``."""
+    from ..idl.messages import PRIORITY_CLASSES
+    if not specs:
+        return [("", concurrency)]
+    out: list[tuple] = []
+    used = 0
+    for spec in specs:
+        cls, _, n = spec.partition(":")
+        if cls not in PRIORITY_CLASSES:
+            raise SystemExit(
+                f"stress: unknown class {cls!r} in --priority "
+                f"(known: {list(PRIORITY_CLASSES)})")
+        workers = int(n) if n else 1
+        out.append((cls, workers))
+        used += workers
+    if used < concurrency:
+        out.append(("standard", concurrency - used))
+    return out
+
+
+def _class_stats() -> dict:
+    return {"requests": 0, "errors": 0, "shed": 0, "bytes": 0,
+            "latencies": []}
+
+
 async def run_stress(url: str, *, proxy: str = "", concurrency: int = 8,
                      duration_s: float = 10.0,
-                     connect_timeout_s: float = 10.0) -> dict:
+                     connect_timeout_s: float = 10.0,
+                     tenant: str = "",
+                     class_mix: list[tuple] | None = None) -> dict:
     import aiohttp
 
     deadline = time.monotonic() + duration_s
-    latencies: list[float] = []
-    state = {"requests": 0, "errors": 0, "bytes": 0}
+    mix = class_mix or [("", concurrency)]
+    per_class: dict[str, dict] = {}
 
-    async def worker(session: aiohttp.ClientSession) -> None:
+    async def worker(session: aiohttp.ClientSession, cls: str) -> None:
+        stats = per_class.setdefault(cls or "", _class_stats())
+        headers = {}
+        if cls:
+            headers["X-Dragonfly-Class"] = cls
+        if tenant:
+            headers["X-Dragonfly-Tenant"] = tenant
         while time.monotonic() < deadline:
             t0 = time.monotonic()
             try:
-                async with session.get(url, proxy=proxy or None) as resp:
+                async with session.get(url, proxy=proxy or None,
+                                       headers=headers or None) as resp:
                     got = 0
                     async for chunk in resp.content.iter_chunked(1 << 20):
                         got += len(chunk)
-                    if resp.status not in (200, 206):
-                        state["errors"] += 1
+                    if resp.status == 429:
+                        # the QoS shed path (brownout / tenant quota):
+                        # counted apart from errors — a shed under
+                        # contention is the plane working, and honoring
+                        # Retry-After is what a well-behaved bulk
+                        # client does
+                        stats["shed"] += 1
+                        retry = resp.headers.get("Retry-After", "")
+                        pause = (float(retry) if retry.strip().isdigit()
+                                 else 0.5)
+                        await asyncio.sleep(min(pause, max(
+                            deadline - time.monotonic(), 0.0)))
+                    elif resp.status not in (200, 206):
+                        stats["errors"] += 1
                     else:
-                        state["bytes"] += got
-                        latencies.append(time.monotonic() - t0)
+                        stats["bytes"] += got
+                        stats["latencies"].append(time.monotonic() - t0)
             except Exception:  # noqa: BLE001 - counted, load goes on
-                state["errors"] += 1
-            state["requests"] += 1
+                stats["errors"] += 1
+            stats["requests"] += 1
 
     # sock_read: a server that stalls mid-body (what a stress tool exists
     # to expose) must count as an error, not hang the run past its deadline
@@ -72,18 +124,34 @@ async def run_stress(url: str, *, proxy: str = "", concurrency: int = 8,
                                     sock_read=max(duration_s, 10.0))
     async with aiohttp.ClientSession(timeout=timeout) as session:
         t0 = time.monotonic()
-        await asyncio.gather(*(worker(session) for _ in range(concurrency)))
+        workers = [worker(session, cls)
+                   for cls, n in mix for _ in range(n)]
+        await asyncio.gather(*workers)
         elapsed = time.monotonic() - t0
 
-    latencies.sort()
-    return {
+    latencies = sorted(lat for s in per_class.values()
+                       for lat in s["latencies"])
+    classes = {}
+    for cls, s in per_class.items():
+        lats = sorted(s.pop("latencies"))
+        classes[cls or "unclassed"] = {
+            **s,
+            "latency_ms": {
+                "p50": round(_percentile(lats, 0.50) * 1000, 1),
+                "p99": round(_percentile(lats, 0.99) * 1000, 1),
+            },
+        }
+    result = {
         "url": url,
         "concurrency": concurrency,
         "duration_s": round(elapsed, 2),
-        "requests": state["requests"],
-        "errors": state["errors"],
-        "bytes": state["bytes"],
-        "throughput_gbps": round(state["bytes"] / 1e9 / max(elapsed, 1e-9), 4),
+        "requests": sum(s["requests"] for s in classes.values()),
+        "errors": sum(s["errors"] for s in classes.values()),
+        "shed": sum(s["shed"] for s in classes.values()),
+        "bytes": sum(s["bytes"] for s in classes.values()),
+        "throughput_gbps": round(
+            sum(s["bytes"] for s in classes.values()) / 1e9
+            / max(elapsed, 1e-9), 4),
         "latency_ms": {
             "p50": round(_percentile(latencies, 0.50) * 1000, 1),
             "p90": round(_percentile(latencies, 0.90) * 1000, 1),
@@ -91,6 +159,12 @@ async def run_stress(url: str, *, proxy: str = "", concurrency: int = 8,
             "p99": round(_percentile(latencies, 0.99) * 1000, 1),
         },
     }
+    if tenant:
+        result["tenant"] = tenant
+    if len(classes) > 1 or "" not in per_class:
+        # per-class breakdown only when the run was actually classed
+        result["classes"] = classes
+    return result
 
 
 async def _run_with_chaos(args) -> dict:
@@ -124,7 +198,8 @@ async def _run_with_chaos(args) -> dict:
             faultgate.arm_script(args.chaos)
         return await run_stress(
             args.url, proxy=args.proxy, concurrency=args.concurrency,
-            duration_s=args.duration)
+            duration_s=args.duration, tenant=args.tenant,
+            class_mix=parse_class_mix(args.priority, args.concurrency))
     finally:
         if session is not None:
             try:
@@ -145,6 +220,17 @@ def main(argv: list[str] | None = None) -> int:
                         "http://127.0.0.1:65001")
     p.add_argument("-c", "--concurrency", type=int, default=8)
     p.add_argument("-d", "--duration", type=float, default=10.0)
+    p.add_argument("--tenant", default="",
+                   help="tenant the load is accounted to "
+                   "(X-Dragonfly-Tenant on every request)")
+    p.add_argument("--priority", action="append", default=[],
+                   metavar="CLASS[:WORKERS]",
+                   help="mixed-class load: allocate workers to a QoS "
+                   "class (critical/standard/bulk), repeatable — e.g. "
+                   "'--priority critical:2 --priority bulk:6'. The "
+                   "report then breaks out per-class p50/p99 latency "
+                   "and 429-shed counts. Unallocated workers run as "
+                   "standard; with no --priority the run is classless.")
     p.add_argument("--chaos", default="",
                    help="faultgate script to arm for the run, e.g. "
                         "'piece.wire=delay:0.2:n=-1' (docs/RESILIENCE.md)")

@@ -440,6 +440,92 @@ class TestPr10Store:
         assert not list(tmp_path.iterdir())      # stdout only
 
 
+class TestPr11Qos:
+    """PR-11 point: multi-tenant QoS under contention. The contended
+    fluid sim must be deterministic, the scheduler sim untouched with
+    QoS disarmed (digest == BENCH_pr3), foreground `critical` p99 must
+    hold within 1.5x of its uncontended baseline while the same herd
+    without QoS blows far past it, and `bulk` must DEGRADE (queued/shed,
+    lower throughput) rather than the pod deadlocking (zero starved
+    foreground pieces)."""
+
+    def test_qos_bench_deterministic(self):
+        from dragonfly2_tpu.tools.dfbench import run_qos_bench
+        a = run_qos_bench(seed=7, fg_pieces=8, bulk_workers=6,
+                          piece_size=256 << 10)
+        b = run_qos_bench(seed=7, fg_pieces=8, bulk_workers=6,
+                          piece_size=256 << 10)
+        assert a == b
+        c = run_qos_bench(seed=11, fg_pieces=8, bulk_workers=6,
+                          piece_size=256 << 10)
+        assert c != a
+
+    def test_contended_acceptance(self):
+        """The headline inequality chain, in-process: under one shared
+        uplink, QoS holds the foreground tail while fair-share does not,
+        and bulk pays for it in throughput — not in starvation."""
+        from dragonfly2_tpu.tools.dfbench import run_qos_bench
+        shape = dict(seed=7, fg_pieces=8, bulk_workers=6,
+                     piece_size=256 << 10)
+        unc = run_qos_bench(**shape, qos=True, contended=False)
+        noq = run_qos_bench(**shape, qos=False, contended=True)
+        qos = run_qos_bench(**shape, qos=True, contended=True)
+        base_p99 = unc["fg_latency_ms"]["p99"]
+        assert qos["fg_latency_ms"]["p99"] <= 1.5 * base_p99
+        assert noq["fg_latency_ms"]["p99"] > 3.0 * base_p99
+        assert qos["bulk_throughput_bps"] < noq["bulk_throughput_bps"]
+        # graceful: admission queued/shed, nothing starved or wedged
+        assert qos["bulk_queued"] > 0
+        assert qos["fg_starved"] == 0
+        assert noq["fg_starved"] == 0
+        # every bulk worker still makes progress under QoS (degradation,
+        # not starvation — the brownout contract)
+        assert qos["bulk_pieces_done"] > 0
+
+    def test_pr11_matches_committed_baselines(self, tmp_path):
+        """The committed trajectory gate: a default-size --pr11 run must
+        reproduce the committed qos_digest byte-for-byte, carry the
+        BENCH_pr3 schedule digest (QoS disarmed moves no scheduling),
+        and stamp every acceptance flag."""
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr11", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads((tmp_path / "BENCH_pr11.json").read_text())
+        assert r["bench"] == "dfbench-qos"
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["fg_holds_slo"] is True
+        assert r["bulk_degrades"] is True
+        assert r["fg_starved"] == 0
+        committed = json.loads(
+            open(os.path.join(REPO, "BENCH_pr11.json")).read())
+        assert r["qos_digest"] == committed["qos_digest"]
+        assert committed["schedule_digest"] == pr3["schedule_digest"]
+        assert committed["fg_holds_slo"] is True
+        assert committed["bulk_degrades"] is True
+        # the committed full-size point exercises the WHOLE ladder:
+        # the shed path fired and was counted, not wedged
+        assert committed["bulk_shed"] > 0
+        assert committed["fg_starved"] == 0
+
+    def test_pr11_smoke_stdout_only(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr11", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-qos"
+        assert r["fg_holds_slo"] is True
+        assert r["bulk_degrades"] is True
+        assert r["fg_starved"] == 0
+        assert not list(tmp_path.iterdir())      # stdout only
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(

@@ -509,6 +509,49 @@ class TestDF006DecisionVocabulary:
         assert codes(lint_file(str(mod), repo_root=str(tmp_path))) == []
 
 
+class TestDF006PriorityClasses:
+    def _tree(self, tmp_path, *, classes, used, obs_doc="", res_doc=""):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text(obs_doc)
+        (tmp_path / "docs" / "RESILIENCE.md").write_text(res_doc)
+        pkg = tmp_path / "pkg"
+        (pkg / "idl").mkdir(parents=True, exist_ok=True)
+        idl = pkg / "idl" / "messages.py"
+        names = ", ".join(f'"{c}"' for c in classes)
+        idl.write_text(f"PRIORITY_CLASSES = ({names},)\n")
+        lines = "\n".join(f'    if cls == "{c}":\n        pass'
+                          for c in used)
+        (pkg / "governor.py").write_text(
+            f"def admit(cls):\n{lines or '    pass'}\n")
+        return idl
+
+    def test_registered_used_documented_is_clean(self, tmp_path):
+        idl = self._tree(tmp_path, classes=["critical", "bulk"],
+                         used=["bulk"],
+                         obs_doc="classes: `critical`",
+                         res_doc="brownout sheds `bulk` first")
+        assert codes(lint_file(str(idl), repo_root=str(tmp_path))) == []
+
+    def test_undocumented_and_unregistered_flag(self, tmp_path):
+        idl = self._tree(tmp_path, classes=["critical", "bulk"],
+                         used=["bulk", "gold"],
+                         obs_doc="classes: `critical`")
+        fs = active(lint_file(str(idl), repo_root=str(tmp_path)))
+        msgs = " ".join(f.message for f in fs)
+        # 'bulk' declared but never backticked; 'gold' used at a
+        # surface but absent from the registry
+        assert "not backticked" in msgs
+        assert "'gold'" in msgs and "PRIORITY_CLASSES" in msgs
+        assert len(fs) == 2
+
+    def test_other_modules_are_not_the_registry(self, tmp_path):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text("")
+        mod = tmp_path / "other.py"
+        mod.write_text('PRIORITY_CLASSES = ("whatever",)\n')
+        assert codes(lint_file(str(mod), repo_root=str(tmp_path))) == []
+
+
 class TestDF006Faultgate:
     def _tree(self, tmp_path, *, sites, fired, res_doc):
         (tmp_path / "docs").mkdir(exist_ok=True)

@@ -656,7 +656,7 @@ class TestFaultControlPlane:
         args = argparse.Namespace(
             url="http://127.0.0.1:9/none", proxy="", concurrency=1,
             duration_s=0.0, duration=0.1, chaos="rpc.unary=fail:n=-1",
-            chaos_target="")
+            chaos_target="", tenant="", priority=[])
         result = asyncio.run(_run_with_chaos(args))
         assert result["requests"] == result["errors"]   # origin is dead
         assert not faultgate.ARMED                      # always disarmed
@@ -689,6 +689,218 @@ class TestReportDropAccounting:
             assert ss._report_dropped.value() == before + 1
             assert FakeConductor.flight.report_drops == 1
             assert session._out.qsize() == 0
+
+        asyncio.run(go())
+
+
+class TestQosChaos:
+    """Multi-tenant QoS under chaos (docs/RESILIENCE.md 'QoS and
+    graceful brownout'): a noisy tenant must degrade — 429-shaped sheds,
+    queued admissions — while foreground `critical` work completes P2P
+    inside its SLO budget, and a daemon dying mid-preemption must strand
+    no work."""
+
+    def test_quota_storm_sheds_while_critical_completes_p2p(self, tmp_path):
+        """A tenant storming past its max_running quota gets
+        RESOURCE_EXHAUSTED sheds (the wire form of the 429 contract)
+        while a concurrent `critical` pull rides the mesh to completion
+        with zero origin bytes and zero SLO breaches."""
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.daemon.config import (
+            SchedulerConfig as DaemonSchedCfg)
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest, UrlMeta
+        from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+        from dragonfly2_tpu.scheduler.config import SeedPeerAddr
+
+        async def go():
+            data = os.urandom((2 << 20) + 333)
+            files = {f"storm{i}.bin": data for i in range(4)}
+            files["hot.bin"] = data
+            origin, base = await start_origin(files)
+            seed_cfg = daemon_config(tmp_path, "seed")
+            seed_cfg.is_seed = True
+            seed = Daemon(seed_cfg)
+            await seed.start()
+            sched = Scheduler(SchedulerConfig(seed_peers=[SeedPeerAddr(
+                ip="127.0.0.1", rpc_port=seed.rpc.port,
+                download_port=seed.upload_server.port)]))
+            await sched.start()
+            # the manager-fed quota table, injected directly (dynconfig's
+            # job in production): one running download for 'noisy'
+            sched.service.tenants = {
+                "noisy": {"qos_class": "bulk", "max_running": 1,
+                          "shed_retry_after_ms": 50}}
+            leech_cfg = daemon_config(tmp_path, "leech")
+            leech_cfg.scheduler = DaemonSchedCfg(
+                addresses=[sched.address], schedule_timeout_s=20.0)
+            leech = Daemon(leech_cfg)
+            await leech.start()
+            try:
+                async def pull(name, meta, out):
+                    async for _ in leech.ptm.start_file_task(
+                            DownloadRequest(
+                                url=f"{base}/{name}",
+                                output=str(tmp_path / out),
+                                url_meta=meta,
+                                disable_back_source=True,
+                                timeout_s=30.0)):
+                        pass
+
+                # the storm: 4 concurrent bulk pulls by the quota-1 tenant
+                storm = [asyncio.create_task(pull(
+                    f"storm{i}.bin",
+                    UrlMeta(tenant="noisy", qos_class="bulk"),
+                    f"storm{i}.out")) for i in range(4)]
+                await asyncio.sleep(0.1)
+                # the foreground pull, mid-storm
+                await pull("hot.bin",
+                           UrlMeta(tenant="svc", qos_class="critical"),
+                           "hot.out")
+                assert (tmp_path / "hot.out").read_bytes() == data
+                results = await asyncio.gather(*storm,
+                                               return_exceptions=True)
+                sheds = [r for r in results
+                         if isinstance(r, DFError)
+                         and r.code == Code.RESOURCE_EXHAUSTED]
+                # the quota BIT: most of the storm was shed with the
+                # coded 429 equivalent, none of it wedged
+                assert len(sheds) >= 2, results
+                assert all(isinstance(r, (DFError, type(None)))
+                           for r in results)
+                # the critical pull was untouched: 100% P2P, its class
+                # rode the flight summary, and it held its (tightened)
+                # SLO budgets
+                from dragonfly2_tpu.common import ids
+                task = ids.task_id(f"{base}/hot.bin")
+                conductor = leech.ptm.conductor(task)
+                assert conductor.state == conductor.SUCCESS
+                assert conductor.traffic_source == 0
+                assert conductor.qos_class == "critical"
+                summary = leech.flight_recorder.get(task).summarize()
+                assert summary["qos_class"] == "critical"
+                assert summary["slo_breaches"] == {}
+            finally:
+                await leech.stop()
+                await sched.stop()
+                await seed.stop()
+                await origin.cleanup()
+
+        asyncio.run(go())
+
+    def test_mid_preemption_daemon_kill_strands_no_pieces(self, tmp_path):
+        """The full preemption story under churn: a bulk child holds the
+        seed's ONLY upload slot; a critical child joins, starves, and
+        preempts the bulk edge (ledger-visible). The critical daemon
+        then dies mid-pull. The preempted bulk child must RE-DISPATCH
+        its pieces — reacquiring the freed seed slot — and finish the
+        task byte-identical with zero origin bytes: preemption plus a
+        kill re-routes work, it never orphans it."""
+        from test_daemon_e2e import daemon_config, start_origin
+
+        from dragonfly2_tpu.common import ids
+        from dragonfly2_tpu.daemon.config import (
+            SchedulerConfig as DaemonSchedCfg)
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.idl.messages import DownloadRequest, UrlMeta
+        from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+        from dragonfly2_tpu.scheduler.config import SeedPeerAddr
+
+        async def go():
+            data = os.urandom((10 << 20) + 123)      # 3 pieces
+            origin, base = await start_origin({"m.bin": data})
+            seed_cfg = daemon_config(tmp_path, "seed")
+            seed_cfg.is_seed = True
+            # a slowed seed uplink keeps the bulk child mid-first-piece
+            # (pieceless) long enough for the critical child to join
+            seed_cfg.upload.rate_limit_bps = int(2e6)
+            seed = Daemon(seed_cfg)
+            await seed.start()
+            # ONE scheduler-side seed upload slot (the seed-client stores
+            # the seed host with auto limits, so the cap must come from
+            # the cluster config): the bulk child's edge monopolizes it
+            sched = Scheduler(SchedulerConfig(
+                seed_peers=[SeedPeerAddr(
+                    ip="127.0.0.1", rpc_port=seed.rpc.port,
+                    download_port=seed.upload_server.port)],
+                seed_upload_limit=1))
+            await sched.start()
+
+            def mk_leech(name):
+                cfg = daemon_config(tmp_path, name)
+                cfg.scheduler = DaemonSchedCfg(
+                    addresses=[sched.address], schedule_timeout_s=60.0)
+                return Daemon(cfg)
+
+            bulk, crit = mk_leech("bulk"), mk_leech("crit")
+            await bulk.start()
+            await crit.start()
+            url = f"{base}/m.bin"
+            task = ids.task_id(url)
+            try:
+                async def pull(daemon, cls, out):
+                    async for _ in daemon.ptm.start_file_task(
+                            DownloadRequest(
+                                url=url, output=str(tmp_path / out),
+                                url_meta=UrlMeta(qos_class=cls,
+                                                 tenant=cls),
+                                disable_back_source=True,
+                                timeout_s=90.0)):
+                        pass
+
+                bulk_task = asyncio.create_task(
+                    pull(bulk, "bulk", "bulk.out"))
+                # wait until the bulk child actually HOLDS the seed's one
+                # upload slot (the DAG edge exists and the slot is gone) —
+                # a blind sleep races the edge formation both ways
+                deadline = time.monotonic() + 20.0
+                while True:
+                    assert time.monotonic() < deadline, \
+                        "bulk never acquired the seed edge"
+                    t = sched.resource.tasks.get(task)
+                    if t is not None:
+                        seed_peer = next(
+                            (p for p in t.peers.values()
+                             if p.host.msg.type.name != "NORMAL"), None)
+                        if (seed_peer is not None
+                                and seed_peer.host.free_upload_slots() == 0
+                                and t.dag.children(seed_peer.id)):
+                            break
+                    await asyncio.sleep(0.05)
+                crit_task = asyncio.create_task(
+                    pull(crit, "critical", "crit.out"))
+                # wait for the preemption ruling to land in the ledger
+                deadline = time.monotonic() + 20.0
+                while sched.ledger.by_kind.get("preempt", 0) == 0:
+                    assert time.monotonic() < deadline, \
+                        "preemption never fired"
+                    await asyncio.sleep(0.1)
+                # mid-preemption kill: the critical daemon dies with its
+                # pull (and the freshly preempted slot) in flight
+                crit_task.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await crit_task
+                await crit.stop()
+                # the preempted bulk child re-dispatches and completes —
+                # nothing orphaned, nothing from origin
+                await asyncio.wait_for(bulk_task, 120.0)
+                assert (tmp_path / "bulk.out").read_bytes() == data
+                conductor = bulk.ptm.conductor(task)
+                assert conductor.state == conductor.SUCCESS
+                assert conductor.traffic_source == 0
+                assert len(conductor.ready) == conductor.total_pieces
+                # the ruling is replayable: the preempt row names the
+                # bulk victim and the freed parent
+                rows = [r for r in sched.ledger._ring
+                        if r.get("decision_kind") == "preempt"]
+                assert rows and rows[0]["qos_class"] == "critical"
+                assert rows[0]["preempted"]["victim_class"] == "bulk"
+            finally:
+                await bulk.stop()
+                await sched.stop()
+                await seed.stop()
+                await origin.cleanup()
 
         asyncio.run(go())
 

@@ -426,6 +426,9 @@ class TestServeJournalGating:
         from dragonfly2_tpu.daemon.upload_server import UploadServer, _Slot
         srv = UploadServer.__new__(UploadServer)
         srv._active = 0
+        srv._active_cls = {}
+        srv.bulk_limit = 1
+        srv._bulk_waiters = []
         srv._transfer_ms = 0.0
         srv._transfer_ms_at = 0.0
         srv._slot_waiters = []

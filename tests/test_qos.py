@@ -1,0 +1,900 @@
+"""Multi-tenant QoS plane (PR 11): priority classes, per-tenant quotas,
+preemption, and graceful brownout.
+
+Covers, bottom up: the token-bucket edge cases the hierarchical shaper
+leans on (reserve/refund/_unreserve interleavings, set_rate shrink below
+outstanding reservations, zero/None burst); the class-share arithmetic
+and its shaper integration; the daemon admission governor's degradation
+ladder (normal -> brownout queue -> shed with retry-after, and the
+no-wedge discipline of its queue); the class-aware upload-slot gate; the
+class threading end to end (UrlMeta -> conductor -> piece GET ``?cls=``,
+surviving the scheduler-less pex synthetic-session rung); scheduler-side
+class resolution, tenant quotas, bulk preemption with decision-ledger
+rulings, and per-class relay fan-out caps; per-class SLO budgets; and
+class-weighted storage eviction.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dragonfly2_tpu.common.errors import Code, DFError
+from dragonfly2_tpu.common.rate import TokenBucket, class_shares
+from dragonfly2_tpu.idl.messages import (Host, HostType, PRIORITY_CLASSES,
+                                         RegisterPeerTaskRequest, UrlMeta,
+                                         resolve_class)
+
+
+# ---------------------------------------------------------------------------
+# common/rate.py edge cases (the surface the shaper layering leans on)
+# ---------------------------------------------------------------------------
+
+class TestTokenBucketEdges:
+    def test_none_burst_defaults_to_rate_with_floor(self):
+        assert TokenBucket(10).burst == 10.0
+        # sub-1 rates keep a workable burst floor of 1.0
+        assert TokenBucket(0.5).burst == 1.0
+
+    def test_zero_rate_means_unlimited_everywhere(self):
+        b = TokenBucket(0)
+        assert b.try_acquire(1 << 40)
+        assert b.reserve(1 << 40) == 0.0
+        b.refund(1 << 40)          # no-op, must not blow up or overflow
+        assert b.reserve(1) == 0.0
+
+    def test_reserve_goes_negative_and_prices_the_wait(self):
+        b = TokenBucket(100, burst=100)
+        assert b.reserve(100) == 0.0           # burst covers it
+        wait = b.reserve(50)                   # 50 tokens in debt
+        assert wait == pytest.approx(0.5, rel=0.05)
+
+    def test_reserve_refund_interleavings_restore_the_debt(self):
+        b = TokenBucket(100, burst=100)
+        b.reserve(100)                         # tokens ~0
+        w1 = b.reserve(100)                    # ~-100 -> ~1s
+        assert w1 == pytest.approx(1.0, rel=0.05)
+        b.refund(100)                          # cancelled transfer
+        # the debt is repaid: a new reservation prices like the first
+        w2 = b.reserve(100)
+        assert w2 == pytest.approx(1.0, rel=0.05)
+        # refund twice (the 404 + cancel paths can both fire) clamps at
+        # burst rather than minting free tokens
+        b.refund(100)
+        b.refund(100)
+        assert b._tokens <= b.burst + 1e-9
+        assert b.reserve(100) == pytest.approx(0.0, abs=0.01)
+
+    def test_unreserve_is_clamped_at_burst(self):
+        b = TokenBucket(100, burst=10)
+        b._unreserve(1000)
+        assert b._tokens == 10.0
+
+    def test_set_rate_shrink_below_outstanding_reservations(self):
+        b = TokenBucket(1000, burst=1000)
+        b.reserve(1000)
+        b.reserve(500)                         # ~-500 debt at rate 1000
+        b.set_rate(50)                         # rate collapses 20x
+        # burst followed the new rate; tokens stay in debt (clamped only
+        # from above) and the NEXT wait prices at the NEW rate
+        assert b.burst == 50.0
+        wait = b.reserve(0)
+        assert wait == pytest.approx(500 / 50, rel=0.1)
+        # refunding the cancelled transfer cannot exceed the new burst
+        b.refund(5000)
+        assert b._tokens <= b.burst + 1e-9
+
+    def test_acquire_cancellation_refunds(self):
+        async def main():
+            b = TokenBucket(100, burst=1)
+            await b.acquire(1)                 # drain the burst
+            t = asyncio.create_task(b.acquire(200))   # ~2s wait
+            await asyncio.sleep(0.01)
+            t.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await t
+            # the 200 tokens went back: a small acquire is ~instant again
+            assert b.reserve(0) <= 0.05
+        asyncio.run(main())
+
+
+class TestClassShares:
+    WEIGHTS = {"critical": 8.0, "standard": 3.0, "bulk": 1.0}
+
+    def test_idle_class_capacity_is_borrowed(self):
+        s = class_shares(90.0, self.WEIGHTS, {"bulk": 5.0})
+        assert s["bulk"] == 90.0 and s["critical"] == 0.0
+
+    def test_contended_split_follows_weights(self):
+        s = class_shares(90.0, self.WEIGHTS,
+                         {"critical": 1.0, "bulk": 1.0})
+        assert s["critical"] == pytest.approx(80.0)
+        assert s["bulk"] == pytest.approx(10.0)
+        assert sum(s.values()) == pytest.approx(90.0)
+
+    def test_zero_total_and_no_demand(self):
+        assert all(v == 0.0 for v in class_shares(
+            0.0, self.WEIGHTS, {"bulk": 1.0}).values())
+        assert all(v == 0.0 for v in class_shares(
+            90.0, self.WEIGHTS, {}).values())
+
+
+class TestShaperClassSplit:
+    def test_critical_out_earns_bulk_under_contention(self):
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+        sh = TrafficShaper(total_rate_bps=9e6)
+        sh.register("c" * 8, qos_class="critical", tenant="svc")
+        sh.register("b" * 8, qos_class="bulk", tenant="batch")
+        sh.record("c" * 8, 1 << 20)
+        sh.record("b" * 8, 1 << 20)
+        sh._retune()
+        crit = sh._tasks["c" * 8].rate
+        bulk = sh._tasks["b" * 8].rate
+        assert crit > 5 * bulk
+        assert crit + bulk == pytest.approx(9e6, rel=0.01)
+        # the bulk herd inherits the whole pipe once critical leaves
+        sh.unregister("c" * 8)
+        sh.record("b" * 8, 1 << 20)
+        sh._retune()
+        assert sh._tasks["b" * 8].rate == pytest.approx(9e6, rel=0.01)
+
+    def test_classless_registration_is_the_pre_qos_split(self):
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+        sh = TrafficShaper(total_rate_bps=8e6)
+        sh.register("x" * 8)
+        sh.register("y" * 8)
+        sh._retune()
+        # one (standard) class -> the old whole-budget two-way split
+        assert sh._tasks["x" * 8].rate + sh._tasks["y" * 8].rate \
+            == pytest.approx(8e6, rel=0.01)
+
+    def test_class_snapshot_attributes_tenants(self):
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+        sh = TrafficShaper(total_rate_bps=1e6)
+        sh.register("a" * 8, qos_class="bulk", tenant="noisy")
+        sh.record("a" * 8, 4096)
+        snap = sh.class_snapshot()
+        assert snap["bulk"]["tasks"] == 1
+        assert snap["bulk"]["tenants"]["noisy"]["consumed_bytes"] == 4096
+
+
+# ---------------------------------------------------------------------------
+# the admission governor's degradation ladder
+# ---------------------------------------------------------------------------
+
+def _governor(**kw):
+    from dragonfly2_tpu.daemon.qos import QosGovernor, QosSection
+    return QosGovernor(QosSection(**kw))
+
+
+class TestGovernor:
+    def test_non_bulk_is_never_blocked_or_shed(self):
+        async def main():
+            g = _governor(bulk_active_limit=1)
+            for _ in range(50):
+                cls, ruling = await g.admit("critical", "svc")
+                assert (cls, ruling) == ("critical", "ok")
+            assert g.active["critical"] == 50
+            for _ in range(50):
+                g.release("critical")
+            assert g.active["critical"] == 0
+        asyncio.run(main())
+
+    def test_unknown_class_clamps_to_standard(self):
+        async def main():
+            cls, _ = await _governor().admit("gold")
+            assert cls == "standard"
+        asyncio.run(main())
+
+    def test_bulk_brownout_queue_then_admit_on_release(self):
+        async def main():
+            g = _governor(bulk_active_limit=1, queue_wait_s=5.0)
+            assert await g.admit("bulk", "t1") == ("bulk", "ok")
+            waiter = asyncio.create_task(g.admit("bulk", "t2"))
+            await asyncio.sleep(0.02)
+            assert g.state == "brownout"
+            assert not waiter.done()
+            g.release("bulk")
+            assert await asyncio.wait_for(waiter, 1.0) \
+                == ("bulk", "queued")
+            assert g.counters["queued"] == 1
+            g.release("bulk")
+            assert g.state == "normal"
+        asyncio.run(main())
+
+    def test_foreground_pressure_browns_out_bulk(self):
+        async def main():
+            g = _governor(bulk_active_limit=8,
+                          brownout_critical_threshold=1,
+                          queue_wait_s=5.0)
+            await g.admit("critical", "svc")
+            waiter = asyncio.create_task(g.admit("bulk", "batch"))
+            await asyncio.sleep(0.02)
+            assert g.state == "brownout" and not waiter.done()
+            g.release("critical")           # pressure recedes
+            assert await asyncio.wait_for(waiter, 1.0) \
+                == ("bulk", "queued")
+        asyncio.run(main())
+
+    def test_shed_on_queue_timeout_carries_retry_after(self):
+        async def main():
+            g = _governor(bulk_active_limit=1, queue_wait_s=0.05,
+                          shed_retry_after_ms=1234)
+            await g.admit("bulk")
+            with pytest.raises(DFError) as exc:
+                await g.admit("bulk", "noisy")
+            assert exc.value.code == Code.RESOURCE_EXHAUSTED
+            assert exc.value.retry_after_ms == 1234
+            assert g.state == "shed"
+            assert g.counters["shed"]["bulk"] == 1
+            assert g.tenant_counters["noisy"]["shed"] == 1
+            # the shed path drained cleanly: a release recovers normal
+            g.release("bulk")
+            assert g.state == "normal"
+        asyncio.run(main())
+
+    def test_shed_immediately_when_queue_full(self):
+        async def main():
+            g = _governor(bulk_active_limit=1, queue_limit=0,
+                          queue_wait_s=5.0)
+            await g.admit("bulk")
+            with pytest.raises(DFError):
+                await g.admit("bulk")
+            assert g.counters["shed"]["bulk"] == 1
+        asyncio.run(main())
+
+    def test_cancelled_waiter_never_strands_a_wake(self):
+        """The upload-slot discipline: a bulk admission cancelled while
+        queued must hand any granted wake to the next live waiter, and
+        release() must skip dead futures."""
+        async def main():
+            g = _governor(bulk_active_limit=1, queue_wait_s=5.0)
+            await g.admit("bulk")
+            w1 = asyncio.create_task(g.admit("bulk", "a"))
+            w2 = asyncio.create_task(g.admit("bulk", "b"))
+            await asyncio.sleep(0.02)
+            w1.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await w1
+            g.release("bulk")
+            assert await asyncio.wait_for(w2, 1.0) == ("bulk", "queued")
+            g.release("bulk")
+            assert g.active["bulk"] == 0 and g.state == "normal"
+        asyncio.run(main())
+
+    def test_receding_pressure_wakes_every_waiter_with_headroom(self):
+        """A critical task finishing with several bulk admissions parked
+        must wake ALL of them (up to bulk headroom) in one release —
+        dripping one per release would shed the rest on their deadlines
+        while bulk slots sat idle."""
+        async def main():
+            g = _governor(bulk_active_limit=8,
+                          brownout_critical_threshold=1,
+                          queue_wait_s=5.0)
+            await g.admit("critical", "svc")
+            waiters = [asyncio.create_task(g.admit("bulk", f"t{i}"))
+                       for i in range(4)]
+            await asyncio.sleep(0.02)
+            assert all(not w.done() for w in waiters)
+            g.release("critical")
+            results = await asyncio.wait_for(
+                asyncio.gather(*waiters), 1.0)
+            assert all(r == ("bulk", "queued") for r in results)
+            assert g.active["bulk"] == 4
+            for _ in range(4):
+                g.release("bulk")
+            assert g.state == "normal"
+        asyncio.run(main())
+
+    def test_disabled_governor_admits_everything(self):
+        async def main():
+            g = _governor(enabled=False, bulk_active_limit=0)
+            for _ in range(20):
+                assert await g.admit("bulk") == ("bulk", "ok")
+        asyncio.run(main())
+
+    def test_snapshot_shape(self):
+        async def main():
+            g = _governor()
+            await g.admit("critical", "svc")
+            snap = g.snapshot()
+            assert snap["state"] == "normal"
+            assert snap["active"]["critical"] == 1
+            assert snap["tenants"]["svc"]["admitted"] == 1
+            assert set(snap["limits"]) >= {"bulk_active_limit",
+                                           "queue_wait_s"}
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# class-aware upload-slot gate
+# ---------------------------------------------------------------------------
+
+class TestUploadClassGate:
+    def test_bulk_capped_below_total_standard_still_served(self, tmp_path):
+        """With the bulk cap saturated but total slots free, a bulk GET
+        503s (counted as a QoS shed) while a standard GET on the same
+        gate is served — the reserved-headroom contract."""
+        import aiohttp
+
+        from dragonfly2_tpu.daemon.upload_server import UploadServer, _Slot
+        from dragonfly2_tpu.storage.manager import (StorageConfig,
+                                                    StorageManager)
+        from dragonfly2_tpu.storage.metadata import TaskMetadata
+
+        size = 32 << 10
+
+        async def main():
+            mgr = StorageManager(StorageConfig(data_dir=str(tmp_path)))
+            md = TaskMetadata(task_id="q" * 32, url="http://o/x",
+                              content_length=size, total_piece_count=1,
+                              piece_size=size)
+            ts = mgr.register_task(md)
+            ts.write_piece(0, 0, b"z" * size)
+            srv = UploadServer(mgr, host="127.0.0.1", concurrent_limit=4,
+                               bulk_concurrent_limit=1)
+            await srv.start()
+            try:
+                url = (f"http://127.0.0.1:{srv.port}/download/"
+                       f"{'q' * 3}/{'q' * 32}")
+                rng = {"Range": f"bytes=0-{size - 1}"}
+                held = _Slot(srv, cls="bulk")     # bulk cap saturated
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url, headers=rng,
+                                     params={"cls": "bulk"}) as r:
+                        assert r.status == 503
+                        assert "X-Retry-After-Ms" in r.headers
+                    async with s.get(url, headers=rng,
+                                     params={"cls": "standard"}) as r:
+                        assert r.status == 206
+                        assert await r.read() == b"z" * size
+                    # an unclassed child (pre-QoS peer) rides standard
+                    async with s.get(url, headers=rng) as r:
+                        assert r.status == 206
+                held.release()
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url, headers=rng,
+                                     params={"cls": "bulk"}) as r:
+                        assert r.status == 206
+                assert srv._active == 0
+                assert srv._active_cls.get("bulk", 0) == 0
+            finally:
+                await srv.stop()
+
+        asyncio.run(main())
+
+    def test_pass_on_slot_wakes_non_bulk_first(self):
+        """Direct wake-order unit on the queue discipline: with both
+        deques populated, a freed slot goes to the non-bulk waiter even
+        when the bulk waiter queued earlier."""
+        async def main():
+            from dragonfly2_tpu.daemon.upload_server import UploadServer
+
+            class _Mgr:
+                def get(self, _tid):
+                    return None
+            srv = UploadServer(_Mgr(), concurrent_limit=2,
+                               bulk_concurrent_limit=2)
+            srv._active = 2
+            loop = asyncio.get_running_loop()
+            bulk_fut = loop.create_future()
+            std_fut = loop.create_future()
+            srv._bulk_waiters.append(bulk_fut)
+            srv._slot_waiters.append(std_fut)
+            srv._pass_on_slot()
+            assert std_fut.done() and not bulk_fut.done()
+            srv._pass_on_slot()
+            assert bulk_fut.done()
+            # bulk at cap: a freed slot returns to capacity instead of
+            # waking a bulk waiter that could not start anyway
+            srv._active = 2
+            srv._active_cls["bulk"] = 2
+            parked = loop.create_future()
+            srv._bulk_waiters.append(parked)
+            srv._pass_on_slot()
+            assert not parked.done() and srv._active == 1
+            parked.cancel()
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# class threading end to end (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestClassPropagation:
+    def test_conductor_resolves_and_registers_class(self, tmp_path):
+        from dragonfly2_tpu.daemon.conductor import PeerTaskConductor
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+        from dragonfly2_tpu.storage.manager import (StorageConfig,
+                                                    StorageManager)
+
+        mgr = StorageManager(StorageConfig(data_dir=str(tmp_path)))
+        c = PeerTaskConductor(
+            task_id="t" * 64, peer_id="p1", url="http://o/x",
+            url_meta=UrlMeta(qos_class="bulk", tenant="batch"),
+            storage_mgr=mgr, piece_mgr=None)
+        assert c.qos_class == "bulk" and c.tenant == "batch"
+        sh = TrafficShaper(total_rate_bps=1e6)
+        c.attach_shaper(sh)
+        assert sh._tasks["t" * 64].cls == "bulk"
+        assert sh._tasks["t" * 64].tenant == "batch"
+        # storage metadata carries the class (eviction weighting)
+        c.set_content_info(1 << 16)
+        assert c.storage.md.qos_class == "bulk"
+        # unknown classes clamp to standard, never error
+        c2 = PeerTaskConductor(
+            task_id="u" * 64, peer_id="p1", url="http://o/y",
+            url_meta=UrlMeta(qos_class="gold"), storage_mgr=mgr,
+            piece_mgr=None)
+        assert c2.qos_class == "standard"
+        assert resolve_class("") == "standard"
+
+    def test_piece_get_carries_cls_param(self):
+        """The wire half: download_piece/span stamp ``?cls=`` so the
+        parent's class gate sees the requester's class."""
+        import aiohttp
+        from aiohttp import web
+
+        from dragonfly2_tpu.daemon.piece_downloader import PieceDownloader
+        from dragonfly2_tpu.common.bufpool import POOL
+        from dragonfly2_tpu.idl.messages import PieceInfo
+
+        seen = {}
+
+        async def main():
+            async def handler(request):
+                seen["cls"] = request.query.get("cls", "")
+                seen["peer"] = request.query.get("peerId", "")
+                return web.Response(status=206, body=b"x" * 16)
+
+            app = web.Application()
+            app.router.add_get("/download/{p}/{tid}", handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = runner.addresses[0][1]
+            dl = PieceDownloader(timeout_s=5.0)
+            try:
+                data, _ = await dl.download_piece(
+                    dst_addr=f"127.0.0.1:{port}", task_id="t" * 64,
+                    src_peer_id="me",
+                    piece=PieceInfo(piece_num=0, range_start=0,
+                                    range_size=16),
+                    qos_class="critical")
+                POOL.release(data)
+            finally:
+                await dl.close()
+                await runner.cleanup()
+            assert seen["cls"] == "critical"
+            # classless callers (pre-QoS) add no param at all
+            seen.clear()
+        asyncio.run(main())
+
+    def test_pex_synthetic_session_preserves_class(self):
+        """The pex rung replaces the scheduler session with a synthetic
+        one and a FRESH engine — the class must ride the conductor
+        through it untouched (it does: the engine reads
+        ``conductor.qos_class`` at dispatch time, not the session)."""
+        from dragonfly2_tpu.daemon.pex import PexGossiper
+        from dragonfly2_tpu.daemon.swarm_index import SwarmEntry, SwarmIndex
+
+        captured = {}
+
+        class _Engine:
+            async def pull(self, conductor, session):
+                captured["cls"] = conductor.qos_class
+                captured["tenant"] = conductor.tenant
+                captured["session"] = type(session).__name__
+                return True
+
+        class _Conductor:
+            task_id = "t" * 64
+            peer_id = "me"
+            qos_class = "bulk"
+            tenant = "batch"
+            flight = None
+            ready: set = set()
+            total_pieces = -1
+
+            class log:
+                info = staticmethod(lambda *a, **k: None)
+
+        async def main():
+            index = SwarmIndex(ttl_s=60.0)
+            index.update("t" * 64, SwarmEntry(
+                host_id="h1", ip="127.0.0.1", rpc_port=7, download_port=8,
+                done=True, total_pieces=4, content_length=1 << 16,
+                piece_size=1 << 14,
+                expires_at=time.monotonic() + 60.0))
+            pex = PexGossiper(
+                storage_mgr=None,
+                host_info=lambda: Host(id="me-host", ip="127.0.0.1"),
+                index=index, engine_factory=_Engine)
+            assert await pex.try_pull(_Conductor()) is True
+            assert captured["cls"] == "bulk"
+            assert captured["tenant"] == "batch"
+            assert captured["session"] == "_PexSession"
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# scheduler: class resolution, quotas, preemption, fan-out caps
+# ---------------------------------------------------------------------------
+
+def _service(**cfg_kw):
+    from dragonfly2_tpu.scheduler.config import SchedulerConfig
+    from dragonfly2_tpu.scheduler.evaluator import Evaluator
+    from dragonfly2_tpu.scheduler.resource import Resource
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling
+    from dragonfly2_tpu.scheduler.seed_client import SeedPeerClient
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+    from dragonfly2_tpu.scheduler.topology_store import TopologyStore
+    cfg = SchedulerConfig(**cfg_kw)
+    res = Resource()
+    return SchedulerService(cfg, res, Scheduling(cfg, Evaluator()),
+                            SeedPeerClient(res, []), TopologyStore())
+
+
+def _register_req(task_no: int, peer_no: int, meta: UrlMeta,
+                  host_id: str = "") -> RegisterPeerTaskRequest:
+    return RegisterPeerTaskRequest(
+        task_id=f"{task_no:064d}", url=f"http://o/f{task_no}",
+        peer_id=f"peer-{task_no}-{peer_no}", url_meta=meta,
+        peer_host=Host(id=host_id or f"h{task_no}-{peer_no}",
+                       ip="127.0.0.1", port=1, download_port=2,
+                       type=HostType.NORMAL))
+
+
+class TestSchedulerClassResolution:
+    def test_register_stamps_class_tenant_and_bulk_priority(self):
+        async def main():
+            svc = _service()
+            await svc.register_peer_task(_register_req(
+                1, 1, UrlMeta(qos_class="bulk", tenant="batch")), None)
+            peer = svc.resource.find_peer(f"{1:064d}", "peer-1-1")
+            assert peer.qos_class == "bulk"
+            assert peer.tenant == "batch"
+            # bulk sinks to LEVEL6 by default (GC + back-source ordering)
+            assert peer.priority == 6
+            # explicit priority still wins over the class default
+            await svc.register_peer_task(_register_req(
+                2, 1, UrlMeta(qos_class="bulk", priority=3)), None)
+            assert svc.resource.find_peer(f"{2:064d}",
+                                          "peer-2-1").priority == 3
+        asyncio.run(main())
+
+    def test_tenant_default_class_applies_to_classless_requests(self):
+        async def main():
+            svc = _service()
+            svc.tenants = {"batch": {"qos_class": "bulk",
+                                     "max_running": 0}}
+            await svc.register_peer_task(_register_req(
+                3, 1, UrlMeta(tenant="batch")), None)
+            peer = svc.resource.find_peer(f"{3:064d}", "peer-3-1")
+            assert peer.qos_class == "bulk"
+        asyncio.run(main())
+
+
+class TestTenantQuota:
+    def test_max_running_sheds_with_retry_after(self):
+        async def main():
+            svc = _service()
+            svc.tenants = {"noisy": {"qos_class": "bulk",
+                                     "max_running": 2,
+                                     "shed_retry_after_ms": 777}}
+            meta = UrlMeta(tenant="noisy", qos_class="bulk")
+            await svc.register_peer_task(_register_req(10, 1, meta), None)
+            await svc.register_peer_task(_register_req(11, 1, meta), None)
+            with pytest.raises(DFError) as exc:
+                await svc.register_peer_task(
+                    _register_req(12, 1, meta), None)
+            assert exc.value.code == Code.RESOURCE_EXHAUSTED
+            assert exc.value.retry_after_ms == 777
+            # other tenants are untouched by noisy's quota
+            await svc.register_peer_task(_register_req(
+                13, 1, UrlMeta(tenant="calm")), None)
+            # a finished peer frees quota
+            from dragonfly2_tpu.scheduler.resource import PeerState
+            p = svc.resource.find_peer(f"{10:064d}", "peer-10-1")
+            p.transit(PeerState.RUNNING)
+            p.transit(PeerState.SUCCEEDED)
+            await svc.register_peer_task(_register_req(12, 1, meta), None)
+        asyncio.run(main())
+
+
+class TestPreemption:
+    def _mesh(self, svc):
+        """One task: a content-holding parent whose single upload slot is
+        taken by a bulk child, plus a waiting critical child."""
+        async def build():
+            from dragonfly2_tpu.scheduler.resource import PeerState
+            # parent with exactly ONE upload slot
+            req = _register_req(20, 1, UrlMeta())
+            req.peer_host.concurrent_upload_limit = 1
+            await svc.register_peer_task(req, None)
+            parent = svc.resource.find_peer(f"{20:064d}", "peer-20-1")
+            parent.transit(PeerState.RUNNING)
+            parent.finished_pieces = {0, 1}
+            await svc.register_peer_task(_register_req(
+                20, 2, UrlMeta(qos_class="bulk", tenant="batch")), None)
+            bulk = svc.resource.find_peer(f"{20:064d}", "peer-20-2")
+            bulk.transit(PeerState.RUNNING)
+            bulk.task.set_parents(bulk.id, [parent.id])
+            bulk.last_offer_ids = {parent.id}
+            await svc.register_peer_task(_register_req(
+                20, 3, UrlMeta(qos_class="critical", tenant="svc")), None)
+            crit = svc.resource.find_peer(f"{20:064d}", "peer-20-3")
+            crit.transit(PeerState.RUNNING)
+            return parent, bulk, crit
+        return build()
+
+    def test_critical_preempts_bulk_edge_and_ruling_rides_ledger(self):
+        async def main():
+            svc = _service()
+            rows = []
+            svc.scheduling.decision_sink = rows.append
+            parent, bulk, crit = await self._mesh(svc)
+            task = crit.task
+            # slots exhausted: the only legal offer is the pieceless
+            # bulk sibling — no CONTENT HOLDER is reachable (starvation)
+            assert parent.host.free_upload_slots() == 0
+            offer = svc.scheduling.find_parents(crit)
+            assert not any(p.has_content() for p in offer)
+            victim = svc.scheduling.preempt_for(crit)
+            assert victim is bulk
+            # the bulk edge is gone, the slot freed, pieces kept
+            assert parent.id not in task.dag.parents(bulk.id)
+            assert parent.host.free_upload_slots() == 1
+            assert parent in svc.scheduling.find_parents(crit)
+            pre = [r for r in rows if r["decision_kind"] == "preempt"]
+            assert len(pre) == 1
+            assert pre[0]["qos_class"] == "critical"
+            assert pre[0]["tenant"] == "svc"
+            assert pre[0]["preempted"]["victim_peer_id"] == bulk.id
+            assert pre[0]["preempted"]["victim_tenant"] == "batch"
+            assert pre[0]["preempted"]["parent_id"] == parent.id
+        asyncio.run(main())
+
+    def test_standard_child_never_preempts(self):
+        async def main():
+            svc = _service()
+            parent, bulk, crit = await self._mesh(svc)
+            crit.qos_class = "standard"
+            assert svc.scheduling.preempt_for(crit) is None
+            assert parent.id in crit.task.dag.parents(bulk.id)
+        asyncio.run(main())
+
+    def test_preemption_can_be_disabled(self):
+        async def main():
+            svc = _service(qos_preemption=False)
+            parent, bulk, crit = await self._mesh(svc)
+            assert svc.scheduling.preempt_for(crit) is None
+        asyncio.run(main())
+
+    def test_patience_loop_schedules_critical_via_preemption(self):
+        """End to end through _schedule_with_patience: the critical child
+        gets a parents packet NOW (not a back-source verdict), and the
+        victim is pushed its shrunk assignment."""
+        async def main():
+            svc = _service()
+            parent, bulk, crit = await self._mesh(svc)
+            crit_sink: asyncio.Queue = asyncio.Queue()
+            bulk_sink: asyncio.Queue = asyncio.Queue()
+            crit.packet_sink = crit_sink
+            bulk.packet_sink = bulk_sink
+            await asyncio.wait_for(
+                svc._schedule_with_patience(crit, crit_sink), 5.0)
+            offer = crit_sink.get_nowait()
+            assert offer.code == 0
+            offered = [offer.main_peer.peer_id] + [
+                p.peer_id for p in (offer.candidate_peers or [])]
+            assert parent.id in offered
+            shrunk = bulk_sink.get_nowait()
+            ids = [p.peer_id for p in ([shrunk.main_peer]
+                                       if shrunk.main_peer else [])
+                   + (shrunk.candidate_peers or [])]
+            assert parent.id not in ids
+        asyncio.run(main())
+
+
+class TestClassFanoutCaps:
+    def test_bulk_fanout_capped_at_half(self):
+        from dragonfly2_tpu.scheduler.config import SchedulerConfig
+        from dragonfly2_tpu.scheduler.evaluator import Evaluator
+        from dragonfly2_tpu.scheduler.resource import (PeerState,
+                                                       Resource, Task)
+        from dragonfly2_tpu.scheduler.scheduling import Scheduling
+        from dragonfly2_tpu.idl.messages import Host as HostMsg
+
+        sched = Scheduling(SchedulerConfig(relay_fanout=4), Evaluator())
+        res = Resource()
+        task = Task("f" * 64, "http://o/f")
+        task.set_content_info(1 << 20, 1 << 18, 4)
+
+        def peer(name, cls="standard"):
+            host = res.store_host(HostMsg(
+                id=f"{name}-h", ip="1.1.1.1", port=1, download_port=2))
+            p = res.get_or_create_peer(name, task, host)
+            p.qos_class = cls
+            return p
+
+        parent = peer("parent")
+        parent.transit(PeerState.RUNNING)
+        parent.finished_pieces = {0, 1, 2, 3}
+        # parent already feeds 2 children
+        for i in range(2):
+            kid = peer(f"kid{i}")
+            task.set_parents(kid.id, [parent.id])
+        std = peer("std-child")
+        blk = peer("blk-child", cls="bulk")
+        # standard child: 2 < 4, parent not demoted
+        shaped, note = sched._relay_shape(std, [parent])
+        assert note is None
+        # bulk child: cap is relay_fanout // 2 == 2, parent demoted
+        shaped, note = sched._relay_shape(blk, [parent])
+        assert note is not None and note["fanout"] == 2
+        assert parent.id in note["capped"]
+        # explicit per-class caps win over the half-rule
+        sched.cfg.class_fanout_caps = {"bulk": 4}
+        shaped, note = sched._relay_shape(blk, [parent])
+        assert note is None
+        sched.cfg.class_fanout_caps = {}
+
+
+# ---------------------------------------------------------------------------
+# per-class SLO budgets + class-weighted eviction
+# ---------------------------------------------------------------------------
+
+class TestClassSloBudgets:
+    def test_budgets_scale_by_class(self):
+        from dragonfly2_tpu.common.health import SLOEngine
+        eng = SLOEngine({"wire": 100.0})
+        row = {"queue_ms": 0.0, "ttfb_ms": 0.0, "wire_ms": 150.0,
+               "hbm_ms": 0.0}
+        # standard/classless: 150 > 100 -> breach
+        assert eng.annotate({"piece_rows": [dict(row)]}
+                            )["slo_breaches"] == {"wire": 1}
+        # bulk gets 4x headroom: 150 < 400 -> clean, budgets annotated
+        s = eng.annotate({"piece_rows": [dict(row)], "qos_class": "bulk"})
+        assert s["slo_breaches"] == {}
+        assert s["slo_budgets_ms"]["wire"] == 400.0
+        # critical answers to HALF the budget: 60 > 50 -> breach
+        tight = dict(row, wire_ms=60.0)
+        s = eng.annotate({"piece_rows": [tight], "qos_class": "critical"})
+        assert s["slo_breaches"] == {"wire": 1}
+
+    def test_flight_summary_carries_class(self):
+        from dragonfly2_tpu.daemon.flight_recorder import TaskFlight
+        f = TaskFlight("t" * 64, "p1", qos_class="critical",
+                       tenant="svc")
+        s = f.summarize()
+        assert s["qos_class"] == "critical" and s["tenant"] == "svc"
+
+
+class TestClassWeightedEviction:
+    def test_popular_bulk_loses_to_less_popular_critical(self, tmp_path):
+        """Same priority band, bulk serving MORE bytes than critical —
+        the 16:1 class weight must still evict the bulk task first."""
+        from dragonfly2_tpu.storage.manager import (StorageConfig,
+                                                    StorageManager)
+        from dragonfly2_tpu.storage.metadata import TaskMetadata
+
+        mgr = StorageManager(StorageConfig(
+            data_dir=str(tmp_path), capacity_bytes=3_000_000,
+            disk_gc_high_ratio=0.5, disk_gc_low_ratio=0.4,
+            task_ttl_s=3600))
+        for i, cls in enumerate(["critical", "bulk"]):
+            payload = bytes([ord("a") + i]) * 1_000_000
+            md = TaskMetadata(task_id=f"{i:064x}", url=f"http://o/{i}",
+                              content_length=len(payload),
+                              total_piece_count=1,
+                              piece_size=len(payload),
+                              priority=0, qos_class=cls)
+            ts = mgr.register_task(md)
+            ts.write_piece(0, 0, payload)
+            ts.mark_done(success=True)
+        # bulk observed 4x the serve traffic of critical
+        mgr.castore.record_serve(f"{1:064x}", 4_000_000)
+        mgr.castore.record_serve(f"{0:064x}", 1_000_000)
+        assert mgr.try_gc() >= 1
+        kept = [ts.md.qos_class for ts in mgr.tasks()]
+        assert "critical" in kept and "bulk" not in kept, kept
+
+
+# ---------------------------------------------------------------------------
+# manager tenants + REST quota, dfdiag verdict, stress mix parsing
+# ---------------------------------------------------------------------------
+
+class TestManagerTenants:
+    def test_store_roundtrip_and_list_rpc(self, tmp_path):
+        async def main():
+            from dragonfly2_tpu.manager.service import ManagerService
+            from dragonfly2_tpu.manager.store import Store
+            store = Store(str(tmp_path / "m.db"))
+            store.upsert_tenant("batch", qos_class="bulk",
+                                max_running=8, shed_retry_after_ms=500)
+            store.upsert_tenant("svc", qos_class="critical")
+            store.upsert_tenant("typo", qos_class="gold")  # clamped
+            store.upsert_tenant("batch", qos_class="bulk", max_running=4,
+                                shed_retry_after_ms=500)   # upsert wins
+            svc = ManagerService(store)
+            resp = await svc.list_tenants(None, None)
+            rows = {t.name: t for t in resp.tenants}
+            assert rows["batch"].max_running == 4
+            assert rows["batch"].qos_class == "bulk"
+            assert rows["batch"].shed_retry_after_ms == 500
+            assert rows["svc"].qos_class == "critical"
+            assert rows["typo"].qos_class == ""
+        asyncio.run(main())
+
+    def test_rest_quota_429(self, tmp_path):
+        from dragonfly2_tpu.manager.auth import Authenticator
+        from dragonfly2_tpu.manager.store import Store
+        store = Store(str(tmp_path / "m.db"))
+        auth = Authenticator(store, rest_quota_rps=2.0,
+                             rest_quota_burst=2.0)
+        user = {"id": 1, "name": "noisy", "role": "root"}
+        assert auth.check_quota(user) == 0.0
+        assert auth.check_quota(user) == 0.0
+        retry = auth.check_quota(user)
+        assert retry >= 1.0
+        # quota is per identity: another tenant is unaffected
+        assert auth.check_quota({"id": 2, "name": "calm",
+                                 "role": "root"}) == 0.0
+        # off by default — the pre-QoS surface
+        assert Authenticator(store).check_quota(user) == 0.0
+
+
+class TestDfdiagQosVerdict:
+    def _snap(self, **kw):
+        snap = {"state": "brownout", "queued_now": 3,
+                "active": {"critical": 2, "standard": 0, "bulk": 0},
+                "shed": {"critical": 0, "standard": 0, "bulk": 5},
+                "admitted": {"critical": 2, "standard": 0, "bulk": 1},
+                "classes": {"critical": {"tenants": {
+                    "svc": {"consumed_bytes": 999}}}},
+                "tenants": {}}
+        snap.update(kw)
+        return snap
+
+    def test_names_starved_class_and_offending_tenant(self):
+        from dragonfly2_tpu.tools.dfdiag import qos_verdict, render_qos
+        text, breach = qos_verdict(self._snap())
+        assert "'bulk'" in text and "shed" in text
+        assert "'svc'" in text          # the offender, by consumption
+        assert breach is False          # bulk browning out = by design
+        assert "bulk" in render_qos(self._snap())
+
+    def test_starved_foreground_is_a_breach(self):
+        from dragonfly2_tpu.tools.dfdiag import qos_verdict
+        snap = self._snap(
+            active={"critical": 0, "standard": 0, "bulk": 4},
+            shed={"critical": 2, "standard": 0, "bulk": 0},
+            classes={"bulk": {"tenants": {
+                "batch": {"consumed_bytes": 777}}}})
+        text, breach = qos_verdict(snap)
+        assert breach is True
+        assert "'critical'" in text and "'batch'" in text
+
+    def test_healthy_plane_no_breach(self):
+        from dragonfly2_tpu.tools.dfdiag import qos_verdict
+        text, breach = qos_verdict(
+            {"state": "normal", "queued_now": 0, "active": {},
+             "shed": {}, "classes": {}})
+        assert breach is False and "no class is starved" in text
+
+
+class TestStressClassMix:
+    def test_parse_and_fill(self):
+        from dragonfly2_tpu.tools.stress import parse_class_mix
+        assert parse_class_mix([], 8) == [("", 8)]
+        mix = parse_class_mix(["critical:2", "bulk:4"], 8)
+        assert mix == [("critical", 2), ("bulk", 4), ("standard", 2)]
+        assert parse_class_mix(["bulk"], 1) == [("bulk", 1)]
+        with pytest.raises(SystemExit):
+            parse_class_mix(["gold:2"], 8)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

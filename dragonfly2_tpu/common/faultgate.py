@@ -25,6 +25,13 @@ stress tool can arm with deterministic scripts:
                     stops advancing mid-relay ('hang' parks the serve so
                     the child's piece deadline fires and the piece is
                     re-pulled from another holder)
+    upload.serve    daemon/upload_server.py piece-serve path, keyed by
+                    "<host_id>|<task_id>": a byzantine daemon —
+                    'corrupt' flips a byte in the served range so every
+                    child's landing verification rejects it (the swarm
+                    immune system's chaos lever; arm with pct= to poison
+                    a deterministic fraction of serves,
+                    ``stress.py --byzantine``)
 
 Script syntax (one clause per site, ';'-separated)::
 
@@ -34,6 +41,10 @@ Script syntax (one clause per site, ';'-separated)::
             code=<Code name|int>  DFError code raised      (default UNAVAILABLE)
             after_ms=<ms>       retry_after_ms hint on the raised error
             delay_s=<seconds>   sleep length for kind=delay
+            pct=<1-100>         fire on this percentage of matching
+                                attempts (deterministic striding, not
+                                random — attempt k fires iff
+                                floor(k*pct/100) > floor((k-1)*pct/100))
             <float>             positional shorthand for delay_s
             <int>               positional shorthand for n
 
@@ -74,6 +85,7 @@ SITES = frozenset({
     "sched.register",
     "pex.gossip",
     "relay.stall",
+    "upload.serve",
 })
 
 KINDS = frozenset({"fail", "error", "delay", "hang", "corrupt"})
@@ -90,17 +102,19 @@ class FaultScript:
     """One armed fault at one site, optionally key-scoped."""
 
     __slots__ = ("site", "kind", "key", "n", "code", "after_ms", "delay_s",
-                 "fired")
+                 "pct", "attempts", "fired")
 
     def __init__(self, site: str, kind: str, *, key: str = "", n: int = 1,
                  code: Code = Code.UNAVAILABLE, after_ms: int = 0,
-                 delay_s: float = 0.5):
+                 delay_s: float = 0.5, pct: int = 100):
         if site not in SITES:
             raise ValueError(f"unknown faultgate site {site!r} "
                              f"(known: {sorted(SITES)})")
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} "
                              f"(known: {sorted(KINDS)})")
+        if not 1 <= int(pct) <= 100:
+            raise ValueError(f"pct must be 1-100, got {pct!r}")
         self.site = site
         self.kind = kind
         self.key = key
@@ -108,10 +122,23 @@ class FaultScript:
         self.code = Code(code)
         self.after_ms = int(after_ms)
         self.delay_s = float(delay_s)
+        self.pct = int(pct)     # fire on this % of matching attempts
+        self.attempts = 0       # matching attempts seen (pct striding)
         self.fired = 0
 
     def matches(self, key: str) -> bool:
         return self.n != 0 and (not self.key or self.key in key)
+
+    def due(self) -> bool:
+        """Advance the deterministic pct stride: attempt k fires iff the
+        integer floor of k*pct/100 advanced — pct=100 fires every
+        attempt (the pre-pct behavior), pct=25 every 4th, with no rng
+        (chaos runs must replay)."""
+        self.attempts += 1
+        if self.pct >= 100:
+            return True
+        return (self.attempts * self.pct) // 100 \
+            > ((self.attempts - 1) * self.pct) // 100
 
     def consume(self) -> None:
         self.fired += 1
@@ -121,6 +148,7 @@ class FaultScript:
     def describe(self) -> dict:
         return {"site": self.site, "kind": self.kind, "key": self.key,
                 "remaining": self.n, "fired": self.fired,
+                "attempts": self.attempts, "pct": self.pct,
                 "code": self.code.name, "after_ms": self.after_ms,
                 "delay_s": self.delay_s}
 
@@ -180,6 +208,8 @@ def arm_script(text: str) -> list[FaultScript]:
                 kwargs["after_ms"] = int(value)
             elif name == "delay_s":
                 kwargs["delay_s"] = float(value)
+            elif name == "pct":
+                kwargs["pct"] = int(value)
             else:
                 raise ValueError(f"unknown faultgate arg {name!r} in {clause!r}")
         armed.append(arm(site.strip(), kind, **kwargs))
@@ -200,15 +230,30 @@ def status() -> dict:
 
 def _claim(site: str, key: str, *, kinds: frozenset | None = None
            ) -> FaultScript | None:
-    """Find-and-consume the first matching armed script."""
+    """Find-and-consume the first matching armed script. A matching
+    script whose pct stride says "not this attempt" counts the attempt
+    and yields no fire (later scripts still get a chance)."""
     with _lock:
         for s in _scripts:
             if s.site == site and s.matches(key) and (
                     kinds is None or s.kind in kinds):
+                if not s.due():
+                    continue
                 s.consume()
                 _recompute_armed()
                 return s
     return None
+
+
+def peek(site: str, key: str = "", *, kinds: frozenset | None = None) -> bool:
+    """True when an armed script WOULD match (site, key) — without
+    consuming a fire or advancing the pct stride. Call sites whose fast
+    path bypasses Python (the upload server's sendfile branch) use this
+    to route through the corruptible path only while a script is armed."""
+    with _lock:
+        return any(s.site == site and s.matches(key)
+                   and (kinds is None or s.kind in kinds)
+                   for s in _scripts)
 
 
 _RAISING = frozenset({"fail", "error"})

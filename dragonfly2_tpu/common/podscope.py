@@ -82,7 +82,8 @@ def collect_daemon(addr: str, *, timeout_s: float = 10.0,
                 f"{base}/debug/flight/{tid}", timeout_s)
         except (OSError, ValueError):
             continue            # flight evicted between index and fetch
-    for key, path in (("health", "/debug/health"), ("pex", "/debug/pex")):
+    for key, path in (("health", "/debug/health"), ("pex", "/debug/pex"),
+                      ("verdicts", "/debug/verdicts")):
         try:
             snap[key] = _get_json(f"{base}{path}", timeout_s)
         except (OSError, ValueError):
@@ -411,21 +412,62 @@ def aggregate(snapshots: list[dict]) -> dict:
             by_task.setdefault(tid, []).append((s["addr"], flight))
         if "error" in s:
             continue
-        # the per-daemon health/pex halves of the snapshot, compacted:
-        # a stalled loop or empty gossip view explains a bad tree
+        # the per-daemon health/pex/verdict halves of the snapshot,
+        # compacted: a stalled loop, empty gossip view, or shunned
+        # parent explains a bad tree
         health = s.get("health") or {}
         pex = s.get("pex") or {}
+        verdicts = s.get("verdicts") or {}
+        vparents = verdicts.get("parents") or {}
         daemons_detail[s["addr"]] = {
             "health_status": health.get("status", ""),
             "loop_max_lag_s": (health.get("loop") or {}).get(
                 "max_lag_s", 0.0),
             "pex_peers": len(pex.get("peers") or []),
             "flight_index": s.get("flight_index") or {},
+            "self_quarantined": bool(verdicts.get("self_quarantined")),
+            "shunned": sorted(a for a, row in vparents.items()
+                              if row.get("shunned")),
         }
     tasks = {tid: _aggregate_task(tid, holders)
              for tid, holders in sorted(by_task.items())}
 
+    # quarantine view: who the pod's local verdicts condemn, and whether
+    # a condemned address is STILL being offered (present as a holder in
+    # some daemon's swarm index — the exact re-poisoning loop the immune
+    # system exists to break)
+    shunned_by: dict[str, list[str]] = {}
+    selfq: list[str] = []
+    for addr, d in daemons_detail.items():
+        if d["self_quarantined"]:
+            selfq.append(addr)
+        for bad in d["shunned"]:
+            shunned_by.setdefault(bad, []).append(addr)
+    still_offered: dict[str, list[str]] = {}
+    for s in snapshots:
+        if "error" in s:
+            continue
+        swarm = ((s.get("pex") or {}).get("swarm") or {}).get("tasks") or {}
+        holder_addrs = {e.get("addr", "") for entries in swarm.values()
+                        for e in entries}
+        for bad in shunned_by:
+            if bad in holder_addrs:
+                still_offered.setdefault(bad, []).append(s["addr"])
+    quarantine = {
+        "self_quarantined": sorted(selfq),
+        "shunned": {bad: sorted(who) for bad, who in
+                    sorted(shunned_by.items())},
+        "still_offered": {bad: sorted(who) for bad, who in
+                          sorted(still_offered.items())},
+    }
+
     breaches: list[str] = []
+    for bad, where in sorted(still_offered.items()):
+        breaches.append(
+            f"poisoner_offered: {bad} is shunned by "
+            f"{'/'.join(shunned_by[bad])} on local corrupt verdicts but "
+            f"still indexed as a holder on {'/'.join(sorted(where))} — "
+            "the pod can be steered back at it")
     for addr, err in sorted(unreachable.items()):
         breaches.append(f"unreachable: {addr} ({err})")
     for addr, d in sorted(daemons_detail.items()):
@@ -461,6 +503,7 @@ def aggregate(snapshots: list[dict]) -> dict:
         "daemons_detail": daemons_detail,
         "unreachable": unreachable,
         "tasks": tasks,
+        "quarantine": quarantine,
         "breaches": breaches,
     }
     report["verdict"] = pod_verdict(report)
@@ -655,6 +698,16 @@ def pod_verdict(report: dict) -> str:
             parts.append(
                 f"task {tid[:12]}: {_fmt_bytes(t['placed_bytes'])} "
                 "dedupe-served from the content store (healthy-warm)")
+    q = report.get("quarantine") or {}
+    for addr in q.get("self_quarantined") or []:
+        parts.append(f"{addr} has SELF-QUARANTINED (its own storage "
+                     "failed re-verification): not advertising, flagged "
+                     "to the scheduler")
+    for bad, who in (q.get("shunned") or {}).items():
+        parts.append(f"{bad} is locally quarantined by {'/'.join(who)} "
+                     "on verified corrupt pieces"
+                     + (" — AND STILL OFFERED (see breaches)"
+                        if bad in (q.get("still_offered") or {}) else ""))
     breaches = report.get("breaches") or []
     if breaches:
         parts.append("BREACH " + "; BREACH ".join(breaches))

@@ -74,6 +74,15 @@ class Daemon:
         # per-class rate readout
         from .qos import QosGovernor
         self.qos = QosGovernor(cfg.qos, shaper=self.shaper)
+        # per-parent verdict ledger (daemon/verdicts.py): the local half
+        # of the swarm immune system — typed failure memory consulted by
+        # the engine's parent admission, the PEX rung, and self-quarantine
+        from .verdicts import VerdictLedger
+        self.verdicts = VerdictLedger()
+        if self.storage_mgr.castore is not None:
+            self.storage_mgr.castore.on_rot = lambda tid: \
+                self.verdicts.self_quarantine(
+                    f"cas placement re-verify failed (task {tid[:12]})")
         from .flight_recorder import FlightRecorder
         self.flight_recorder = FlightRecorder(
             enabled=cfg.flight.enabled, max_tasks=cfg.flight.max_tasks,
@@ -99,7 +108,8 @@ class Daemon:
                 index=SwarmIndex(ttl_s=cfg.pex.ttl_s),
                 interval_s=cfg.pex.interval_s, fanout=cfg.pex.fanout,
                 max_digest_tasks=cfg.pex.max_digest_tasks,
-                bootstrap=cfg.pex.bootstrap, relay=self.relay)
+                bootstrap=cfg.pex.bootstrap, relay=self.relay,
+                verdicts=self.verdicts)
         self.upload_server = UploadServer(
             self.storage_mgr, port=cfg.upload.port,
             rate_limit_bps=cfg.upload.rate_limit_bps,
@@ -108,7 +118,11 @@ class Daemon:
             bulk_concurrent_limit=cfg.upload.bulk_concurrent_limit,
             host=cfg.listen_ip, flight_recorder=self.flight_recorder,
             pex=self.pex, relay=self.relay,
-            relay_stall_s=cfg.download.relay_stall_s, qos=self.qos)
+            relay_stall_s=cfg.download.relay_stall_s, qos=self.qos,
+            verdicts=self.verdicts)
+        # scopes the upload.serve faultgate key (byzantine chaos) to THIS
+        # daemon even when several share one process (the test pod)
+        self.upload_server.host_id = f"{self.hostname}-{self.host_ip}"
         self._scheduler_factory = scheduler_factory
         self._p2p_engine_factory = p2p_engine_factory
         self.scheduler: Any = None
@@ -134,7 +148,11 @@ class Daemon:
             type=HostType.SUPER_SEED if self.cfg.is_seed else HostType.NORMAL,
             os=os.uname().sysname.lower(), platform=os.uname().machine,
             topology=self.topology,
-            concurrent_upload_limit=self.cfg.upload.concurrent_limit)
+            concurrent_upload_limit=self.cfg.upload.concurrent_limit,
+            # self-quarantine rides every register AND announce: the
+            # scheduler's quarantine registry treats the flag as hard
+            # evidence (this daemon verified its own bit-rot)
+            quarantined=self.verdicts.self_quarantined)
 
     def device_sink_builder(self, spec: DeviceSink):
         """Returns a factory(content_length) -> DeviceIngest honoring the
@@ -249,6 +267,19 @@ class Daemon:
                      "verified, %d dropped", self.storage_mgr.reloaded_tasks,
                      stats.get("pieces_ok", 0),
                      stats.get("pieces_dropped", 0))
+            if stats.get("pieces_rot", 0):
+                # ROT only — pieces of COMPLETED tasks that once verified
+                # and now hash wrong: the disk is lying, so self-
+                # quarantine (stop advertising in PEX, flag every
+                # announce) until an operator/restart re-verifies clean.
+                # Pulling still works: quarantine is about not SERVING.
+                # Drops from PARTIAL tasks are ordinary crash-torn writes
+                # (data is not fsynced per write) and heal silently —
+                # every unclean restart would otherwise sideline a
+                # healthy daemon pod-wide.
+                self.verdicts.self_quarantine(
+                    f"boot re-verify found {stats['pieces_rot']} "
+                    f"rotted piece(s) in completed tasks")
         if self.cfg.tracing.enabled:
             from ..common import tracing
             tracing.configure(
@@ -303,7 +334,8 @@ class Daemon:
                                 if self.topology else ""),
                     peer_observer=(self.pex.observe_parent
                                    if self.pex is not None else None),
-                    relay=self.relay)
+                    relay=self.relay,
+                    verdicts=self.verdicts)
         if self.pex is not None:
             # the pex rung builds a FRESH engine per pull (the scheduler
             # path may already have consumed the conductor's), and gossip

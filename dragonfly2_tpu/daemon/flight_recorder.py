@@ -56,6 +56,18 @@ CORRUPT = "corrupt"          # digest mismatch at landing (parent = sender):
 # the piece was requeued; repeated corrupt events from one parent are the
 # dfdiag fingerprint of a corrupting peer (bad NIC/disk), and the summary
 # counts them per parent so the verdict can name it
+# typed transfer-failure kinds (idl.FAIL_CODES minus corrupt, which has
+# its own richer event above): one event per failed fetch, parent = the
+# failing sender — the summary folds all four into ``fail_codes`` so
+# dfdiag and the ledger joins can learn from failure *kind*, not just a
+# bare ok=False
+STALL = "stall"              # transfer died mid-body (short read/reset)
+TIMEOUT = "timeout"          # per-piece deadline fired
+REFUSED = "refused"          # parent errored before any payload moved
+QUARANTINE = "quarantine"    # the verdict ledger flipped a parent to
+# locally shunned DURING this task (parent = the shunned address): the
+# journal shows exactly when the immune response engaged, next to the
+# corrupt events that triggered it
 PLACED = "placed"            # dedupe hit (parent = "cas"): the piece's
 # bytes were already on disk under another task's digest and were placed
 # locally by the content store — zero wire bytes moved; the summary
@@ -220,6 +232,8 @@ class TaskFlight:
         parents: dict[str, dict] = {}
         rungs: list[str] = []
         corrupt: dict[str, int] = {}
+        fail_codes: dict[str, int] = {}
+        quarantined: list[str] = []
         hbm_dma_ms = 0.0
         placed_pieces = 0
         bytes_placed = 0
@@ -235,6 +249,14 @@ class TaskFlight:
                 continue
             if stage == CORRUPT:
                 corrupt[parent] = corrupt.get(parent, 0) + 1
+                fail_codes[CORRUPT] = fail_codes.get(CORRUPT, 0) + 1
+                continue
+            if stage in (STALL, TIMEOUT, REFUSED):
+                fail_codes[stage] = fail_codes.get(stage, 0) + 1
+                continue
+            if stage == QUARANTINE:
+                if parent not in quarantined:
+                    quarantined.append(parent)
                 continue
             if stage == RUNG:
                 # dedupe consecutive repeats (reschedule can re-fire while
@@ -363,6 +385,13 @@ class TaskFlight:
             # itself was requeued and its eventual row credits whoever
             # delivered the good copy)
             "corrupt_pieces": corrupt,
+            # typed failure tallies (FAIL_CODES) across the whole flight:
+            # what KIND of failures this download absorbed — the wasted-
+            # work attribution the quarantine plane is judged by
+            "fail_codes": fail_codes,
+            # parent addresses the local verdict ledger shunned during
+            # this task (the `quarantine` events): dfdiag names them
+            "quarantined_parents": quarantined,
             "piece_rows": piece_rows,
         }
         total_bytes = summary["bytes_p2p"] + summary["bytes_source"]

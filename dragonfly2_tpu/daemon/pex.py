@@ -169,9 +169,16 @@ class PexGossiper:
                  scheduler: Any = None,
                  engine_factory: Callable[[], Any] | None = None,
                  relay: Any = None,
+                 verdicts: Any = None,
                  rng: random.Random | None = None):
         self.storage_mgr = storage_mgr
         self.relay = relay               # RelayHub: watermark in digests
+        # per-parent verdict ledger (daemon/verdicts.py): shunned holders
+        # are dropped from the swarm index and the pex rung's candidates;
+        # digests carry our LOCAL corrupt suspects as hints (receivers
+        # deprioritize only — the anti-slander rule) and, when this
+        # daemon self-quarantines, advertise NO tasks at all
+        self.verdicts = verdicts
         self.host_info = host_info       # lazy: ports resolve after bind
         self.index = index if index is not None else SwarmIndex()
         self.interval_s = interval_s
@@ -289,7 +296,8 @@ class PexGossiper:
     def build_digest(self) -> dict:
         host = self.host_info()
         tasks = []
-        for ts in self.storage_mgr.tasks():
+        selfq = self.verdicts is not None and self.verdicts.self_quarantined
+        for ts in () if selfq else self.storage_mgr.tasks():
             md = ts.md
             if not md.pieces and not (md.done and md.success):
                 continue
@@ -317,12 +325,13 @@ class PexGossiper:
         sample = list(self.peers.values())
         if len(sample) > PEER_SAMPLE:
             sample = self.rng.sample(sample, PEER_SAMPLE)
-        return {
+        digest = {
             "v": DIGEST_VERSION,
             "origin": {"host_id": host.id, "ip": host.ip,
                        "rpc_port": host.port,
                        "download_port": host.download_port,
                        "is_seed": int(host.type) != 0,
+                       "selfq": selfq,
                        "topology": _topo_to_wire(
                            getattr(host, "topology", None))},
             "peers": [{"host_id": p.host_id, "ip": p.ip,
@@ -333,6 +342,14 @@ class PexGossiper:
                       for p in sample],
             "tasks": tasks,
         }
+        if self.verdicts is not None:
+            # LOCAL corrupt-shun verdicts only, bounded: receivers treat
+            # these as hearsay hints (deprioritize, never shun) — see the
+            # anti-slander contract in daemon/verdicts.py
+            suspects = self.verdicts.shunned_addrs()[:8]
+            if suspects:
+                digest["suspects"] = suspects
+        return digest
 
     def envelope(self) -> bytes:
         return seal(self.build_digest())
@@ -355,6 +372,8 @@ class PexGossiper:
             rpc_port = int(origin.get("rpc_port") or 0)
             download_port = int(origin.get("download_port") or 0)
             is_seed = bool(origin.get("is_seed"))
+            origin_selfq = bool(origin.get("selfq"))
+            suspects = [str(a) for a in body.get("suspects") or []][:16]
             sampled = [dict(host_id=str(p.get("host_id") or ""),
                             ip=str(p.get("ip") or ""),
                             rpc_port=int(p.get("rpc_port") or 0),
@@ -396,7 +415,22 @@ class PexGossiper:
                           topology=topo, direct=True)
         for p in sampled:
             self.observe_peer(**p)
-        if ip and download_port:
+        origin_addr = f"{ip}:{download_port}"
+        if self.verdicts is not None:
+            # third-party accusations are hearsay: HINT only (the
+            # accused host is deprioritized in parent ordering, never
+            # shunned — one forged digest must not evict an honest host)
+            for a in suspects:
+                if a != self_addr and a != origin_addr:
+                    self.verdicts.hint(a)
+        locally_shunned = (self.verdicts is not None
+                           and self.verdicts.shunned(origin_addr))
+        if origin_selfq or locally_shunned:
+            # a self-quarantined origin asked to be excluded; a locally-
+            # shunned one served US corruption first-hand — either way its
+            # availability claims stop being indexed (and prior claims go)
+            self.index.forget_host(host_id or origin_addr)
+        elif ip and download_port:
             for task_id, entry in entries:
                 self.index.update(task_id, entry)
         _digests_received.labels(transport).inc()
@@ -430,6 +464,13 @@ class PexGossiper:
         Public so tests and operators can drive it deterministically."""
         self.rounds += 1
         self.index.purge()
+        if self.verdicts is not None:
+            # verdicts may have flipped since the entries landed: a
+            # holder shunned mid-interval stops being offerable NOW, not
+            # at its next digest
+            for p in list(self.peers.values()):
+                if self.verdicts.shunned(p.addr):
+                    self.index.forget_host(p.host_id)
         for addr in self._bootstrap:
             ip, _, port = addr.rpartition(":")
             if ip and port.isdigit():
@@ -542,10 +583,20 @@ class PexGossiper:
 
     def _candidates(self, conductor) -> list:
         host = self.host_info()
-        return self.index.parents_for(
+        entries = self.index.parents_for(
             conductor.task_id,
             self_topology=getattr(host, "topology", None),
             exclude_host=host.id)
+        if self.verdicts is not None:
+            # the pex rung has no scheduler to rescue it from a poisoner:
+            # locally-shunned holders are OUT; hinted/suspect ones sort
+            # last (deprioritized, still usable — the anti-slander rule's
+            # ceiling for hearsay)
+            entries = [e for e in entries
+                       if not self.verdicts.shunned(e.addr)]
+            entries.sort(key=lambda e: 1 if self.verdicts.deprioritized(
+                e.addr) else 0)
+        return entries
 
     def _packet(self, conductor, entries, *, advisory: bool) -> PeerPacket:
         mine = getattr(self.host_info(), "topology", None)

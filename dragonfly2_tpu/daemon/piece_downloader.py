@@ -34,6 +34,17 @@ from ..idl.messages import PieceInfo
 log = logging.getLogger("df.flow.piecedl")
 
 
+def _classified(code: Code, message: str, fail_code: str) -> DFError:
+    """DFError carrying a typed failure verdict (idl.FAIL_CODES): the
+    engine forwards ``fail_code`` on the piece report and into the
+    per-parent verdict ledger, where the *kind* of failure decides the
+    response (corrupt = shun; stall/timeout/refused = congestion-shaped
+    backoff only)."""
+    err = DFError(code, message)
+    err.fail_code = fail_code
+    return err
+
+
 class PieceDownloader:
     def __init__(self, *, timeout_s: float = 30.0, max_connections: int = 64,
                  tls: tuple[str, str, str] | None = None):
@@ -108,16 +119,18 @@ class PieceDownloader:
                         on_first = None
                     n = len(chunk)
                     if off + n > size:
-                        raise DFError(
+                        raise _classified(
                             Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                            f"{what}: long read {off + n} > {size}")
+                            f"{what}: long read {off + n} > {size}",
+                            "stall")
                     mv[off:off + n] = chunk
                     off += n
                     if span is not None:
                         span.advance(off)
                 if off != size:
-                    raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                                  f"{what}: short read {off}/{size}")
+                    raise _classified(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                                      f"{what}: short read {off}/{size}",
+                                      "stall")
             finally:
                 # drop the export before any release() probes it
                 mv.release()
@@ -131,7 +144,7 @@ class PieceDownloader:
     async def download_piece(self, *, dst_addr: str, task_id: str,
                              src_peer_id: str, piece: PieceInfo,
                              on_first_byte=None, relay_open=None,
-                             qos_class: str = "",
+                             qos_class: str = "", meta: dict | None = None,
                              ) -> tuple[bytearray, int]:
         """Fetch one piece from a parent. Returns (data, cost_ms); ``data``
         is a POOLED buffer the caller owns (release to ``bufpool.POOL``
@@ -171,9 +184,15 @@ class PieceDownloader:
                         err.retry_after_ms = 0
                     raise err
                 if resp.status not in (200, 206):
-                    raise DFError(
+                    raise _classified(
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                        f"{what}: HTTP {resp.status}")
+                        f"{what}: HTTP {resp.status}", "refused")
+                if meta is not None:
+                    # cut-through serve: the parent relayed these bytes
+                    # mid-landing — a later corrupt verdict on them is
+                    # attributed at reduced weight (see verdicts.record)
+                    meta["relayed"] = \
+                        resp.headers.get("X-DF-Relay") == "1"
                 return await self._read_body(resp, size, what,
                                              on_first=on_first_byte,
                                              relay_open=relay_open)
@@ -185,21 +204,28 @@ class PieceDownloader:
             # would stall the worker forever without this
             data = await asyncio.wait_for(fetch(), self.timeout_s)
         except asyncio.TimeoutError:
-            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                          f"{what}: per-piece deadline "
-                          f"({self.timeout_s:.0f}s)") from None
+            raise _classified(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                              f"{what}: per-piece deadline "
+                              f"({self.timeout_s:.0f}s)",
+                              "timeout") from None
         except DFError:
             raise
         except Exception as exc:  # noqa: BLE001 - network boundary
-            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                          f"{what}: {type(exc).__name__}: {exc}") from None
+            # connection-establishment failures never moved a byte
+            # ("refused"); anything that died with a request in flight is
+            # a mid-transfer stall
+            refused = isinstance(exc, (ConnectionRefusedError,
+                                       aiohttp.ClientConnectorError))
+            raise _classified(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                              f"{what}: {type(exc).__name__}: {exc}",
+                              "refused" if refused else "stall") from None
         cost_ms = int((time.monotonic() - t0) * 1000)
         return data, cost_ms
 
     async def download_span(self, *, dst_addr: str, task_id: str,
                             src_peer_id: str, pieces: list[PieceInfo],
                             on_first_byte=None, relay_open=None,
-                            qos_class: str = "",
+                            qos_class: str = "", meta: dict | None = None,
                             ) -> tuple[bytearray, int]:
         """Fetch CONTIGUOUS pieces in one ranged GET.
 
@@ -217,7 +243,7 @@ class PieceDownloader:
                 dst_addr=dst_addr, task_id=task_id,
                 src_peer_id=src_peer_id, piece=pieces[0],
                 on_first_byte=on_first_byte, relay_open=relay_open,
-                qos_class=qos_class)
+                qos_class=qos_class, meta=meta)
         url = f"{self.scheme}://{dst_addr}/download/{task_id[:3]}/{task_id}"
         start = pieces[0].range_start
         size = sum(p.range_size for p in pieces)
@@ -244,9 +270,15 @@ class PieceDownloader:
                         err.retry_after_ms = 0
                     raise err
                 if resp.status not in (200, 206):
-                    raise DFError(
+                    raise _classified(
                         Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                        f"{what}: HTTP {resp.status}")
+                        f"{what}: HTTP {resp.status}", "refused")
+                if meta is not None:
+                    # cut-through serve: the parent relayed these bytes
+                    # mid-landing — a later corrupt verdict on them is
+                    # attributed at reduced weight (see verdicts.record)
+                    meta["relayed"] = \
+                        resp.headers.get("X-DF-Relay") == "1"
                 return await self._read_body(resp, size, what,
                                              on_first=on_first_byte,
                                              relay_open=relay_open)
@@ -255,13 +287,20 @@ class PieceDownloader:
             # same hard per-span deadline as download_piece (see there)
             data = await asyncio.wait_for(fetch(), self.timeout_s)
         except asyncio.TimeoutError:
-            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                          f"{what}: per-piece deadline "
-                          f"({self.timeout_s:.0f}s)") from None
+            raise _classified(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                              f"{what}: per-piece deadline "
+                              f"({self.timeout_s:.0f}s)",
+                              "timeout") from None
         except DFError:
             raise
         except Exception as exc:  # noqa: BLE001 - network boundary
-            raise DFError(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
-                          f"{what}: {type(exc).__name__}: {exc}") from None
+            # connection-establishment failures never moved a byte
+            # ("refused"); anything that died with a request in flight is
+            # a mid-transfer stall
+            refused = isinstance(exc, (ConnectionRefusedError,
+                                       aiohttp.ClientConnectorError))
+            raise _classified(Code.CLIENT_PIECE_DOWNLOAD_FAIL,
+                              f"{what}: {type(exc).__name__}: {exc}",
+                              "refused" if refused else "stall") from None
         cost_ms = int((time.monotonic() - t0) * 1000)
         return data, cost_ms

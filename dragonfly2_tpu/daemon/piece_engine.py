@@ -125,6 +125,10 @@ class _Synchronizer:
             # origin mid-flight): skip — the done-refresh re-announces all
             return
         dst_addr = packet.dst_addr or f"{self.parent.ip}:{self.parent.download_port}"
+        if not self.engine._admissible(self.parent.peer_id, dst_addr):
+            # locally-shunned address: its announcements must not grow a
+            # dispatcher slot, however it got a sync stream
+            return
         await self.engine.dispatcher.add_parent(self.parent.peer_id, dst_addr,
                                                 is_seed=self.parent.is_seed,
                                                 link=self.parent.link)
@@ -185,12 +189,18 @@ class PieceEngine:
                  channel_pool: ChannelPool | None = None,
                  slice_name: str = "",
                  peer_observer=None,
-                 relay=None):
+                 relay=None,
+                 verdicts=None):
         self.parallelism = parallelism
         self.slice_name = slice_name    # advertised to super-seeding parents
         # PEX membership hook (daemon/pex.py): every parent the scheduler
         # assigns is observed so the gossip plane knows the mesh
         self.peer_observer = peer_observer
+        # per-parent verdict ledger (daemon/verdicts.py): typed failure
+        # verdicts recorded here; parents the ledger shuns on local
+        # corrupt evidence are never admitted to the dispatcher — even
+        # when the scheduler (or the PEX rung) keeps offering them
+        self.verdicts = verdicts
         # cut-through relay hub (daemon/relay.py): every in-flight span
         # this engine downloads becomes readable by the upload server's
         # streaming range path while its bytes are still arriving
@@ -270,16 +280,26 @@ class PieceEngine:
             with health.PLANE.watchdog.section(
                     "piece.wire", health.PLANE.slo.section_deadline_s(),
                     stage="wire"):
+                wire_meta: dict = {}
                 data, cost = await self.downloader.download_piece(
                     dst_addr=single.dst_addr, task_id=conductor.task_id,
                     src_peer_id=conductor.peer_id, piece=info,
                     on_first_byte=on_first, relay_open=span,
-                    qos_class=getattr(conductor, "qos_class", ""))
+                    qos_class=getattr(conductor, "qos_class", ""),
+                    meta=wire_meta)
         except DFError as exc:
             _p2p_pieces.labels("fail").inc()
+            # backpressure is not a failure VERDICT (parity with the
+            # span path's requeue-without-strike): a busy 503 earns no
+            # typed code, no flight failure event, no ledger entry
+            busy = exc.code == Code.CLIENT_PEER_BUSY
+            fcode = "" if busy else self._fail_code(exc)
+            if not busy:
+                self._note_fail(conductor, info, single.dst_peer_id,
+                                single.dst_addr, fcode)
             await session.report_piece(self._piece_result(
                 conductor, info, single.dst_peer_id, t0, ok=False,
-                code=exc.code))
+                code=exc.code, fail_code=fcode))
             return False
         t_wire = flight.now_ms() if flight is not None else 0.0
         try:
@@ -291,10 +311,13 @@ class PieceEngine:
             span.retire()
             POOL.release(data)
         if corrupt:
-            self._note_corrupt(conductor, info, single.dst_peer_id)
+            self._note_corrupt(conductor, info, single.dst_peer_id,
+                               addr=single.dst_addr,
+                               relayed=wire_meta.get("relayed", False))
             await session.report_piece(self._piece_result(
                 conductor, info, single.dst_peer_id, t0, ok=False,
-                code=Code.CLIENT_DIGEST_MISMATCH))
+                code=Code.CLIENT_DIGEST_MISMATCH, fail_code="corrupt",
+                relayed=wire_meta.get("relayed", False)))
             return False
         if raced:
             # an endgame racer is mid-landing: its outcome is unknown, so
@@ -308,22 +331,73 @@ class PieceEngine:
         if placed:
             _p2p_piece_bytes.observe(info.range_size)
         _p2p_pieces.labels("ok").inc()
+        if self.verdicts is not None:
+            self.verdicts.record_ok(single.dst_addr)
         await session.report_piece(self._piece_result(
             conductor, info, single.dst_peer_id, t0, ok=True, cost_ms=cost))
         return True
 
-    @staticmethod
-    def _note_corrupt(conductor, info: PieceInfo, parent_id: str) -> None:
+    def _note_corrupt(self, conductor, info: PieceInfo, parent_id: str,
+                      addr: str = "", relayed: bool = False) -> bool:
         """A transfer failed digest verification at landing: count it
-        (df_p2p_piece_total{result="corrupt"}) and journal a flight event
-        so dfdiag can name the corrupting parent — pre-PR5 this was a
-        log.debug and an invisible requeue."""
+        (df_p2p_piece_total{result="corrupt"}), journal a flight event
+        so dfdiag can name the corrupting parent, and record the hard
+        verdict in the daemon-wide ledger — enough decayed corrupt
+        verdicts locally shun the address for EVERY task on this daemon
+        (scheduler reachable or not), journaled as a ``quarantine``
+        flight event at the flip."""
         _p2p_pieces.labels("corrupt").inc()
         log.warning("piece %d from %s: digest mismatch (requeued)",
                     info.piece_num, parent_id[-12:])
         if conductor.flight is not None:
             conductor.flight.event(fr.CORRUPT, info.piece_num, parent_id,
                                    info.range_size)
+        if self.verdicts is not None and addr:
+            flipped = self.verdicts.record(addr, "corrupt",
+                                           peer_id=parent_id,
+                                           relayed=relayed)
+            if flipped and conductor.flight is not None:
+                conductor.flight.event(fr.QUARANTINE, info.piece_num, addr)
+            return flipped
+        return False
+
+    @staticmethod
+    def _fail_code(exc: DFError) -> str:
+        """Typed verdict for a failed fetch (idl.FAIL_CODES): the
+        downloader classifies transport failures at the raise site;
+        digest mismatches are corrupt by definition."""
+        code = getattr(exc, "fail_code", "")
+        if code:
+            return code
+        return "corrupt" if exc.code == Code.CLIENT_DIGEST_MISMATCH \
+            else "stall"
+
+    _FAIL_EVENTS = {"stall": fr.STALL, "timeout": fr.TIMEOUT,
+                    "refused": fr.REFUSED}
+
+    def _note_fail(self, conductor, info: PieceInfo, parent_id: str,
+                   addr: str, code: str) -> None:
+        """Journal + ledger one NON-corrupt typed failure (corrupt goes
+        through _note_corrupt): soft evidence — the ledger decays it for
+        ordering, never shuns on it."""
+        if conductor.flight is not None:
+            kind = self._FAIL_EVENTS.get(code)
+            if kind is not None:
+                conductor.flight.event(kind, info.piece_num, parent_id)
+        if self.verdicts is not None and addr and code != "corrupt":
+            self.verdicts.record(addr, code, peer_id=parent_id)
+
+    def _admissible(self, parent_id: str, addr: str) -> bool:
+        """Parent admission gate: a locally-shunned address is refused a
+        dispatcher slot no matter who offers it (scheduler packet, sync
+        announcement, PEX rung) — the round trip of pulling, verifying,
+        and requeuing a poisoned piece is exactly the waste the ledger
+        exists to stop."""
+        if self.verdicts is None or not self.verdicts.shunned(addr):
+            return True
+        log.info("refusing shunned parent %s (%s): local corrupt "
+                 "verdicts", parent_id[-12:], addr)
+        return False
 
     async def _pull_normal(self, conductor, session) -> bool:
         if session.result.content_length >= 0:
@@ -469,6 +543,8 @@ class PieceEngine:
                 if parent.peer_id == conductor.peer_id:
                     continue
                 dl_addr = f"{parent.ip}:{parent.download_port}"
+                if not self._admissible(parent.peer_id, dl_addr):
+                    continue
                 await self.dispatcher.add_parent(parent.peer_id, dl_addr,
                                                  resurrect=True,
                                                  is_seed=parent.is_seed,
@@ -538,6 +614,9 @@ class PieceEngine:
         for peer_id, parent in list(self._current_parents.items()):
             sync = self._synchronizers.get(peer_id)
             if sync is not None and sync.task is not None and sync.task.done():
+                if not self._admissible(
+                        peer_id, f"{parent.ip}:{parent.download_port}"):
+                    continue
                 if self.dispatcher.hard_removed(peer_id):
                     # lifetime fail cap: stays dead until the SCHEDULER
                     # re-offers it in a packet (its blocklists are the
@@ -589,11 +668,13 @@ class PieceEngine:
                         health.PLANE.slo.section_deadline_s(len(d.pieces)),
                         stage="wire"):
                     span = self._relay_opener(conductor, d.pieces)
+                    wire_meta: dict = {}
                     buf, cost = await self.downloader.download_span(
                         dst_addr=d.parent.addr, task_id=conductor.task_id,
                         src_peer_id=conductor.peer_id, pieces=d.pieces,
                         on_first_byte=on_first, relay_open=span,
-                        qos_class=getattr(conductor, "qos_class", ""))
+                        qos_class=getattr(conductor, "qos_class", ""),
+                        meta=wire_meta)
         except DFError as exc:
             if exc.code == Code.CLIENT_PEER_BUSY:
                 # backpressure, not failure: requeue; no scheduler report
@@ -606,6 +687,12 @@ class PieceEngine:
             log.debug("pieces %s from %s failed: %s",
                       [p.piece_num for p in d.pieces],
                       d.parent.peer_id[-12:], exc)
+            fcode = self._fail_code(exc)
+            # one transfer, one typed verdict (however many pieces rode
+            # it) — per-piece ledger strikes would triple-count a single
+            # dead connection
+            self._note_fail(conductor, d.piece, d.parent.peer_id,
+                            d.parent.addr, fcode)
             await self.dispatcher.report(d, ok=False)
             if d.parent.removed:
                 # permanently removed (hard fail cap): its sync stream dies
@@ -618,7 +705,7 @@ class PieceEngine:
             for info in d.pieces:   # every group member failed, report each
                 await session.report_piece(self._piece_result(
                     conductor, info, d.parent.peer_id, t0, ok=False,
-                    code=exc.code))
+                    code=exc.code, fail_code=fcode))
             return
         per_piece_cost = max(1, cost // len(d.pieces))
         # timestamp before the landing await, journaled only for pieces
@@ -641,12 +728,16 @@ class PieceEngine:
             POOL.release(buf)
         placed_set, corrupt_set = set(placed), set(corrupt)
         raced_set = set(raced)
+        shun_flipped = False
         for info in d.pieces:
             if info.piece_num in corrupt_set:
-                self._note_corrupt(conductor, info, d.parent.peer_id)
+                shun_flipped |= self._note_corrupt(
+                    conductor, info, d.parent.peer_id, addr=d.parent.addr,
+                    relayed=wire_meta.get("relayed", False))
                 await session.report_piece(self._piece_result(
                     conductor, info, d.parent.peer_id, t0, ok=False,
-                    code=Code.CLIENT_DIGEST_MISMATCH))
+                    code=Code.CLIENT_DIGEST_MISMATCH, fail_code="corrupt",
+                    relayed=wire_meta.get("relayed", False)))
                 continue
             if info.piece_num in raced_set:
                 # an endgame racer is mid-landing: outcome unknown — say
@@ -659,9 +750,21 @@ class PieceEngine:
                                  dur_ms=per_piece_cost, t_ms=t_wire)
                 _p2p_piece_bytes.observe(info.range_size)
             _p2p_pieces.labels("ok").inc()
+            if self.verdicts is not None:
+                self.verdicts.record_ok(d.parent.addr)
             await session.report_piece(self._piece_result(
                 conductor, info, d.parent.peer_id, t0, ok=True,
                 cost_ms=per_piece_cost, finished=len(conductor.ready)))
+        if shun_flipped:
+            # the ledger just shunned this address on local corrupt
+            # evidence: sever it for THIS task immediately (permanent
+            # removal + dead sync stream) — the admission gate keeps it
+            # out of every later task, and the scheduler's pod-wide
+            # quarantine follows from the corrupt reports above
+            await self.dispatcher.remove_parent(d.parent.peer_id)
+            sync = self._synchronizers.get(d.parent.peer_id)
+            if sync is not None:
+                sync.stop()
         await self.dispatcher.report(
             d, ok=True, cost_ms=cost,
             # a raced piece must NOT be marked done (the racer may yet
@@ -674,7 +777,8 @@ class PieceEngine:
     @staticmethod
     def _piece_result(conductor, info: PieceInfo, parent_id: str, t0: int, *,
                       ok: bool, cost_ms: int = 0, code: Code = Code.OK,
-                      finished: int = 0) -> PieceResult:
+                      finished: int = 0, fail_code: str = "",
+                      relayed: bool = False) -> PieceResult:
         reported = PieceInfo(piece_num=info.piece_num,
                              range_start=info.range_start,
                              range_size=info.range_size, digest=info.digest,
@@ -683,7 +787,7 @@ class PieceEngine:
             task_id=conductor.task_id, src_peer_id=conductor.peer_id,
             dst_peer_id=parent_id, piece_info=reported, begin_ms=t0,
             end_ms=t0 + cost_ms, success=ok, code=int(code),
-            finished_count=finished)
+            fail_code=fail_code, relayed=relayed, finished_count=finished)
 
     # ------------------------------------------------------------------
 

@@ -192,10 +192,15 @@ class UploadServer:
                  bulk_concurrent_limit: int = 0,
                  host: str = "0.0.0.0", debug_endpoints: bool = False,
                  flight_recorder=None, pex=None, relay=None,
-                 relay_stall_s: float = 10.0, qos=None):
+                 relay_stall_s: float = 10.0, qos=None, verdicts=None):
         self.storage_mgr = storage_mgr
         self.flight_recorder = flight_recorder
         self.pex = pex
+        self.verdicts = verdicts            # VerdictLedger (/debug/verdicts)
+        # this daemon's host id, set by the bootstrap: scopes the
+        # ``upload.serve`` faultgate key so a chaos run (or a co-resident
+        # test pod) can poison exactly ONE daemon's serves
+        self.host_id = ""
         self.relay = relay                  # RelayHub (None = store-and-forward)
         self.relay_stall_s = relay_stall_s  # per-wait watermark deadline
         self.qos = qos                      # QosGovernor (GET /debug/qos)
@@ -277,6 +282,12 @@ class UploadServer:
             # rides the same port and TLS posture
             from .pex import add_pex_routes
             add_pex_routes(app.router, self.pex)
+        if self.verdicts is not None:
+            # per-parent verdict ledger readout (GET /debug/verdicts):
+            # read-only + bounded like /debug/flight, always on — dfdiag
+            # --pod sweeps it to name shunned/self-quarantined hosts
+            from .verdicts import add_verdict_routes
+            add_verdict_routes(app.router, self.verdicts)
         if self.debug_endpoints:
             # pprof-equivalent debug surface (reference cmd/dependency
             # InitMonitor --pprof-port) — OFF by default: profiling slows
@@ -519,11 +530,20 @@ class UploadServer:
             if streaming:
                 return await self._serve_relay(request, ts, rng, slot,
                                                task_id)
+            # byzantine chaos (site ``upload.serve``, keyed
+            # "<host_id>|<task_id>"): while a corrupt script is armed for
+            # this daemon, serves route through the buffered path (a
+            # sendfile body never enters Python, so it cannot be flipped)
+            # and the read bytes get the scripted corruption — the swarm
+            # immune system's proving lever (stress --byzantine)
+            fkey = f"{self.host_id}|{task_id}"
+            poisoned = faultgate.ARMED and faultgate.peek(
+                "upload.serve", fkey, kinds=frozenset({"corrupt"}))
             # whole-file tasks: serve via sendfile (FileResponse honors
             # Range) so piece bytes never enter Python — the upload path is
             # the hottest loop on a seed peer.
             data_path = getattr(ts, "data_path", None)
-            if data_path is not None and total >= 0:
+            if data_path is not None and total >= 0 and not poisoned:
                 wait_t0 = time.monotonic()
                 await self.limiter.acquire(rng.length)
                 _upload_bytes.inc(rng.length)
@@ -566,6 +586,11 @@ class UploadServer:
                 # budget with aborted requests
                 self.limiter.refund(rng.length)
                 raise
+            if poisoned:
+                # scripted byte-flip on this served range: the child's
+                # landing verification catches it, reports a ``corrupt``
+                # verdict, and the quarantine plane takes it from there
+                data = faultgate.corrupt("upload.serve", data, key=fkey)
             _upload_bytes.inc(len(data))
             _upload_piece_bytes.observe(len(data))
             _upload_reqs.labels("206").inc()
@@ -624,6 +649,13 @@ class UploadServer:
         # of the task's lifetime
         stall_at = time.monotonic() + self.relay_stall_s
         last_avail = pos
+        # byzantine chaos on the cut-through path: ONE corrupt attempt
+        # per SERVE (consumed on the first chunk — one flipped byte
+        # already fails the containing piece), so the pct stride keeps
+        # its per-serve semantics instead of advancing per chunk
+        poison_pending = faultgate.ARMED and faultgate.peek(
+            "upload.serve", f"{self.host_id}|{task_id}",
+            kinds=frozenset({"corrupt"}))
         try:
             while pos < rng.end:
                 if faultgate.ARMED:
@@ -689,6 +721,11 @@ class UploadServer:
                     # short disk read (frontier raced): re-check, no spin
                     await relay.wait_progress(task_id, 0.05)
                     continue
+                if poison_pending:
+                    poison_pending = False
+                    chunk = faultgate.corrupt(
+                        "upload.serve", chunk,
+                        key=f"{self.host_id}|{task_id}")
                 # tokens for EXACTLY the bytes about to move (a span read
                 # clamps at its watermark, a disk read at the covered
                 # frontier — charging the pre-clamp size would leak

@@ -71,6 +71,26 @@ def resolve_class(qos_class: str) -> str:
         else DEFAULT_PRIORITY_CLASS
 
 
+# Typed piece-failure vocabulary, pinned the way ``PRIORITY_CLASSES`` and
+# the scheduler's ``EXCLUSION_REASONS`` are: every failed piece report
+# (``PieceResult.fail_code``), flight-journal failure event, ``kind=piece``
+# record row, and per-parent verdict-ledger counter uses one of these
+# strings, each backticked in docs/OBSERVABILITY.md. ``ok=False`` alone
+# told the scheduler nothing about *why* — and a swarm immune system needs
+# the why: ``corrupt`` is hard evidence of a lying parent (quarantinable),
+# the other three are congestion/liveness shapes that only deprioritize.
+#
+#   ``corrupt`` — the bytes landed but failed digest verification:
+#                 the parent served wrong bytes (bit-rot, bad NIC, or a
+#                 byzantine daemon);
+#   ``stall``   — the transfer died mid-body (short read, connection
+#                 reset): the parent wedged or churned away;
+#   ``timeout`` — the per-piece deadline fired before the body finished;
+#   ``refused`` — the parent answered with an error (or never accepted
+#                 the connection) before any payload moved.
+FAIL_CODES = ("corrupt", "stall", "timeout", "refused")
+
+
 class HostType(enum.IntEnum):
     NORMAL = 0       # ordinary peer
     SUPER_SEED = 1   # seed peer, first to back-source
@@ -175,6 +195,12 @@ class Host:
     # serve few children each so fan-outs form trees, not stars)
     concurrent_upload_limit: int = 0
     build_version: str = ""
+    # self-quarantine flag (daemon/verdicts.py): the daemon detected its
+    # OWN storage bit-rot (boot re-verify or content-store placement
+    # re-hash failed) and asks to be excluded as a parent pod-wide. Rides
+    # every register/AnnounceHost; the scheduler's quarantine registry
+    # treats it as hard evidence (state ``quarantined``, reason self).
+    quarantined: bool = False
 
 
 @message
@@ -274,6 +300,18 @@ class PieceResult:
     end_ms: int = 0
     success: bool = False
     code: int = 0                   # errors.Code
+    # typed failure verdict (FAIL_CODES; "" on success): the *kind* of
+    # failure, which ``code`` alone collapsed — the scheduler's quarantine
+    # registry promotes ``corrupt`` verdicts into pod-wide exclusion while
+    # stall/timeout/refused stay congestion-shaped (blocklist only)
+    fail_code: str = ""
+    # the failed transfer rode the parent's cut-through relay path
+    # (X-DF-Relay): corrupt bytes then originated UPSTREAM of the named
+    # parent, so the evidence is circumstantial — it may deprioritize /
+    # mark the relay suspect, never shun or quarantine it (the
+    # relay-plane form of the anti-slander rule; one poisoner must not
+    # get every honest relay below it evicted)
+    relayed: bool = False
     host_load: HostLoad | None = None
     finished_count: int = 0         # pieces this peer now holds
 

@@ -57,7 +57,7 @@ class _HostAgg:
 
 
 class ClusterView:
-    def __init__(self, ledger=None) -> None:
+    def __init__(self, ledger=None, quarantine=None) -> None:
         self._hosts: dict[str, _HostAgg] = {}
         self.started_at = time.time()
         # decision ledger (scheduler/decision_ledger.py): its compact
@@ -65,6 +65,9 @@ class ClusterView:
         # "is the pod herding onto no-slots/bad-node exclusions" next to
         # the throughput it is costing
         self.ledger = ledger
+        # quarantine registry (scheduler/quarantine.py): ladder states
+        # ride the snapshot so /debug/cluster names quarantined hosts
+        self.quarantine = quarantine
 
     def _agg(self, host_id: str) -> _HostAgg:
         agg = self._hosts.get(host_id)
@@ -156,6 +159,8 @@ class ClusterView:
         }
         if self.ledger is not None:
             snap["decisions"] = self.ledger.stats()
+        if self.quarantine is not None:
+            snap["quarantine"] = self.quarantine.snapshot()
         return snap
 
 

@@ -78,6 +78,25 @@ class SchedulerConfig:
     # content holder (Scheduling.preempt_for; the ruling rides the
     # decision ledger). Off = the exact pre-QoS patience path.
     qos_preemption: bool = True
+    # pod-wide peer quarantine (scheduler/quarantine.py): hard corrupt
+    # evidence (typed PieceResult.fail_code verdicts, cross-task) walks a
+    # host down healthy -> suspect -> quarantined -> probation. Disabled
+    # = the exact pre-quarantine scoring/filter path (dfbench digest
+    # gate). Thresholds are decayed-verdict mass, not raw counts.
+    quarantine_enabled: bool = True
+    quarantine_corrupt_threshold: float = 3.0
+    quarantine_halflife_s: float = 600.0
+    # quarantined -> probation after this long without fresh evidence;
+    # probation exposes the host to at most quarantine_probe_children
+    # concurrent children and quarantine_probe_successes clean pieces
+    # climb it back to healthy without an operator
+    quarantine_probation_delay_s: float = 30.0
+    quarantine_probe_successes: int = 2
+    quarantine_probe_children: int = 1
+    # distinct reporting hosts required before corrupt evidence may
+    # QUARANTINE (one forging child must not evict honest parents —
+    # a single reporter tops out at suspect)
+    quarantine_min_reporters: int = 2
     retry_limit: int = RETRY_LIMIT
     retry_back_source_limit: int = RETRY_BACK_SOURCE_LIMIT
     back_source_concurrent: int = DEFAULT_BACK_SOURCE_CONCURRENT

@@ -98,8 +98,52 @@ class DownloadRecords:
             "piece_num": info.piece_num,
             "piece_length": info.range_size,
             "cost_ms": info.download_cost_ms,
+            "success": True,
+            "fail_code": "",
             "features": features,
             "label": label_from_cost(info.range_size, info.download_cost_ms),
+            "created_at": time.time(),
+        }
+        self._append(row)
+
+    def on_piece_fail(self, peer: Peer, result) -> None:
+        """One row per FAILED piece fetch, carrying the typed
+        ``fail_code`` (idl.FAIL_CODES): the outcome join can now learn
+        what KIND of failure a ruling produced — a ``corrupt`` verdict
+        against a chosen parent is the signal the quarantine ladder
+        promoted, and an offline replay should see it too. Label 0.0: a
+        failed fetch is a zero-quality outcome for the (decision,
+        parent) pair."""
+        if not result.dst_peer_id:
+            return
+        if not getattr(result, "fail_code", ""):
+            # untyped failures are backpressure shapes (the engine leaves
+            # busy 503s codeless on purpose): a loaded-but-good parent
+            # must not teach the trainer that offering it was a
+            # zero-quality ruling
+            return
+        parent = peer.task.peers.get(result.dst_peer_id)
+        if parent is None:
+            return
+        info = result.piece_info
+        features = parent_feature_row(
+            peer, parent, total_piece_count=peer.task.total_piece_count)
+        row = {
+            "kind": "piece",
+            "task_id": peer.task.id,
+            "peer_id": peer.id,
+            "host_id": peer.host.id,
+            "decision_id": peer.last_decision_id,
+            "parent_peer_id": parent.id,
+            "parent_host_id": parent.host.id,
+            "piece_num": info.piece_num if info is not None else -1,
+            "piece_length": info.range_size if info is not None else 0,
+            "cost_ms": 0,
+            "success": False,
+            "fail_code": str(getattr(result, "fail_code", "") or ""),
+            "relayed": bool(getattr(result, "relayed", False)),
+            "features": features,
+            "label": 0.0,
             "created_at": time.time(),
         }
         self._append(row)

@@ -36,13 +36,19 @@ _preemptions = REGISTRY.counter(
 # and in decision-row ``excluded`` entries, and an undocumented reason is
 # a surface operators cannot read (dflint DF006 decision-vocabulary).
 EXCLUSION_REASONS = ("stream-gone", "blocklist", "no-slots", "bad-node",
-                     "cycle")
+                     "cycle", "quarantined")
 
 
 class Scheduling:
-    def __init__(self, cfg: SchedulerConfig, evaluator: Evaluator):
+    def __init__(self, cfg: SchedulerConfig, evaluator: Evaluator,
+                 quarantine=None):
         self.cfg = cfg
         self.evaluator = evaluator
+        # quarantine registry (scheduler/quarantine.py). None (default)
+        # skips every lookup — the exact pre-quarantine filter path, which
+        # is how dfbench's baseline schedule_digest stays byte-identical
+        # with the immune system in the tree.
+        self.quarantine = quarantine
         # decision ledger hook: callable(row dict) receiving one
         # ``kind=decision`` row per find/refresh ruling. None (default)
         # skips ALL ledger work — scoring then runs the exact pre-ledger
@@ -107,6 +113,16 @@ class Scheduling:
                 continue
             if self.evaluator.is_bad_node(parent):
                 self._trace(child, parent, "bad-node", excluded)
+                continue
+            if (self.quarantine is not None
+                    and not self.quarantine.offerable(parent.host.id,
+                                                      child.id)):
+                # pod-wide quarantine (hard corrupt evidence / self-flag):
+                # excluded from offers — and therefore from relay-tree
+                # shaping and every downstream choice — until the ladder
+                # walks the host back through probation. Probation hosts
+                # pass here only within the bounded probe budget.
+                self._trace(child, parent, "quarantined", excluded)
                 continue
             if task.would_cycle(parent.id, child.id):
                 self._trace(child, parent, "cycle", excluded)

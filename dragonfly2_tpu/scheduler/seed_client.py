@@ -25,11 +25,16 @@ SEEDER_SERVICE = "df.daemon.Seeder"
 
 class SeedPeerClient:
     def __init__(self, resource: Resource, seed_peers: list[SeedPeerAddr],
-                 *, tls: tuple[str, str, str] | None = None):
+                 *, tls: tuple[str, str, str] | None = None,
+                 quarantine=None):
         """``tls``: (cert, key, ca) fleet material — security-enabled seed
         daemons serve their rpc port over mTLS, and a plaintext trigger
-        would silently fail every seed fleet-wide."""
+        would silently fail every seed fleet-wide. ``quarantine``:
+        registry consulted at seed ELECTION — injecting content through a
+        quarantined (possibly bit-rotted) seed would poison the root of
+        the whole distribution tree."""
         self.resource = resource
+        self.quarantine = quarantine
         self.seed_peers = {self._host_id(s): s for s in seed_peers}
         self._ring = HashRing(list(self.seed_peers))
         if tls is not None:
@@ -46,13 +51,27 @@ class SeedPeerClient:
     def available(self) -> bool:
         return bool(self.seed_peers)
 
+    def _elect(self, task_id: str) -> str | None:
+        """Seed election: the hashed member, walking clockwise past any
+        QUARANTINED seed (a poisoned root poisons the whole tree). With
+        every member quarantined the hashed one still serves — a wholly
+        quarantined seed fleet beats no injection path at all, and each
+        corrupt verdict it earns keeps it excluded everywhere else."""
+        if self.quarantine is None:
+            return self._ring.pick(task_id)
+        cands = self._ring.pick_n(task_id, len(self.seed_peers))
+        for hid in cands:
+            if self.quarantine.offerable(hid):
+                return hid
+        return cands[0] if cands else None
+
     # ------------------------------------------------------------------
 
     async def trigger(self, task: Task, url_meta: UrlMeta | None) -> None:
         """Run one seed download to completion, folding piece announcements
         into the task as they arrive. Exceptions are contained: a failed
         seed leaves the task unseeded and peers fall back to origin."""
-        hid = self._ring.pick(task.id)
+        hid = self._elect(task.id)
         if hid is None:
             return
         seed = self.seed_peers[hid]

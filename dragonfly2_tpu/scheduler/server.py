@@ -45,9 +45,25 @@ class Scheduler:
         from .decision_ledger import DecisionLedger
         self.ledger = DecisionLedger(records=records)
         self.scheduling.decision_sink = self.ledger.on_decision
+        # pod-wide quarantine registry: corrupt verdicts + self-flags in,
+        # offer/relay/seed exclusion out, every transition a ledger row
+        self.quarantine = None
+        if cfg.quarantine_enabled:
+            from .quarantine import QuarantineRegistry
+            self.quarantine = QuarantineRegistry(
+                corrupt_threshold=cfg.quarantine_corrupt_threshold,
+                halflife_s=cfg.quarantine_halflife_s,
+                probation_delay_s=cfg.quarantine_probation_delay_s,
+                probe_successes=cfg.quarantine_probe_successes,
+                probe_children=cfg.quarantine_probe_children,
+                min_reporters=cfg.quarantine_min_reporters,
+                sink=self.ledger.on_decision)
+            self.scheduling.quarantine = self.quarantine
+            self.seed_client.quarantine = self.quarantine
         self.service = SchedulerService(cfg, self.resource, self.scheduling,
                                         self.seed_client, self.topo,
-                                        records=records, ledger=self.ledger)
+                                        records=records, ledger=self.ledger,
+                                        quarantine=self.quarantine)
         self.announcer = None
         self.rpc: RPCServer | None = None
         self.gc = GC()
@@ -108,7 +124,7 @@ class Scheduler:
         await self.seed_client.close()
         self.seed_client = SeedPeerClient(
             self.resource, list(self.seed_client.seed_peers.values()),
-            tls=tls)
+            tls=tls, quarantine=self.quarantine)
         self.service.seed_client = self.seed_client
 
     async def _attach_manager(self) -> None:
@@ -144,7 +160,8 @@ class Scheduler:
                                       download_port=e.download_port)
                          for e in (resp.seed_peers or [])]
                 if seeds:
-                    self.seed_client = SeedPeerClient(self.resource, seeds)
+                    self.seed_client = SeedPeerClient(
+                        self.resource, seeds, quarantine=self.quarantine)
                     self.service.seed_client = self.seed_client
         except Exception as exc:  # noqa: BLE001 - manager optional at boot
             log.warning("manager attach failed (%s); running standalone", exc)

@@ -65,7 +65,8 @@ SEED_RETRIGGER_BACKOFF_CAP_S = 30.0
 class SchedulerService:
     def __init__(self, cfg: SchedulerConfig, resource: Resource,
                  scheduling: Scheduling, seed_client: SeedPeerClient,
-                 topo: TopologyStore, *, records=None, ledger=None):
+                 topo: TopologyStore, *, records=None, ledger=None,
+                 quarantine=None):
         self.cfg = cfg
         self.resource = resource
         self.scheduling = scheduling
@@ -73,7 +74,12 @@ class SchedulerService:
         self.topo = topo
         self.records = records          # download-record sink (trainer dataset)
         self.ledger = ledger            # decision ledger (GET /debug/decisions)
-        self.cluster = ClusterView(ledger=ledger)  # GET /debug/cluster
+        # quarantine registry (scheduler/quarantine.py): fed corrupt
+        # verdicts + self-flags here, consulted by the scheduling filter
+        # and seed election; None = the pre-quarantine fabric
+        self.quarantine = quarantine
+        self.cluster = ClusterView(ledger=ledger,
+                                   quarantine=quarantine)  # GET /debug/cluster
         self._seed_tasks: set[asyncio.Task] = set()
         # application name -> Priority numeric, fed from the manager's
         # applications table (reference dynconfig.GetApplications); consulted
@@ -129,6 +135,13 @@ class SchedulerService:
         # lets the admitted download complete P2P.
         if req.peer_host.type == HostType.NORMAL:
             self._enforce_tenant_quota(tenant)
+        if self.quarantine is not None:
+            # the self-quarantine flag rides every register too: a daemon
+            # that found its own bit-rot is excluded as a parent from its
+            # FIRST contact, not from its next announce interval
+            self.quarantine.record_self(
+                req.peer_host.id, req.peer_host.quarantined,
+                reason="self-quarantine flag on register")
         host = self.resource.store_host(req.peer_host)
         peer = self.resource.get_or_create_peer(req.peer_id, task, host)
         peer.priority = resolved_priority
@@ -536,6 +549,10 @@ class SchedulerService:
                 parent = task.peers.get(result.dst_peer_id)
                 if parent is not None:
                     parent.host.observe_upload(True)
+                    if self.quarantine is not None:
+                        # probation reprieve: a clean piece off this host
+                        # counts toward its climb back to healthy
+                        self.quarantine.record_ok(parent.host.id)
             if self.records is not None and result.piece_info is not None:
                 self.records.on_piece(peer, result)
             # the time-based _refresh_loop handles steady-state re-offers;
@@ -557,7 +574,22 @@ class SchedulerService:
             parent = task.peers.get(result.dst_peer_id)
             if parent is not None:
                 parent.host.observe_upload(False)
+                if (self.quarantine is not None
+                        and result.fail_code == "corrupt"):
+                    # hard evidence: the child verified the bytes and
+                    # they were wrong — promoted cross-task into the
+                    # pod-wide ladder (stall/timeout/refused stay
+                    # congestion-shaped: blocklist + bad-node only)
+                    self.quarantine.record_corrupt(
+                        parent.host.id, task_id=task.id,
+                        reporter=peer.host.id,
+                        relayed=result.relayed)
             peer.block_parent(result.dst_peer_id)
+        if self.records is not None:
+            # failed pieces get rows too (success=False, typed fail_code):
+            # the ledger joins can now learn from failure KIND, which a
+            # bare ok=False collapsed
+            self.records.on_piece_fail(peer, result)
         # losing a parent: offer a fresh assignment (or the origin)
         await self._reschedule(peer)
 
@@ -664,6 +696,12 @@ class SchedulerService:
     async def announce_host(self, req: AnnounceHostRequest, context) -> Empty:
         if req.host is not None:
             self.resource.store_host(req.host)
+            if self.quarantine is not None:
+                # flag set -> quarantined (reason self); flag CLEARED on a
+                # later announce (restart re-verified clean) -> probation
+                self.quarantine.record_self(
+                    req.host.id, req.host.quarantined,
+                    reason="self-quarantine flag on announce")
         return Empty()
 
     async def leave_host(self, req: LeaveHostRequest, context) -> Empty:

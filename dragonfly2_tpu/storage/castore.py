@@ -122,6 +122,11 @@ class CAStore:
                  popularity_halflife_s: float = 600.0):
         self.resolve = resolve or (lambda _tid: None)
         self.popularity_halflife_s = popularity_halflife_s
+        # local bit-rot observer (daemon/verdicts.py self-quarantine): a
+        # placement whose source bytes fail re-verification means THIS
+        # daemon's disk lied — the callable gets the failing task id and
+        # decides whether the daemon should stop advertising pod-wide
+        self.on_rot: Callable[[str], None] | None = None
         self._lock = threading.Lock()
         # digest -> {task_id -> (start, size)}
         self._locs: dict[str, dict[str, tuple[int, int]]] = {}
@@ -240,6 +245,11 @@ class CAStore:
                 _place_failures.labels("verify").inc()
                 log.warning("cas placement of %s from %s failed "
                             "verification; dropped", digest, src_tid[:12])
+                if self.on_rot is not None:
+                    # first-hand evidence of our OWN rot: the verdict
+                    # plane self-quarantines so the swarm stops hearing
+                    # bytes this disk can no longer be trusted to serve
+                    self.on_rot(src_tid)
                 continue
             dst.write_piece(num, offset, data, digest, source="cas",
                             pre_verified=True)

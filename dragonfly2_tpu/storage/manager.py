@@ -249,14 +249,23 @@ class StorageManager:
             log.info("reloaded %d tasks (completed + warm partials)", n)
         return n
 
-    def _verify_task(self, ts: TaskStorage) -> tuple[int, int, bool]:
+    def _verify_task(self, ts: TaskStorage) -> tuple[int, int, bool, int]:
         """Re-hash one reloaded task's recorded pieces against their
         metadata digests (crc32c rides the native path). BLOCKING — one
         unit of storage-executor work. Returns (pieces_ok,
-        pieces_dropped, task_dropped); a task that loses pieces is
-        demoted to partial (the next conductor re-pulls just the holes),
-        one that loses everything is deleted."""
+        pieces_dropped, task_dropped, pieces_rot); a task that loses
+        pieces is demoted to partial (the next conductor re-pulls just
+        the holes), one that loses everything is deleted.
+
+        ``pieces_rot`` counts drops from tasks that were COMPLETE
+        (done+success) when reloaded: those bytes once verified and
+        were finalized, so failing now is disk bit-rot — the
+        self-quarantine signal. Drops from PARTIAL tasks are the
+        ordinary crash-torn-write shape (data files are not fsynced per
+        write) and must NOT sideline an otherwise healthy daemon at
+        every unclean restart."""
         md = ts.md
+        was_complete = bool(md.done and md.success)
         bad: list[int] = []
         n_ok = 0
         for num, p in sorted(md.pieces.items()):
@@ -275,10 +284,11 @@ class StorageManager:
                 bad.append(num)
                 _reload_pieces.labels("dropped").inc()
         if not bad:
-            return n_ok, 0, False
+            return n_ok, 0, False, 0
+        rot = len(bad) if was_complete else 0
         if len(bad) == len(md.pieces):
             self.delete_task(md.task_id)
-            return n_ok, len(bad), True
+            return n_ok, len(bad), True, rot
         with ts._lock:
             for num in bad:
                 del md.pieces[num]
@@ -290,7 +300,7 @@ class StorageManager:
         if self.castore is not None:
             self.castore.drop_task(md.task_id)
             self.castore.add_task(ts)
-        return n_ok, len(bad), False
+        return n_ok, len(bad), False, rot
 
     def verify_reloaded(self) -> dict:
         """Re-verification of reloaded pieces — a crashed writer's torn
@@ -300,17 +310,18 @@ class StorageManager:
         storage pool instead of serializing a cache-sized scan on one
         thread."""
         stats = {"tasks": 0, "pieces_ok": 0, "pieces_dropped": 0,
-                 "tasks_dropped": 0}
+                 "tasks_dropped": 0, "pieces_rot": 0}
         if not self.cfg.reload_verify:
             return stats
         for ts in self.tasks():
             if not ts.md.pieces:
                 continue
             stats["tasks"] += 1
-            ok, dropped, gone = self._verify_task(ts)
+            ok, dropped, gone, rot = self._verify_task(ts)
             stats["pieces_ok"] += ok
             stats["pieces_dropped"] += dropped
             stats["tasks_dropped"] += 1 if gone else 0
+            stats["pieces_rot"] += rot
         if stats["pieces_dropped"] or stats["tasks_dropped"]:
             log.warning("reload verification dropped %d piece(s), "
                         "%d task(s)", stats["pieces_dropped"],
@@ -324,17 +335,18 @@ class StorageManager:
         single-threaded scan, before the daemon starts serving."""
         from .io_executor import run_io
         stats = {"tasks": 0, "pieces_ok": 0, "pieces_dropped": 0,
-                 "tasks_dropped": 0}
+                 "tasks_dropped": 0, "pieces_rot": 0}
         if not self.cfg.reload_verify:
             return stats
         pending = [ts for ts in self.tasks() if ts.md.pieces]
         stats["tasks"] = len(pending)
         results = await asyncio.gather(
             *(run_io(self._verify_task, ts) for ts in pending))
-        for ok, dropped, gone in results:
+        for ok, dropped, gone, rot in results:
             stats["pieces_ok"] += ok
             stats["pieces_dropped"] += dropped
             stats["tasks_dropped"] += 1 if gone else 0
+            stats["pieces_rot"] += rot
         if stats["pieces_dropped"] or stats["tasks_dropped"]:
             log.warning("reload verification dropped %d piece(s), "
                         "%d task(s)", stats["pieces_dropped"],

@@ -132,7 +132,8 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
               scenario: str = "baseline",
               collect_timeline: bool = False,
               collect_podscope: bool = False,
-              collect_decisions: bool = False) -> dict:
+              collect_decisions: bool = False,
+              quarantine=None) -> dict:
     """Run one simulated fan-out; returns the result dict (pure function
     of its arguments — no wall clock, no global state beyond the process
     metrics registry the flight summaries touch). ``scenario`` switches
@@ -175,9 +176,13 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     # cold_relay drives the REAL relay-tree shaping: the same
     # Scheduling._relay_shape ruling a live scheduler applies (relay off =
     # the exact baseline scoring path, so the PR-3 digest cannot move)
+    # ``quarantine``: an armed (possibly empty) QuarantineRegistry — the
+    # --pr12 purity gate proves an armed-but-evidence-free registry
+    # leaves the schedule digest byte-identical (no verdicts = every
+    # filter lookup answers healthy, no rng touched)
     sched = Scheduling(
         SchedulerConfig(relay_fanout=RELAY_FANOUT if relay_mode else 0),
-        make_evaluator("default"))
+        make_evaluator("default"), quarantine=quarantine)
     decision_rows: list[dict] = []
     if collect_decisions:
         sched.decision_sink = decision_rows.append
@@ -1337,6 +1342,325 @@ def _run_pr10(args) -> dict:
     }
 
 
+# --------------------------------------------------------------- PR-12
+# Poisoned-swarm harness: one byzantine holder serving corrupt bytes into
+# a fan-out, quarantine plane on vs off, through the REAL Scheduling
+# filter + the REAL QuarantineRegistry ladder on a virtual clock. The
+# poisoner is a COMPLETE non-seed holder — exactly the parent the
+# evaluator loves (full piece coverage, free slots) and the pre-PR12
+# fabric kept re-offering after every silent requeue. Measured: pod
+# makespan, wasted corrupt bytes (transfers whose bytes failed
+# verification), time-to-quarantine, and corrupt verdicts absorbed before
+# the ladder engaged.
+
+BYZ_CORRUPT_PCT = 60         # % of poisoner serves that are corrupt
+BYZ_LOCAL_SHUN = 2           # child-local verdict-ledger shun threshold
+                             # (daemon/verdicts.py SHUN_THRESHOLD)
+BYZ_QUARANTINE_THRESHOLD = 3  # registry threshold (scheduler default)
+
+
+def run_byzantine_bench(*, seed: int = 7, daemons: int = 8,
+                        pieces: int = 32, piece_size: int = 4 << 20,
+                        parallelism: int = 4,
+                        corrupt_pct: int = BYZ_CORRUPT_PCT,
+                        quarantine: bool = True) -> dict:
+    """One poisoned fan-out; returns makespan + wasted-byte accounting.
+
+    ``quarantine=True`` models the shipped immune system: each child's
+    local verdict ledger shuns the poisoner after ``BYZ_LOCAL_SHUN``
+    verified corruptions, and the REAL ``QuarantineRegistry`` (driven
+    through ``Scheduling.filter_candidates`` via the ``quarantined``
+    exclusion) removes it pod-wide at the threshold. ``quarantine=False``
+    is the pre-PR12 fabric: corruption is caught piece-by-piece at each
+    landing, silently requeued, and the scheduler keeps offering the
+    poisoner — every child pays for the same lesson separately, forever.
+    Pure function of its arguments (virtual clock, seeded rng)."""
+    from ..idl.messages import Host as HostMsg
+    from ..idl.messages import HostType
+    from ..scheduler.config import SchedulerConfig
+    from ..scheduler.evaluator import make_evaluator
+    from ..scheduler.quarantine import QUARANTINED, QuarantineRegistry
+    from ..scheduler.resource import Peer, PeerState, Resource, Task
+    from ..scheduler.scheduling import Scheduling
+
+    rng = random.Random(seed)
+    random.seed(seed)          # filter_candidates' pool shuffle (see run_bench)
+    now_ref = [0.0]            # virtual ms, read by the registry clock
+
+    res = Resource()
+    task = Task("byz" + "0" * 61, "bench://byzantine")
+    task.set_content_info(pieces * piece_size, piece_size, pieces)
+
+    quarantine_rows: list[dict] = []
+    registry = None
+    if quarantine:
+        registry = QuarantineRegistry(
+            corrupt_threshold=BYZ_QUARANTINE_THRESHOLD,
+            halflife_s=1e9,            # no decay inside one short sim
+            probation_delay_s=1e9,     # no mid-sim reprieve (chaos e2e
+                                       # proves the reprieve half live)
+            sink=quarantine_rows.append,
+            clock=lambda: now_ref[0] / 1000.0)
+    sched = Scheduling(SchedulerConfig(), make_evaluator("default"),
+                       quarantine=registry)
+
+    def topo(slice_name: str, x: int, y: int) -> TopologyInfo:
+        return TopologyInfo(slice_name=slice_name, ici_coords=(x, y),
+                            zone="bench-zone")
+
+    def mk_host(name: str, slice_name: str, x: int, y: int,
+                host_type: HostType = HostType.NORMAL):
+        return res.store_host(HostMsg(
+            id=f"{name}-host", ip="10.0.0.1", port=1, download_port=2,
+            type=host_type, topology=topo(slice_name, x, y)))
+
+    def complete_peer(name: str, host) -> Peer:
+        p = res.get_or_create_peer(f"{name}-peer", task, host)
+        p.transit(PeerState.RUNNING)
+        p.finished_pieces = set(range(pieces))
+        p.transit(PeerState.SUCCEEDED)
+        return p
+
+    seed_peer = complete_peer(
+        "seedh", mk_host("seedh", "slice-seed", 9, 9, HostType.SUPER_SEED))
+    # the poisoner: a complete NORMAL holder INSIDE slice 0 — best link
+    # class, full coverage, the evaluator's favourite parent
+    poisoner = complete_peer("poison", mk_host("poison", "slice-0", 3, 3))
+
+    leechers: list[_Leecher] = []
+    local_corrupt: list[dict] = []     # per-leecher {parent_id: verdicts}
+    for i in range(daemons):
+        s = i % 2
+        idx = i // 2
+        host = mk_host(f"s{s}w{idx}", f"slice-{s}", idx % 2, idx // 2)
+        peer = Peer(f"s{s}w{idx}-peer", task, host)
+        joined = i * 10.0 * rng.uniform(0.9, 1.1)
+        lc = _Leecher(peer, None, joined)
+        leechers.append(lc)
+        local_corrupt.append({})
+
+    by_peer_id = {lc.peer.id: lc for lc in leechers}
+    active: dict[str, int] = {}
+    wasted_bytes = 0
+    wasted_transfers = 0
+    poison_serves_total = 0
+    quarantined_at: float | None = None
+    serves_after_quarantine = 0
+
+    def refresh_parents(lc: _Leecher) -> None:
+        parents = sched.find_parents(lc.peer)
+        lc.parents = parents
+        lc.peer.last_offer_ids = {p.id for p in parents}
+        task.set_parents(lc.peer.id, [p.id for p in parents])
+
+    def holds(parent, piece: int) -> bool:
+        if parent is seed_peer or parent is poisoner:
+            return True
+        src = by_peer_id.get(parent.id)
+        return src is not None and piece in src.done
+
+    def pick(lc: _Leecher, i: int):
+        shun = local_corrupt[i]
+        for piece in range(pieces):
+            if piece in lc.done or piece in lc.inflight:
+                continue
+            holders = [p for p in lc.parents if holds(p, piece)]
+            if quarantine:
+                # the child's own verdict ledger: locally-shunned parents
+                # are refused a dispatcher slot whatever the offer says
+                holders = [p for p in holders
+                           if shun.get(p.id, 0) < BYZ_LOCAL_SHUN]
+            if not holders:
+                continue
+            lt = {p.id: link_type(lc.peer.host.msg.topology,
+                                  p.host.msg.topology) for p in holders}
+            holders.sort(key=lambda p: (active.get(p.id, 0),
+                                        int(lt[p.id]), p.id))
+            return piece, holders[0]
+        return None
+
+    events: list[tuple] = []
+    seq = 0
+
+    def push(t: float, *payload) -> None:
+        nonlocal seq
+        heapq.heappush(events, (t, seq, *payload))
+        seq += 1
+
+    for i, lc in enumerate(leechers):
+        for _ in range(parallelism):
+            push(lc.joined_ms, "worker", i)
+
+    finished = 0
+    while events and finished < len(leechers):
+        now, _s, kind, i, *rest = heapq.heappop(events)
+        now_ref[0] = now
+        lc = leechers[i]
+        if kind == "land":
+            piece, parent_id, corrupted = rest
+            lc.inflight.discard(piece)
+            active[parent_id] = max(0, active.get(parent_id, 0) - 1)
+            if corrupted:
+                # caught at the child's landing verification: the piece
+                # requeues; the corrupt verdict is the immune signal
+                wasted_bytes += piece_size
+                wasted_transfers += 1
+                lc.schedule.append([piece, parent_id, "corrupt"])
+                local_corrupt[i][parent_id] = \
+                    local_corrupt[i].get(parent_id, 0) + 1
+                if registry is not None:
+                    registry.record_corrupt(
+                        "poison-host", task_id=task.id,
+                        reporter=lc.peer.host.id)
+                    refresh_parents(lc)
+                    if (quarantined_at is None and registry.state(
+                            "poison-host") == QUARANTINED):
+                        # stamped HERE, at the verdict that tripped the
+                        # ruling — sampling it on a later worker event
+                        # lagged time_to_quarantine and let a dispatch in
+                        # the gap escape the serves-after counter
+                        quarantined_at = now
+                push(now, "worker", i)
+                continue
+            lc.done.add(piece)
+            lc.peer.finished_pieces.add(piece)
+            lc.schedule.append([piece, parent_id, "ok"])
+            if len(lc.done) >= pieces:
+                lc.done_ms = now
+                lc.peer.transit(PeerState.SUCCEEDED)
+                finished += 1
+            elif len(lc.done) % REFRESH_EVERY == 0:
+                refresh_parents(lc)
+            continue
+        # worker event
+        if len(lc.done) + len(lc.inflight) >= pieces:
+            continue
+        if lc.peer.id not in task.peers:
+            task.add_peer(lc.peer)
+            lc.peer.transit(PeerState.RUNNING)
+            refresh_parents(lc)
+        if not lc.parents:
+            refresh_parents(lc)
+        got = pick(lc, i)
+        if got is None:
+            refresh_parents(lc)
+            push(now + POLL_MS, "worker", i)
+            continue
+        piece, parent = got
+        lc.inflight.add(piece)
+        lt = link_type(lc.peer.host.msg.topology, parent.host.msg.topology)
+        load = active.get(parent.id, 0)
+        active[parent.id] = load + 1
+        ttfb_ms = (LINK_RTT_MS[lt] * (1.0 + TTFB_QUEUE_FACTOR * load)
+                   * rng.uniform(0.9, 1.3))
+        wire_ms = (piece_size / LINK_BW_BPS[lt] * 1000.0
+                   * (1.0 + WIRE_SHARE_FACTOR * load) * rng.uniform(0.9, 1.25))
+        corrupted = False
+        if parent is poisoner:
+            poison_serves_total += 1
+            if quarantined_at is not None:
+                serves_after_quarantine += 1
+            # deterministic per-dispatch draw (seeded rng, dispatch order)
+            corrupted = rng.random() * 100.0 < corrupt_pct
+        t_done = now + ttfb_ms + wire_ms
+        push(t_done, "land", i, piece, parent.id, corrupted)
+        push(t_done, "worker", i)
+    makespan = max((lc.done_ms for lc in leechers), default=0.0)
+    total_bytes = daemons * pieces * piece_size
+    schedules = {lc.peer.id: lc.schedule for lc in leechers}
+    digest = hashlib.sha256(
+        json.dumps(schedules, sort_keys=True).encode()).hexdigest()
+    corrupt_verdicts = sum(sum(d.values()) for d in local_corrupt)
+    return {
+        "seed": seed,
+        "daemons": daemons,
+        "pieces": pieces,
+        "piece_size": piece_size,
+        "corrupt_pct": corrupt_pct,
+        "quarantine": quarantine,
+        "makespan_ms": round(makespan, 3),
+        "wasted_corrupt_bytes": wasted_bytes,
+        "wasted_transfers": wasted_transfers,
+        # corrupt bytes per unit of useful content delivered — the
+        # pod-wide tax the poisoner extracts
+        "wasted_ratio": round(wasted_bytes / total_bytes, 4),
+        "corrupt_verdicts": corrupt_verdicts,
+        "poisoner_serves": poison_serves_total,
+        "poisoner_serves_after_quarantine": serves_after_quarantine,
+        "time_to_quarantine_ms": (round(quarantined_at, 3)
+                                  if quarantined_at is not None else None),
+        "quarantine_rows": len(quarantine_rows),
+        "quarantine_transitions": [
+            {"from": r.get("from_state"), "to": r.get("to_state"),
+             "why": r.get("why")} for r in quarantine_rows],
+        "schedule_digest": digest,
+    }
+
+
+def _run_pr12(args) -> dict:
+    """The PR-12 trajectory point: the swarm immune system under a
+    byzantine holder, quarantine on vs off. A plain baseline sim rides
+    along twice — bare, and with an ARMED-but-evidence-free registry —
+    as the digest gates (both byte-identical to BENCH_pr3: the filter
+    consults the registry only per-candidate and an empty registry
+    answers healthy without touching the rng). Acceptance
+    (tests/test_dfbench.py): quarantine bounds wasted corrupt bytes to a
+    small multiple of the evidence threshold while the unprotected pod's
+    waste scales with daemons x corrupt_pct; the poisoner is quarantined
+    after a bounded number of verdicts and serves ~nothing afterwards;
+    makespan improves."""
+    from ..scheduler.quarantine import QuarantineRegistry
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    armed = run_bench(seed=args.seed, daemons=args.daemons,
+                      pieces=args.pieces, piece_size=args.piece_size,
+                      parallelism=args.parallelism,
+                      quarantine=QuarantineRegistry())
+    shape = dict(seed=args.seed,
+                 daemons=4 if args.smoke else 8,
+                 pieces=8 if args.smoke else 32,
+                 piece_size=(256 << 10) if args.smoke else (4 << 20),
+                 parallelism=args.parallelism)
+    protected = run_byzantine_bench(**shape, quarantine=True)
+    exposed = run_byzantine_bench(**shape, quarantine=False)
+    byz_digest = hashlib.sha256(json.dumps(
+        {"on": protected, "off": exposed},
+        sort_keys=True).encode()).hexdigest()
+    return {
+        "bench": "dfbench-byzantine",
+        "seed": args.seed,
+        "daemons": shape["daemons"],
+        "pieces": shape["pieces"],
+        "piece_size": shape["piece_size"],
+        "corrupt_pct": protected["corrupt_pct"],
+        # the scheduler sim untouched by the quarantine plumbing: digest
+        # gates vs BENCH_pr3 (bare AND armed-empty-registry runs)
+        "schedule_digest": base["schedule_digest"],
+        "quarantine_pure": (base["schedule_digest"]
+                            == armed["schedule_digest"]),
+        "quarantine_on": protected,
+        "quarantine_off": exposed,
+        "makespan_ms": {"on": protected["makespan_ms"],
+                        "off": exposed["makespan_ms"]},
+        "wasted_ratio": {"on": protected["wasted_ratio"],
+                         "off": exposed["wasted_ratio"]},
+        "time_to_quarantine_ms": protected["time_to_quarantine_ms"],
+        "verdicts_to_quarantine": BYZ_QUARANTINE_THRESHOLD,
+        # the headline: with quarantine, pod-wide wasted corrupt bytes
+        # stay bounded near threshold x piece_size; exposed, every child
+        # pays separately and waste scales with daemons x corrupt_pct.
+        # (Makespan is reported, not gated: a 60%-corrupt parent still
+        # contributes 40% goodput in the link model, so wall-clock is
+        # roughly a wash — the tax quarantine removes is wasted BYTES
+        # and verdict churn, which at pod scale is shared-uplink load.)
+        "quarantine_bounds_waste": (
+            protected["wasted_corrupt_bytes"]
+            < exposed["wasted_corrupt_bytes"]),
+        "byzantine_digest": byz_digest,
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -1388,6 +1712,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "p50/p99, foreground p99 vs its uncontended baseline, "
                    "bulk degradation + shed counts, and the QoS-disabled "
                    "digest gate against BENCH_pr3")
+    p.add_argument("--pr12", action="store_true",
+                   help="drive the poisoned-swarm scenario (one byzantine "
+                   "holder serving corrupt bytes, REAL Scheduling filter "
+                   "+ REAL QuarantineRegistry ladder) quarantine-on vs "
+                   "off and write the PR-12 trajectory point "
+                   "(BENCH_pr12.json): makespan, wasted-corrupt-bytes "
+                   "ratio, time-to-quarantine, and the quarantine-"
+                   "disabled digest gate against BENCH_pr3")
     p.add_argument("--pr8", action="store_true",
                    help="replay the baseline run's decision-ledger rows "
                    "through every offline evaluator (default/nt/ml) and "
@@ -1432,7 +1764,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr11:
+        if args.pr12:
+            args.out = "BENCH_pr12.json"
+        elif args.pr11:
             args.out = "BENCH_pr11.json"
         elif args.pr10:
             args.out = "BENCH_pr10.json"
@@ -1452,7 +1786,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr11:
+    if args.pr12:
+        result = _run_pr12(args)
+    elif args.pr11:
         result = _run_pr11(args)
     elif args.pr10:
         result = _run_pr10(args)
@@ -1475,7 +1811,18 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr11:
+        if args.pr12:
+            mk = result["makespan_ms"]
+            wr = result["wasted_ratio"]
+            ttq = result["time_to_quarantine_ms"]
+            print(f"dfbench: wrote {args.out} (byzantine swarm: makespan "
+                  f"on={mk['on']:.0f}ms vs off={mk['off']:.0f}ms, wasted "
+                  f"ratio on={wr['on']} vs off={wr['off']}, quarantined "
+                  f"after {result['quarantine_on']['corrupt_verdicts']} "
+                  f"verdict(s) at {ttq}ms, pure="
+                  f"{result['quarantine_pure']}, "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.pr11:
             print(f"dfbench: wrote {args.out} (fg p99 ratio: "
                   f"qos={result['fg_p99_ratio_qos']}x vs "
                   f"no_qos={result['fg_p99_ratio_no_qos']}x of "

@@ -171,6 +171,16 @@ def verdict(summary: dict) -> str:
             f"refetched — worst sender {worst[-12:] or 'origin'} "
             f"({corrupt[worst]}); a repeat offender here is a corrupting "
             "parent (bad NIC/disk), not congestion")
+    fails = summary.get("fail_codes") or {}
+    noncorrupt = {c: n for c, n in fails.items() if c != "corrupt"}
+    if noncorrupt:
+        parts.append("failed fetches by kind: " + ", ".join(
+            f"{c}x{n}" for c, n in sorted(noncorrupt.items())))
+    for addr in summary.get("quarantined_parents") or []:
+        parts.append(
+            f"parent {addr} was locally QUARANTINED mid-task on corrupt "
+            "verdicts (the verdict ledger shuns it for every task on "
+            "this daemon; the scheduler's registry handles the pod)")
     drops = summary.get("report_drops", 0)
     if drops:
         parts.append(f"{drops} piece reports dropped on a dead scheduler "

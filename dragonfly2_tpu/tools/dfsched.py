@@ -83,6 +83,16 @@ def render_decision(d: dict, *, max_candidates: int = 10) -> str:
     fresh = set(d.get("fresh") or [])
     outcomes = d.get("outcomes") or {}
     edges = d.get("edges") or {}
+    if d.get("decision_kind") == "quarantine":
+        # a quarantine-ladder ruling: no candidate table — the host, the
+        # transition, and the evidence ARE the ruling
+        return (f"decision {d.get('decision_id', '?')} (quarantine)  "
+                f"host {d.get('host_id', '?')[-28:]}: "
+                f"{d.get('from_state', '?')} -> {d.get('to_state', '?')}"
+                f"  [{d.get('why', '')}]"
+                f"  evidence={d.get('corrupt_evidence', 0)}"
+                f" reporters={len(d.get('reporters') or [])}"
+                + ("  SELF-FLAGGED" if d.get("self_flagged") else ""))
     out = [f"decision {d.get('decision_id', '?')} "
            f"({d.get('decision_kind', '?')}, {d.get('evaluator', '?')})  "
            f"task {d.get('task_id', '?')[:16]}  "

@@ -174,6 +174,17 @@ async def _run_with_chaos(args) -> dict:
 
     from ..common import faultgate
 
+    if getattr(args, "byzantine", None):
+        # --byzantine PCT: the target daemon becomes a poisoner — flip
+        # bytes in PCT% of the ranges it serves (site upload.serve,
+        # deterministic striding) so the pod's verdict/quarantine plane
+        # can be exercised against a live swarm
+        clause = f"upload.serve=corrupt:pct={int(args.byzantine)}:n=-1"
+        args.chaos = f"{args.chaos};{clause}" if args.chaos else clause
+        if not args.chaos_target:
+            raise SystemExit("stress: --byzantine needs --chaos-target "
+                             "http://daemon:upload_port (the daemon that "
+                             "will serve corrupt bytes)")
     target = args.chaos_target.rstrip("/")
     session = None
     try:
@@ -234,6 +245,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--chaos", default="",
                    help="faultgate script to arm for the run, e.g. "
                         "'piece.wire=delay:0.2:n=-1' (docs/RESILIENCE.md)")
+    p.add_argument("--byzantine", nargs="?", const=30, type=int,
+                   default=None, metavar="PCT",
+                   help="arm the --chaos-target daemon as a byzantine "
+                        "poisoner: corrupt PCT%% (default 30) of the "
+                        "ranges it serves (site upload.serve), disarmed "
+                        "after the run. The report gains per-parent "
+                        "corrupt-verdict counts swept from the "
+                        "--pod-report daemons' /debug/verdicts — the "
+                        "live proof the quarantine plane engaged")
     p.add_argument("--chaos-target", default="",
                    help="daemon debug base URL (http://host:upload_port); "
                         "the script is POSTed to /debug/faults there and "
@@ -258,8 +278,50 @@ def main(argv: list[str] | None = None) -> int:
         result["pex"] = asyncio.run(_fetch_pex(args.pex_dump.rstrip("/")))
     if args.pod_report:
         result["podscope"] = _pod_report(args.pod_report)
+    if args.byzantine:
+        result["byzantine"] = {
+            "pct": int(args.byzantine),
+            "target": args.chaos_target,
+            # per-parent corrupt counts as the DOWNLOADERS saw them:
+            # who recorded verdicts against whom, and who got shunned
+            "verdicts": _verdict_report(args.pod_report),
+        }
     print(json.dumps(result))
     return 1 if result["requests"] == result["errors"] else 0
+
+
+def _verdict_report(pod: str) -> dict:
+    """Per-parent corrupt-verdict counts swept from each daemon's
+    /debug/verdicts (the --byzantine report body). Diagnostics must not
+    fail a run; no pod set = nothing to sweep. Deliberately a direct
+    sweep rather than a ride-along on --pod-report's podscope collection:
+    the podscope compaction drops the per-parent COUNT columns this
+    report exists to show, and one extra GET per daemon on a diagnostics
+    path is cheaper than a second compaction contract."""
+    if not pod:
+        return {"note": "pass --pod-report to sweep /debug/verdicts"}
+    import urllib.error
+    import urllib.request
+
+    out: dict = {}
+    for addr in (a.strip() for a in pod.split(",") if a.strip()):
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}/debug/verdicts", timeout=5.0) as resp:
+                snap = json.loads(resp.read())
+        except (OSError, ValueError) as exc:
+            out[addr] = {"error": str(exc) or type(exc).__name__}
+            continue
+        parents = snap.get("parents") or {}
+        out[addr] = {
+            "self_quarantined": snap.get("self_quarantined", False),
+            "corrupt": {p: row.get("codes", {}).get("corrupt", 0)
+                        for p, row in parents.items()
+                        if row.get("codes", {}).get("corrupt")},
+            "shunned": [p for p, row in parents.items()
+                        if row.get("shunned")],
+        }
+    return out
 
 
 def _pod_report(pod: str) -> dict:

@@ -526,6 +526,93 @@ class TestPr11Qos:
         assert not list(tmp_path.iterdir())      # stdout only
 
 
+class TestPr12Byzantine:
+    """PR-12 point: the swarm immune system under a byzantine holder.
+    The poisoned sim must be deterministic, the scheduler sim untouched
+    with the quarantine plane disarmed OR armed-but-evidence-free
+    (digest == BENCH_pr3), and quarantine must bound pod-wide wasted
+    corrupt bytes while the exposed pod pays per child forever."""
+
+    def test_byzantine_bench_deterministic(self):
+        from dragonfly2_tpu.tools.dfbench import run_byzantine_bench
+        shape = dict(seed=7, daemons=4, pieces=8, piece_size=256 << 10)
+        a = run_byzantine_bench(**shape, quarantine=True)
+        b = run_byzantine_bench(**shape, quarantine=True)
+        assert a == b
+        c = run_byzantine_bench(seed=11, daemons=4, pieces=8,
+                                piece_size=256 << 10, quarantine=True)
+        assert c["schedule_digest"] != a["schedule_digest"]
+
+    def test_armed_empty_registry_never_moves_the_digest(self):
+        """The purity gate, in-process: an armed registry with zero
+        evidence answers healthy for every host and the schedule is
+        byte-identical to the registry-less run."""
+        from dragonfly2_tpu.scheduler.quarantine import QuarantineRegistry
+        bare = run_bench(seed=7, daemons=6, pieces=24)
+        armed = run_bench(seed=7, daemons=6, pieces=24,
+                          quarantine=QuarantineRegistry())
+        assert armed["schedule_digest"] == bare["schedule_digest"]
+
+    def test_quarantine_bounds_waste_and_engages_fast(self):
+        from dragonfly2_tpu.tools.dfbench import (BYZ_QUARANTINE_THRESHOLD,
+                                                  run_byzantine_bench)
+        shape = dict(seed=7, daemons=6, pieces=16, piece_size=256 << 10)
+        on = run_byzantine_bench(**shape, quarantine=True)
+        off = run_byzantine_bench(**shape, quarantine=False)
+        # exposed: every child keeps being steered back at the poisoner
+        assert off["wasted_corrupt_bytes"] > 4 * on["wasted_corrupt_bytes"]
+        # bounded engagement: a small multiple of the evidence threshold
+        # (concurrent in-flight transfers race the ruling by a few)
+        assert on["time_to_quarantine_ms"] is not None
+        assert on["corrupt_verdicts"] <= 3 * BYZ_QUARANTINE_THRESHOLD
+        # excluded pod-wide once ruled: nothing new dispatched to it
+        assert on["poisoner_serves_after_quarantine"] == 0
+        # and the ladder's rulings are on the row stream
+        assert any(t["to"] == "quarantined"
+                   for t in on["quarantine_transitions"])
+        assert off["quarantine_rows"] == 0
+
+    def test_pr12_matches_committed_baselines(self, tmp_path):
+        """The committed trajectory gate: a default-size --pr12 run must
+        reproduce the committed byzantine_digest byte-for-byte and carry
+        the BENCH_pr3 schedule digest (quarantine disarmed/evidence-free
+        moves no scheduling)."""
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr12", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads((tmp_path / "BENCH_pr12.json").read_text())
+        assert r["bench"] == "dfbench-byzantine"
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["quarantine_pure"] is True
+        assert r["quarantine_bounds_waste"] is True
+        committed = json.loads(
+            open(os.path.join(REPO, "BENCH_pr12.json")).read())
+        assert r["byzantine_digest"] == committed["byzantine_digest"]
+        assert committed["schedule_digest"] == pr3["schedule_digest"]
+        assert committed["quarantine_pure"] is True
+        assert committed["quarantine_bounds_waste"] is True
+        assert committed["time_to_quarantine_ms"] is not None
+        assert committed["quarantine_on"][
+            "poisoner_serves_after_quarantine"] == 0
+
+    def test_pr12_smoke_stdout_only(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr12", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-byzantine"
+        assert r["quarantine_pure"] is True
+        assert r["quarantine_bounds_waste"] is True
+        assert not list(tmp_path.iterdir())      # stdout only
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(

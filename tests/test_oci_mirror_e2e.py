@@ -21,6 +21,8 @@ import os
 import ssl
 
 import pytest
+
+pytest.importorskip("cryptography")  # TLS registry + MITM need the wheel
 from aiohttp import web
 
 from dragonfly2_tpu.common.certs import CertIssuer

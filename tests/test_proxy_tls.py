@@ -16,6 +16,8 @@ import ssl
 
 import pytest
 
+pytest.importorskip("cryptography")  # MITM cert minting needs the wheel
+
 from dragonfly2_tpu.common.certs import CertIssuer, generate_ca
 from dragonfly2_tpu.daemon.config import (DaemonConfig, DownloadConfig,
                                           ProxyConfig, StorageSection)

@@ -13,6 +13,8 @@ import os
 
 import pytest
 
+pytest.importorskip("cryptography")  # cert minting needs the wheel
+
 from dragonfly2_tpu.common.certs import CertIssuer
 from dragonfly2_tpu.idl.messages import Empty
 from dragonfly2_tpu.rpc.client import Channel, ServiceClient

@@ -133,8 +133,14 @@ def _flight_times(flight: dict, summary: dict) -> tuple[float, float]:
     return start, start + end_ms / 1000.0
 
 
-def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
-    """One task's tree/edge/makespan report from [(addr, flight), ...]."""
+def _aggregate_task(task_id: str, holders: list[tuple[str, dict]],
+                    pods: dict[str, str] | None = None) -> dict:
+    """One task's tree/edge/makespan report from [(addr, flight), ...].
+    ``pods`` (addr -> pod id, from each daemon's /debug/pex host block or
+    a bench snapshot's ``pod`` label) marks pod-CROSSING edges: the DCN
+    tier the federation plane rations, rendered as ``[dcn]`` by
+    render_pod and summed into ``cross_pod_bytes``."""
+    pods = pods or {}
     peer_to_addr: dict[str, str] = {}
     for addr, flight in holders:
         pid = flight.get("peer_id") or ""
@@ -237,6 +243,11 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
         e["ttfb_ms"] = round(e["ttfb_ms"], 3)
         e["bandwidth_bps"] = (round(e["bytes"] / (e["wire_ms"] / 1e3))
                               if e["wire_ms"] > 0 else 0)
+        # pod-tier mark: both endpoints' pods known and different = a
+        # DCN-crossing edge of the two-level federation tree
+        sp, dp = pods.get(e["src"], ""), pods.get(e["dst"], "")
+        if sp and dp and sp != dp:
+            e["cross_pod"] = True
     # fallback stitch: a parent that never downloaded the task here (a
     # restarted seed re-seeded from disk) journals serves on a flight
     # with NO peer id, so the exact key can't match. When a child edge's
@@ -378,6 +389,8 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
         amp_note = ""
     makespan_ms = (round((max(ends) - min(starts)) * 1000.0, 3)
                    if starts and ends else 0.0)
+    cross_pod_bytes = sum(e["bytes"] for e in edges.values()
+                          if e.get("cross_pod"))
     return {
         "task_id": task_id,
         "content_length": content,
@@ -387,6 +400,7 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]]) -> dict:
         "depth": depth,
         "origin_bytes": origin_bytes,
         "placed_bytes": placed_bytes,
+        "cross_pod_bytes": cross_pod_bytes,
         "amplification": amplification,
         "amplification_note": amp_note,
         "edges": sorted(edges.values(),
@@ -407,7 +421,14 @@ def aggregate(snapshots: list[dict]) -> dict:
     unreachable = {s["addr"]: s["error"] for s in snapshots if "error" in s}
     by_task: dict[str, list[tuple[str, dict]]] = {}
     daemons_detail: dict[str, dict] = {}
+    # addr -> pod id: from a bench snapshot's own label, else the
+    # daemon's /debug/pex host block — the per-tier edge marks' source
+    pods: dict[str, str] = {}
     for s in snapshots:
+        pod = (s.get("pod")
+               or ((s.get("pex") or {}).get("host") or {}).get("pod") or "")
+        if pod:
+            pods[s["addr"]] = pod
         for tid, flight in (s.get("flights") or {}).items():
             by_task.setdefault(tid, []).append((s["addr"], flight))
         if "error" in s:
@@ -420,6 +441,7 @@ def aggregate(snapshots: list[dict]) -> dict:
         verdicts = s.get("verdicts") or {}
         vparents = verdicts.get("parents") or {}
         daemons_detail[s["addr"]] = {
+            "pod": pods.get(s["addr"], ""),
             "health_status": health.get("status", ""),
             "loop_max_lag_s": (health.get("loop") or {}).get(
                 "max_lag_s", 0.0),
@@ -429,7 +451,7 @@ def aggregate(snapshots: list[dict]) -> dict:
             "shunned": sorted(a for a, row in vparents.items()
                               if row.get("shunned")),
         }
-    tasks = {tid: _aggregate_task(tid, holders)
+    tasks = {tid: _aggregate_task(tid, holders, pods=pods)
              for tid, holders in sorted(by_task.items())}
 
     # quarantine view: who the pod's local verdicts condemn, and whether
@@ -523,6 +545,7 @@ def bench_summary(task_report: dict) -> dict:
         "amplification": task_report["amplification"],
         "origin_bytes": task_report["origin_bytes"],
         "placed_bytes": task_report.get("placed_bytes", 0),
+        "cross_pod_bytes": task_report.get("cross_pod_bytes", 0),
         "edges": len(task_report["edges"]),
         "edge_bandwidth_bps": {"p5": _pctl(bws, 0.05),
                                "p50": _pctl(bws, 0.50),
@@ -614,6 +637,10 @@ def render_pod(report: dict, *, max_edges_per_node: int = 8) -> str:
                 last = i == len(shown) - 1
                 tick = "└─ " if last else "├─ "
                 mark = ""
+                if e.get("cross_pod"):
+                    # a pod-crossing (DCN-tier) edge of the two-level
+                    # federation tree — healthy only on seed edges
+                    mark += "  [dcn]"
                 if e.get("relayed"):
                     mark += "  [relay]"
                 if e.get("confirmed"):
@@ -663,6 +690,11 @@ def render_pod(report: dict, *, max_edges_per_node: int = 8) -> str:
                 f"{rl['pieces']}pc streamed mid-landing, chain depth "
                 f"{rl['depth']}, ~{rl['per_hop_added_ms']:.1f}ms added "
                 "per hop")
+        if t.get("cross_pod_bytes"):
+            out.append(
+                f"  federation: {_fmt_bytes(t['cross_pod_bytes'])} "
+                "crossed a pod boundary ([dcn] edges) — healthy when "
+                "only pod-seed edges carry it")
         su = t.get("seed_uplink")
         if su:
             out.append(

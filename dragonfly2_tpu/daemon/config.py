@@ -127,6 +127,18 @@ class PexConfig:
     ttl_s: float = 60.0               # swarm-index entry lifetime
     bootstrap: list[str] = field(default_factory=list)  # ip:upload_port seeds
     max_digest_tasks: int = 256       # tasks advertised per digest
+    # cross-pod federation (ROADMAP item 2): full piece-set digests stay
+    # pod-scoped when the host knows its pod (pod_scope); an OPERATOR-
+    # DESIGNATED summary seed (pod_seed — deliberately static config,
+    # independent of the scheduler's per-task routing election, so
+    # summary exchange survives a scheduler outage; designate >= 2 per
+    # pod) additionally exchanges the compact completeness summary with
+    # the other pods' summary seeds listed in federation_peers
+    # (ip:upload_port) — gossip bytes then scale with the pod, not the
+    # fleet (docs/RESILIENCE.md "Cross-pod federation")
+    pod_scope: bool = True
+    pod_seed: bool = False
+    federation_peers: list[str] = field(default_factory=list)
 
 
 @dataclass

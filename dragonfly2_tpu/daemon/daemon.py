@@ -109,7 +109,10 @@ class Daemon:
                 interval_s=cfg.pex.interval_s, fanout=cfg.pex.fanout,
                 max_digest_tasks=cfg.pex.max_digest_tasks,
                 bootstrap=cfg.pex.bootstrap, relay=self.relay,
-                verdicts=self.verdicts)
+                verdicts=self.verdicts,
+                pod_scope=cfg.pex.pod_scope,
+                pod_seed=cfg.pex.pod_seed,
+                federation_peers=cfg.pex.federation_peers)
         self.upload_server = UploadServer(
             self.storage_mgr, port=cfg.upload.port,
             rate_limit_bps=cfg.upload.rate_limit_bps,

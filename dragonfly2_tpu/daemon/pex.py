@@ -51,13 +51,19 @@ from ..common.errors import Code
 from ..common.metrics import REGISTRY
 from ..idl.messages import (PeerAddr, PeerPacket, RegisterResult, SizeScope,
                             TopologyInfo)
-from ..tpu.topology import ici_hops, link_type
+from ..tpu.topology import ici_hops, link_type, pod_id
 from . import flight_recorder as fr
 from .swarm_index import SwarmEntry, SwarmIndex
 
 log = logging.getLogger("df.flow.pex")
 
 DIGEST_VERSION = 1
+# origins whose partial summary claims are retained for /debug/pex,
+# and how long a claim outlives the last summary that refreshed it (a
+# dead pod seed's stale progress must age out like every other PEX
+# structure, and stale corpses must not crowd live seeds out of the cap)
+MAX_FED_PARTIALS = 32
+FED_PARTIALS_TTL_S = 120.0
 # peers dropped from membership after this many consecutive failed rounds
 PEER_FAIL_LIMIT = 3
 # membership sample size carried per digest (transitive discovery)
@@ -83,6 +89,11 @@ _peers_gauge = REGISTRY.gauge(
 _sched_revived = REGISTRY.counter(
     "df_pex_sched_revived_total",
     "demoted schedulers revived by the PEX ticker's lazy probe")
+_fed_summaries = REGISTRY.counter(
+    "df_federation_summaries_total",
+    "compact inter-pod completeness summaries exchanged between elected "
+    "pod seeds (task -> done/have counts, never piece sets), by "
+    "direction", ("transport",))
 
 
 class PeerInfo:
@@ -118,7 +129,7 @@ def _topo_to_wire(t: TopologyInfo | None) -> dict | None:
     if t is None:
         return None
     return {"slice": t.slice_name, "ici": list(t.ici_coords or []) or None,
-            "zone": t.zone}
+            "zone": t.zone, "pod": t.pod}
 
 
 def _topo_from_wire(d: dict | None) -> TopologyInfo | None:
@@ -127,7 +138,8 @@ def _topo_from_wire(d: dict | None) -> TopologyInfo | None:
     ici = d.get("ici")
     return TopologyInfo(slice_name=d.get("slice", ""),
                         ici_coords=tuple(ici) if ici else None,
-                        zone=d.get("zone", ""))
+                        zone=d.get("zone", ""),
+                        pod=str(d.get("pod") or ""))
 
 
 def seal(body: dict) -> bytes:
@@ -170,8 +182,32 @@ class PexGossiper:
                  engine_factory: Callable[[], Any] | None = None,
                  relay: Any = None,
                  verdicts: Any = None,
+                 pod_scope: bool = True,
+                 pod_seed: bool = False,
+                 federation_peers: list[str] | None = None,
                  rng: random.Random | None = None):
         self.storage_mgr = storage_mgr
+        # cross-pod federation (ROADMAP item 2): full piece-set digests
+        # stay POD-SCOPED (gossip bandwidth must not grow with total
+        # fleet size) — when this host has a pod identity, full digests
+        # only target same-pod (or pod-less) peers. A daemon configured
+        # as a pod seed additionally exchanges the COMPACT inter-pod
+        # summary (build_summary: task -> completeness, never piece
+        # sets) with the other pods' seeds named in federation_peers.
+        self.pod_scope = pod_scope
+        self.pod_seed = pod_seed
+        self.federation_peers = list(federation_peers or [])
+        # receiver-side view of other pods' PARTIAL progress claims from
+        # inter-pod summaries (task -> have/total per origin host): never
+        # indexed as coverage (a count is not a piece set), but surfaced
+        # on /debug/pex so "how far along is pod B's seed" is answerable
+        # without asking pod B; bounded per MAX_FED_PARTIALS
+        self.fed_partials: dict[str, dict] = {}
+        # per-federation-peer failure cooldown: federation_peers is
+        # STATIC config, so a decommissioned seed would otherwise add a
+        # full HTTP timeout to every round forever — a failed addr sits
+        # out like an evicted gossip peer does (_dead_until semantics)
+        self._fed_backoff: dict[str, float] = {}
         self.relay = relay               # RelayHub: watermark in digests
         # per-parent verdict ledger (daemon/verdicts.py): shunned holders
         # are dropped from the swarm index and the pex rung's candidates;
@@ -274,10 +310,24 @@ class PexGossiper:
     def _targets(self) -> list[PeerInfo]:
         """Gossip fanout for this round: ICI neighbors first (cheapest
         links carry the chattiest traffic), then by freshness, with one
-        random pick appended so distant membership still converges."""
+        random pick appended so distant membership still converges.
+        Pod-scoped (``pod_scope``): when this host knows its pod, FULL
+        piece-set digests go only to same-pod (or pod-less) peers —
+        cross-pod availability travels as the seeds' compact summaries
+        instead, so per-round gossip bytes scale with the POD, not the
+        fleet."""
         host = self.host_info()
         mine = getattr(host, "topology", None)
         peers = list(self.peers.values())
+        my_pod = pod_id(mine)
+        if self.pod_scope and my_pod:
+            local = [p for p in peers
+                     if pod_id(p.topology) in ("", my_pod)]
+            # lone-daemon fallback: a fresh pod's first daemon often
+            # knows ONLY another pod's seed (its bootstrap) — gossiping
+            # cross-pod beats being isolated entirely; the scope bounds
+            # the steady state, it must never silence the boot
+            peers = local or peers
         if not peers:
             return []
         peers.sort(key=lambda p: (int(link_type(mine, p.topology)),
@@ -354,6 +404,47 @@ class PexGossiper:
     def envelope(self) -> bytes:
         return seal(self.build_digest())
 
+    def build_summary(self) -> dict:
+        """The compact inter-pod digest: per task one COMPLETENESS row —
+        done flag, landed count, geometry — and no piece sets, no peer
+        sample. This is what elected pod seeds exchange across the DCN:
+        a complete cross-pod holder is indexable (a seed can pull whole
+        tasks through it), a partial one is a counter for observability
+        only (``ingest`` skips pieceless partial rows, so a summary can
+        never plant phantom partial coverage the pex rung would park
+        on). Size is O(tasks), independent of pod or fleet size."""
+        host = self.host_info()
+        tasks = []
+        selfq = self.verdicts is not None and self.verdicts.self_quarantined
+        for ts in () if selfq else self.storage_mgr.tasks():
+            md = ts.md
+            if not md.pieces and not (md.done and md.success):
+                continue
+            tasks.append({"task_id": md.task_id,
+                          "total": md.total_piece_count,
+                          "content_length": md.content_length,
+                          "piece_size": md.piece_size,
+                          "done": bool(md.done and md.success),
+                          "have": len(md.pieces)})
+            if len(tasks) >= self.max_digest_tasks:
+                break
+        return {
+            "v": DIGEST_VERSION,
+            "kind": "summary",
+            "origin": {"host_id": host.id, "ip": host.ip,
+                       "rpc_port": host.port,
+                       "download_port": host.download_port,
+                       "is_seed": int(host.type) != 0,
+                       "selfq": selfq,
+                       "topology": _topo_to_wire(
+                           getattr(host, "topology", None))},
+            "peers": [],
+            "tasks": tasks,
+        }
+
+    def summary_envelope(self) -> bytes:
+        return seal(self.build_summary())
+
     def ingest(self, raw: bytes, *, transport: str = "push") -> bool:
         """Verify + merge a received envelope. False = rejected (checksum,
         JSON, version, or field types — the seal only proves the sender
@@ -365,6 +456,8 @@ class PexGossiper:
         if body is None:
             return False
         try:
+            body_kind = str(body.get("kind") or "digest")
+            partials: dict[str, dict] = {}
             origin = body.get("origin") or {}
             topo = _topo_from_wire(origin.get("topology"))
             host_id = str(origin.get("host_id") or "")
@@ -393,6 +486,13 @@ class PexGossiper:
                                 else {int(n) for n in t.get("relay") or []}
                                 or None)
                 if not done and not pieces and not relay_pieces:
+                    if body_kind == "summary":
+                        # partial cross-pod claims are NEVER coverage (a
+                        # count is not a piece set) but they ARE progress
+                        # observability — retained for /debug/pex
+                        partials[task_id] = {
+                            "have": int(t.get("have") or 0),
+                            "total": int(t.get("total", -1))}
                     continue
                 entries.append((task_id, SwarmEntry(
                     host_id=host_id or f"{ip}:{download_port}", ip=ip,
@@ -433,8 +533,21 @@ class PexGossiper:
         elif ip and download_port:
             for task_id, entry in entries:
                 self.index.update(task_id, entry)
+        if body_kind == "summary" and not origin_selfq:
+            key = host_id or origin_addr
+            self.fed_partials.pop(key, None)
+            self._purge_fed_partials()
+            if partials and len(self.fed_partials) < MAX_FED_PARTIALS:
+                self.fed_partials[key] = {"at": time.monotonic(),
+                                          "tasks": partials}
         _digests_received.labels(transport).inc()
         return True
+
+    def _purge_fed_partials(self, *, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        for key in [k for k, v in self.fed_partials.items()
+                    if now - v["at"] > FED_PARTIALS_TTL_S]:
+            del self.fed_partials[key]
 
     # -- gossip rounds -------------------------------------------------
 
@@ -464,6 +577,7 @@ class PexGossiper:
         Public so tests and operators can drive it deterministically."""
         self.rounds += 1
         self.index.purge()
+        self._purge_fed_partials()
         if self.verdicts is not None:
             # verdicts may have flipped since the entries landed: a
             # holder shunned mid-interval stops being offerable NOW, not
@@ -519,7 +633,60 @@ class PexGossiper:
                     self._dead_until[peer.addr] = (
                         time.monotonic() + 10 * self.interval_s)
                     _peers_gauge.set(len(self.peers))
+        exchanged += await self._federation_round()
         await self._probe_demoted_schedulers()
+        return exchanged
+
+    async def _federation_round(self) -> int:
+        """The inter-pod half: an elected pod seed push-pulls the COMPACT
+        completeness summary with the other pods' seeds
+        (``federation_peers``). Rides the same ``pex.gossip`` faultgate
+        site as in-pod digests, with its own failure cooldown (the peer
+        list is static config, so a dead seed backs off instead of being
+        evicted), and never grows with pod size — cross-pod gossip is
+        O(seeds x tasks), which is how the PEX plane scales to a fleet
+        without every daemon gossiping with every other pod."""
+        if not self.pod_seed or not self.federation_peers:
+            return 0
+        exchanged = 0
+        now = time.monotonic()
+        window = [a for a in self.federation_peers
+                  if self._fed_backoff.get(a, 0.0) <= now]
+        if len(window) > self.fanout + 1:
+            # rotate the window by round so every configured seed pair
+            # eventually exchanges — a fixed prefix would leave pods
+            # beyond it permanently blind to each other (summaries carry
+            # no transitive re-gossip by design)
+            start = self.rounds % len(window)
+            window = [window[(start + k) % len(window)]
+                      for k in range(self.fanout + 1)]
+        for addr in window:
+            ip, _, port = addr.rpartition(":")
+            if not ip or not port.isdigit():
+                continue
+            try:
+                if faultgate.ARMED:
+                    await faultgate.fire("pex.gossip", key=addr)
+                payload = self.summary_envelope()
+                if faultgate.ARMED:
+                    payload = faultgate.corrupt("pex.gossip", payload,
+                                                key=addr)
+                url = f"{self._scheme}://{addr}/pex/summary"
+                async with self._get_session().post(url,
+                                                    data=payload) as resp:
+                    if resp.status != 200:
+                        raise OSError(f"HTTP {resp.status}")
+                    self.ingest(await resp.read(), transport="summary")
+                exchanged += 1
+                self._fed_backoff.pop(addr, None)
+                _fed_summaries.labels("sent").inc()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - seed churn is normal
+                _fed_summaries.labels("error").inc()
+                self._fed_backoff[addr] = (time.monotonic()
+                                           + 10 * self.interval_s)
+                log.debug("inter-pod summary with %s failed: %s", addr, exc)
         return exchanged
 
     async def _probe_demoted_schedulers(self) -> None:
@@ -583,17 +750,34 @@ class PexGossiper:
 
     def _candidates(self, conductor) -> list:
         host = self.host_info()
+        mine = getattr(host, "topology", None)
         entries = self.index.parents_for(
             conductor.task_id,
-            self_topology=getattr(host, "topology", None),
+            self_topology=mine,
             exclude_host=host.id)
         if self.verdicts is not None:
             # the pex rung has no scheduler to rescue it from a poisoner:
-            # locally-shunned holders are OUT; hinted/suspect ones sort
-            # last (deprioritized, still usable — the anti-slander rule's
-            # ceiling for hearsay)
+            # locally-shunned holders are OUT — and they are dropped
+            # BEFORE the pod-first gate below, or a shunned in-pod
+            # holder would both satisfy coverage and discard the clean
+            # cross-pod fallback, pushing the pull all the way to origin
             entries = [e for e in entries
                        if not self.verdicts.shunned(e.addr)]
+        my_pod = pod_id(mine)
+        if my_pod and entries:
+            # pod-first rung: when pod-local holders (incl. pod-less
+            # plain peers) cover everything this conductor still needs,
+            # never cross the DCN — cross-pod entries (the seeds'
+            # summary-advertised holders) are the fallback for content
+            # the pod does not hold, not a parallel source that would
+            # turn every cache miss into N DCN streams
+            local = [e for e in entries
+                     if pod_id(e.topology) in ("", my_pod)]
+            if local and self._covers_task(local, conductor):
+                entries = local
+        if self.verdicts is not None:
+            # hinted/suspect holders sort last (deprioritized, still
+            # usable — the anti-slander rule's ceiling for hearsay)
             entries.sort(key=lambda e: 1 if self.verdicts.deprioritized(
                 e.addr) else 0)
         return entries
@@ -692,11 +876,27 @@ class PexGossiper:
 
     # -- debug surface -------------------------------------------------
 
+    def _fed_partials_view(self) -> dict:
+        self._purge_fed_partials()
+        now = time.monotonic()
+        return {key: {"age_s": round(now - v["at"], 1), "tasks": v["tasks"]}
+                for key, v in self.fed_partials.items()}
+
     def debug_snapshot(self) -> dict:
+        host = self.host_info()
+        topo = getattr(host, "topology", None)
         return {
             "interval_s": self.interval_s,
             "fanout": self.fanout,
             "rounds": self.rounds,
+            # this daemon's own fabric position: podscope stitches the
+            # two-level tree's per-tier edge marks from these
+            "host": {"pod": pod_id(topo),
+                     "slice": getattr(topo, "slice_name", ""),
+                     "zone": getattr(topo, "zone", ""),
+                     "pod_seed": self.pod_seed},
+            "federation_peers": list(self.federation_peers),
+            "federation_partials": self._fed_partials_view(),
             "peers": [p.describe() for p in self.peers.values()],
             "swarm": self.index.snapshot(),
         }
@@ -745,9 +945,25 @@ def add_pex_routes(router, gossiper: PexGossiper) -> None:
         return web.Response(body=gossiper.envelope(),
                             content_type="application/octet-stream")
 
+    async def get_summary(_r: web.Request) -> web.Response:
+        return web.Response(body=gossiper.summary_envelope(),
+                            content_type="application/octet-stream")
+
+    async def post_summary(request: web.Request) -> web.Response:
+        # the inter-pod half: another pod's seed pushes its completeness
+        # summary; the 200 body is OUR summary (push-pull, like digests)
+        raw = await request.read()
+        if not gossiper.ingest(raw, transport="summary"):
+            raise web.HTTPBadRequest(text="summary verification failed")
+        _fed_summaries.labels("received").inc()
+        return web.Response(body=gossiper.summary_envelope(),
+                            content_type="application/octet-stream")
+
     async def debug_pex(_r: web.Request) -> web.Response:
         return web.json_response(gossiper.debug_snapshot())
 
     router.add_get("/pex/digest", get_digest)
     router.add_post("/pex/digest", post_digest)
+    router.add_get("/pex/summary", get_summary)
+    router.add_post("/pex/summary", post_summary)
     router.add_get("/debug/pex", debug_pex)

@@ -43,11 +43,27 @@ _picks = REGISTRY.counter("df_dispatch_pick_total",
 # links are uncongested. Saturation still escapes the tier: busy (503) and
 # cooldown-ejected parents drop out of the holder set, and in-flight load
 # shifts choice within the tier.
+#
+# The tiers name the BANDWIDTH classes the federation plane routes
+# around — in the default slice-derived pod mapping they coincide with
+# pod boundaries (same-pod beats pod-crossing beats zone-crossing), and
+# the ordering is unit-pinned against LINK_BANDWIDTH_SCORE and
+# LINK_TIER_NAMES in tests/test_federation.py. Under an explicit
+# DF_POD_ID that groups several slices into one pod, an intra-pod DCN
+# link still ranks in the DCN tier on purpose: the dispatcher orders by
+# where the bytes flow (the NIC), while pod-boundary POLICY stays the
+# scheduler's (federation.allows) — the two dimensions agree on
+# bandwidth, not on membership:
+TIER_SAME_POD = 0    # LOCAL + ICI: the bytes never leave the pod's
+                     # wired fabric — ICI moves them at memory-ish rates
+TIER_CROSS_POD = 1   # DCN: pod-crossing, the thin tier cross-pod
+                     # federation rations through elected pod seeds
+TIER_CROSS_ZONE = 2  # WAN: cross-zone / unknown — last resort
 LINK_TIER = {
-    LinkType.LOCAL: 0,
-    LinkType.ICI: 0,     # same-host and same-slice are both "don't leave
-    LinkType.DCN: 1,     # the slice" — ICI moves bytes at memory-ish rates
-    LinkType.WAN: 2,
+    LinkType.LOCAL: TIER_SAME_POD,
+    LinkType.ICI: TIER_SAME_POD,
+    LinkType.DCN: TIER_CROSS_POD,
+    LinkType.WAN: TIER_CROSS_ZONE,
 }
 
 EXPLORE_RATIO = 0.1          # epsilon for random parent choice

@@ -144,6 +144,14 @@ class TopologyInfo:
     num_chips: int = 0
     zone: str = ""                   # cloud zone (DCN domain)
     cluster_id: int = 0
+    # explicit pod identity (cross-pod federation, ROADMAP item 2): the
+    # ICI bandwidth domain this host belongs to. "" = derive from slice
+    # identity (``tpu.topology.pod_id``: one slice == one ICI domain ==
+    # one pod); set explicitly (DF_POD_ID) only when a deployment groups
+    # hosts differently from slice boundaries. Rides every register/
+    # announce so the scheduler can route cross-pod pulls through the
+    # pod's elected seeds instead of letting the whole fleet cross DCN.
+    pod: str = ""
 
 
 @message

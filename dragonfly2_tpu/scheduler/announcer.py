@@ -193,7 +193,16 @@ class SchedulerAnnouncer:
                 or not model.data:
             return False
         from ..trainer.serving import make_gnn_impute
-        topo.bind_imputer(make_gnn_impute(model.data))
+        try:
+            topo.bind_imputer(make_gnn_impute(model.data))
+        except ValueError as exc:
+            # schema-gate refusal (stale NODE_FEATURES layout): remember
+            # the refused version so if_none_match skips the full-blob
+            # refetch every cycle — the trainer's next refit changes the
+            # version and gets fetched normally
+            self.gnn_version = model.version
+            log.warning("topology gnn %s refused: %s", model.version, exc)
+            return False
         self.gnn_version = model.version
         log.info("topology store now imputing with %s@%s",
                  model.name, model.version)

@@ -97,6 +97,16 @@ class SchedulerConfig:
     # QUARANTINE (one forging child must not evict honest parents —
     # a single reporter tops out at suspect)
     quarantine_min_reporters: int = 2
+    # cross-pod federation (scheduler/federation.py, ROADMAP item 2):
+    # per-pod seed election + DCN routing policy — cross-pod parents are
+    # legal only for a pod's elected seeds, so the distribution chain is
+    # origin -> pod-seed (one DCN copy per pod) -> in-pod ICI relay.
+    # Disabled (default) = the exact pre-federation filter path: the
+    # single-pod schedule_digest stays byte-identical (dfbench gate).
+    federation_enabled: bool = False
+    # elected seeds per (task, pod): >1 spreads the pod's DCN ingest and
+    # survives one seed death without a re-election stall
+    federation_seeds_per_pod: int = 1
     retry_limit: int = RETRY_LIMIT
     retry_back_source_limit: int = RETRY_BACK_SOURCE_LIMIT
     back_source_concurrent: int = DEFAULT_BACK_SOURCE_CONCURRENT

@@ -19,7 +19,8 @@ import logging
 import statistics
 
 from ..idl.messages import HostType, LinkType
-from ..tpu.topology import LINK_BANDWIDTH_SCORE, ici_hops, link_type
+from ..tpu.topology import (LINK_BANDWIDTH_SCORE, LINK_TIER_NAMES, classify,
+                            ici_hops, link_type)
 from .resource import Peer
 
 log = logging.getLogger("df.sched.eval")
@@ -87,10 +88,23 @@ class Evaluator:
         ``{"terms": {name: raw score}, "total": float}`` where ``total``
         is bit-identical to ``evaluate()`` on the same state. Variants
         annotate what they substituted (``nt``: the locality term from
-        measured RTT; ``ml``: the whole total from the served model)."""
+        measured RTT; ``ml``: the whole total from the served model).
+        ``link_tier`` is the pinned tier name (tpu.topology
+        LINK_TIER_NAMES) the locality score was computed from, and
+        ``cross_pod`` flags a pod-boundary crossing (tpu.topology
+        ``classify``; a multi-slice DF_POD_ID grouping can make these
+        disagree with the raw link class) — the federation plane's
+        per-candidate ledger terms, so which tier a ruling chose (and
+        what cross-pod traffic it authorized) replays from the row
+        alone. Annotation only: the weighted total, and therefore the
+        schedule digest, never moves."""
         terms = self._term_scores(child, parent,
                                   total_piece_count=total_piece_count)
-        return {"terms": terms, "total": weighted_total(terms)}
+        lc = classify(child.host.msg.topology, parent.host.msg.topology,
+                      same_host=child.host.id == parent.host.id)
+        return {"terms": terms, "total": weighted_total(terms),
+                "link_tier": LINK_TIER_NAMES[lc.link],
+                "cross_pod": lc.dcn_hops > 0}
 
     # -- individual scores --------------------------------------------
 

@@ -378,6 +378,12 @@ class Resource:
         self.host_ttl_s = host_ttl_s
         self.peer_upload_limit = peer_upload_limit
         self.seed_upload_limit = seed_upload_limit
+        # optional eviction observers (the server sets these when the
+        # federation plane is armed): a host or task leaving the resource
+        # model must also leave the federation view, or per-pod seed
+        # elections keep naming hosts that no longer exist
+        self.on_host_evict = None      # callable(host_id)
+        self.on_task_evict = None      # callable(task_id)
 
     # -- lookups -------------------------------------------------------
 
@@ -428,6 +434,8 @@ class Resource:
         """Remove the host and every peer on it; returns orphaned children's
         peers so the service can reschedule them."""
         self.hosts.pop(host_id, None)
+        if self.on_host_evict is not None:
+            self.on_host_evict(host_id)
         orphaned: list[Peer] = []
         for task in self.tasks.values():
             gone = [p for p in task.peers.values() if p.host.id == host_id]
@@ -454,9 +462,13 @@ class Resource:
                     evicted += 1
             if not task.peers and now - task.updated_at > self.task_ttl_s:
                 del self.tasks[task.id]
+                if self.on_task_evict is not None:
+                    self.on_task_evict(task.id)
                 evicted += 1
         for host in list(self.hosts.values()):
             if now - host.updated_at > self.host_ttl_s:
                 del self.hosts[host.id]
+                if self.on_host_evict is not None:
+                    self.on_host_evict(host.id)
                 evicted += 1
         return evicted

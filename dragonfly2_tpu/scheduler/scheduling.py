@@ -36,12 +36,12 @@ _preemptions = REGISTRY.counter(
 # and in decision-row ``excluded`` entries, and an undocumented reason is
 # a surface operators cannot read (dflint DF006 decision-vocabulary).
 EXCLUSION_REASONS = ("stream-gone", "blocklist", "no-slots", "bad-node",
-                     "cycle", "quarantined")
+                     "cycle", "quarantined", "cross-pod")
 
 
 class Scheduling:
     def __init__(self, cfg: SchedulerConfig, evaluator: Evaluator,
-                 quarantine=None):
+                 quarantine=None, federation=None):
         self.cfg = cfg
         self.evaluator = evaluator
         # quarantine registry (scheduler/quarantine.py). None (default)
@@ -49,6 +49,11 @@ class Scheduling:
         # is how dfbench's baseline schedule_digest stays byte-identical
         # with the immune system in the tree.
         self.quarantine = quarantine
+        # cross-pod federation view (scheduler/federation.py). None
+        # (default) skips every lookup — the exact pre-federation filter
+        # path, which is how the single-pod schedule_digest stays
+        # byte-identical with the federation plane in the tree.
+        self.federation = federation
         # decision ledger hook: callable(row dict) receiving one
         # ``kind=decision`` row per find/refresh ruling. None (default)
         # skips ALL ledger work — scoring then runs the exact pre-ledger
@@ -70,6 +75,13 @@ class Scheduling:
         task = child.task
         pool = list(task.peers.values())
         random.shuffle(pool)
+        # ONE reachability sweep per ruling: every cycle probe below asks
+        # "can child reach parent" over the same frozen DAG (offers only
+        # mutate edges via set_parents AFTER the ruling), so walking the
+        # child's descendant set once and testing membership replaces
+        # O(candidates x DAG) repeated can_reach walks — the filter's
+        # former hot spot at 1k+-peer pools (dfbench --pr13 fakepods)
+        cycle_blocked = task.dag.descendants(child.id)
         out: list[Peer] = []
         for parent in pool:
             full = len(out) >= self.cfg.filter_parent_limit
@@ -124,7 +136,18 @@ class Scheduling:
                 # pass here only within the bounded probe budget.
                 self._trace(child, parent, "quarantined", excluded)
                 continue
-            if task.would_cycle(parent.id, child.id):
+            if (self.federation is not None
+                    and not self.federation.allows(child, parent)):
+                # cross-pod federation: a parent in ANOTHER pod is legal
+                # only for this pod's elected seeds — everyone else gets
+                # the bytes off the pod seed's ICI tree instead of
+                # opening one more DCN stream per child (the two-level
+                # origin -> pod-seed -> ICI relay chain, ROADMAP item 2)
+                self._trace(child, parent, "cross-pod", excluded)
+                continue
+            if parent.id in cycle_blocked:
+                # would_cycle(parent, child): the parent is downstream of
+                # the child, so the edge would close a loop
                 self._trace(child, parent, "cycle", excluded)
                 continue
             out.append(parent)
@@ -353,7 +376,8 @@ class Scheduling:
                              locality, float(len(p.finished_pieces)),
                              float(p.host.concurrent_upload_count)],
             }
-            for key in ("substituted", "rtt_us", "base_total"):
+            for key in ("substituted", "rtt_us", "base_total",
+                        "link_tier", "cross_pod"):
                 if key in ex:
                     cand[key] = ex[key]
             candidates.append(cand)
@@ -382,6 +406,14 @@ class Scheduling:
             # the excluded[] reasons, so "why isn't the seed my parent"
             # is answerable from the row alone
             row["relay"] = relay_note
+        if self.federation is not None:
+            # federation ruling context: the child's pod, its elected
+            # seed set, and whether this child may cross the DCN — with
+            # the per-candidate ``link_tier`` term this makes federation
+            # fairness replayable from the row stream alone
+            fed_note = self.federation.note(child)
+            if fed_note is not None:
+                row["federation"] = fed_note
         if decision_kind == "refresh":
             # sticky attribution of the final offer: which slots the
             # stickiness held vs which the newcomers won

@@ -56,14 +56,16 @@ class SeedPeerClient:
         QUARANTINED seed (a poisoned root poisons the whole tree). With
         every member quarantined the hashed one still serves — a wholly
         quarantined seed fleet beats no injection path at all, and each
-        corrupt verdict it earns keeps it excluded everywhere else."""
+        corrupt verdict it earns keeps it excluded everywhere else.
+        The walk itself is ``federation.walk_ring`` — the SAME election
+        the cross-pod plane runs per (task, pod), so both tiers of the
+        distribution tree skip poisoned roots identically."""
         if self.quarantine is None:
             return self._ring.pick(task_id)
-        cands = self._ring.pick_n(task_id, len(self.seed_peers))
-        for hid in cands:
-            if self.quarantine.offerable(hid):
-                return hid
-        return cands[0] if cands else None
+        from .federation import walk_ring
+        picked = walk_ring(self._ring, task_id, len(self.seed_peers),
+                           self.quarantine)
+        return picked[0] if picked else None
 
     # ------------------------------------------------------------------
 

@@ -60,10 +60,26 @@ class Scheduler:
                 sink=self.ledger.on_decision)
             self.scheduling.quarantine = self.quarantine
             self.seed_client.quarantine = self.quarantine
+        # cross-pod federation view: fed from register/announce, consulted
+        # by the scheduling filter; off (None) = exact pre-federation path
+        self.federation = None
+        if cfg.federation_enabled:
+            from .federation import PodFederation
+            self.federation = PodFederation(
+                seeds_per_pod=cfg.federation_seeds_per_pod,
+                quarantine=self.quarantine,
+                sink=self.ledger.on_decision)
+            self.scheduling.federation = self.federation
+            # evicted hosts/tasks leave the election electorate too —
+            # without this a GC'd (silently dead) pod seed would keep
+            # winning elections it can never serve
+            self.resource.on_host_evict = self.federation.forget_host
+            self.resource.on_task_evict = self.federation.drop_task
         self.service = SchedulerService(cfg, self.resource, self.scheduling,
                                         self.seed_client, self.topo,
                                         records=records, ledger=self.ledger,
-                                        quarantine=self.quarantine)
+                                        quarantine=self.quarantine,
+                                        federation=self.federation)
         self.announcer = None
         self.rpc: RPCServer | None = None
         self.gc = GC()

@@ -66,7 +66,7 @@ class SchedulerService:
     def __init__(self, cfg: SchedulerConfig, resource: Resource,
                  scheduling: Scheduling, seed_client: SeedPeerClient,
                  topo: TopologyStore, *, records=None, ledger=None,
-                 quarantine=None):
+                 quarantine=None, federation=None):
         self.cfg = cfg
         self.resource = resource
         self.scheduling = scheduling
@@ -78,6 +78,10 @@ class SchedulerService:
         # verdicts + self-flags here, consulted by the scheduling filter
         # and seed election; None = the pre-quarantine fabric
         self.quarantine = quarantine
+        # cross-pod federation view (scheduler/federation.py): fed host
+        # pods from register/announce, forgets on leave; None = the
+        # pre-federation single-pod fabric
+        self.federation = federation
         self.cluster = ClusterView(ledger=ledger,
                                    quarantine=quarantine)  # GET /debug/cluster
         self._seed_tasks: set[asyncio.Task] = set()
@@ -142,6 +146,12 @@ class SchedulerService:
             self.quarantine.record_self(
                 req.peer_host.id, req.peer_host.quarantined,
                 reason="self-quarantine flag on register")
+        if self.federation is not None:
+            # the federation view learns the host's pod from its FIRST
+            # contact too — per-pod seed elections need the membership
+            # before the first cross-pod ruling, not an announce later
+            self.federation.observe_host(req.peer_host.id,
+                                         req.peer_host.topology)
         host = self.resource.store_host(req.peer_host)
         peer = self.resource.get_or_create_peer(req.peer_id, task, host)
         peer.priority = resolved_priority
@@ -267,6 +277,15 @@ class SchedulerService:
                     peer.stream_gone = True
                     log.info("peer %s report stream gone mid-task",
                              peer.id[-12:])
+                    if self.federation is not None:
+                        # a likely-dead host must stop winning pod-seed
+                        # elections NOW (the mid-pull seed-kill failover)
+                        # — its next announce re-admits it to the
+                        # electorate via observe_host, so a transient
+                        # stream wobble costs one announce interval of
+                        # electability, while a dead seed's pod re-elects
+                        # on its very next ruling
+                        self.federation.forget_host(peer.host.id)
 
     REFRESH_INTERVAL_S = 0.5
 
@@ -702,9 +721,17 @@ class SchedulerService:
                 self.quarantine.record_self(
                     req.host.id, req.host.quarantined,
                     reason="self-quarantine flag on announce")
+            if self.federation is not None:
+                # pod id is a pure function of the announced coordinates,
+                # so re-announce is a no-op — elections stay sticky
+                self.federation.observe_host(req.host.id,
+                                             req.host.topology)
         return Empty()
 
     async def leave_host(self, req: LeaveHostRequest, context) -> Empty:
+        # federation view notified via Resource.on_host_evict inside
+        # leave_host: a departed host stops being electable NOW and its
+        # pod re-elects on the next ruling (docs/RESILIENCE.md)
         orphans = self.resource.leave_host(req.host_id)
         for child in orphans:
             await self._reschedule(child)

@@ -133,7 +133,8 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
               collect_timeline: bool = False,
               collect_podscope: bool = False,
               collect_decisions: bool = False,
-              quarantine=None) -> dict:
+              quarantine=None,
+              origin_link: LinkType = LinkType.WAN) -> dict:
     """Run one simulated fan-out; returns the result dict (pure function
     of its arguments — no wall clock, no global state beyond the process
     metrics registry the flight summaries touch). ``scenario`` switches
@@ -147,7 +148,10 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     rows — explain() totals are bit-identical to evaluate() and the sink
     never touches the rng, so the digest cannot move (gated in
     tests/test_dfbench.py); these rows feed the --pr8 counterfactual
-    replay."""
+    replay. ``origin_link`` is the link tier origin/back-source fetches
+    ride (default WAN — the pre-federation hardcode, so every committed
+    digest is untouched); federation scenarios pass DCN to model a
+    GCS-attached origin without forking the sim."""
     if scenario not in SCENARIOS + COLD_SCENARIOS:
         raise ValueError(f"unknown scenario {scenario!r} "
                          f"(known: {SCENARIOS + COLD_SCENARIOS})")
@@ -415,14 +419,16 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
         lc.inflight.add(piece)
         if parent is None:
             # scheds-down, no PEX: the origin serves this piece over the
-            # WAN link, sharing one contended egress with the whole pod
+            # ``origin_link`` tier (WAN unless the scenario models a
+            # DCN-attached origin), sharing one contended egress with
+            # the whole pod
             lc.schedule.append([piece, _ORIGIN_ID])
             load = active.get(_ORIGIN_ID, 0)
             active[_ORIGIN_ID] = load + 1
-            ttfb_ms = (LINK_RTT_MS[LinkType.WAN]
+            ttfb_ms = (LINK_RTT_MS[origin_link]
                        * (1.0 + TTFB_QUEUE_FACTOR * load)
                        * rng.uniform(0.9, 1.3))
-            wire_ms = (piece_size / LINK_BW_BPS[LinkType.WAN] * 1000.0
+            wire_ms = (piece_size / LINK_BW_BPS[origin_link] * 1000.0
                        * (1.0 + WIRE_SHARE_FACTOR * load)
                        * rng.uniform(0.9, 1.25))
             hbm_ms = piece_size / HBM_BW_BPS * 1000.0 * rng.uniform(0.95, 1.15)
@@ -1661,6 +1667,496 @@ def _run_pr12(args) -> dict:
     }
 
 
+# --------------------------------------------------------------- PR-13
+# Cross-pod federation harness (ROADMAP item 2): many pods behind thin
+# DCN links, one origin, whole-fleet cold start — the feeder-limited
+# regime of the MLPerf-on-pods papers. ``fed_naive`` is the flat fabric:
+# every daemon may back-source and cross-pod parents are unrestricted,
+# so the cold herd storms the origin from every pod at once.
+# ``fed_hier`` drives the REAL two-level stack: the REAL PodFederation
+# (hash-ring per-pod seed election) armed inside the REAL Scheduling
+# filter — cross-pod parents are legal only for each pod's elected
+# seeds, members never touch the origin, and the in-pod fan-out rides
+# the PR-9 relay shaping with cut-through pipelining, so the chain is
+# origin -> pod-seed (DCN) -> ICI relay tree. The seed-kill chaos
+# variant kills a pod's elected seed mid-pull: the federation view
+# forgets the host, the ring re-elects, and the pod completes with no
+# origin copies beyond the replacement's resume of the holes.
+
+FED_SCENARIOS = ("fed_naive", "fed_hier")
+FED_PIECES = 32              # pieces per federation run (fixed: the scale
+                             # axis is PODS, not content size)
+
+
+def run_federation_bench(*, seed: int = 7, pods: int = 4,
+                         daemons_per_pod: int = 16, pieces: int = FED_PIECES,
+                         piece_size: int = 4 << 20, parallelism: int = 4,
+                         federation: bool = True,
+                         origin_link: LinkType = LinkType.DCN,
+                         seed_kill: bool = False,
+                         collect_podscope: bool = False) -> dict:
+    """One multi-pod cold-start fan-out; returns makespan + per-tier byte
+    accounting. Pure function of its arguments (virtual clock, seeded
+    rng, deterministic elections). ``federation=False`` models the flat
+    pre-federation fabric (anyone may back-source, anyone may cross
+    pods); ``federation=True`` arms the REAL PodFederation inside the
+    REAL Scheduling filter. ``seed_kill`` kills pod-0's elected seed
+    once it has landed half the content (a deterministic trigger — no
+    wall clock), exercising forget-host -> ring re-election -> resume."""
+    from ..daemon import flight_recorder as fr
+    from ..daemon.flight_recorder import TaskFlight
+    from ..idl.messages import Host as HostMsg
+    from ..scheduler.config import SchedulerConfig
+    from ..scheduler.evaluator import make_evaluator
+    from ..scheduler.resource import Peer, PeerState, Resource, Task
+    from ..scheduler.scheduling import Scheduling
+    from ..tpu.topology import LINK_TIER_NAMES
+
+    rng = random.Random(seed)
+    random.seed(seed)          # filter_candidates' pool shuffle (see run_bench)
+
+    res = Resource()
+    task = Task("fed" + "0" * 61, "bench://federation")
+    task.set_content_info(pieces * piece_size, piece_size, pieces)
+
+    fed = None
+    if federation:
+        from ..scheduler.federation import PodFederation
+        fed = PodFederation(seeds_per_pod=1)
+    sched = Scheduling(SchedulerConfig(relay_fanout=RELAY_FANOUT),
+                       make_evaluator("default"), federation=fed)
+
+    def topo(pod: int, i: int) -> TopologyInfo:
+        return TopologyInfo(slice_name=f"pod-{pod}", ici_coords=(i % 8, i // 8),
+                            zone="bench-zone")
+
+    leechers: list[_Leecher] = []
+    pod_of: dict[str, str] = {}        # peer id -> pod name
+    for p in range(pods):
+        for i in range(daemons_per_pod):
+            t = topo(p, i)
+            host = res.store_host(HostMsg(
+                id=f"p{p}w{i}-host", ip="10.0.0.1", port=1, download_port=2,
+                topology=t))
+            peer = Peer(f"p{p}w{i}-peer", task, host)
+            if fed is not None:
+                fed.observe_host(host.id, t)   # the announce plane
+            idx = p * daemons_per_pod + i
+            joined = (idx * COLD_JOIN_MS / max(pods * daemons_per_pod, 1)) \
+                * rng.uniform(0.8, 1.2)
+            flight = None
+            if collect_podscope:
+                flight = TaskFlight(task.id, peer.id, url="bench://federation",
+                                    max_events=5 * pieces + 8)
+                flight.events.append((joined, fr.REGISTERED, -1, "", 0, 0.0))
+            lc = _Leecher(peer, flight, joined)
+            pod_of[peer.id] = f"pod-{p}"
+            leechers.append(lc)
+
+    by_peer_id = {lc.peer.id: lc for lc in leechers}
+    by_host_id = {lc.peer.host.id: lc for lc in leechers}
+    active: dict[str, int] = {}
+    served_children: dict[str, set[str]] = {}
+    dead: set[str] = set()             # peer ids of killed daemons
+    bytes_by_tier = {name: 0 for name in
+                     (*LINK_TIER_NAMES.values(), "origin")}
+    origin_by_peer: dict[str, int] = {}
+    kill_ms: float | None = None
+    victim: _Leecher | None = None
+    reelected: list[str] = []
+    pod0_origin_after_kill = 0
+
+    def is_pod_seed(lc: _Leecher) -> bool:
+        if fed is None:
+            return True                # flat fabric: anyone back-sources
+        return lc.peer.host.id in fed.seeds_for(task.id, pod_of[lc.peer.id])
+
+    def refresh_parents(lc: _Leecher, now: float = 0.0) -> None:
+        parents = sched.find_parents(lc.peer)
+        lc.parents = parents
+        lc.peer.last_offer_ids = {p.id for p in parents}
+        task.set_parents(lc.peer.id, [p.id for p in parents])
+
+    def holds(parent, piece: int, now: float) -> bool:
+        src = by_peer_id.get(parent.id)
+        if src is None or parent.id in dead:
+            return False
+        t = src.landed_at.get(piece)
+        if t is not None and t <= now:
+            return True
+        # cut-through (PR 9): an in-flight piece is announce-ahead
+        # pullable — including behind a pod seed's ORIGIN stream, which
+        # is exactly the origin -> pod-seed -> ICI pipeline
+        return piece in src.arrive
+
+    def landed_now(parent, piece: int, now: float) -> bool:
+        src = by_peer_id.get(parent.id)
+        if src is None or parent.id in dead:
+            return False
+        t = src.landed_at.get(piece)
+        return t is not None and t <= now
+
+    def pick(lc: _Leecher, now: float):
+        """(piece, parent_or_None) — None parent = origin back-source,
+        legal only for pod seeds under federation. The holder ranking is
+        the cold_relay rule: under-fanout-cap first, earliest available
+        copy, load, link tier."""
+        allowed_origin = None
+        for piece in range(pieces):
+            if piece in lc.done or piece in lc.inflight:
+                continue
+            holders = [p for p in lc.parents
+                       if p.id not in dead and holds(p, piece, now)]
+            if not holders:
+                if allowed_origin is None:
+                    allowed_origin = is_pod_seed(lc)
+                if allowed_origin:
+                    return piece, None
+                continue
+            lt = {p.id: link_type(lc.peer.host.msg.topology,
+                                  p.host.msg.topology) for p in holders}
+
+            def capped(p) -> int:
+                kids = served_children.get(p.id)
+                if kids is None or lc.peer.id in kids:
+                    return 0
+                return 1 if len(kids) >= RELAY_FANOUT else 0
+
+            def avail_ms(p) -> float:
+                if landed_now(p, piece, now):
+                    return 0.0
+                up = by_peer_id[p.id].arrive.get(piece)
+                return up[1] if up is not None else 1e12
+            holders.sort(key=lambda p: (
+                capped(p), avail_ms(p), active.get(p.id, 0),
+                int(lt[p.id]), p.id))
+            return piece, holders[0]
+        return None
+
+    def kill_seed(now: float) -> None:
+        """Pod-0's elected seed dies mid-pull: process gone, storage
+        gone, stream gone. The federation view forgets it (the live
+        scheduler does this on leave/stream-gone), so the next ruling
+        that needs pod-0's seed re-elects the next ring member."""
+        nonlocal kill_ms
+        kill_ms = now
+        dead.add(victim.peer.id)
+        victim.peer.stream_gone = True
+        task.set_parents(victim.peer.id, [])
+        fed.forget_host(victim.peer.host.id)
+        if victim.flight is not None:
+            victim.flight.state = "failed"
+
+    events: list[tuple] = []
+    seq = 0
+
+    def push(t: float, *payload) -> None:
+        nonlocal seq
+        heapq.heappush(events, (t, seq, *payload))
+        seq += 1
+
+    for i, lc in enumerate(leechers):
+        for _ in range(parallelism):
+            push(lc.joined_ms, "worker", i)
+
+    if seed_kill:
+        if fed is None:
+            raise ValueError("seed_kill needs federation=True")
+        # election is deterministic, so the victim is known up front;
+        # register pod-0 hosts are observed already
+        vic_host = fed.seeds_for(task.id, "pod-0")[0]
+        victim = by_host_id[vic_host]
+
+    SAFETY_MS = 600_000.0
+    finished = 0
+    while events:
+        alive = len(leechers) - len(dead)
+        if finished >= alive:
+            break
+        now, _s, kind, i, *rest = heapq.heappop(events)
+        if now > SAFETY_MS:
+            break
+        lc = leechers[i]
+        if lc.peer.id in dead:
+            continue                   # a dead daemon's events are void
+        if kind == "land":
+            piece, parent_id, t_wire = rest
+            lc.inflight.discard(piece)
+            if parent_id in dead:
+                # the parent died mid-stream: the transfer aborted, the
+                # piece deadline re-pulls it from another holder
+                lc.arrive.pop(piece, None)
+                push(now, "worker", i)
+                continue
+            lc.done.add(piece)
+            lc.landed_at[piece] = t_wire
+            lc.peer.finished_pieces.add(piece)
+            active[parent_id] = max(0, active.get(parent_id, 0) - 1)
+            lc.since_refresh += 1
+            if (victim is not None and kill_ms is None and lc is victim
+                    and len(lc.done) >= pieces // 2):
+                kill_seed(now)
+                continue
+            if len(lc.done) >= pieces:
+                if lc.flight is not None:
+                    lc.flight.state = "success"
+                lc.peer.transit(PeerState.SUCCEEDED)
+                # a completed peer needs no parents: clearing its
+                # in-edges (the live scheduler does this when the
+                # conductor closes) releases the cycle filter so EARLY
+                # joiners — ancestors of half the DAG — can finally be
+                # offered the finished holders below them
+                task.set_parents(lc.peer.id, [])
+                lc.peer.last_offer_ids = set()
+                lc.parents = []
+                finished += 1
+            elif lc.since_refresh >= REFRESH_EVERY:
+                lc.since_refresh = 0
+                refresh_parents(lc, now)
+            continue
+        # worker event
+        if len(lc.done) + len(lc.inflight) >= pieces:
+            continue
+        if lc.peer.id not in task.peers:
+            task.add_peer(lc.peer)
+            lc.peer.transit(PeerState.RUNNING)
+            refresh_parents(lc)
+        if not lc.parents:
+            refresh_parents(lc, now)
+        got = pick(lc, now)
+        if got is None:
+            if now - lc.last_refresh >= COLD_REFRESH_MS:
+                lc.last_refresh = now
+                refresh_parents(lc, now)
+            push(now + POLL_MS, "worker", i)
+            continue
+        piece, parent = got
+        lc.inflight.add(piece)
+        if parent is None:
+            # origin back-source over the origin tier (one contended
+            # egress for the whole fleet — the resource federation
+            # exists to ration)
+            lc.schedule.append([piece, _ORIGIN_ID])
+            load = active.get(_ORIGIN_ID, 0)
+            active[_ORIGIN_ID] = load + 1
+            ttfb_ms = (LINK_RTT_MS[origin_link]
+                       * (1.0 + TTFB_QUEUE_FACTOR * load)
+                       * rng.uniform(0.9, 1.3))
+            wire_ms = (piece_size / LINK_BW_BPS[origin_link] * 1000.0
+                       * (1.0 + WIRE_SHARE_FACTOR * load)
+                       * rng.uniform(0.9, 1.25))
+            t_first = now + ttfb_ms
+            t_wire = t_first + wire_ms
+            lc.arrive[piece] = (t_first, t_wire)
+            bytes_by_tier["origin"] += piece_size
+            origin_by_peer[lc.peer.id] = \
+                origin_by_peer.get(lc.peer.id, 0) + piece_size
+            if kill_ms is not None and pod_of[lc.peer.id] == "pod-0":
+                # the replacement seed's resume: the only origin traffic
+                # the failover is allowed to add
+                pod0_origin_after_kill += piece_size
+            lc.done_ms = max(lc.done_ms, t_wire)
+            if lc.flight is not None:
+                lc.flight.events.append((t_wire, fr.WIRE_DONE, piece, "",
+                                         piece_size, wire_ms))
+            push(t_wire, "land", i, piece, _ORIGIN_ID, t_wire)
+            push(t_wire, "worker", i)
+            continue
+        lc.schedule.append([piece, parent.id])
+        served_children.setdefault(parent.id, set()).add(lc.peer.id)
+        lt = link_type(lc.peer.host.msg.topology, parent.host.msg.topology)
+        bytes_by_tier[LINK_TIER_NAMES[lt]] += piece_size
+        load = active.get(parent.id, 0)
+        active[parent.id] = load + 1
+        queue_ms = rng.uniform(0.1, 0.5)
+        ttfb_ms = (LINK_RTT_MS[lt] * (1.0 + TTFB_QUEUE_FACTOR * load)
+                   * rng.uniform(0.9, 1.3))
+        wire_ms = (piece_size / LINK_BW_BPS[lt] * 1000.0
+                   * (1.0 + WIRE_SHARE_FACTOR * load) * rng.uniform(0.9, 1.25))
+        t_disp = now + queue_ms
+        t_first = t_disp + ttfb_ms
+        t_wire = t_first + wire_ms
+        if not landed_now(parent, piece, now):
+            # cut-through hop behind the parent's own landing watermark
+            up = by_peer_id[parent.id].arrive.get(piece)
+            if up is not None:
+                hop = LINK_RTT_MS[lt]
+                t_first = max(t_first, up[0] + hop)
+                t_wire = max(t_first + wire_ms, up[1] + hop)
+                lc.relay_pulls += 1
+        lc.arrive[piece] = (t_first, t_wire)
+        lc.done_ms = max(lc.done_ms, t_wire)
+        if lc.flight is not None:
+            ev = lc.flight.events.append
+            ev((now, fr.SCHEDULED, piece, parent.id, 0, 0.0))
+            ev((t_disp, fr.DISPATCHED, piece, parent.id, 0, 0.0))
+            ev((t_first, fr.FIRST_BYTE, piece, parent.id, 0, 0.0))
+            ev((t_wire, fr.WIRE_DONE, piece, parent.id, piece_size, wire_ms))
+        push(t_wire, "land", i, piece, parent.id, t_wire)
+        push(t_wire, "worker", i)
+
+    alive = [lc for lc in leechers if lc.peer.id not in dead]
+    makespan = max((lc.done_ms for lc in alive), default=0.0)
+    content = pieces * piece_size
+    schedules = {lc.peer.id: lc.schedule for lc in leechers}
+    digest = hashlib.sha256(
+        json.dumps(schedules, sort_keys=True).encode()).hexdigest()
+    seed_hosts = set()
+    if fed is not None:
+        for p in range(pods):
+            seed_hosts |= set(fed.seeds_for(task.id, f"pod-{p}"))
+    member_origin = sum(
+        n for pid, n in origin_by_peer.items()
+        if fed is not None
+        and by_peer_id[pid].peer.host.id not in seed_hosts
+        and (victim is None or pid != victim.peer.id))
+    result = {
+        "seed": seed,
+        "federation": federation,
+        "pods": pods,
+        "daemons_per_pod": daemons_per_pod,
+        "daemons": pods * daemons_per_pod,
+        "pieces": pieces,
+        "piece_size": piece_size,
+        "content_bytes": content,
+        "origin_link": LINK_TIER_NAMES[origin_link],
+        "makespan_ms": round(makespan, 3),
+        "complete": sum(1 for lc in alive if len(lc.done) >= pieces),
+        "alive": len(alive),
+        "origin_bytes": bytes_by_tier["origin"],
+        # the headline ratio: copies of the content that crossed the
+        # origin uplink (hier acceptance: <= 1.25 x pods)
+        "origin_copies": round(bytes_by_tier["origin"] / content, 3),
+        "bytes_by_tier": dict(bytes_by_tier),
+        "cross_pod_p2p_bytes": bytes_by_tier["dcn"] + bytes_by_tier["wan"],
+        # bytes NON-SEED members pulled from origin: the federation
+        # contract is exactly 0 — every member byte arrives over the
+        # pod seed's ICI tree. None when federation is off: the flat
+        # fabric has no seed/member distinction, and reporting 0 there
+        # would read as the contract holding in the very scenario that
+        # violates it
+        "member_origin_bytes": (member_origin if fed is not None
+                                else None),
+        "relay_pulled_pieces": sum(lc.relay_pulls for lc in leechers),
+        "schedule_digest": digest,
+    }
+    if seed_kill:
+        result["seed_kill"] = {
+            "killed_host": victim.peer.host.id,
+            "kill_ms": round(kill_ms, 3) if kill_ms is not None else None,
+            "reelected": (fed.seeds_for(task.id, "pod-0")
+                          if fed is not None else []),
+            "completed": all(len(lc.done) >= pieces for lc in alive),
+            # resume bound: pod-0's origin bytes after the kill cover at
+            # most the holes the dead seed never spread in-pod
+            "pod0_origin_bytes_after_kill": pod0_origin_after_kill,
+            "resume_bounded": pod0_origin_after_kill <= content,
+        }
+    if collect_podscope:
+        snaps = []
+        for lc in leechers:
+            dump = lc.flight.timeline()
+            dump["started_at"] = 0.0
+            dump["summary"] = lc.flight.summarize()
+            snaps.append({"addr": lc.peer.id, "pod": pod_of[lc.peer.id],
+                          "flights": {task.id: dump}})
+        result["podscope_snapshots"] = snaps
+    return result
+
+
+def _run_pr13(args) -> dict:
+    """The PR-13 trajectory point: cross-pod federation over DCN. A
+    plain single-pod baseline sim rides along as the digest gate
+    (federation disarmed == byte-identical to BENCH_pr3); the fakepod
+    then scales across pod counts for flat (fed_naive) vs hierarchical
+    (fed_hier) distribution, and a seed-kill chaos run proves mid-pull
+    failover. Acceptance (tests/test_dfbench.py): hier origin egress
+    <= 1.25 x (pods x content) at the largest size, hier makespan growth
+    <= 2x while the pod count grows 4x, members never touch the origin,
+    and the killed pod re-elects + completes with the replacement's
+    resume as the only extra origin traffic."""
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    if args.smoke:
+        sizes = [(2, 6), (4, 6)]
+        pieces, psize = 8, 256 << 10
+    else:
+        sizes = [(4, 64), (8, 64), (16, 64)]
+        pieces, psize = FED_PIECES, 4 << 20
+    scenarios: dict[str, dict] = {sc: {} for sc in FED_SCENARIOS}
+    for pods, dpp in sizes:
+        for sc, fed_on in (("fed_naive", False), ("fed_hier", True)):
+            r = run_federation_bench(
+                seed=args.seed, pods=pods, daemons_per_pod=dpp,
+                pieces=pieces, piece_size=psize,
+                parallelism=args.parallelism, federation=fed_on)
+            scenarios[sc][f"{pods}x{dpp}"] = r
+    # two-level tree shape at the smallest size, through the REAL
+    # podscope aggregation (pure readout — never in the rng path)
+    from ..common import podscope
+    tree_run = run_federation_bench(
+        seed=args.seed, pods=sizes[0][0], daemons_per_pod=sizes[0][1],
+        pieces=pieces, piece_size=psize, parallelism=args.parallelism,
+        federation=True, collect_podscope=True)
+    report = podscope.aggregate(tree_run.pop("podscope_snapshots"))
+    task_report = next(iter(report["tasks"].values()))
+    chaos = run_federation_bench(
+        seed=args.seed, pods=sizes[0][0], daemons_per_pod=sizes[0][1],
+        pieces=pieces, piece_size=psize, parallelism=args.parallelism,
+        federation=True, seed_kill=True)
+    biggest = f"{sizes[-1][0]}x{sizes[-1][1]}"
+    smallest = f"{sizes[0][0]}x{sizes[0][1]}"
+    hier = scenarios["fed_hier"]
+    naive = scenarios["fed_naive"]
+    content = hier[biggest]["content_bytes"]
+    pod_growth = sizes[-1][0] / sizes[0][0]
+    growth = {sc: round(scenarios[sc][biggest]["makespan_ms"]
+                        / max(scenarios[sc][smallest]["makespan_ms"], 1e-9),
+                        3) for sc in FED_SCENARIOS}
+    fed_digest = hashlib.sha256(json.dumps(
+        {sc: {k: v["schedule_digest"] for k, v in scenarios[sc].items()}
+         for sc in FED_SCENARIOS} | {"chaos": chaos["schedule_digest"]},
+        sort_keys=True).encode()).hexdigest()
+    return {
+        "bench": "dfbench-federation",
+        "seed": args.seed,
+        "sizes": [f"{p}x{d}" for p, d in sizes],
+        "pieces": pieces,
+        "piece_size": psize,
+        "parallelism": args.parallelism,
+        # federation disarmed == the plain scheduler path: digest gate
+        # vs BENCH_pr3 (the tier-1 gate)
+        "schedule_digest": base["schedule_digest"],
+        "scenarios": scenarios,
+        "makespan_ms": {sc: {k: v["makespan_ms"]
+                             for k, v in scenarios[sc].items()}
+                        for sc in FED_SCENARIOS},
+        "origin_copies": {sc: {k: v["origin_copies"]
+                               for k, v in scenarios[sc].items()}
+                          for sc in FED_SCENARIOS},
+        "pod_growth_factor": pod_growth,
+        "makespan_growth": growth,
+        # acceptance flags (gated in tests/test_dfbench.py)
+        "origin_bounded": (hier[biggest]["origin_bytes"]
+                           <= 1.25 * sizes[-1][0] * content),
+        "sublinear_in_pods": growth["fed_hier"] <= 2.0,
+        "hier_beats_naive": all(
+            hier[f"{p}x{d}"]["makespan_ms"]
+            < naive[f"{p}x{d}"]["makespan_ms"] for p, d in sizes),
+        "member_origin_bytes": hier[biggest]["member_origin_bytes"],
+        "tree": {"depth": task_report["depth"],
+                 "cross_pod_bytes": task_report["cross_pod_bytes"],
+                 "edges": len(task_report["edges"])},
+        "seed_kill": chaos["seed_kill"] | {
+            "makespan_ms": chaos["makespan_ms"],
+            "origin_copies": chaos["origin_copies"],
+            "member_origin_bytes": chaos["member_origin_bytes"],
+        },
+        "federation_digest": fed_digest,
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -1720,6 +2216,16 @@ def build_parser() -> argparse.ArgumentParser:
                    "(BENCH_pr12.json): makespan, wasted-corrupt-bytes "
                    "ratio, time-to-quarantine, and the quarantine-"
                    "disabled digest gate against BENCH_pr3")
+    p.add_argument("--pr13", action="store_true",
+                   help="scale the fakepod to many pods behind DCN links "
+                   "(flat fabric vs REAL PodFederation-armed scheduler: "
+                   "per-pod seed election, cross-pod pulls only through "
+                   "seeds, in-pod relay) plus a mid-pull pod-seed kill, "
+                   "and write the PR-13 trajectory point "
+                   "(BENCH_pr13.json): origin copies vs pod count, "
+                   "makespan growth vs pod growth, two-level tree "
+                   "shape, and the federation-disabled digest gate "
+                   "against BENCH_pr3")
     p.add_argument("--pr8", action="store_true",
                    help="replay the baseline run's decision-ledger rows "
                    "through every offline evaluator (default/nt/ml) and "
@@ -1764,7 +2270,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr12:
+        if args.pr13:
+            args.out = "BENCH_pr13.json"
+        elif args.pr12:
             args.out = "BENCH_pr12.json"
         elif args.pr11:
             args.out = "BENCH_pr11.json"
@@ -1786,7 +2294,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr12:
+    if args.pr13:
+        result = _run_pr13(args)
+    elif args.pr12:
         result = _run_pr12(args)
     elif args.pr11:
         result = _run_pr11(args)
@@ -1811,7 +2321,20 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr12:
+        if args.pr13:
+            mk = result["makespan_ms"]
+            oc = result["origin_copies"]
+            big = result["sizes"][-1]
+            print(f"dfbench: wrote {args.out} (federation: makespan@{big} "
+                  f"hier={mk['fed_hier'][big]:.0f}ms vs "
+                  f"naive={mk['fed_naive'][big]:.0f}ms, origin copies "
+                  f"hier={oc['fed_hier'][big]} vs "
+                  f"naive={oc['fed_naive'][big]}, growth "
+                  f"x{result['makespan_growth']['fed_hier']} over "
+                  f"x{result['pod_growth_factor']} pods, seed-kill "
+                  f"completed={result['seed_kill']['completed']}, "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.pr12:
             mk = result["makespan_ms"]
             wr = result["wasted_ratio"]
             ttq = result["time_to_quarantine_ms"]

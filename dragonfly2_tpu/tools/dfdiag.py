@@ -242,7 +242,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated daemon upload host:port set — "
                    "render the podscope distribution tree (per-edge "
                    "bytes/bandwidth, makespan, depth, amplification, "
-                   "bottleneck verdict) across the whole pod")
+                   "bottleneck verdict) across the whole pod; spanning "
+                   "several pods, pod-crossing edges carry a [dcn] tier "
+                   "mark and the per-task federation line sums the "
+                   "bytes that crossed a pod boundary")
     p.add_argument("--json", action="store_true",
                    help="machine-readable JSON instead of rendered text "
                    "(with --pod: the full aggregate report for CI gates)")

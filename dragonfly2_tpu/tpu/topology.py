@@ -193,6 +193,7 @@ def detect() -> TopologyInfo:
     scheduler then treats the host as a plain DCN peer.
     """
     slice_name = os.environ.get("TPU_SLICE_NAME", "")
+    pod = os.environ.get("DF_POD_ID", "")
     zone = os.environ.get("DF_ZONE", os.environ.get("CLOUD_ZONE", ""))
     try:
         worker = int(os.environ.get("TPU_WORKER_ID", "-1"))
@@ -228,7 +229,8 @@ def detect() -> TopologyInfo:
     if not zone:
         zone = os.environ.get("DF_DEFAULT_ZONE", "local")
     return TopologyInfo(slice_name=slice_name, worker_index=worker,
-                        ici_coords=coords, num_chips=num_chips, zone=zone)
+                        ici_coords=coords, num_chips=num_chips, zone=zone,
+                        pod=pod)
 
 
 def hostname_ip() -> tuple[str, str]:
@@ -238,6 +240,27 @@ def hostname_ip() -> tuple[str, str]:
     except OSError:
         ip = "127.0.0.1"
     return hostname, ip
+
+
+def pod_id(t: TopologyInfo | None) -> str:
+    """The host's pod identity: the ICI bandwidth domain it belongs to.
+
+    An explicit ``pod`` (``DF_POD_ID``, deployments that group hosts
+    across slice boundaries) wins; otherwise the pod is derived from
+    slice identity — one slice == one ICI domain == one pod. "" means no
+    pod identity at all (the plain-DCN-peer fallback ``detect()``
+    degrades to on non-TPU hosts): such a host belongs to no pod and the
+    federation plane never restricts it. Stable across re-announce by
+    construction — a pure function of the announced coordinates, never
+    of announce order or time."""
+    if t is None:
+        return ""
+    return t.pod or t.slice_name
+
+
+def same_pod(a: TopologyInfo | None, b: TopologyInfo | None) -> bool:
+    pa, pb = pod_id(a), pod_id(b)
+    return bool(pa) and pa == pb
 
 
 def link_type(a: TopologyInfo | None, b: TopologyInfo | None,
@@ -252,6 +275,44 @@ def link_type(a: TopologyInfo | None, b: TopologyInfo | None,
     if a.zone and a.zone == b.zone:
         return LinkType.DCN
     return LinkType.WAN
+
+
+class LinkClass:
+    """One classified (child, parent) pair: the link tier plus the pod/
+    DCN coordinates the federation plane routes by. ``dcn_hops`` is the
+    DCN distance between the two PODS: 0 = same pod (bytes stay on the
+    wired ICI mesh), 1 = pod-crossing inside one zone (the DCN tier
+    cross-pod federation exists to ration), 2 = cross-zone / unknown
+    (WAN). ``ici`` is the chip-mesh Manhattan distance, meaningful only
+    when ``link`` is ICI."""
+
+    __slots__ = ("link", "same_pod", "dcn_hops", "ici")
+
+    def __init__(self, link: LinkType, same_pod_: bool, dcn_hops: int,
+                 ici: int):
+        self.link = link
+        self.same_pod = same_pod_
+        self.dcn_hops = dcn_hops
+        self.ici = ici
+
+
+def classify(a: TopologyInfo | None, b: TopologyInfo | None,
+             *, same_host: bool = False) -> LinkClass:
+    """``link_type`` plus the pod tier: where the bytes would flow AND
+    whether they would leave the pod. A host with no topology at all
+    classifies as a plain WAN peer with no pod (the ``detect()``
+    fallback) — cross-pod routing never restricts it, it just scores
+    like the distant peer it is."""
+    lt = link_type(a, b, same_host=same_host)
+    sp = same_host or same_pod(a, b)
+    if sp:
+        dcn = 0
+    elif lt in (LinkType.LOCAL, LinkType.ICI, LinkType.DCN):
+        dcn = 1
+    else:
+        dcn = 2
+    hops = ici_hops(a, b) if a is not None and b is not None else 1 << 16
+    return LinkClass(lt, sp, dcn, hops)
 
 
 def ici_hops(a: TopologyInfo, b: TopologyInfo) -> int:
@@ -272,4 +333,18 @@ LINK_BANDWIDTH_SCORE = {
     LinkType.ICI: 0.9,
     LinkType.DCN: 0.4,
     LinkType.WAN: 0.1,
+}
+
+# The pinned link-tier vocabulary: the name each LinkType rides the
+# decision ledger under (candidate ``link_tier`` — docs/OBSERVABILITY.md
+# decision-row schema). Pinned like EXCLUSION_REASONS: replaying
+# federation fairness offline needs the tier strings stable across
+# versions, and the ordering here (best to worst) must agree with
+# LINK_BANDWIDTH_SCORE (descending) and the dispatcher's LINK_TIER
+# (ascending) — unit-pinned in tests/test_federation.py.
+LINK_TIER_NAMES = {
+    LinkType.LOCAL: "local",
+    LinkType.ICI: "ici",
+    LinkType.DCN: "dcn",
+    LinkType.WAN: "wan",
 }

@@ -42,9 +42,21 @@ PARENT_FEATURES = (
 )
 FEATURE_DIM = len(PARENT_FEATURES)
 
+# Schema version, stamped into trained-model metadata so the scheduler
+# refuses mismatched arrays. v2 (cross-pod federation): NODE_FEATURES
+# grew ``pod_id`` and decision-outcome rows carry ``link_tier``/``pod``
+# METADATA columns — PARENT_FEATURES (and therefore FEATURE_DIM and the
+# committed BENCH_pr8 candidate rows) is deliberately UNCHANGED, so
+# every logged v1 decision row still parses and replays byte-identically.
+FEATURE_SCHEMA_VERSION = 2
+
 # GNN graph schema: nodes = hosts, edges = probed (src, dst) links.
+# ``pod_id`` is a dense integer the caller assigns per pod (e.g. index
+# into the sorted pod list; -1 = no pod identity) — the GNN sees the
+# federation boundary the scheduler routes by, so learned imputation can
+# tell "slow because pod-crossing" from "slow because that host".
 NODE_FEATURES = ("host_type", "upload_ratio", "upload_load", "slice_id",
-                 "coord_x", "coord_y")
+                 "coord_x", "coord_y", "pod_id")
 EDGE_FEATURES = ("log_rtt", "link_class")
 
 # Pad edge lists to the next bucket so XLA recompiles only on bucket growth
@@ -128,6 +140,12 @@ def decision_outcome_rows(rows: list[dict]) -> list[dict]:
             "label": label_sum / n,
             "rank": cand.get("rank"),
             "pieces": n,
+            # federation metadata (v2, defaults keep v1/BENCH_pr8 rows
+            # parsing): which link tier the ruling chose and which pod
+            # the child sat in — a learned evaluator can condition on
+            # the DCN boundary without the feature array changing shape
+            "link_tier": cand.get("link_tier", ""),
+            "pod": (decision.get("federation") or {}).get("pod", ""),
         })
     return out
 
@@ -145,7 +163,8 @@ def _node_row(host_row: dict) -> list[float]:
             float(host_row.get("upload_load", 0.0)),
             float(host_row.get("slice_id", -1)),
             float(host_row.get("coord_x", -1)),
-            float(host_row.get("coord_y", -1))]
+            float(host_row.get("coord_y", -1)),
+            float(host_row.get("pod_id", -1))]
 
 
 def topology_to_graph(topo_rows: list[dict],

@@ -29,7 +29,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 MLP_FEATURES = 7          # scheduler/evaluator_ml.py feature_row length
-GNN_NODE_FEATURES = 6     # host features: type, upload ratio, load, coords...
+GNN_NODE_FEATURES = 7     # host features: type, upload ratio, load,
+                          # coords, pod id (features.NODE_FEATURES v2)
 GNN_EDGE_FEATURES = 2     # log-rtt, link-class
 
 Params = Any  # pytree of jnp arrays

@@ -112,6 +112,18 @@ def make_gnn_impute(model_bytes: bytes):
 
     params, meta = params_io.deserialize_params(model_bytes)
     version = meta.get("version", params_io.version_of(model_bytes))
+    # schema gate: a blob trained against an older NODE_FEATURES layout
+    # (v1 had no pod_id column) would crash the evaluator hot path with
+    # a shape error on the first imputation — refuse it HERE, at bind
+    # time, so the refresh loop logs and keeps the current imputer (or
+    # the static-locality fallback) until the trainer refits
+    node_dim = int(params["encode"]["w"].shape[0])
+    if node_dim != len(features.NODE_FEATURES):
+        raise ValueError(
+            f"topology_gnn node dim {node_dim} != schema "
+            f"{len(features.NODE_FEATURES)} (feature schema "
+            f"v{features.FEATURE_SCHEMA_VERSION}) — stale model refused; "
+            "retrain against the current NODE_FEATURES")
 
     def impute(topo_rows: list[dict],
                pairs: list[tuple[str, str]]) -> dict[tuple[str, str], float]:

@@ -90,6 +90,7 @@ def train_mlp(rows: list[dict], *, epochs: int = 40, batch_size: int = 512,
         "train_seconds": time.monotonic() - t0,
         "feature_dim": features.FEATURE_DIM,
         "feature_names": list(features.PARENT_FEATURES),
+        "schema_version": features.FEATURE_SCHEMA_VERSION,
         "devices": len(jax.devices()),
     }
     host_params = jax.tree_util.tree_map(np.asarray, params)
@@ -137,6 +138,8 @@ def train_gnn(topo_rows: list[dict], *, epochs: int = 60, lr: float = 1e-3,
         "model": GNN_MODEL_NAME,
         "edges": int(graph["edge_mask"].sum()),
         "nodes": int(len(graph["host_ids"])),
+        "node_features": list(features.NODE_FEATURES),
+        "schema_version": features.FEATURE_SCHEMA_VERSION,
         "epochs": epochs,
         "first_epoch_loss": first_loss,
         "final_loss": last_loss,

@@ -613,6 +613,117 @@ class TestPr12Byzantine:
         assert not list(tmp_path.iterdir())      # stdout only
 
 
+class TestPr13Federation:
+    """PR-13 point: cross-pod federation over DCN. The multi-pod sim
+    must be deterministic, the single-pod scheduler sim untouched with
+    federation disarmed (digest == BENCH_pr3), hierarchical distribution
+    must bound origin egress and beat the flat fabric, members must
+    never touch the origin, and a mid-pull pod-seed kill must re-elect
+    and complete with only the replacement's resume as extra origin
+    traffic."""
+
+    SHAPE = dict(seed=7, pods=2, daemons_per_pod=6, pieces=8,
+                 piece_size=256 << 10)
+
+    def test_federation_bench_deterministic(self):
+        from dragonfly2_tpu.tools.dfbench import run_federation_bench
+        a = run_federation_bench(**self.SHAPE, federation=True)
+        b = run_federation_bench(**self.SHAPE, federation=True)
+        assert a == b
+        c = run_federation_bench(seed=11, pods=2, daemons_per_pod=6,
+                                 pieces=8, piece_size=256 << 10,
+                                 federation=True)
+        assert c["schedule_digest"] != a["schedule_digest"]
+
+    def test_federation_disarmed_never_moves_the_digest(self):
+        """The purity gate, in-process: running the federation machinery
+        (elections, the cross-pod filter) must not perturb a plain
+        single-pod run's rng path — BENCH_pr3 stays comparable."""
+        from dragonfly2_tpu.tools.dfbench import run_federation_bench
+        base = run_bench(seed=7, daemons=6, pieces=24)
+        run_federation_bench(**self.SHAPE, federation=True)
+        again = run_bench(seed=7, daemons=6, pieces=24)
+        assert base["schedule_digest"] == again["schedule_digest"]
+
+    def test_hier_contract_members_off_origin(self):
+        from dragonfly2_tpu.tools.dfbench import run_federation_bench
+        hier = run_federation_bench(**self.SHAPE, federation=True)
+        content = hier["content_bytes"]
+        assert hier["complete"] == hier["alive"] == 12
+        # origin egress bounded by ~1 copy per pod
+        assert hier["origin_bytes"] <= 1.25 * 2 * content
+        # THE federation contract: non-seed members never touch origin
+        assert hier["member_origin_bytes"] == 0
+        # the pod boundary is crossed sparingly: DCN carries ~1 copy per
+        # crossing pod, ICI carries the in-pod fan-out
+        assert hier["bytes_by_tier"]["dcn"] <= 1.5 * content
+        assert hier["bytes_by_tier"]["ici"] > hier["bytes_by_tier"]["dcn"]
+
+    def test_naive_crosses_pods_freely(self):
+        from dragonfly2_tpu.tools.dfbench import run_federation_bench
+        naive = run_federation_bench(**self.SHAPE, federation=False)
+        hier = run_federation_bench(**self.SHAPE, federation=True)
+        # the flat fabric moves multiples of the content across the DCN
+        assert naive["bytes_by_tier"]["dcn"] \
+            > 3 * hier["bytes_by_tier"]["dcn"]
+        assert hier["makespan_ms"] < naive["makespan_ms"]
+
+    def test_seed_kill_reelects_and_resumes(self):
+        from dragonfly2_tpu.tools.dfbench import run_federation_bench
+        r = run_federation_bench(**self.SHAPE, federation=True,
+                                 seed_kill=True)
+        sk = r["seed_kill"]
+        assert sk["completed"] is True
+        assert sk["reelected"] and sk["reelected"][0] != sk["killed_host"]
+        # zero additional origin copies beyond the replacement's resume
+        assert sk["resume_bounded"] is True
+        assert sk["pod0_origin_bytes_after_kill"] <= r["content_bytes"]
+        # members stayed 100% P2P through the failover
+        assert r["member_origin_bytes"] == 0
+        # every SURVIVING daemon completed byte-identically (all pieces)
+        assert r["complete"] == r["alive"] == 11
+
+    def test_pr13_committed_matches_pr3_digest(self):
+        """The committed trajectory gate: BENCH_pr13's federation-
+        disabled single-pod digest is byte-identical to BENCH_pr3 and
+        every acceptance flag is stamped true at 4->16 pods x 64."""
+        r = json.loads(open(os.path.join(REPO, "BENCH_pr13.json")).read())
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["sizes"] == ["4x64", "8x64", "16x64"]
+        # origin egress <= 1.25 x (pods x content) at 16 pods x 64
+        assert r["origin_bounded"] is True
+        hier_big = r["scenarios"]["fed_hier"]["16x64"]
+        assert hier_big["origin_bytes"] \
+            <= 1.25 * 16 * hier_big["content_bytes"]
+        # makespan growth <= 2x while pods grew 4x
+        assert r["pod_growth_factor"] == 4.0
+        assert r["makespan_growth"]["fed_hier"] <= 2.0
+        assert r["sublinear_in_pods"] is True
+        assert r["hier_beats_naive"] is True
+        assert r["member_origin_bytes"] == 0
+        sk = r["seed_kill"]
+        assert sk["completed"] is True and sk["resume_bounded"] is True
+        assert sk["member_origin_bytes"] == 0
+        # the two-level tree actually formed (depth > 2, bounded)
+        assert 2 < r["tree"]["depth"] <= 32
+
+    def test_pr13_smoke_stdout_only(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr13", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-federation"
+        assert r["origin_bounded"] is True
+        assert r["sublinear_in_pods"] is True
+        assert r["member_origin_bytes"] == 0
+        assert r["seed_kill"]["completed"] is True
+        assert not list(tmp_path.iterdir())      # stdout only
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(

@@ -1,0 +1,657 @@
+"""Cross-pod federation: pod identity + classify, per-pod seed election,
+the scheduler's cross-pod filter, dispatcher tier pinning, PEX pod
+scoping + inter-pod summaries, feature-schema versioning, and the
+podscope [dcn] tier marks. All in-process — no sockets."""
+
+import pytest
+
+from dragonfly2_tpu.idl.messages import Host as HostMsg
+from dragonfly2_tpu.idl.messages import LinkType, TopologyInfo
+from dragonfly2_tpu.tpu import topology
+from dragonfly2_tpu.tpu.topology import (LINK_BANDWIDTH_SCORE,
+                                         LINK_TIER_NAMES, classify, ici_hops,
+                                         link_type, pod_id)
+
+
+def topo(slice_name="", zone="", pod="", coords=None):
+    return TopologyInfo(slice_name=slice_name, zone=zone, pod=pod,
+                        ici_coords=coords)
+
+
+class TestPodIdentity:
+    def test_pod_derived_from_slice_identity(self):
+        assert pod_id(topo(slice_name="v5p-256-s0")) == "v5p-256-s0"
+
+    def test_explicit_pod_wins_over_slice(self):
+        assert pod_id(topo(slice_name="s0", pod="pod-A")) == "pod-A"
+
+    def test_no_topology_means_no_pod(self):
+        # the detect() plain-DCN-peer fallback: no identity, never
+        # restricted by the federation plane
+        assert pod_id(None) == ""
+        assert pod_id(topo()) == ""
+
+    def test_pod_id_stable_across_reannounce(self):
+        # pod id is a pure function of the announced coordinates — two
+        # announce cycles of the same host must land in the same pod
+        a1 = topo(slice_name="s0", zone="z", coords=(1, 2))
+        a2 = topo(slice_name="s0", zone="z", coords=(1, 2))
+        assert pod_id(a1) == pod_id(a2)
+        from dragonfly2_tpu.scheduler.federation import PodFederation
+        fed = PodFederation()
+        fed.observe_host("h1", a1)
+        first = dict(fed.describe()["pods"])
+        fed.observe_host("h1", a2)          # re-announce: no-op
+        assert fed.describe()["pods"] == first
+
+    def test_detect_reads_df_pod_id(self, monkeypatch):
+        monkeypatch.setenv("DF_POD_ID", "pod-env")
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        topology.detect.cache_clear()
+        try:
+            assert topology.detect().pod == "pod-env"
+        finally:
+            topology.detect.cache_clear()
+
+
+class TestClassify:
+    def test_same_host(self):
+        c = classify(topo("s0", "z"), topo("s0", "z"), same_host=True)
+        assert c.link == LinkType.LOCAL
+        assert c.same_pod and c.dcn_hops == 0
+
+    def test_same_pod_ici(self):
+        c = classify(topo("s0", "z", coords=(0, 0)),
+                     topo("s0", "z", coords=(2, 1)))
+        assert c.link == LinkType.ICI
+        assert c.same_pod and c.dcn_hops == 0
+        assert c.ici == 3
+
+    def test_cross_pod_same_zone_is_dcn(self):
+        c = classify(topo("s0", "z"), topo("s1", "z"))
+        assert c.link == LinkType.DCN
+        assert not c.same_pod and c.dcn_hops == 1
+
+    def test_cross_zone_is_wan(self):
+        c = classify(topo("s0", "za"), topo("s1", "zb"))
+        assert c.link == LinkType.WAN
+        assert not c.same_pod and c.dcn_hops == 2
+
+    def test_missing_topology_is_plain_wan_peer(self):
+        # the topology.py detect() fallback: no coordinates at all
+        c = classify(None, topo("s0", "z"))
+        assert c.link == LinkType.WAN
+        assert not c.same_pod
+        assert c.dcn_hops == 2
+        assert c.ici == 1 << 16
+
+    def test_explicit_pod_groups_slices(self):
+        # two slices grouped into one pod: the link is still DCN (bytes
+        # ride the NIC) but the pod boundary is not crossed
+        c = classify(topo("s0", "z", pod="P"), topo("s1", "z", pod="P"))
+        assert c.link == LinkType.DCN
+        assert c.same_pod and c.dcn_hops == 0
+
+    def test_ici_hops_mismatched_dims_unknown(self):
+        assert ici_hops(topo(coords=(1, 2)), topo(coords=(1, 2, 3))) \
+            == 1 << 16
+
+
+class TestTierOrderingPinned:
+    """The satellite pin: the dispatcher's demand-side tiers, the
+    evaluator's bandwidth scores, and the pinned ledger tier names must
+    agree on the ordering LOCAL == ICI (same pod) < DCN (cross-pod) <
+    WAN (cross-zone) — a disagreement would let the daemon prefer the
+    exact links the scheduler is rationing."""
+
+    def test_dispatcher_tiers_name_the_pod_boundary(self):
+        from dragonfly2_tpu.daemon.piece_dispatcher import (LINK_TIER,
+                                                            TIER_CROSS_POD,
+                                                            TIER_CROSS_ZONE,
+                                                            TIER_SAME_POD)
+        assert LINK_TIER[LinkType.LOCAL] == TIER_SAME_POD
+        assert LINK_TIER[LinkType.ICI] == TIER_SAME_POD
+        assert LINK_TIER[LinkType.DCN] == TIER_CROSS_POD
+        assert LINK_TIER[LinkType.WAN] == TIER_CROSS_ZONE
+        assert TIER_SAME_POD < TIER_CROSS_POD < TIER_CROSS_ZONE
+
+    def test_dispatcher_ranking_agrees_with_evaluator_scoring(self):
+        from dragonfly2_tpu.daemon.piece_dispatcher import LINK_TIER
+        links = [LinkType.LOCAL, LinkType.ICI, LinkType.DCN, LinkType.WAN]
+        tiers = [LINK_TIER[lt] for lt in links]
+        scores = [LINK_BANDWIDTH_SCORE[lt] for lt in links]
+        # tiers ascend (worse) exactly while scores descend (worse)
+        assert tiers == sorted(tiers)
+        assert scores == sorted(scores, reverse=True)
+
+    def test_ledger_tier_names_cover_every_link(self):
+        assert set(LINK_TIER_NAMES) == set(LinkType)
+        assert [LINK_TIER_NAMES[lt] for lt in
+                (LinkType.LOCAL, LinkType.ICI, LinkType.DCN, LinkType.WAN)
+                ] == ["local", "ici", "dcn", "wan"]
+
+
+# --------------------------------------------------------------- election
+
+class FakeQuarantine:
+    def __init__(self, bad=()):
+        self.bad = set(bad)
+
+    def offerable(self, host_id, child_id=""):
+        return host_id not in self.bad
+
+
+class TestPodFederationElection:
+    def make(self, members=8, **kw):
+        from dragonfly2_tpu.scheduler.federation import PodFederation
+        fed = PodFederation(**kw)
+        for i in range(members):
+            fed.observe_host(f"h{i}", topo("pod-0", "z"))
+        return fed
+
+    def test_election_deterministic_and_sticky(self):
+        a = self.make().seeds_for("task-x", "pod-0")
+        b = self.make().seeds_for("task-x", "pod-0")
+        assert a == b and len(a) == 1
+        fed = self.make()
+        first = fed.seeds_for("task-x", "pod-0")
+        assert fed.seeds_for("task-x", "pod-0") is first   # memoized
+
+    def test_different_tasks_spread_over_the_ring(self):
+        fed = self.make(members=16)
+        seeds = {fed.seeds_for(f"task-{i}", "pod-0")[0] for i in range(32)}
+        assert len(seeds) > 1     # hash-ring, not a fixed leader
+
+    def test_quarantined_member_skipped(self):
+        plain = self.make().seeds_for("task-x", "pod-0")[0]
+        fed = self.make(quarantine=FakeQuarantine(bad=[plain]))
+        assert fed.seeds_for("task-x", "pod-0")[0] != plain
+
+    def test_wholly_quarantined_pod_still_elects(self):
+        # every member bad: the hashed member serves anyway (the
+        # SeedPeerClient._elect exhaustion semantics, shared walk)
+        all_bad = FakeQuarantine(bad={f"h{i}" for i in range(8)})
+        fed = self.make(quarantine=all_bad)
+        assert fed.seeds_for("task-x", "pod-0")
+
+    def test_exhausted_election_emits_once(self):
+        # a wholly-quarantined pod re-walks to the same hashed members:
+        # the memo must refresh SILENTLY, not flood the ledger at
+        # per-candidate rate (seeds_for runs per allows()/note() call)
+        rows = []
+        all_bad = FakeQuarantine(bad={f"h{i}" for i in range(8)})
+        fed = self.make(quarantine=all_bad, sink=rows.append)
+        first = fed.seeds_for("task-x", "pod-0")
+        for _ in range(5):
+            assert fed.seeds_for("task-x", "pod-0") == first
+        assert len(rows) == 1
+        assert rows[0]["result"] == "exhausted"
+
+    def test_exhaustion_and_recovery_both_journaled(self):
+        # the TRANSITIONS are what operators need: healthy -> exhausted
+        # (the pod knowingly routes through a quarantined seed) and the
+        # recovery back — each exactly once, even when the seed LIST
+        # never changes
+        rows = []
+        q = FakeQuarantine()
+        fed = self.make(quarantine=q, sink=rows.append)
+        fed.seeds_for("task-x", "pod-0")
+        q.bad = {f"h{i}" for i in range(8)}
+        fed.seeds_for("task-x", "pod-0")
+        fed.seeds_for("task-x", "pod-0")
+        q.bad = set()
+        fed.seeds_for("task-x", "pod-0")
+        fed.seeds_for("task-x", "pod-0")
+        assert [r["result"] for r in rows] == \
+            ["elected", "exhausted", "reelected"]
+
+    def test_dead_seed_reelected(self):
+        rows = []
+        fed = self.make(sink=rows.append)
+        first = fed.seeds_for("task-x", "pod-0")[0]
+        fed.forget_host(first)
+        second = fed.seeds_for("task-x", "pod-0")[0]
+        assert second != first
+        kinds = [(r["decision_kind"], r["result"]) for r in rows]
+        assert ("federation", "elected") in kinds
+        assert ("federation", "reelected") in kinds
+
+    def test_seed_client_walks_the_same_ring(self):
+        # the shared walk: origin-seed election skips quarantined seeds
+        from dragonfly2_tpu.rpc.balancer import HashRing
+        from dragonfly2_tpu.scheduler.federation import walk_ring
+        ring = HashRing(["a", "b", "c"])
+        plain = walk_ring(ring, "k", 3, None)
+        assert plain == [ring.pick("k")]
+        skipped = walk_ring(ring, "k", 3, FakeQuarantine(bad=[plain[0]]))
+        assert skipped and skipped[0] != plain[0]
+
+
+# ----------------------------------------------------- scheduling filter
+
+def build_task(pods=2, per_pod=3):
+    from dragonfly2_tpu.scheduler.resource import (Peer, PeerState, Resource,
+                                                   Task)
+    res = Resource()
+    task = Task("fedtest" + "0" * 57, "bench://fed")
+    task.set_content_info(4 << 20, 1 << 20, 4)
+    peers = []
+    for p in range(pods):
+        for i in range(per_pod):
+            t = topo(f"pod-{p}", "z", coords=(i, 0))
+            host = res.store_host(HostMsg(
+                id=f"p{p}w{i}-host", ip="10.0.0.1", port=1, download_port=2,
+                topology=t))
+            peer = res.get_or_create_peer(f"p{p}w{i}-peer", task, host)
+            peer.transit(PeerState.RUNNING)
+            peer.finished_pieces = {0, 1}
+            peers.append(peer)
+    return task, peers
+
+
+class TestSchedulingCrossPod:
+    def make_sched(self, federation):
+        from dragonfly2_tpu.scheduler.config import SchedulerConfig
+        from dragonfly2_tpu.scheduler.evaluator import make_evaluator
+        from dragonfly2_tpu.scheduler.scheduling import Scheduling
+        return Scheduling(SchedulerConfig(), make_evaluator("default"),
+                          federation=federation)
+
+    def make_fed(self, task, peers, seeds_per_pod=1):
+        from dragonfly2_tpu.scheduler.federation import PodFederation
+        fed = PodFederation(seeds_per_pod=seeds_per_pod)
+        for peer in peers:
+            fed.observe_host(peer.host.id, peer.host.msg.topology)
+        return fed
+
+    def test_member_offer_never_crosses_pods(self):
+        task, peers = build_task()
+        fed = self.make_fed(task, peers)
+        sched = self.make_sched(fed)
+        seeds = set(fed.seeds_for(task.id, "pod-0"))
+        member = next(p for p in peers
+                      if p.host.msg.topology.slice_name == "pod-0"
+                      and p.host.id not in seeds)
+        offer = sched.find_parents(member)
+        assert offer
+        for parent in offer:
+            assert parent.host.msg.topology.slice_name == "pod-0"
+
+    def test_pod_seed_may_cross(self):
+        task, peers = build_task()
+        fed = self.make_fed(task, peers)
+        sched = self.make_sched(fed)
+        seed_hid = fed.seeds_for(task.id, "pod-0")[0]
+        seed = next(p for p in peers if p.host.id == seed_hid)
+        offer = sched.find_parents(seed)
+        assert any(p.host.msg.topology.slice_name == "pod-1"
+                   for p in offer)
+
+    def test_podless_host_never_restricted(self):
+        from dragonfly2_tpu.scheduler.resource import PeerState
+        task, peers = build_task()
+        fed = self.make_fed(task, peers)
+        sched = self.make_sched(fed)
+        host = task.peers[peers[0].id].host.msg  # reuse resource via peer
+        from dragonfly2_tpu.scheduler.resource import Resource
+        # a plain-DCN peer (no topology): joins the task, gets offers
+        res_host = peers[0].host.__class__(HostMsg(
+            id="plain-host", ip="10.0.0.2", port=1, download_port=2,
+            topology=None))
+        from dragonfly2_tpu.scheduler.resource import Peer
+        plain = Peer("plain-peer", task, res_host)
+        task.add_peer(plain)
+        plain.transit(PeerState.RUNNING)
+        offer = sched.find_parents(plain)
+        assert offer    # cross-pod exclusion never applies to it
+
+    def test_cross_pod_exclusion_rides_the_ledger(self):
+        from dragonfly2_tpu.scheduler.scheduling import EXCLUSION_REASONS
+        assert "cross-pod" in EXCLUSION_REASONS
+        task, peers = build_task()
+        fed = self.make_fed(task, peers)
+        sched = self.make_sched(fed)
+        rows = []
+        sched.decision_sink = rows.append
+        seeds = set(fed.seeds_for(task.id, "pod-0"))
+        member = next(p for p in peers
+                      if p.host.msg.topology.slice_name == "pod-0"
+                      and p.host.id not in seeds)
+        sched.find_parents(member)
+        row = rows[-1]
+        assert any(e["reason"] == "cross-pod" for e in row["excluded"])
+        assert row["federation"]["pod"] == "pod-0"
+        assert row["federation"]["is_pod_seed"] is False
+        assert row["federation"]["pod_seeds"] == sorted(seeds)
+        # every candidate carries the pinned link tier term + the
+        # pod-boundary flag (classify is shipped semantics, not test-ware)
+        for cand in row["candidates"]:
+            assert cand["link_tier"] in ("local", "ici", "dcn", "wan")
+            assert cand["cross_pod"] is False   # offer is all in-pod
+
+    def test_federation_none_is_exact_old_path(self):
+        # same pool, no federation: cross-pod parents offered freely and
+        # no federation note on the row
+        task, peers = build_task()
+        sched = self.make_sched(None)
+        rows = []
+        sched.decision_sink = rows.append
+        member = peers[0]
+        offer = sched.find_parents(member)
+        assert any(p.host.msg.topology.slice_name == "pod-1"
+                   for p in offer)
+        assert "federation" not in rows[-1]
+
+
+# ----------------------------------------------------------- PEX scoping
+
+class _Md:
+    def __init__(self, task_id, pieces, total, done):
+        self.task_id = task_id
+        self.pieces = pieces
+        self.total_piece_count = total
+        self.content_length = total * (1 << 20)
+        self.piece_size = 1 << 20
+        self.done = done
+        self.success = done
+
+
+class _Ts:
+    def __init__(self, md):
+        self.md = md
+
+
+class _FakeStorage:
+    def __init__(self, entries):
+        self._entries = entries
+
+    def tasks(self):
+        return [_Ts(md) for md in self._entries]
+
+
+def make_gossiper(pod="pod-0", tasks=(), ip="10.0.0.9", **kw):
+    from dragonfly2_tpu.daemon.pex import PexGossiper
+    host = HostMsg(id=f"{pod or 'plain'}-self", ip=ip, port=1,
+                   download_port=9000,
+                   topology=topo(pod, "z") if pod else None)
+    return PexGossiper(storage_mgr=_FakeStorage(list(tasks)),
+                       host_info=lambda: host, **kw)
+
+
+class TestPexPodScope:
+    def test_full_digests_stay_pod_scoped(self):
+        g = make_gossiper()
+        g.observe_peer(host_id="same", ip="10.0.0.2", download_port=1,
+                       topology=topo("pod-0", "z"), direct=True)
+        g.observe_peer(host_id="other", ip="10.0.0.3", download_port=1,
+                       topology=topo("pod-1", "z"), direct=True)
+        g.observe_peer(host_id="podless", ip="10.0.0.4", download_port=1,
+                       direct=True)
+        names = {p.host_id for p in g._targets()}
+        assert "same" in names and "podless" in names
+        assert "other" not in names     # full piece sets never cross pods
+
+    def test_pod_scope_off_or_podless_host_targets_everyone(self):
+        g = make_gossiper(pod="")
+        g.observe_peer(host_id="other", ip="10.0.0.3", download_port=1,
+                       topology=topo("pod-1", "z"), direct=True)
+        assert {p.host_id for p in g._targets()} == {"other"}
+
+    def test_summary_has_no_piece_sets(self):
+        from dragonfly2_tpu.daemon.pex import unseal
+        g = make_gossiper(tasks=[
+            _Md("t-done" + "0" * 58, {0, 1, 2, 3}, 4, True),
+            _Md("t-part" + "0" * 58, {0, 1}, 4, False)])
+        body = unseal(g.summary_envelope())
+        assert body["kind"] == "summary"
+        assert body["peers"] == []      # no membership hearsay either
+        for t in body["tasks"]:
+            assert "pieces" not in t and "relay" not in t
+        part = next(t for t in body["tasks"] if not t["done"])
+        assert part["have"] == 2
+
+    def test_summary_ingest_indexes_only_complete_holders(self):
+        sender = make_gossiper(pod="pod-1", ip="10.0.0.8", tasks=[
+            _Md("t-done" + "0" * 58, {0, 1, 2, 3}, 4, True),
+            _Md("t-part" + "0" * 58, {0, 1}, 4, False)])
+        receiver = make_gossiper(pod="pod-0")
+        assert receiver.ingest(sender.summary_envelope(),
+                               transport="summary")
+        assert receiver.index.tasks() == ["t-done" + "0" * 58]
+        entry = receiver.index.parents_for("t-done" + "0" * 58)[0]
+        assert entry.done
+        # partial cross-pod claims never plant coverage the pex rung
+        # would park on
+        assert receiver.index.parents_for("t-part" + "0" * 58) == []
+
+    def test_candidates_prefer_pod_local_coverage(self):
+        from dragonfly2_tpu.daemon.swarm_index import SwarmEntry
+
+        class Cond:
+            task_id = "t" + "0" * 63
+            ready = set()
+
+        g = make_gossiper()
+        local = SwarmEntry(host_id="local", ip="10.0.0.2", rpc_port=1,
+                           download_port=1, topology=topo("pod-0", "z"),
+                           done=True)
+        remote = SwarmEntry(host_id="remote", ip="10.0.0.3", rpc_port=1,
+                            download_port=1, topology=topo("pod-1", "z"),
+                            done=True)
+        g.index.update(Cond.task_id, local)
+        g.index.update(Cond.task_id, remote)
+        # pod-local holder covers: never leave the pod
+        assert [e.host_id for e in g._candidates(Cond())] == ["local"]
+        g.index.forget_host("local")
+        # no pod-local coverage: the cross-pod holder is the fallback
+        assert [e.host_id for e in g._candidates(Cond())] == ["remote"]
+
+    def test_shunned_local_holder_never_masks_cross_pod_fallback(self):
+        # the shun filter runs BEFORE the pod-first coverage gate: a
+        # poisoned in-pod holder must not both satisfy coverage and
+        # discard the clean cross-pod fallback (which would push the
+        # pull all the way to origin)
+        from dragonfly2_tpu.daemon.swarm_index import SwarmEntry
+
+        class Cond:
+            task_id = "t" + "0" * 63
+            ready = set()
+
+        class Shun:
+            def shunned(self, addr):
+                return addr == "10.0.0.2:1"
+
+            def deprioritized(self, addr):
+                return False
+
+        g = make_gossiper(verdicts=Shun())
+        g.index.update(Cond.task_id, SwarmEntry(
+            host_id="bad-local", ip="10.0.0.2", rpc_port=1,
+            download_port=1, topology=topo("pod-0", "z"), done=True))
+        g.index.update(Cond.task_id, SwarmEntry(
+            host_id="clean-remote", ip="10.0.0.3", rpc_port=1,
+            download_port=1, topology=topo("pod-1", "z"), done=True))
+        assert [e.host_id for e in g._candidates(Cond())] \
+            == ["clean-remote"]
+
+    def test_lone_daemon_with_only_cross_pod_contacts_still_gossips(self):
+        # a fresh pod's first daemon bootstrapped off another pod's seed
+        # must not be isolated by the pod-scope filter
+        g = make_gossiper()
+        g.observe_peer(host_id="other", ip="10.0.0.3", download_port=1,
+                       topology=topo("pod-1", "z"), direct=True)
+        assert {p.host_id for p in g._targets()} == {"other"}
+        # ...but the moment a pod-local peer appears, scope re-engages
+        g.observe_peer(host_id="same", ip="10.0.0.2", download_port=1,
+                       topology=topo("pod-0", "z"), direct=True)
+        assert {p.host_id for p in g._targets()} == {"same"}
+
+    def test_summary_partials_surfaced_on_receiver(self):
+        sender = make_gossiper(pod="pod-1", ip="10.0.0.8", tasks=[
+            _Md("t-part" + "0" * 58, {0, 1}, 4, False)])
+        receiver = make_gossiper(pod="pod-0")
+        assert receiver.ingest(sender.summary_envelope(),
+                               transport="summary")
+        partials = receiver.debug_snapshot()["federation_partials"]
+        claims = partials["pod-1-self"]
+        assert claims["tasks"]["t-part" + "0" * 58] == {"have": 2,
+                                                        "total": 4}
+        assert claims["age_s"] >= 0.0
+        # a later summary with the task completed clears the claim
+        sender2 = make_gossiper(pod="pod-1", ip="10.0.0.8", tasks=[
+            _Md("t-part" + "0" * 58, {0, 1, 2, 3}, 4, True)])
+        receiver.ingest(sender2.summary_envelope(), transport="summary")
+        assert "pod-1-self" not in \
+            receiver.debug_snapshot()["federation_partials"]
+
+    def test_summary_partials_age_out(self):
+        from dragonfly2_tpu.daemon.pex import FED_PARTIALS_TTL_S
+        sender = make_gossiper(pod="pod-1", ip="10.0.0.8", tasks=[
+            _Md("t-part" + "0" * 58, {0, 1}, 4, False)])
+        receiver = make_gossiper(pod="pod-0")
+        receiver.ingest(sender.summary_envelope(), transport="summary")
+        # a dead pod seed's claim must not outlive the TTL (nor crowd
+        # live seeds out of the cap)
+        receiver.fed_partials["pod-1-self"]["at"] -= \
+            FED_PARTIALS_TTL_S + 1
+        assert receiver.debug_snapshot()["federation_partials"] == {}
+
+    def test_topology_pod_survives_the_wire(self):
+        from dragonfly2_tpu.daemon.pex import _topo_from_wire, _topo_to_wire
+        t = topo("s0", "z", pod="pod-X", coords=(1, 2))
+        assert _topo_from_wire(_topo_to_wire(t)).pod == "pod-X"
+
+
+class TestEvictionHooks:
+    """A host/task leaving the resource model must leave the federation
+    view too — a GC'd (silently dead) pod seed must not keep winning
+    elections it can never serve."""
+
+    def test_resource_eviction_notifies_federation(self):
+        from dragonfly2_tpu.scheduler.resource import Resource
+        res = Resource(host_ttl_s=0.0, task_ttl_s=0.0, peer_ttl_s=0.0)
+        gone_hosts, gone_tasks = [], []
+        res.on_host_evict = gone_hosts.append
+        res.on_task_evict = gone_tasks.append
+        res.store_host(HostMsg(id="h1", ip="10.0.0.1", port=1,
+                               download_port=2))
+        res.get_or_create_task("t" + "0" * 63, "bench://x")
+        res.gc()
+        assert gone_hosts == ["h1"]
+        assert gone_tasks == ["t" + "0" * 63]
+
+    def test_leave_host_notifies_federation(self):
+        from dragonfly2_tpu.scheduler.resource import Resource
+        res = Resource()
+        gone = []
+        res.on_host_evict = gone.append
+        res.store_host(HostMsg(id="h1", ip="10.0.0.1", port=1,
+                               download_port=2))
+        res.leave_host("h1")
+        assert gone == ["h1"]
+
+
+class TestGnnSchemaGate:
+    def test_stale_node_dim_refused_at_bind(self):
+        # a v1 blob (6 node features, no pod_id) must be refused at bind
+        # time — not crash the evaluator hot path on first imputation
+        import numpy as np
+
+        from dragonfly2_tpu.trainer import params_io, serving
+        stale = {"encode": {"w": np.zeros((6, 8), np.float32),
+                            "b": np.zeros((8,), np.float32)}}
+        blob = params_io.serialize_params(stale, {"model": "topology_gnn"})
+        with pytest.raises(ValueError, match="stale model refused"):
+            serving.make_gnn_impute(blob)
+
+
+# ------------------------------------------------- features + podscope
+
+class TestFeatureSchema:
+    def test_parent_features_unchanged_for_pr8_rows(self):
+        from dragonfly2_tpu.trainer import features
+        assert features.FEATURE_DIM == 7
+        assert features.FEATURE_SCHEMA_VERSION == 2
+        assert features.NODE_FEATURES[-1] == "pod_id"
+
+    def test_decision_outcome_rows_carry_tier_and_pod(self):
+        from dragonfly2_tpu.trainer.features import decision_outcome_rows
+        feats = [0.5] * 7
+        rows = [
+            {"kind": "decision", "decision_id": "d1", "task_id": "t",
+             "peer_id": "c", "federation": {"pod": "pod-0"},
+             "candidates": [{"peer_id": "p", "features": feats,
+                             "rank": 1, "link_tier": "ici"}]},
+            {"kind": "piece", "decision_id": "d1", "parent_peer_id": "p",
+             "label": 0.7},
+            # a v1 row (no tier/federation) must still parse
+            {"kind": "decision", "decision_id": "d2", "task_id": "t",
+             "peer_id": "c",
+             "candidates": [{"peer_id": "q", "features": feats,
+                             "rank": 1}]},
+            {"kind": "piece", "decision_id": "d2", "parent_peer_id": "q",
+             "label": 0.5},
+        ]
+        out = {r["decision_id"]: r for r in decision_outcome_rows(rows)}
+        assert out["d1"]["link_tier"] == "ici"
+        assert out["d1"]["pod"] == "pod-0"
+        assert out["d2"]["link_tier"] == "" and out["d2"]["pod"] == ""
+
+    def test_node_row_includes_pod(self):
+        from dragonfly2_tpu.trainer.features import topology_to_graph
+        g = topology_to_graph(
+            [{"src": "a", "dst": "b", "avg_rtt_us": 100.0}],
+            host_rows={"a": {"pod_id": 3}})
+        assert g["nodes"].shape[1] == 7
+        assert g["nodes"][0][-1] == 3.0
+
+
+class TestPodscopeTierMarks:
+    def make_snaps(self):
+        # two daemons in different pods; d2 pulled its piece from d1
+        flight = {
+            "peer_id": "d2-peer", "state": "success", "started_at": 0.0,
+            "summary": {
+                "task_id": "t1", "pieces": 1, "bytes_p2p": 100,
+                "bytes_source": 0,
+                "piece_rows": [{"piece": 0, "parent": "d1-peer",
+                                "bytes": 100, "start_ms": 0.0,
+                                "wire_ms": 1.0, "ttfb_ms": 0.1,
+                                "queue_ms": 0.0, "hbm_ms": 0.0,
+                                "total_ms": 1.1}],
+            },
+            "events": [],
+        }
+        serve_flight = {"peer_id": "d1-peer", "state": "serving",
+                        "started_at": 0.0, "summary": {"task_id": "t1"},
+                        "events": []}
+        return [
+            {"addr": "d1:1", "pod": "pod-0",
+             "flights": {"t1": serve_flight}},
+            {"addr": "d2:1", "pod": "pod-1", "flights": {"t1": flight}},
+        ]
+
+    def test_cross_pod_edge_marked_and_rendered(self):
+        from dragonfly2_tpu.common import podscope
+        report = podscope.aggregate(self.make_snaps())
+        t = report["tasks"]["t1"]
+        edge = next(e for e in t["edges"] if e["src"] == "d1:1")
+        assert edge["cross_pod"] is True
+        assert t["cross_pod_bytes"] == 100
+        text = podscope.render_pod(report)
+        assert "[dcn]" in text and "federation:" in text
+        assert report["daemons_detail"]["d1:1"]["pod"] == "pod-0"
+
+    def test_same_pod_edges_unmarked(self):
+        from dragonfly2_tpu.common import podscope
+        snaps = self.make_snaps()
+        snaps[1]["pod"] = "pod-0"
+        report = podscope.aggregate(snaps)
+        t = report["tasks"]["t1"]
+        assert all(not e.get("cross_pod") for e in t["edges"])
+        assert t["cross_pod_bytes"] == 0
+        assert "[dcn]" not in podscope.render_pod(report)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

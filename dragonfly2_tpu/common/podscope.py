@@ -164,8 +164,19 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]],
     downloaders = 0
     slo: dict[str, int] = {}
     rungs: dict[str, int] = {}
+    # sharded-task readiness across the pod: (host, shard) ready/total
+    # tallies + tree-vs-swap byte split from the summaries' shards block
+    shards_ready = shards_total = 0
+    shard_tree_bytes = shard_swap_bytes = shard_fallbacks = 0
     for addr, flight in holders:
         summary = _flight_summary(flight)
+        sh = summary.get("shards")
+        if sh:
+            shards_ready += sh.get("ready", 0)
+            shards_total += sh.get("total", 0)
+            shard_tree_bytes += sh.get("tree_bytes", 0)
+            shard_swap_bytes += sh.get("swap_bytes", 0)
+            shard_fallbacks += sh.get("fallbacks", 0)
         rows = summary.get("piece_rows") or []
         dl_bytes = (summary.get("bytes_p2p", 0)
                     + summary.get("bytes_source", 0)
@@ -411,6 +422,11 @@ def _aggregate_task(task_id: str, holders: list[tuple[str, dict]],
         "seed_uplink": seed_uplink,
         "slo_breaches": slo,
         "rungs": rungs,
+        "shards": ({"ready": shards_ready, "total": shards_total,
+                    "tree_bytes": shard_tree_bytes,
+                    "swap_bytes": shard_swap_bytes,
+                    "fallbacks": shard_fallbacks}
+                   if shards_total else None),
     }
 
 
@@ -695,6 +711,14 @@ def render_pod(report: dict, *, max_edges_per_node: int = 8) -> str:
                 f"  federation: {_fmt_bytes(t['cross_pod_bytes'])} "
                 "crossed a pod boundary ([dcn] edges) — healthy when "
                 "only pod-seed edges carry it")
+        shd = t.get("shards")
+        if shd:
+            fb = (f", {shd['fallbacks']} tree fallback(s)"
+                  if shd.get("fallbacks") else "")
+            out.append(
+                f"  shards: {shd['ready']}/{shd['total']} ready "
+                f"pod-wide ({_fmt_bytes(shd['tree_bytes'])} tree, "
+                f"{_fmt_bytes(shd['swap_bytes'])} swapped over ICI{fb})")
         su = t.get("seed_uplink")
         if su:
             out.append(

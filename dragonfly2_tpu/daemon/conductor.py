@@ -40,6 +40,25 @@ _span_lands = REGISTRY.counter(
     "df_span_land_total", "downloaded spans landed in storage, by landing "
     "path", ("path",))
 
+# sharded-task delivery (common/sharding.py): per-shard readiness +
+# tree-vs-swap byte attribution — the numbers behind "time-to-serving"
+_shard_ready = REGISTRY.counter(
+    "df_shard_ready_total", "manifest shards whose bytes all verified, "
+    "by supply path (tree = this host's assigned fetch subset, swap = "
+    "co-located replicas over ICI-near P2P)", ("src",))
+_shard_ready_s = REGISTRY.histogram(
+    "df_shard_ready_seconds", "time from task start to each shard "
+    "becoming ready",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             120.0, 300.0))
+_shard_fallbacks = REGISTRY.counter(
+    "df_shard_fallback_total", "swap-class pieces re-pulled from the "
+    "tree after the bounded swap hold expired (the ICI swap partner "
+    "died or stalled)")
+_shard_bytes = REGISTRY.counter(
+    "df_shard_bytes_total", "bytes landed into manifest shards, by the "
+    "piece's supply class", ("src",))
+
 
 class PeerTaskConductor:
     # terminal states
@@ -56,7 +75,9 @@ class PeerTaskConductor:
                  trace: Any = None,
                  flight: Any = None,
                  pex: Any = None,
-                 relay: Any = None):
+                 relay: Any = None,
+                 shard_manifest: Any = None,
+                 requested_shards: list[str] | None = None):
         self.task_id = task_id
         self.peer_id = peer_id
         self.url = url
@@ -86,6 +107,39 @@ class PeerTaskConductor:
         self.pex = pex               # PexGossiper (None = plane disabled)
         self.relay = relay           # RelayHub (None = cut-through off)
         self._relay_tracked = False
+        # sharded-task delivery (common/sharding.py): the manifest's shard
+        # table, the subset this host needs, and — once piece geometry is
+        # known (_init_shards) — the tracker that turns verified piece
+        # landings into per-shard readiness. Ranged requests keep the
+        # whole-file path: a manifest's offsets are content-absolute and
+        # a sub-range task's pieces are range-relative.
+        shards = getattr(shard_manifest, "shards", shard_manifest)
+        self.shard_manifest = (list(shards) if shards
+                               and content_range is None
+                               and not self.url_meta.range else None)
+        self.requested_shards = (list(requested_shards)
+                                 if requested_shards else None)
+        self.shard_tracker: Any = None
+        # piece numbers this download actually needs (None = all): the
+        # requested-shard subset's coverage — the dispatcher, back-source
+        # hole computation, and the finish check all read this
+        self.needed_pieces: set[int] | None = None
+        # scheduler shard affinity: the disjoint tree-fetch subset this
+        # peer was assigned (RegisterResult.assigned_shards); pieces of
+        # every OTHER requested shard are swap-class — held off the seed
+        # for a bounded window so co-located replicas supply them over
+        # ICI-near P2P (piece_dispatcher swap hold)
+        self.affinity_shards: list[str] | None = None
+        self.swap_piece_nums: set[int] = set()
+        self._swap_shard_names: set[str] = set()
+        self._fallback_noted: set[int] = set()
+        # completion commit point: set SYNCHRONOUSLY with the final
+        # needed-coverage check (engine loop / back-source / finalize) —
+        # a widen that loses this race is refused, so a finishing subset
+        # task can never be widened into "incomplete" (raising for both
+        # requesters) or into a success that silently lacks the
+        # joiner's shards
+        self._finishing = False
         # True when register failed at the TRANSPORT level (every ring
         # member unreachable) rather than by scheduler verdict — only then
         # may the pex rung second-guess the missing control plane
@@ -159,6 +213,11 @@ class PeerTaskConductor:
                 self._session = await self._register()
                 if self.flight is not None and self._session is not None:
                     self.flight.event(fr.REGISTERED)
+                if self._session is not None:
+                    assigned = getattr(self._session.result,
+                                       "assigned_shards", None)
+                    if assigned is not None:
+                        self.set_affinity(list(assigned))
                 if self._session is not None and self._p2p_engine is not None:
                     if self.flight is not None:
                         self.flight.rung(fr.RUNG_P2P)
@@ -280,11 +339,12 @@ class PeerTaskConductor:
         self.content_length = ts.md.content_length
         self.piece_size = ts.md.piece_size
         self.total_pieces = ts.md.total_piece_count
+        self._init_shards()
         self.storage_mgr.castore.note_hit("content", ts.md.content_length)
         if (self.device_sink_factory is not None
                 and self.content_length > 0 and self.device_ingest is None):
             try:
-                self.device_ingest = self.device_sink_factory(
+                self.device_ingest = self._make_device_ingest(
                     self.content_length)
             except Exception:  # device sink is best-effort
                 self.log.exception("device sink init failed; continuing "
@@ -301,6 +361,7 @@ class PeerTaskConductor:
             self.traffic_placed += p.size
             if self.flight is not None:
                 self.flight.event(fr.PLACED, num, "cas", p.size)
+            self._note_shard_progress(num, p.start, p.size)
             self._publish({"type": "piece", "num": num, "size": p.size,
                            "completed": self.completed_length,
                            "total": self.content_length})
@@ -361,6 +422,7 @@ class PeerTaskConductor:
             placed.add(num)
             if self.flight is not None:
                 self.flight.event(fr.PLACED, num, "cas", size)
+            self._note_shard_progress(num, offset, size)
             if self._relay_tracked:
                 self.relay.pulse(self.task_id)
             self._publish({"type": "piece", "num": num, "size": size,
@@ -393,6 +455,190 @@ class PeerTaskConductor:
     # content metadata + piece arrival (called by piece manager / engine)
     # ------------------------------------------------------------------
 
+    # ------------------------------------------------------------------
+    # sharded delivery (common/sharding.py)
+    # ------------------------------------------------------------------
+
+    def _init_shards(self) -> None:
+        """Build the shard tracker once piece geometry is known. A
+        malformed manifest demotes the task to the whole-file path (the
+        download still completes; nothing becomes a named ready array)."""
+        if (self.shard_manifest is None or self.shard_tracker is not None
+                or self.piece_size <= 0):
+            return
+        from ..common import sharding
+        try:
+            sharding.validate_manifest(self.shard_manifest,
+                                       self.content_length)
+            tracker = sharding.ShardTracker(self.shard_manifest,
+                                            self.requested_shards)
+        except ValueError:
+            self.log.exception("bad shard manifest; whole-file fallback")
+            self.shard_manifest = None
+            self.requested_shards = None
+            return
+        self.shard_tracker = tracker
+        if self.flight is not None:
+            self.flight.shards_total = tracker.total
+        if self.requested_shards is not None and self.total_pieces >= 0:
+            self.needed_pieces = tracker.needed_pieces(self.piece_size,
+                                                       self.total_pieces)
+        self._classify_affinity()
+        self.log.info("sharded task: %d/%d shards requested (%s pieces "
+                      "needed, %d swap-class)", tracker.total,
+                      len(self.shard_manifest),
+                      "all" if self.needed_pieces is None
+                      else len(self.needed_pieces),
+                      len(self.swap_piece_nums))
+
+    def set_affinity(self, names: list[str]) -> None:
+        """Scheduler shard-affinity ruling: these requested shards are
+        THIS peer's to fetch from the tree; the rest arrive by swap."""
+        self.affinity_shards = names
+        self._classify_affinity()
+
+    def _classify_affinity(self) -> None:
+        tracker = self.shard_tracker
+        if tracker is None or self.affinity_shards is None \
+                or self.piece_size <= 0:
+            return
+        from ..common.sharding import pieces_for_shards
+        mine = set(self.affinity_shards)
+        self._swap_shard_names = {s.name for s in tracker.shards
+                                  if s.name not in mine}
+        swap_shards = [s for s in tracker.shards
+                       if s.name in self._swap_shard_names]
+        swap = pieces_for_shards(swap_shards, self.piece_size,
+                                 self.total_pieces)
+        tree_shards = [s for s in tracker.shards if s.name in mine]
+        tree = pieces_for_shards(tree_shards, self.piece_size,
+                                 self.total_pieces)
+        # a boundary piece shared by a tree shard and a swap shard is
+        # tree-class: this host must fetch it anyway, and holding it
+        # back would stall the tree shard behind the swap window
+        self.swap_piece_nums = swap - tree
+
+    def pieces_remaining(self) -> int:
+        """Pieces still to land before this download is DONE — the
+        requested-subset count for sharded tasks, total otherwise
+        (-1 = unknown geometry)."""
+        if self.total_pieces < 0:
+            return -1
+        if self.needed_pieces is not None:
+            return len(self.needed_pieces - self.ready)
+        return self.total_pieces - len(self.ready)
+
+    def needed_piece_nums(self, total: int) -> list[int]:
+        """Sorted piece numbers this task needs out of ``total`` — the
+        back-source hole universe (piece_manager.download_source)."""
+        if self.needed_pieces is not None:
+            return sorted(n for n in self.needed_pieces if n < total)
+        return list(range(total))
+
+    def _note_shard_progress(self, num: int, offset: int, size: int,
+                             replay: bool = False) -> None:
+        """One verified piece landed: advance shard coverage, journal +
+        publish any shard that just completed. Cheap (interval merge) —
+        rides every landing path including placements and adoption.
+        ``replay`` (the widen path re-feeding already-landed pieces into
+        a fresh tracker) skips the byte counters: those bytes were
+        counted, with their true tree/swap class, when they landed."""
+        tracker = self.shard_tracker
+        if tracker is None:
+            return
+        if not replay:
+            # count only the bytes that fall INSIDE tracked shards:
+            # manifest-gap pieces (and the non-shard halves of boundary
+            # pieces) must not inflate the tree/swap split the metric
+            # exists to report
+            in_shards = tracker.shard_bytes_in(offset, offset + size)
+            if in_shards:
+                swap = num in self.swap_piece_nums
+                _shard_bytes.labels("swap" if swap else "tree").inc(
+                    in_shards)
+        t = self.flight.now_ms() if self.flight is not None else 0.0
+        for name in tracker.on_span(offset, offset + size, t):
+            shard = tracker.shard_for(name)
+            src = (fr.SHARD_SRC_SWAP if name in self._swap_shard_names
+                   else fr.SHARD_SRC_TREE)
+            _shard_ready.labels(fr.SHARD_SRC_NAMES[src]).inc()
+            _shard_ready_s.observe(max(t, 0.0) / 1000.0)
+            if self.flight is not None:
+                self.flight.event(fr.SHARD_READY, src, name,
+                                  shard.range_size, t_ms=t)
+            self._publish({"type": "shard", "name": name,
+                           "src": fr.SHARD_SRC_NAMES[src],
+                           "bytes": shard.range_size,
+                           "ready": len(tracker.ready),
+                           "total": tracker.total})
+
+    def note_shard_fallback(self, num: int, parent_id: str) -> None:
+        """A swap-class piece is being served by the TREE after its swap
+        hold expired (engine hook): journal it once per piece so dfdiag
+        can tell a healthy swap from a died-partner fallback."""
+        if num in self._fallback_noted:
+            return
+        self._fallback_noted.add(num)
+        _shard_fallbacks.inc()
+        if self.flight is not None:
+            self.flight.event(fr.SHARD_FALLBACK, num, parent_id)
+
+    def widen_to_whole_file(self) -> bool:
+        """A joiner needs shards (or the whole file) outside this subset
+        download: widen to the full piece set mid-flight. Landed coverage
+        is replayed into a full-manifest tracker so already-complete
+        shards stay ready and partially-covered ones keep their bytes —
+        nothing re-fetches. Returns False when this download has already
+        COMMITTED to finishing (the engine's/back-source's final
+        coverage check, or finalize itself): widening then could fail a
+        complete subset as "incomplete" or hand the joiner a success
+        missing its shards — the caller starts a fresh conductor over
+        the same task storage instead (it adopts the landed pieces and
+        fetches only the gap). Runs on the event loop, so the refusal
+        check and the mutation are atomic w.r.t. the commit points."""
+        if self.requested_shards is None:
+            return True
+        if self._finishing or self.done_event.is_set():
+            return False
+        self.log.info("sharded task widened to the whole file by a joiner")
+        self.requested_shards = None
+        self.needed_pieces = None
+        self.swap_piece_nums = set()
+        self._swap_shard_names = set()
+        if (self.shard_tracker is not None and self.piece_size > 0
+                and self.shard_manifest):
+            from ..common.sharding import ShardTracker
+            fresh = ShardTracker(self.shard_manifest)
+            fresh.ready.update(self.shard_tracker.ready)
+            self.shard_tracker = fresh
+            if self.flight is not None:
+                self.flight.shards_total = fresh.total
+            if self.storage is not None:
+                for num in sorted(self.ready):
+                    meta = self.storage.md.pieces.get(num)
+                    if meta is not None:
+                        self._note_shard_progress(num, meta.start,
+                                                  meta.size, replay=True)
+        engine = self._p2p_engine
+        if engine is not None:
+            engine.apply_shard_state(self)
+        return True
+
+    def _device_shard_specs(self) -> list[tuple] | None:
+        tracker = self.shard_tracker
+        if tracker is None:
+            return None
+        return [(s.name, s.range_start, s.range_size, s.dtype,
+                 list(s.shape) if s.shape else None)
+                for s in tracker.shards]
+
+    def _make_device_ingest(self, content_length: int):
+        specs = self._device_shard_specs()
+        if specs:
+            return self.device_sink_factory(content_length,
+                                            shard_specs=specs)
+        return self.device_sink_factory(content_length)
+
     def set_content_info(self, content_length: int,
                          piece_size: int = 0) -> int:
         """Fix piece geometry; register storage + device sink. Returns the
@@ -413,6 +659,7 @@ class PeerTaskConductor:
             piece_size=self.piece_size, digest=self.url_meta.digest,
             priority=self.resolved_priority, qos_class=self.qos_class)
         self.storage = self.storage_mgr.register_task(md)
+        self._init_shards()
         if self.relay is not None and not self._relay_tracked:
             # cut-through: from here until finish, the upload server may
             # serve this task's bytes up to the landing watermark
@@ -422,7 +669,7 @@ class PeerTaskConductor:
         if (self.device_sink_factory is not None and effective_len > 0
                 and self.device_ingest is None):
             try:
-                self.device_ingest = self.device_sink_factory(effective_len)
+                self.device_ingest = self._make_device_ingest(effective_len)
             except Exception:  # device sink is best-effort
                 self.log.exception("device sink init failed; continuing to disk")
         return self.piece_size
@@ -626,6 +873,9 @@ class PeerTaskConductor:
                                "completed": self.completed_length,
                                "total": self.content_length})
             self._piece_cond.notify_all()
+        for n in counted:
+            p = by_num[n]
+            self._note_shard_progress(n, p.range_start, p.range_size)
         if self._relay_tracked:
             # landed bytes are now disk-covered: move relay readers along
             self.relay.pulse(self.task_id)
@@ -671,6 +921,7 @@ class PeerTaskConductor:
             self.ready.add(num)
             self.completed_length += len(data)
             self._piece_cond.notify_all()
+        self._note_shard_progress(num, offset, len(data))
         if self._relay_tracked:
             self.relay.pulse(self.task_id)
         self._publish({"type": "piece", "num": num, "size": len(data),
@@ -724,15 +975,71 @@ class PeerTaskConductor:
             raise DFError(Code.CLIENT_DIGEST_MISMATCH,
                           f"content digest mismatch: {algo}:{got[:12]}..")
 
+    async def _verify_shard_digests(self) -> None:
+        """Optional whole-shard digests (ShardInfo.digest) checked at
+        finalize over the landed bytes; per-piece digests already
+        verified every piece at landing, so this is belt-and-braces for
+        manifests that carry them."""
+        tracker = self.shard_tracker
+        if tracker is None or self.storage is None:
+            return
+        to_check = [s for s in tracker.shards
+                    if s.digest and s.name in tracker.ready]
+        if not to_check:
+            return
+        path = self.storage.data_path()
+
+        def compute() -> list[str]:
+            bad: list[str] = []
+            with open(path, "rb") as f:
+                for s in to_check:
+                    algo, want = digestlib.parse(s.digest)
+                    hasher = digestlib.Hasher(algo)
+                    f.seek(s.range_start)
+                    remaining = s.range_size
+                    while remaining > 0:
+                        b = f.read(min(4 << 20, remaining))
+                        if not b:
+                            break
+                        remaining -= len(b)
+                        hasher.update(b)
+                    if remaining or hasher.hexdigest() != want:
+                        bad.append(s.name)
+            return bad
+
+        # default executor, same rationale as _verify_digest: multi-GB
+        # hashing must not queue span landings on the 4-thread storage pool
+        bad = await asyncio.to_thread(compute)
+        if bad:
+            raise DFError(Code.CLIENT_DIGEST_MISMATCH,
+                          f"shard digest mismatch: {bad}")
+
     async def _finish_success(self) -> None:
-        if self.total_pieces >= 0 and len(self.ready) < self.total_pieces:
+        # a requested-shard subset finishes when ITS pieces are all in;
+        # the task's storage then stays a warm PARTIAL (never marked
+        # done): peers see exactly the pieces it holds, a later request
+        # for other shards adopts them via place_from_store, and the
+        # complete-task reuse path can never serve the partial file as
+        # whole content
+        self._finishing = True      # widen refused from here on
+        subset_done = (self.needed_pieces is not None
+                       and self.total_pieces >= 0
+                       and len(self.ready) < self.total_pieces
+                       and not (self.needed_pieces - self.ready))
+        if (self.total_pieces >= 0 and len(self.ready) < self.total_pieces
+                and not subset_done):
             raise DFError(Code.CLIENT_STORAGE_ERROR,
                           f"incomplete: {len(self.ready)}/{self.total_pieces} pieces")
-        await self._verify_digest()
-        if self.storage is not None:
-            await run_io(self.storage.mark_done, success=True,
-                         content_length=self.content_length,
-                         total_piece_count=self.total_pieces)
+        await self._verify_shard_digests()
+        if subset_done:
+            if self.storage is not None:
+                await run_io(self.storage.persist)
+        else:
+            await self._verify_digest()
+            if self.storage is not None:
+                await run_io(self.storage.mark_done, success=True,
+                             content_length=self.content_length,
+                             total_piece_count=self.total_pieces)
         if self.device_ingest is not None:
             try:
                 self.device_ingest.flush()   # enqueue-only, non-blocking

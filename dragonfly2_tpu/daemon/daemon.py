@@ -158,9 +158,12 @@ class Daemon:
             quarantined=self.verdicts.self_quarantined)
 
     def device_sink_builder(self, spec: DeviceSink):
-        """Returns a factory(content_length) -> DeviceIngest honoring the
-        request's sink spec."""
-        def factory(content_length: int):
+        """Returns a factory(content_length[, shard_specs]) -> DeviceIngest
+        honoring the request's sink spec. ``shard_specs`` (sharded tasks,
+        common/sharding.py) switches the sink to manifest mode: named
+        uneven shards that each become a device array the moment their
+        bytes are covered."""
+        def factory(content_length: int, shard_specs: list | None = None):
             if not topology.ensure_runtime_alive():
                 # permanently poisoned (our own probe thread is parked in
                 # jax init holding its locks), host-marked wedged, or a
@@ -175,6 +178,9 @@ class Daemon:
             import jax
 
             from ..tpu.hbm_sink import DeviceIngest
+            if shard_specs:
+                return DeviceIngest(content_length, dtype=spec.dtype,
+                                    shard_specs=shard_specs)
             spd = spec.pipeline_shards
             if spd <= 0:
                 # auto: one shard per DMA unit. Measured on the real chip:

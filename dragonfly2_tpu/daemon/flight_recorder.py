@@ -73,6 +73,16 @@ PLACED = "placed"            # dedupe hit (parent = "cas"): the piece's
 # locally by the content store — zero wire bytes moved; the summary
 # carries these as bytes_placed so podscope can tell a warm pod (origin
 # bytes 0 because nothing needed transferring) from a blind one
+SHARD_READY = "shard_ready"  # a named manifest shard's bytes all verified
+# (parent = shard name, bytes = shard size, piece = source class index
+# into SHARD_SRC_NAMES): the moment the shard became eligible to be a
+# ready device array — the sharded-task analog of wire_done, and the
+# series dfget's per-shard timestamps and the pr14 bench makespan read
+SHARD_FALLBACK = "shard_fallback"  # a swap-class piece (a shard assigned
+# to a co-located replica's tree fetch) ran out its swap hold and was
+# re-pulled from the tree instead (parent = the serving parent): the
+# ICI-swap partner died or stalled, and the bounded hold kept the task
+# from wedging on it — the sharded analog of a degradation-ladder rung
 # task-level stages
 REGISTERED = "registered"    # scheduler register returned
 HBM_SHARD = "hbm_shard"      # one device DMA completed (piece = shard idx)
@@ -100,6 +110,12 @@ RUNG_FAIL = "fail"                    # ladder exhausted; coded verdict
 
 ORIGIN = ""                  # parent id of a back-to-source fetch
 
+# SHARD_READY source classes, indexed by the event's piece field: which
+# path supplied the shard's bytes — the host's own assigned tree fetch,
+# or co-located replicas over ICI-near P2P (the shard swap)
+SHARD_SRC_NAMES = ("tree", "swap")
+SHARD_SRC_TREE, SHARD_SRC_SWAP = 0, 1
+
 
 class TaskFlight:
     """One task's event journal. Events are ``(t_ms, stage, piece, parent,
@@ -107,7 +123,7 @@ class TaskFlight:
 
     __slots__ = ("task_id", "peer_id", "started_at", "_m0", "events",
                  "serves", "state", "url", "report_drops", "_sum_key",
-                 "_sum_cache", "qos_class", "tenant")
+                 "_sum_cache", "qos_class", "tenant", "shards_total")
 
     def __init__(self, task_id: str, peer_id: str, *, url: str = "",
                  max_events: int = 4096, max_serves: int = 1024,
@@ -134,6 +150,10 @@ class TaskFlight:
         # (scheduler_session.report_piece) — a silent drop becomes a ghost
         # peer on the scheduler, so the count rides the flight summary
         self.report_drops = 0
+        # sharded tasks: how many manifest shards this download tracks
+        # (0 = not sharded) — set by the conductor so the summary's
+        # shards block can report ready/total without replaying events
+        self.shards_total = 0
         self._sum_key: tuple | None = None   # summarize() memo (see there)
         self._sum_cache: dict = {}
 
@@ -225,7 +245,8 @@ class TaskFlight:
         # mid-flight summary from the HTTP surface
         key = (len(self.events), self.state, self.report_drops,
                self.events[-1] if self.events else None,
-               len(self.serves), self.serves[-1] if self.serves else None)
+               len(self.serves), self.serves[-1] if self.serves else None,
+               self.shards_total)
         if key == self._sum_key:
             return dict(self._sum_cache)
         pieces: dict[int, dict] = {}
@@ -237,9 +258,20 @@ class TaskFlight:
         hbm_dma_ms = 0.0
         placed_pieces = 0
         bytes_placed = 0
+        shard_rows: list[dict] = []
+        shard_fallbacks = 0
         for t, stage, piece, parent, nbytes, dur in self.events:
             if stage == HBM_SHARD:
                 hbm_dma_ms += dur
+                continue
+            if stage == SHARD_READY:
+                src = (SHARD_SRC_NAMES[piece]
+                       if 0 <= piece < len(SHARD_SRC_NAMES) else "tree")
+                shard_rows.append({"name": parent, "src": src,
+                                   "t_ms": round(t, 3), "bytes": nbytes})
+                continue
+            if stage == SHARD_FALLBACK:
+                shard_fallbacks += 1
                 continue
             if stage == PLACED:
                 # content-store placements moved zero wire bytes: counted
@@ -394,6 +426,24 @@ class TaskFlight:
             "quarantined_parents": quarantined,
             "piece_rows": piece_rows,
         }
+        if self.shards_total or shard_rows:
+            # sharded-task readiness: one row per completed shard (name,
+            # tree vs swap, ready timestamp) plus the slowest — what
+            # dfdiag's verdict and podscope's per-task shards line read
+            shards: dict = {
+                "total": self.shards_total or len(shard_rows),
+                "ready": len(shard_rows),
+                "tree_bytes": sum(r["bytes"] for r in shard_rows
+                                  if r["src"] == "tree"),
+                "swap_bytes": sum(r["bytes"] for r in shard_rows
+                                  if r["src"] == "swap"),
+                "fallbacks": shard_fallbacks,
+                "rows": shard_rows,
+            }
+            if shard_rows:
+                shards["slowest"] = max(shard_rows,
+                                        key=lambda r: r["t_ms"])
+            summary["shards"] = shards
         total_bytes = summary["bytes_p2p"] + summary["bytes_source"]
         summary["back_to_source_ratio"] = (
             round(summary["bytes_source"] / total_bytes, 4)
@@ -420,6 +470,14 @@ class TaskFlight:
         1000-piece task must not ship a 1000-row report)."""
         s = self.summarize()
         del s["piece_rows"]
+        if "shards" in s:
+            # same cap rationale as piece_rows: a 1000-shard checkpoint
+            # must not ship a 1000-row report — keep the latest-ready few
+            # (the tail that sets time-to-serving), totals stay exact
+            sh = dict(s["shards"])
+            sh["rows"] = sorted(sh["rows"], key=lambda r: r["t_ms"],
+                                reverse=True)[:max_parents]
+            s["shards"] = sh
         parents = sorted(s["per_parent"].items(),
                          key=lambda kv: kv[1]["bytes"], reverse=True)
         s["per_parent"] = dict(parents[:max_parents])

@@ -66,10 +66,16 @@ class PeerTaskManager:
             task_type: TaskType = TaskType.STANDARD,
             disable_back_source: bool = False,
             device_sink_factory: Any = None,
-            ordered: bool = False) -> PeerTaskConductor:
+            ordered: bool = False,
+            shard_manifest: Any = None) -> PeerTaskConductor:
         task_id = self._task_id(url, meta)
         content_range: Range | None = None
-        existing = await self._join_existing(task_id, ordered)
+        requested_shards = None
+        if meta.shards:
+            from ..common.sharding import parse_shard_names
+            requested_shards = parse_shard_names(meta.shards) or None
+        existing = await self._join_existing(
+            task_id, ordered, requested_shards=requested_shards)
         if existing is not None:
             return existing
         # QoS admission happens OUTSIDE the manager lock: a bulk request
@@ -93,10 +99,16 @@ class PeerTaskManager:
             if (conductor is not None
                     and conductor.state != PeerTaskConductor.FAILED):
                 # lost the creation race while queued at admission: the
-                # winner's admission is the accounted one
-                if qos_cls is not None:
-                    self.qos.release(qos_cls)
-                return conductor
+                # winner's admission is the accounted one. A FINISHED or
+                # finishing subset conductor that doesn't cover this
+                # request falls through to a fresh conductor instead
+                # (same task storage; only the gap transfers).
+                gap = self._subset_gap(conductor, requested_shards)
+                if not gap or (not conductor.done_event.is_set()
+                               and conductor.widen_to_whole_file()):
+                    if qos_cls is not None:
+                        self.qos.release(qos_cls)
+                    return conductor
             peer_id = ids.peer_id(self.hostname, self.host_ip,
                                   seed=self.is_seed)
             flight = (self.flight_recorder.begin(
@@ -110,7 +122,9 @@ class PeerTaskManager:
                 content_range=content_range,
                 disable_back_source=disable_back_source, task_type=task_type,
                 device_sink_factory=device_sink_factory, ordered=ordered,
-                flight=flight, pex=self.pex, relay=self.relay)
+                flight=flight, pex=self.pex, relay=self.relay,
+                shard_manifest=shard_manifest,
+                requested_shards=requested_shards)
             if qos_cls is not None:
                 conductor.qos_release = (
                     lambda c=qos_cls: self.qos.release(c))
@@ -129,8 +143,9 @@ class PeerTaskManager:
             conductor.start()
             return conductor
 
-    async def _join_existing(self, task_id: str,
-                             ordered: bool) -> PeerTaskConductor | None:
+    async def _join_existing(self, task_id: str, ordered: bool,
+                             requested_shards: list[str] | None = None,
+                             ) -> PeerTaskConductor | None:
         """Join a live conductor for this task if one exists (subscribers
         share one download — joining costs no QoS admission; the original
         admission already accounts the work)."""
@@ -146,7 +161,31 @@ class PeerTaskManager:
                 engine = conductor._p2p_engine
                 if engine is not None:
                     engine.dispatcher.ordered = True
+            if self._subset_gap(conductor, requested_shards):
+                # the joiner needs shards (or the whole file) the live
+                # subset download would never fetch: widen to the full
+                # piece set so its done_event covers both. A FINISHED
+                # (or finishing — widen refuses) subset download can't
+                # grow: a fresh conductor over the same task storage
+                # adopts its pieces (place_from_store) and fetches only
+                # the gap.
+                if (conductor.done_event.is_set()
+                        or not conductor.widen_to_whole_file()):
+                    return None
             return conductor
+
+    @staticmethod
+    def _subset_gap(conductor: PeerTaskConductor,
+                    requested_shards: list[str] | None) -> bool:
+        """True when ``conductor`` is a requested-subset download that
+        does NOT cover this request's needs (other shards, or the whole
+        file)."""
+        if conductor.requested_shards is None:
+            return False
+        if requested_shards is None:
+            return True
+        return bool(set(requested_shards)
+                    - set(conductor.requested_shards))
 
     def conductor(self, task_id: str) -> PeerTaskConductor | None:
         return self._conductors.get(task_id)
@@ -232,7 +271,8 @@ class PeerTaskManager:
         conductor = await self.get_or_create_conductor(
             req.url, meta, task_type=req.task_type,
             disable_back_source=req.disable_back_source,
-            device_sink_factory=device_factory)
+            device_sink_factory=device_factory,
+            shard_manifest=req.shard_manifest)
         q = conductor.subscribe()
         try:
             while True:
@@ -247,6 +287,17 @@ class PeerTaskManager:
                         task_id=conductor.task_id, peer_id=conductor.peer_id,
                         completed_length=event["completed"],
                         content_length=event["total"])
+                elif event["type"] == "shard":
+                    # sharded tasks: one progress frame per shard that
+                    # became ready (all bytes verified) — dfget prints
+                    # the per-shard ready timestamps off these
+                    yield DownloadResponse(
+                        task_id=conductor.task_id, peer_id=conductor.peer_id,
+                        completed_length=conductor.completed_length,
+                        content_length=conductor.content_length,
+                        shard=event["name"], shard_src=event["src"],
+                        shards_ready=event["ready"],
+                        shards_total=event["total"])
                 elif event["type"] == "done":
                     if not event.get("success"):
                         raise DFError(Code(event.get("code") or Code.UNKNOWN),

@@ -191,6 +191,14 @@ ENDGAME_PIECES = 2   # remaining-piece count at which duplicate racing is allowe
 # (kept tiny: each duplicate is a full extra transfer — on CPU-bound hosts
 # racing the whole tail measurably SLOWS the wave; this is stall insurance
 # for the final pieces, not a parallelism strategy)
+# Sharded-task swap hold: a swap-class piece (assigned to a co-located
+# replica's tree fetch) whose only usable holders are SEEDS waits this
+# long for the replica to land + announce it over ICI — pulling it from
+# the tree immediately would re-fetch every byte affinity just deduped
+# and collapse the disjoint split back into N full pulls. Bounded so a
+# dead partner degrades to one extra tree fetch (journaled as a
+# ``shard_fallback`` flight event), never a wedge.
+SWAP_HOLD_S = 1.5
 
 
 class Dispatch:
@@ -242,6 +250,12 @@ class PieceDispatcher:
         self.wait_stats = {"no_piece_s": 0.0, "busy_s": 0.0,
                            "seed_busy_s": 0.0, "other_s": 0.0}
         self._seed_hold_expiry: float | None = None   # see _pick seed grace
+        # sharded tasks (set_shard_state): pieces this download needs at
+        # all (None = every piece) and the swap-class subset held off
+        # seed parents for SWAP_HOLD_S
+        self.needed: set[int] | None = None
+        self.swap_nums: set[int] = set()
+        self.swap_hold_s = SWAP_HOLD_S
 
     # ------------------------------------------------------------------
     # feeding: parents + announced pieces
@@ -320,6 +334,22 @@ class PieceDispatcher:
             if notify:
                 self._cond.notify_all()
 
+    def set_shard_state(self, needed: set[int] | None,
+                        swap_nums: set[int]) -> None:
+        """Sharded-task piece classes (engine.apply_shard_state): pieces
+        outside ``needed`` are never dispatched (announcements for them
+        are kept — a widen may need them later), ``swap_nums`` wait out
+        the swap hold before a seed may serve them. Plain assignment on
+        purpose (no cond round): workers re-pick within their bounded
+        0.5 s wake cap, and this is called before parents exist on the
+        normal path — only a mid-flight widen ever races it, and a widen
+        only ADDS dispatchable pieces."""
+        self.needed = set(needed) if needed is not None else None
+        self.swap_nums = set(swap_nums)
+
+    def _dispatchable(self, num: int) -> bool:
+        return self.needed is None or num in self.needed
+
     async def close(self) -> None:
         # already-closed short-circuit BEFORE touching the lock: teardown
         # calls close() more than once (engine finally + _teardown), and a
@@ -354,12 +384,26 @@ class PieceDispatcher:
         for ps in self._pieces.values():
             if ps.inflight:
                 continue
+            if not self._dispatchable(ps.info.piece_num):
+                continue
             all_states = [self.parents[h] for h in ps.holders
                           if h in self.parents
                           and not self.parents[h].ejected]
             holders = [h for h in all_states if not h.is_busy()]
             if not holders:
                 continue
+            if (ps.info.piece_num in self.swap_nums
+                    and all(h.is_seed for h in holders)):
+                # swap-class piece with only the tree to serve it: wait
+                # out the swap hold for the owning replica's ICI copy —
+                # expiry rides the worker wake scan like the seed grace
+                hold_age = now - ps.first_seen
+                if hold_age < self.swap_hold_s:
+                    expiry = ps.first_seen + self.swap_hold_s
+                    if (self._seed_hold_expiry is None
+                            or expiry < self._seed_hold_expiry):
+                        self._seed_hold_expiry = expiry
+                    continue
 
             def _is_local(h) -> bool:
                 return not h.is_seed and LINK_TIER.get(h.link, 1) == 0
@@ -430,6 +474,13 @@ class PieceDispatcher:
             if (cand is None or cand is ps or cand.inflight
                     or parent.peer_id not in cand.holders):
                 return False
+            if not self._dispatchable(cand.info.piece_num):
+                return False
+            if parent.is_seed and cand.info.piece_num in self.swap_nums:
+                # grouping must not drag a swap-class piece onto the seed
+                # past its hold — it dispatches alone once the hold runs
+                # out (the journaled fallback path)
+                return False
             # don't drag a piece onto a WORSE link than its own best free
             # holder offers — grouping must not bypass the tier preference
             # (and the pick metric) for its groupmates
@@ -483,6 +534,8 @@ class PieceDispatcher:
         for ps in self._pieces.values():
             if not ps.fetching:
                 continue   # normal path will take it
+            if not self._dispatchable(ps.info.piece_num):
+                continue
             # ONE racer per piece, and only against a fetch that has been
             # in flight a while: uncapped immediate racing turns every slow
             # tail piece into a duplicate from every idle worker — bounded
@@ -493,6 +546,15 @@ class PieceDispatcher:
             alts = [self.parents[h] for h in ps.holders - ps.fetching
                     if h in self.parents and not self.parents[h].ejected
                     and not self.parents[h].is_busy()]
+            if ps.info.piece_num in self.swap_nums:
+                # endgame racers for a swap-class piece come only from
+                # mates: the in-flight fetch IS a live partner serving
+                # it, and racing a duplicate onto the SEED would re-fetch
+                # over the tree exactly the bytes affinity deduped (and
+                # journal a spurious shard_fallback). A wedged mate still
+                # exits via the failure/deadline path, after which the
+                # normal pick seed-serves past the hold.
+                alts = [h for h in alts if not h.is_seed]
             if not alts:
                 continue
             parent = min(alts, key=ParentState.rank)
@@ -683,6 +745,8 @@ class PieceDispatcher:
         that's backpressure working, and pinging through it would turn
         every 503 into an announcement flood."""
         for ps in self._pieces.values():
+            if not self._dispatchable(ps.info.piece_num):
+                continue    # unneeded pieces must not mask starvation
             if ps.inflight:
                 return False
             for h in ps.holders:
@@ -692,7 +756,9 @@ class PieceDispatcher:
         return True
 
     def pending_count(self) -> int:
-        return len(self._pieces)
+        if self.needed is None:
+            return len(self._pieces)
+        return sum(1 for n in self._pieces if n in self.needed)
 
     def has_live_parent(self) -> bool:
         return any(not p.ejected for p in self.parents.values())

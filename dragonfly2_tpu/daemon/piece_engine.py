@@ -120,6 +120,7 @@ class _Synchronizer:
         if packet.content_length >= 0 and self.conductor.piece_size == 0:
             self.conductor.set_content_info(packet.content_length,
                                             packet.piece_size)
+            self.engine.apply_shard_state(self.conductor)
         if self.conductor.piece_size == 0:
             # parent itself doesn't know the geometry yet (unknown-length
             # origin mid-flight): skip — the done-refresh re-announces all
@@ -226,12 +227,30 @@ class PieceEngine:
         self._ping_base = 0.1 * random.uniform(0.9, 1.5)
         self._ping_interval = self._ping_base
         self._announced_at_ping = -1
+        self._shards_applied = False
 
     def peer_client(self, addr: str) -> ServiceClient:
         return ServiceClient(self._channels.get(addr), DAEMON_SERVICE)
 
     def _relay_opener(self, conductor, pieces: list[PieceInfo]) -> _SpanHandle:
         return _SpanHandle(self.relay, conductor.task_id, pieces)
+
+    def apply_shard_state(self, conductor) -> None:
+        """Push the conductor's sharded-task piece classes into the
+        dispatcher once geometry is known: the needed subset (pieces
+        outside it are never dispatched) and the swap-class set (held
+        off seed parents for the bounded swap window so co-located
+        replicas supply them over ICI-near P2P). Idempotent; re-applied
+        on widen (a joiner requesting other shards)."""
+        if conductor.shard_tracker is None or conductor.piece_size <= 0:
+            return
+        if self._shards_applied \
+                and self.dispatcher.needed == conductor.needed_pieces \
+                and self.dispatcher.swap_nums == conductor.swap_piece_nums:
+            return
+        self._shards_applied = True
+        self.dispatcher.set_shard_state(conductor.needed_pieces,
+                                        conductor.swap_piece_nums)
 
     # ------------------------------------------------------------------
 
@@ -403,6 +422,7 @@ class PieceEngine:
         if session.result.content_length >= 0:
             conductor.set_content_info(session.result.content_length,
                                        session.result.piece_size)
+        self.apply_shard_state(conductor)
 
         packet_task = asyncio.get_running_loop().create_task(
             self._consume_packets(conductor, session))
@@ -434,7 +454,16 @@ class PieceEngine:
                 if self._need_back_source:
                     return False
                 if (conductor.total_pieces >= 0
-                        and len(conductor.ready) >= conductor.total_pieces):
+                        and conductor.pieces_remaining() == 0):
+                    # done = every NEEDED piece landed (the requested-shard
+                    # subset for sharded tasks, all pieces otherwise). The
+                    # commit flag is set in the SAME synchronous block as
+                    # the coverage check: a widen (also loop-synchronous)
+                    # either ran before it — and this check then saw the
+                    # widened needed set and kept pulling — or is refused
+                    # after it, so a completing subset can never be
+                    # widened into "incomplete"
+                    conductor._finishing = True
                     return True
                 if not rescuable:
                     if len(conductor.ready) != last_ready:
@@ -449,10 +478,8 @@ class PieceEngine:
                         return False
                 # endgame gate: duplicate-request racing only for the task's
                 # actual tail (see dispatcher._pick_endgame)
-                self.dispatcher.endgame = (
-                    conductor.total_pieces >= 0
-                    and conductor.total_pieces - len(conductor.ready)
-                    <= ENDGAME_PIECES)
+                remaining = conductor.pieces_remaining()
+                self.dispatcher.endgame = (0 <= remaining <= ENDGAME_PIECES)
                 if not self.dispatcher.has_live_parent():
                     # parents gone: give the scheduler a grace period to
                     # re-assign, then fall back to origin — the reschedule
@@ -634,6 +661,15 @@ class PieceEngine:
                 fresh.start()
 
     async def _download_one(self, conductor, session, d: Dispatch) -> None:
+        if conductor.swap_piece_nums and d.parent.is_seed:
+            # a swap-class piece (a co-located replica's tree assignment)
+            # riding the SEED: its swap hold expired — the partner died or
+            # stalled and the tree is covering the hole (journaled so
+            # dfdiag can tell this from a healthy swap)
+            for info in d.pieces:
+                if info.piece_num in conductor.swap_piece_nums:
+                    conductor.note_shard_fallback(info.piece_num,
+                                                  d.parent.peer_id)
         flight = conductor.flight
         if flight is not None:
             # worker pickup: queue_ms then measures the rate-limiter wait;

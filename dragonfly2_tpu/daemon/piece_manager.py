@@ -192,21 +192,41 @@ class PieceManager:
             await conductor.place_from_store(
                 [m.to_info() for m in
                  list(conductor.storage.md.pieces.values())])
-        missing = [i for i in range(n) if i not in conductor.ready]
-        if not missing:
-            conductor.on_source_complete(effective)
-            return
-        partial = len(missing) < n
-        if (ranged and self.cfg.back_source_parallelism > 1
-                and (partial
-                     or effective >= self.cfg.back_source_group_min_bytes)):
-            # the piece-group path also serves the hole-filling case: its
-            # range reads skip everything already on disk
-            await self._download_piece_groups(conductor, req, effective,
-                                              piece_size, missing)
-        else:
-            await self._download_stream(conductor, req, piece_size,
-                                        start_piece=0)
+        # the hole universe is the NEEDED pieces: a sharded task's
+        # requested-shard subset asks the origin for only the ranges that
+        # cover its shards (the missing-run range groups skip the rest).
+        # Looped: a joiner may WIDEN the needed set mid-fetch
+        # (conductor.widen_to_whole_file) — re-deriving the holes after
+        # each round fetches the newly-needed ranges instead of
+        # finishing a now-stale subset, and the commit flag is set in
+        # the same synchronous block as the final emptiness check so a
+        # widen can never slip between "covered" and finalize.
+        prev_missing: list[int] | None = None
+        while True:
+            missing = [i for i in conductor.needed_piece_nums(n)
+                       if i not in conductor.ready]
+            if not missing:
+                conductor._finishing = True
+                break
+            if missing == prev_missing:
+                # a round moved nothing: surface it instead of spinning
+                raise DFError(Code.SOURCE_ERROR,
+                              f"origin round landed none of "
+                              f"{len(missing)} missing pieces")
+            prev_missing = missing
+            partial = len(missing) < n
+            if (ranged and self.cfg.back_source_parallelism > 1
+                    and (partial
+                         or effective
+                         >= self.cfg.back_source_group_min_bytes)):
+                # the piece-group path also serves the hole-filling case:
+                # its range reads skip everything already on disk
+                await self._download_piece_groups(conductor, req,
+                                                  effective, piece_size,
+                                                  missing)
+            else:
+                await self._download_stream(conductor, req, piece_size,
+                                            start_piece=0)
         conductor.on_source_complete(effective)
 
     async def _download_stream(self, conductor, req: SourceRequest,

@@ -127,6 +127,14 @@ class UrlMeta:
     # shaping, and eviction treatment.
     tenant: str = ""
     qos_class: str = ""
+    # sharded tasks (common/sharding.py): comma-joined names of the
+    # manifest shards THIS host's mesh position needs ("" = whole task).
+    # NOT part of the task id — every host pulling any subset of the
+    # same checkpoint joins the same task/swarm and shares pieces; what
+    # differs is which pieces each host fetches and which shards become
+    # ready arrays. The scheduler reads this at register to assign the
+    # host its disjoint tree-fetch subset (RegisterResult.assigned_shards).
+    shards: str = ""
 
 
 @message
@@ -244,6 +252,35 @@ class PiecePacket:
 
 
 @message
+class ShardInfo:
+    """One named array shard of a sharded task: a contiguous byte range of
+    the content plus the array geometry a serving host reassembles it
+    with. Integrity rides the existing per-piece digest machinery (every
+    piece of the shard verifies at landing); ``digest`` is an OPTIONAL
+    whole-shard digest checked at task finalize, not on the incremental
+    shard-ready path."""
+
+    name: str = ""                   # e.g. "layers.17.mlp.w1"
+    range_start: int = 0             # byte offset within the content
+    range_size: int = 0
+    dtype: str = "uint8"             # numpy dtype string for the array view
+    shape: list[int] | None = None   # array shape; None = flat bytes
+    digest: str = ""                 # optional "sha256:..." of the shard
+
+
+@message
+class ShardManifest:
+    """A sharded task's shard table (task -> named shards). Shards are
+    disjoint contiguous ranges; gaps are legal (unnamed bytes still ride
+    the task, they just never become named ready arrays). Identical
+    shards across checkpoint versions dedupe in the CA store via the
+    ordinary piece-digest/content_key machinery — a rollout that reuses
+    unchanged layers transfers only the delta (docs/STORAGE.md)."""
+
+    shards: list[ShardInfo] | None = None
+
+
+@message
 class DeviceSink:
     """TPU-native: optional terminal sink describing how verified bytes land
     in device HBM (which mesh axis shard this host holds, dtype, etc.)."""
@@ -287,6 +324,14 @@ class RegisterResult:
     # default) echoed back so the daemon's storage GC can order eviction
     # by it even when the request itself carried no explicit priority
     resolved_priority: Priority = Priority.LEVEL0
+    # sharded tasks: the disjoint tree-fetch subset of the request's
+    # ``UrlMeta.shards`` this peer was assigned (scheduler shard
+    # affinity, ``decision_kind=shard``). The daemon fetches these from
+    # the distribution tree and waits for co-located replicas to supply
+    # the rest over ICI-near P2P (tree fallback after a bounded hold).
+    # None = no affinity ruling (scheduler arm disabled / whole-file
+    # task): every needed piece is tree-eligible immediately.
+    assigned_shards: list[str] | None = None
 
 
 @message
@@ -449,6 +494,12 @@ class DownloadRequest:
     keep_original_offset: bool = False
     device_sink: DeviceSink | None = None
     task_type: TaskType = TaskType.STANDARD
+    # sharded tasks: the checkpoint's shard table. With a manifest the
+    # daemon maps pieces -> shards as they verify, emits ``shard_ready``
+    # flight events, hands each complete shard to the HBM sink
+    # incrementally, and — when ``url_meta.shards`` names a subset —
+    # pulls only the pieces that cover it.
+    shard_manifest: ShardManifest | None = None
 
 
 @message
@@ -461,6 +512,16 @@ class DownloadResponse:
     output: str = ""                # echo of where this entry landed (recursive)
     code: int = 0
     message: str = ""
+    # sharded tasks: a ``shard_ready`` progress frame — this named shard's
+    # bytes all verified and (when a device sink rides the request) its
+    # HBM handoff is enqueued. ``shard_src`` says how its bytes arrived:
+    # ``tree`` (this host's assigned tree-fetch subset) or ``swap``
+    # (supplied by co-located replicas over ICI-near P2P). dfget prints
+    # one per-shard ready timestamp per frame.
+    shard: str = ""
+    shard_src: str = ""
+    shards_ready: int = 0
+    shards_total: int = 0
 
 
 @message

@@ -107,6 +107,16 @@ class SchedulerConfig:
     # elected seeds per (task, pod): >1 spreads the pod's DCN ingest and
     # survives one seed death without a re-election stall
     federation_seeds_per_pod: int = 1
+    # sharded-checkpoint shard affinity (scheduler/shard_affinity.py,
+    # ROADMAP item 3): at register, a sharded task's requested shards
+    # are split disjointly across the co-located replicas requesting
+    # them (RegisterResult.assigned_shards, decision_kind=shard) so the
+    # group fetches ONE tree copy and swaps the rest over ICI. Only
+    # activates on requests that carry UrlMeta.shards; parent scoring is
+    # untouched either way (dfbench digest gate). Disabled = no
+    # assignment ever rides a register — every daemon tree-fetches its
+    # whole requested set.
+    shard_affinity_enabled: bool = True
     retry_limit: int = RETRY_LIMIT
     retry_back_source_limit: int = RETRY_BACK_SOURCE_LIMIT
     back_source_concurrent: int = DEFAULT_BACK_SOURCE_CONCURRENT

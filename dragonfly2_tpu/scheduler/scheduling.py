@@ -41,7 +41,7 @@ EXCLUSION_REASONS = ("stream-gone", "blocklist", "no-slots", "bad-node",
 
 class Scheduling:
     def __init__(self, cfg: SchedulerConfig, evaluator: Evaluator,
-                 quarantine=None, federation=None):
+                 quarantine=None, federation=None, sharded=None):
         self.cfg = cfg
         self.evaluator = evaluator
         # quarantine registry (scheduler/quarantine.py). None (default)
@@ -54,6 +54,13 @@ class Scheduling:
         # path, which is how the single-pod schedule_digest stays
         # byte-identical with the federation plane in the tree.
         self.federation = federation
+        # shard-affinity arm (scheduler/shard_affinity.py). None
+        # (default) = no shard rulings at all: register never attaches
+        # an assignment, every daemon fetches its whole requested set
+        # from the tree — the exact pre-sharding path (parent scoring is
+        # untouched either way, so the schedule digest cannot move; the
+        # dfbench gate proves it).
+        self.sharded = sharded
         # decision ledger hook: callable(row dict) receiving one
         # ``kind=decision`` row per find/refresh ruling. None (default)
         # skips ALL ledger work — scoring then runs the exact pre-ledger
@@ -61,6 +68,19 @@ class Scheduling:
         # byte-identical with the ledger code in the tree.
         self.decision_sink = None
         self._decision_seq = 0
+
+    def shard_assignment(self, child: Peer,
+                         requested: list[str]) -> list[str] | None:
+        """Sharded-task register hook: the disjoint tree-fetch subset of
+        ``requested`` ruled for this peer (``decision_kind=shard`` rides
+        the affinity's own ledger sink). None while the arm is disabled
+        — the daemon then treats every requested shard as tree-class."""
+        if self.sharded is None or not requested:
+            return None
+        return self.sharded.assign(
+            task_id=child.task.id, peer_id=child.id,
+            host_id=child.host.id,
+            topology=child.host.msg.topology, requested=requested)
 
     # ------------------------------------------------------------------
 

@@ -75,6 +75,29 @@ class Scheduler:
             # winning elections it can never serve
             self.resource.on_host_evict = self.federation.forget_host
             self.resource.on_task_evict = self.federation.drop_task
+        # sharded-checkpoint shard affinity: disjoint tree-fetch subsets
+        # ruled at register for requests carrying UrlMeta.shards; the
+        # eviction hooks CHAIN with federation's (both views must forget)
+        self.sharded = None
+        if cfg.shard_affinity_enabled:
+            from .shard_affinity import ShardAffinity
+            self.sharded = ShardAffinity(sink=self.ledger.on_decision)
+            self.scheduling.sharded = self.sharded
+            prev_host, prev_task = (self.resource.on_host_evict,
+                                    self.resource.on_task_evict)
+
+            def _evict_host(hid, _prev=prev_host, _sh=self.sharded):
+                _sh.forget_host(hid)
+                if _prev is not None:
+                    _prev(hid)
+
+            def _evict_task(tid, _prev=prev_task, _sh=self.sharded):
+                _sh.drop_task(tid)
+                if _prev is not None:
+                    _prev(tid)
+
+            self.resource.on_host_evict = _evict_host
+            self.resource.on_task_evict = _evict_task
         self.service = SchedulerService(cfg, self.resource, self.scheduling,
                                         self.seed_client, self.topo,
                                         records=records, ledger=self.ledger,

@@ -185,6 +185,16 @@ class SchedulerService:
             if single is not None:
                 result.size_scope = SizeScope.SMALL
                 result.single_piece = single
+        if req.url_meta is not None and req.url_meta.shards:
+            # sharded task: rule this peer's disjoint tree-fetch subset
+            # of its requested shards (decision_kind=shard rides the
+            # ledger); the rest arrive by ICI-near swap from co-located
+            # replicas. None (arm disabled) leaves the field off the
+            # wire and the daemon tree-fetches everything it requested.
+            from ..common.sharding import parse_shard_names
+            names = parse_shard_names(req.url_meta.shards)
+            result.assigned_shards = self.scheduling.shard_assignment(
+                peer, names)
         _registers.labels(result.size_scope.name).inc()
         return result
 

@@ -162,6 +162,27 @@ def verdict(summary: dict) -> str:
             parts.append("every scheduler was unreachable; parents came "
                          "from PEX gossip (the swarm index) instead of "
                          "the origin")
+    sh = summary.get("shards")
+    if sh:
+        # sharded task: per-shard readiness + the tail that set
+        # time-to-serving, with its supply path named
+        parts.append(f"shards: {sh.get('ready', 0)}/{sh.get('total', 0)} "
+                     f"ready ({_fmt_bytes(sh.get('tree_bytes', 0))} "
+                     f"tree-fetched, {_fmt_bytes(sh.get('swap_bytes', 0))} "
+                     "ICI-swapped)")
+        slow_sh = sh.get("slowest")
+        if slow_sh:
+            how = ("ICI-swapped from co-located replicas"
+                   if slow_sh.get("src") == "swap"
+                   else "tree-fetched (this host's assigned subset)")
+            parts.append(f"slowest shard {slow_sh['name']} became ready "
+                         f"at {slow_sh['t_ms']:.0f}ms — {how}")
+        fb = sh.get("fallbacks", 0)
+        if fb:
+            parts.append(
+                f"{fb} swap-class piece(s) fell back to the tree after "
+                "the swap hold — the ICI swap partner died or stalled "
+                "(bounded degradation, not a wedge)")
     corrupt = summary.get("corrupt_pieces") or {}
     if corrupt:
         total = sum(corrupt.values())

@@ -167,6 +167,81 @@ async def run_stress(url: str, *, proxy: str = "", concurrency: int = 8,
     return result
 
 
+async def run_rollout(url: str, *, proxy: str = "", workers: int = 4,
+                      manifest_path: str = "",
+                      connect_timeout_s: float = 10.0) -> dict:
+    """One sharded-checkpoint rollout wave: ``workers`` clients each pull
+    a DISJOINT subset of the manifest's shards (round-robin split) as
+    ranged GETs through the proxy, recording per-shard ready timestamps —
+    the client-side shape of the serving-fleet rollout the PR-14 bench
+    models pod-wide. The report carries per-shard fetch p50/p99 and the
+    wave's time-to-all-shards makespan."""
+    import aiohttp
+
+    # dflint: disable=DF001 — one KB-scale manifest read on stress's CLI-private loop
+    with open(manifest_path, encoding="utf-8") as f:
+        raw = json.load(f)
+    entries = raw.get("shards", raw) if isinstance(raw, dict) else raw
+    if not entries:
+        raise SystemExit("stress: empty shard manifest")
+    subsets = {i: entries[i::workers] for i in range(workers)}
+    shard_lat: dict[str, float] = {}
+    ready_at: dict[str, float] = {}
+    errors = 0
+    total_bytes = 0
+
+    t_start = time.monotonic()
+
+    async def worker(session: aiohttp.ClientSession, i: int) -> None:
+        nonlocal errors, total_bytes
+        for e in subsets[i]:
+            start = int(e["range_start"])
+            end = start + int(e["range_size"]) - 1
+            t0 = time.monotonic()
+            try:
+                async with session.get(
+                        url, proxy=proxy or None,
+                        headers={"Range": f"bytes={start}-{end}"}) as resp:
+                    got = 0
+                    async for chunk in resp.content.iter_chunked(1 << 20):
+                        got += len(chunk)
+                    if resp.status != 206 or got != int(e["range_size"]):
+                        # a 200 full-body answer means the server ignored
+                        # the Range: every "shard" would be the whole
+                        # checkpoint and the per-shard numbers fiction —
+                        # count it as an error, don't launder it
+                        errors += 1
+                        continue
+                    total_bytes += got
+                    shard_lat[e["name"]] = time.monotonic() - t0
+                    ready_at[e["name"]] = time.monotonic() - t_start
+            except Exception:  # noqa: BLE001 - counted, wave goes on
+                errors += 1
+
+    timeout = aiohttp.ClientTimeout(total=None,
+                                    sock_connect=connect_timeout_s)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        await asyncio.gather(*(worker(session, i) for i in range(workers)))
+    elapsed = time.monotonic() - t_start
+    lats = sorted(shard_lat.values())
+    return {
+        "url": url,
+        "rollout_workers": workers,
+        "shards": len(entries),
+        "shards_ready": len(ready_at),
+        "errors": errors,
+        "bytes": total_bytes,
+        "makespan_s": round(max(ready_at.values(), default=0.0), 3),
+        "duration_s": round(elapsed, 2),
+        "shard_fetch_ms": {
+            "p50": round(_percentile(lats, 0.50) * 1000, 1),
+            "p99": round(_percentile(lats, 0.99) * 1000, 1),
+        },
+        "per_worker_shards": {i: [e["name"] for e in subsets[i]]
+                              for i in range(workers)},
+    }
+
+
 async def _run_with_chaos(args) -> dict:
     """Arm the chaos script (remote daemon or in-process), run the load,
     ALWAYS disarm — a stress run must not leave a live daemon wedged."""
@@ -242,6 +317,15 @@ def main(argv: list[str] | None = None) -> int:
                    "report then breaks out per-class p50/p99 latency "
                    "and 429-shed counts. Unallocated workers run as "
                    "standard; with no --priority the run is classless.")
+    p.add_argument("--rollout", type=int, default=0, metavar="WORKERS",
+                   help="sharded-rollout scenario: WORKERS clients each "
+                   "pull a disjoint subset of --shard-manifest's shards "
+                   "as ranged GETs (one wave, not duration-based); the "
+                   "report carries per-shard fetch p50/p99 and the "
+                   "wave's time-to-all-shards makespan")
+    p.add_argument("--shard-manifest", default="", dest="shard_manifest",
+                   help="shard-manifest JSON path for --rollout "
+                   "(same schema as dfget --shard-manifest)")
     p.add_argument("--chaos", default="",
                    help="faultgate script to arm for the run, e.g. "
                         "'piece.wire=delay:0.2:n=-1' (docs/RESILIENCE.md)")
@@ -271,6 +355,16 @@ def main(argv: list[str] | None = None) -> int:
                         "stress/chaos report says what the POD did, not "
                         "just what this client saw")
     args = p.parse_args(argv)
+    if args.rollout:
+        if not args.shard_manifest:
+            raise SystemExit("stress: --rollout needs --shard-manifest")
+        result = asyncio.run(run_rollout(
+            args.url, proxy=args.proxy, workers=args.rollout,
+            manifest_path=args.shard_manifest))
+        if args.pod_report:
+            result["podscope"] = _pod_report(args.pod_report)
+        print(json.dumps(result))
+        return 1 if result["shards_ready"] == 0 else 0
     result = asyncio.run(_run_with_chaos(args))
     if args.chaos:
         result["chaos"] = args.chaos

@@ -113,6 +113,8 @@ class DeviceIngest:
     def __init__(self, content_length: int, *, devices: Any = None,
                  sharding: Any = None, dtype: str = "uint8",
                  shards_per_device: int = 1,
+                 shard_specs: list | None = None,
+                 on_shard_ready: Callable[[str, float], None] | None = None,
                  device_put_fn: Callable[[Any, Any], Any] | None = None):
         """``devices``: explicit device list (contiguous shards per device),
         or ``sharding``: a 1-D jax NamedSharding to assemble a global array
@@ -123,7 +125,21 @@ class DeviceIngest:
         ``device_put`` blocks the worker for the whole file. Only 1 is
         supported with ``sharding`` (global-array assembly needs one array
         per device). ``device_put_fn`` is injectable for tests (defaults to
-        ``jax.device_put``)."""
+        ``jax.device_put``).
+
+        ``shard_specs`` switches the sink to MANIFEST mode (sharded tasks,
+        common/sharding.py): instead of equal-split anonymous shards, each
+        entry is ``(name, start, size[, dtype, shape])`` — a named byte
+        range that transfers the moment its bytes are covered (ranges may
+        be uneven, need not cover the content, and gaps never transfer).
+        ``result()`` then returns ``{name: array}``, each array viewed as
+        the spec's dtype (the sink default when "") and reshaped to the
+        spec's shape when one is given. Devices are assigned round-robin
+        per spec. Incompatible with ``sharding`` (global-array assembly
+        needs the equal-split geometry). ``on_shard_ready`` is called ON
+        THE TRANSFER THREAD as ``(name, monotonic_done_time)`` after each
+        named shard's device transfer completes — callbacks must be cheap
+        and thread-safe (hand off to the loop, don't compute)."""
         import jax
 
         if content_length <= 0:
@@ -134,19 +150,49 @@ class DeviceIngest:
         if sharding is not None:
             if shards_per_device != 1:
                 raise ValueError("shards_per_device must be 1 with sharding")
+            if shard_specs is not None:
+                raise ValueError("shard_specs incompatible with sharding")
             devices = list(sharding.mesh.devices.flat)
         elif devices is None:
             devices = jax.devices()
         self.devices = list(devices)
         self.shards_per_device = max(1, shards_per_device)
-        n = len(self.devices) * self.shards_per_device
-        self.n_shards = n
-        # equal shards padded to dtype & shard-count alignment
-        itemsize = self.dtype.itemsize
-        padded = -(-content_length // (n * itemsize)) * (n * itemsize)
-        self.padded_length = padded
-        self.shard_bytes = padded // n
-        self.host = np.zeros(padded, dtype=np.uint8)
+        self.on_shard_ready = on_shard_ready
+        self._specs: list[tuple] | None = None
+        if shard_specs is not None:
+            if not shard_specs:
+                raise ValueError("shard_specs must be non-empty")
+            specs = []
+            for sp in shard_specs:
+                name, start, size = sp[0], int(sp[1]), int(sp[2])
+                sdtype = np.dtype(sp[3]) if len(sp) > 3 and sp[3] \
+                    else self.dtype
+                shape = tuple(sp[4]) if len(sp) > 4 and sp[4] else None
+                if size <= 0 or start < 0 or start + size > content_length:
+                    raise ValueError(f"shard {name}: bad range "
+                                     f"[{start}, {start + size})")
+                if size % sdtype.itemsize:
+                    raise ValueError(f"shard {name}: size {size} not a "
+                                     f"multiple of {sdtype} itemsize")
+                specs.append((name, start, size, sdtype, shape))
+            self._specs = specs
+            n = len(specs)
+            self.n_shards = n
+            self.padded_length = content_length
+            self.shard_bytes = 0            # uneven; see _shard_range
+            # overlap scan order: (start, end, index) sorted by start
+            self._spec_order = sorted(
+                (sp[1], sp[1] + sp[2], i) for i, sp in enumerate(specs))
+            self.host = np.zeros(content_length, dtype=np.uint8)
+        else:
+            n = len(self.devices) * self.shards_per_device
+            self.n_shards = n
+            # equal shards padded to dtype & shard-count alignment
+            itemsize = self.dtype.itemsize
+            padded = -(-content_length // (n * itemsize)) * (n * itemsize)
+            self.padded_length = padded
+            self.shard_bytes = padded // n
+            self.host = np.zeros(padded, dtype=np.uint8)
         self._coverage = CoverageMap()
         self._shard_arrays: list[Any | None] = [None] * n
         self._shard_sent = [False] * n       # transfer COMPLETED
@@ -166,8 +212,8 @@ class DeviceIngest:
         self._worker = threading.Thread(target=self._transfer_loop,
                                         name="hbm-sink", daemon=True)
         self._worker.start()
-        if content_length < padded:  # pad tail is trivially "present"
-            self._coverage.add(content_length, padded)
+        if content_length < self.padded_length:  # pad tail trivially "present"
+            self._coverage.add(content_length, self.padded_length)
 
     # ------------------------------------------------------------------
     # producer side (piece-landing path) — never blocks on DMA
@@ -195,13 +241,29 @@ class DeviceIngest:
         self._coverage.add(offset, end)
         _hbm_bytes.inc(len(data))
         _hbm_done.set(self.done_fraction())
+        if self._specs is not None:
+            # manifest mode: enqueue every named range this span touches
+            # (a piece straddling a shard boundary can complete two)
+            for s, e, idx in self._spec_order:
+                if e <= offset:
+                    continue
+                if s >= end:
+                    break
+                self._maybe_enqueue(idx)
+            return
         first = offset // self.shard_bytes
         last = (end - 1) // self.shard_bytes
         for shard in range(first, min(last + 1, self.n_shards)):
             self._maybe_enqueue(shard)
 
+    def _shard_range(self, shard: int) -> tuple[int, int]:
+        if self._specs is not None:
+            _name, s, size, _dt, _shape = self._specs[shard]
+            return s, s + size
+        return shard * self.shard_bytes, (shard + 1) * self.shard_bytes
+
     def _maybe_enqueue(self, shard: int) -> None:
-        s, e = shard * self.shard_bytes, (shard + 1) * self.shard_bytes
+        s, e = self._shard_range(shard)
         with self._lock:
             if self._shard_queued[shard] or self._closed:
                 return
@@ -237,9 +299,17 @@ class DeviceIngest:
             if shard is None:            # shutdown sentinel
                 return
             try:
-                s, e = shard * self.shard_bytes, (shard + 1) * self.shard_bytes
-                view = self.host[s:e].view(self.dtype)
-                device = self.devices[shard // self.shards_per_device]
+                s, e = self._shard_range(shard)
+                if self._specs is not None:
+                    name, _s, _size, sdtype, shape = self._specs[shard]
+                    view = self.host[s:e].view(sdtype)
+                    if shape is not None:
+                        view = view.reshape(shape)
+                    device = self.devices[shard % len(self.devices)]
+                else:
+                    name = None
+                    view = self.host[s:e].view(self.dtype)
+                    device = self.devices[shard // self.shards_per_device]
                 t0 = time.monotonic()
                 arr = self._device_put(view, device)
                 # span must end at transfer COMPLETION, not dispatch — on
@@ -255,6 +325,11 @@ class DeviceIngest:
                     self.transfer_spans.append((t0, t1))
                 _hbm_transfer_s.observe(t1 - t0)
                 _hbm_transfers.labels("ok").inc()
+                if name is not None and self.on_shard_ready is not None:
+                    try:
+                        self.on_shard_ready(name, t1)
+                    except Exception:  # noqa: BLE001 - observer only
+                        log.exception("on_shard_ready(%s) raised", name)
                 log.debug("shard %d/%d -> %s", shard, self.n_shards, device)
             except BaseException as exc:  # noqa: BLE001 - surfaced by result()
                 with self._lock:
@@ -307,8 +382,9 @@ class DeviceIngest:
 
         Blocking — call via ``asyncio.to_thread`` from the event loop. With
         a sharding: one global jax.Array of shape (padded_length //
-        itemsize,) sharded over the mesh axis. Without: list of per-device
-        arrays.
+        itemsize,) sharded over the mesh axis. With ``shard_specs``: a
+        ``{name: array}`` dict in manifest order. Without either: list of
+        per-device arrays.
         """
         import jax
 
@@ -319,7 +395,8 @@ class DeviceIngest:
                 sent = list(self._shard_sent)
                 arrays = list(self._shard_arrays)
             if not all(sent):
-                missing = [i for i, s in enumerate(sent) if not s]
+                missing = [self._specs[i][0] if self._specs is not None
+                           else i for i, s in enumerate(sent) if not s]
                 raise RuntimeError(f"shards incomplete: {missing}")
         finally:
             # stop the worker on EVERY exit — a raising result() must not
@@ -327,6 +404,8 @@ class DeviceIngest:
             self.close()
         for a in arrays:
             a.block_until_ready()
+        if self._specs is not None:
+            return {sp[0]: arrays[i] for i, sp in enumerate(self._specs)}
         if self._sharding is None:
             return arrays
         global_shape = (self.padded_length // self.dtype.itemsize,)

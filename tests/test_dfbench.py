@@ -724,6 +724,114 @@ class TestPr13Federation:
         assert not list(tmp_path.iterdir())      # stdout only
 
 
+class TestPr14Sharded:
+    """PR-14 point: sharded-checkpoint delivery. The rollout sim must be
+    deterministic, the plain scheduler sim untouched with the shard arm
+    disarmed (digest == BENCH_pr3), shard affinity + ICI swap must beat
+    naive full-file pull and keep tree bytes at ~one copy per position
+    group, and killing a shard's owner mid-swap must complete via a
+    bounded tree fallback."""
+
+    SHAPE = dict(seed=7, positions=2, replicas=2, shards=8, pieces=16,
+                 piece_size=64 << 10)
+
+    def test_rollout_bench_deterministic(self):
+        from dragonfly2_tpu.tools.dfbench import run_rollout_bench
+        a = run_rollout_bench(**self.SHAPE, sharded=True)
+        b = run_rollout_bench(**self.SHAPE, sharded=True)
+        assert a == b
+        c = run_rollout_bench(seed=11, positions=2, replicas=2, shards=8,
+                              pieces=16, piece_size=64 << 10, sharded=True)
+        # a different seed moves the modeled timings (the tiny shape's
+        # piece->parent schedule can legitimately coincide: the affinity
+        # split is seed-independent by design)
+        assert c["makespan_ms"] != a["makespan_ms"]
+
+    def test_sharded_disarmed_never_moves_the_digest(self):
+        """The purity gate, in-process: running the shard machinery
+        (affinity rendezvous, trackers, the rollout sim) must not
+        perturb a plain run's rng path — BENCH_pr3 stays comparable."""
+        from dragonfly2_tpu.tools.dfbench import run_rollout_bench
+        base = run_bench(seed=7, daemons=6, pieces=24)
+        run_rollout_bench(**self.SHAPE, sharded=True)
+        again = run_bench(seed=7, daemons=6, pieces=24)
+        assert base["schedule_digest"] == again["schedule_digest"]
+
+    def test_sharded_contract_disjoint_tree_and_swap(self):
+        from dragonfly2_tpu.tools.dfbench import run_rollout_bench
+        r = run_rollout_bench(**self.SHAPE, sharded=True)
+        assert r["complete"] == r["alive"] == 4
+        content = r["content_bytes"]
+        # one tree copy per position group (disjoint affinity): the pod
+        # pulls ~content off the seed uplink, however many replicas
+        assert r["dcn_bytes"] <= 1.5 * content
+        # the swap actually happened: replicas moved bytes over ICI
+        assert r["ici_bytes"] > 0
+        # every (host, shard) pair became a ready array
+        assert r["shards_ready"] == 4 * (8 // 2)
+        assert r["swap_fallback_pieces"] == 0
+
+    def test_naive_pulls_content_per_host(self):
+        from dragonfly2_tpu.tools.dfbench import run_rollout_bench
+        naive = run_rollout_bench(**self.SHAPE, sharded=False)
+        shrd = run_rollout_bench(**self.SHAPE, sharded=True)
+        # naive: every host needs every byte; per-host NIC volume is the
+        # whole checkpoint and makespan can't beat content/NIC
+        assert naive["requested_bytes_per_host"] == naive["content_bytes"]
+        assert shrd["requested_bytes_per_host"] \
+            == shrd["content_bytes"] // 2
+        assert shrd["makespan_ms"] < naive["makespan_ms"]
+
+    def test_owner_kill_falls_back_bounded(self):
+        from dragonfly2_tpu.tools.dfbench import run_rollout_bench
+        r = run_rollout_bench(**self.SHAPE, sharded=True, kill_owner=True)
+        k = r["kill"]
+        assert k["completed"] is True
+        assert k["fallback_bounded"] is True
+        # every SURVIVING host still reached all-shards-ready
+        assert r["complete"] == r["alive"] == 3
+
+    def test_pr14_committed_matches_pr3_digest(self):
+        """The committed trajectory gate: BENCH_pr14's sharded-disabled
+        plain digest is byte-identical to BENCH_pr3 and every acceptance
+        flag is stamped true at 16->256 hosts."""
+        r = json.loads(open(os.path.join(REPO, "BENCH_pr14.json")).read())
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["sizes"] == ["4x4", "8x8", "16x16"]
+        # >= 2x over naive full-file pull at 64 hosts (measured ~19x)
+        assert r["sharded_beats_naive_2x"] is True
+        assert r["speedup_size"] == "8x8" and r["speedup"] >= 2.0
+        # scaling contrast: sharded tracks shard_bytes/bisection (per-
+        # host need shrinks with the fleet), naive tracks content/NIC
+        assert r["sharded_tracks_shard_bytes"] is True
+        assert r["naive_tracks_content_bytes"] is True
+        # per-host tree bytes ~= the disjoint subset: pod-wide tree
+        # bytes stay ~1 copy of the checkpoint at every size
+        assert r["tree_bounded"] is True
+        shrd = r["scenarios"]["roll_sharded"]
+        for key in r["sizes"]:
+            s = shrd[key]
+            assert s["complete"] == s["alive"] == s["daemons"]
+            assert s["dcn_bytes"] <= 1.5 * s["content_bytes"]
+        k = r["kill"]
+        assert k["completed"] is True and k["fallback_bounded"] is True
+
+    def test_pr14_smoke_stdout_only(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr14", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-sharded"
+        assert r["sharded_beats_naive_2x"] is True
+        assert r["tree_bounded"] is True
+        assert r["kill"]["completed"] is True
+        assert not list(tmp_path.iterdir())      # stdout only
+
+
 class TestCLI:
     def test_smoke_invocation_writes_no_file(self, tmp_path):
         out = subprocess.run(

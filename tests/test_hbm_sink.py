@@ -27,6 +27,102 @@ class TestCoverageMap:
         assert c.covers(0, 60)
         assert c.covered_bytes() == 60
 
+    def test_duplicate_landing_counts_once(self):
+        # an endgame duplicate (or a retry's re-land) must not inflate
+        # coverage — merged intervals count each byte once
+        c = CoverageMap()
+        c.add(0, 10)
+        c.add(0, 10)
+        c.add(2, 8)
+        assert c.covered_bytes() == 10
+        assert c.covers(0, 10)
+
+    def test_boundary_mid_piece_spans(self):
+        # a piece straddling a shard boundary covers the tail of one
+        # range and the head of the next — both queries see their half
+        c = CoverageMap()
+        c.add(6, 14)                      # piece across the 10-boundary
+        assert c.covers(6, 10) and c.covers(10, 14)
+        assert not c.covers(0, 10) and not c.covers(10, 20)
+        c.add(0, 6)
+        assert c.covers(0, 10)
+
+    def test_adjacent_ranges_merge(self):
+        c = CoverageMap()
+        c.add(0, 10)
+        c.add(10, 20)                     # exactly adjacent: one range
+        assert c.covers(0, 20)
+        assert c._ranges == [(0, 20)]
+
+    def test_empty_and_degenerate_queries(self):
+        c = CoverageMap()
+        assert c.covers(5, 5)             # empty range trivially covered
+        assert not c.covers(0, 1)
+        assert c.covered_bytes() == 0
+
+
+class TestDeviceIngestManifest:
+    """Manifest mode (sharded tasks): named uneven shards, each a device
+    array the moment its bytes are covered."""
+
+    def test_named_shards_ready_incrementally(self):
+        import jax
+        done: list[str] = []
+        di = DeviceIngest(
+            24, devices=jax.devices()[:2],
+            shard_specs=[("a", 0, 10), ("b", 10, 6), ("tail", 20, 4)],
+            on_shard_ready=lambda n, _t: done.append(n))
+        di.write(0, bytes(range(12)))     # completes a; b partial
+        di.drain(timeout=10)
+        assert done == ["a"]
+        di.write(12, bytes(range(12, 24)))  # b + the gap + tail
+        res = di.result(timeout=10)
+        assert set(res) == {"a", "b", "tail"}
+        assert list(res["a"]) == list(range(10))
+        assert list(res["b"]) == [10, 11, 12, 13, 14, 15]
+        assert list(res["tail"]) == [20, 21, 22, 23]
+        assert set(done) == {"a", "b", "tail"}
+
+    def test_gap_bytes_never_transfer(self):
+        import jax
+        di = DeviceIngest(24, devices=jax.devices()[:1],
+                          shard_specs=[("a", 0, 8)])
+        di.write(0, bytes(8))
+        res = di.result(timeout=10)
+        assert set(res) == {"a"}          # the 16-byte gap has no array
+
+    def test_per_shard_dtype_and_shape(self):
+        import jax.numpy as jnp
+        import jax
+        di = DeviceIngest(
+            16, devices=jax.devices()[:1],
+            shard_specs=[("w", 0, 16, "float32", [2, 2])])
+        di.write(0, np.arange(4, dtype=np.float32).tobytes())
+        arr = di.result(timeout=10)["w"]
+        assert arr.shape == (2, 2) and arr.dtype == jnp.float32
+        assert float(arr[1][1]) == 3.0
+
+    def test_incomplete_shard_named_in_error(self):
+        import jax
+        di = DeviceIngest(16, devices=jax.devices()[:1],
+                          shard_specs=[("a", 0, 8), ("b", 8, 8)])
+        di.write(0, bytes(8))
+        with pytest.raises(RuntimeError, match="b"):
+            di.result(timeout=5)
+
+    def test_bad_specs_rejected(self):
+        import jax
+        devs = jax.devices()[:1]
+        with pytest.raises(ValueError, match="bad range"):
+            DeviceIngest(16, devices=devs, shard_specs=[("a", 8, 16)])
+        with pytest.raises(ValueError, match="itemsize"):
+            DeviceIngest(16, devices=devs,
+                         shard_specs=[("a", 0, 6, "float32", None)])
+        with pytest.raises(ValueError, match="incompatible"):
+            mesh = make_mesh()
+            DeviceIngest(16, sharding=named_sharding(mesh),
+                         shard_specs=[("a", 0, 16)])
+
 
 class TestDeviceIngest:
     def test_shards_land_on_all_devices(self):

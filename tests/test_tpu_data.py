@@ -114,6 +114,67 @@ class TestShardPrefetcher:
 
         asyncio.run(main())
 
+    def test_skip_failed_yields_the_rest(self, tmp_path):
+        """A 404ing shard with ``skip_failed=True`` is logged and
+        skipped; the healthy shards still arrive in order (dataset
+        loaders routinely tolerate a missing shard). Without the flag
+        the failure raises at the consuming step."""
+        async def main():
+            origin, base, _hits = await _origin()
+            daemon = Daemon(DaemonConfig(
+                workdir=str(tmp_path / "d"), host_ip="127.0.0.1",
+                hostname="pf4", storage=StorageSection(gc_interval_s=3600)))
+            await daemon.start()
+            try:
+                urls = [f"{base}/shard-0.tar",
+                        f"{base}/missing/shard-9.tar",   # 500s at origin
+                        f"{base}/shard-2.tar"]
+                pf = ShardPrefetcher(daemon, urls, depth=2,
+                                     skip_failed=True)
+                out = [_reassemble(a) async for a in pf.astream()]
+                assert len(out) == 2
+                assert out[0][:len(SHARDS[0])] == SHARDS[0]
+                assert out[1][:len(SHARDS[2])] == SHARDS[2]
+                strict = ShardPrefetcher(daemon,
+                                         [f"{base}/missing/shard-9.tar"])
+                with pytest.raises(Exception):
+                    async for _ in strict.astream():
+                        pass
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(main())
+
+    def test_early_consumer_exit_cancels_inflight(self, tmp_path):
+        """Breaking out of astream() mid-epoch unwinds the in-flight
+        prefetch tasks (the finally's cancel+gather) instead of leaking
+        them — a training loop that stops at step N must not leave
+        depth fetches running forever."""
+        async def main():
+            origin, base, _hits = await _origin()
+            daemon = Daemon(DaemonConfig(
+                workdir=str(tmp_path / "d"), host_ip="127.0.0.1",
+                hostname="pf5", storage=StorageSection(gc_interval_s=3600)))
+            await daemon.start()
+            try:
+                urls = [f"{base}/shard-{i}.tar" for i in range(4)]
+                pf = ShardPrefetcher(daemon, urls, depth=2)
+                stream = pf.astream()
+                first = await anext(stream)
+                assert _reassemble(first)[:len(SHARDS[0])] == SHARDS[0]
+                await stream.aclose()          # early exit at step 1
+                # the daemon still serves new work afterwards (nothing
+                # wedged on the cancelled fetches)
+                pf2 = ShardPrefetcher(daemon, [urls[3]])
+                out = [_reassemble(a) async for a in pf2.astream()]
+                assert out[0][:len(SHARDS[3])] == SHARDS[3]
+            finally:
+                await daemon.stop()
+                await origin.cleanup()
+
+        asyncio.run(main())
+
     def test_second_epoch_reuses_storage_with_fresh_ingest(self, tmp_path):
         """delete_after=False + a second epoch: the completed-task fast
         path has no conductor/sink, so the prefetcher must rebuild the

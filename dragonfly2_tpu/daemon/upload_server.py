@@ -545,6 +545,7 @@ class UploadServer:
             data_path = getattr(ts, "data_path", None)
             if data_path is not None and total >= 0 and not poisoned:
                 wait_t0 = time.monotonic()
+                # dflint: disable=DF008 — sendfile serve: after return the bytes move in-kernel with no failure callback; a dropped send is accounted as moved by design (the disk-read branch below is the refundable one)
                 await self.limiter.acquire(rng.length)
                 _upload_bytes.inc(rng.length)
                 _upload_piece_bytes.observe(rng.length)

@@ -171,7 +171,9 @@ class SchedulerAnnouncer:
                 or not model.data:
             return False
         from ..trainer.serving import make_mlp_infer
-        infer = make_mlp_infer(model.data)
+        # deserialize + hash the model blob off-loop: this is the
+        # scheduler's serving loop, and a rollout must not stall rulings
+        infer = await asyncio.to_thread(make_mlp_infer, model.data)
         evaluator.infer = infer
         self.model_version = model.version
         log.info("ml evaluator now serving %s@%s (final_loss=%s)",
@@ -194,7 +196,9 @@ class SchedulerAnnouncer:
             return False
         from ..trainer.serving import make_gnn_impute
         try:
-            topo.bind_imputer(make_gnn_impute(model.data))
+            # blob deserialize + digest off-loop, same as the MLP path
+            impute = await asyncio.to_thread(make_gnn_impute, model.data)
+            topo.bind_imputer(impute)
         except ValueError as exc:
             # schema-gate refusal (stale NODE_FEATURES layout): remember
             # the refused version so if_none_match skips the full-blob

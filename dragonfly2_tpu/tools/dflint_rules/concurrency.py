@@ -3,6 +3,14 @@
 Every rule here is a post-mortem made executable. The daemon runs ONE
 event loop; these are the five ways this codebase has managed to wedge,
 starve, or silently poison it across PRs 1–5.
+
+v2: DF001 and DF005 are **interprocedural**. The module-local pass is
+unchanged (and is all that runs for standalone files / fixtures), but
+when the module belongs to an indexed package, call sites that resolve
+across module boundaries are checked against the callee's fixpoint
+summary — a blocking helper in ``common/`` called from a coroutine in
+``daemon/`` is reported *at the call site*, which is where the executor
+hop (the fix) belongs.
 """
 
 from __future__ import annotations
@@ -12,55 +20,12 @@ import re
 from typing import Iterator
 
 from . import Finding, ModuleCtx, Rule, register
-
-# ---------------------------------------------------------------------------
-# shared AST helpers
-# ---------------------------------------------------------------------------
+from .symbols import (
+    _blocking_reason, _dotted, _scan_blocking, _terminal, _walk_scope,
+    _CONDISH_RE, _LOCKISH_RE, _SLOW_AWAITS, display,
+)
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-
-
-def _dotted(node: ast.AST) -> str | None:
-    """'a.b.c' for a pure Name/Attribute chain, else None."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _terminal(node: ast.AST) -> str | None:
-    """The last segment of a call target: `x` for x(), `m` for a.b.m()."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
-
-
-def _walk_scope(body: list[ast.stmt]) -> Iterator[ast.AST]:
-    """Walk statements without descending into nested function scopes.
-
-    A nested sync ``def`` or ``lambda`` inside a coroutine is (in this
-    codebase) almost always an executor thunk or a callback — its body
-    does not run on the event loop in the coroutine's context, so
-    blocking calls there are exactly the *fix* for DF001, not the bug.
-    Nested ``async def``s are separate coroutines and are visited in
-    their own right by the rules' outer loops.
-    """
-    stack: list[ast.AST] = list(body)
-    while stack:
-        node = stack.pop()
-        yield node
-        if isinstance(node, _FUNC_NODES):
-            continue    # a def seeded directly from `body` stays opaque too
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, _FUNC_NODES):
-                continue
-            stack.append(child)
 
 
 def _lock_ctor_map(tree: ast.Module) -> dict[str, str]:
@@ -143,102 +108,6 @@ def _call_edges(fn, owner: str | None) -> Iterator[tuple[str, str]]:
 # DF001 — blocking call on the event loop
 # ---------------------------------------------------------------------------
 
-_OS_IO = frozenset({
-    "stat", "lstat", "listdir", "scandir", "walk", "remove", "unlink",
-    "rename", "replace", "makedirs", "mkdir", "rmdir", "removedirs",
-    "fsync", "ftruncate", "truncate", "utime", "link", "symlink",
-    "chmod", "chown", "statvfs", "system", "popen",
-})
-_OSPATH_IO = frozenset({
-    "getsize", "getmtime", "getctime", "exists", "isfile", "isdir",
-    "islink", "samefile", "realpath",
-})
-_SHUTIL_IO = frozenset({
-    "rmtree", "copy", "copy2", "copyfile", "copyfileobj", "copytree",
-    "move", "disk_usage", "which",
-})
-_SOCKET_IO = frozenset({
-    "getaddrinfo", "gethostbyname", "gethostbyaddr", "create_connection",
-    "getfqdn",
-})
-_PATHLIB_IO = frozenset({
-    "read_bytes", "read_text", "write_bytes", "write_text",
-})
-_DIGEST_HELPERS = frozenset({"hash_bytes", "hash_file"})
-_FILE_METHODS = frozenset({"read", "write", "readline", "readlines",
-                           "writelines"})
-
-
-def _blocking_reason(call: ast.Call) -> str | None:
-    d = _dotted(call.func)
-    t = _terminal(call.func)
-    if d in ("open", "io.open"):
-        return "blocking open() — route file IO through an executor"
-    if d == "time.sleep":
-        return "time.sleep() parks the whole event loop — use asyncio.sleep"
-    if d is not None:
-        head, _, rest = d.partition(".")
-        if head == "subprocess":
-            return f"subprocess.{rest or d} blocks the loop — use " \
-                   f"asyncio.create_subprocess_*"
-        if head == "os" and rest in _OS_IO:
-            return f"os.{rest} does synchronous IO on the loop thread"
-        if d.startswith("os.path.") and d[len("os.path."):] in _OSPATH_IO:
-            return f"{d} stats the filesystem on the loop thread"
-        if head == "shutil" and rest in _SHUTIL_IO:
-            return f"shutil.{rest} does synchronous IO on the loop thread"
-        if head == "socket" and rest in _SOCKET_IO:
-            return f"socket.{rest} can block on DNS/connect — use the " \
-                   f"loop's async equivalents"
-        if head == "hashlib" and call.args:
-            return "whole-buffer hashlib digest on the loop thread — " \
-                   "hash off-loop (see storage write_span / PR 5)"
-    if t in _DIGEST_HELPERS:
-        return f"{t}() traverses the whole buffer on the loop thread"
-    if t in _PATHLIB_IO:
-        return f".{t}() does synchronous file IO on the loop thread"
-    return None
-
-
-def _scan_blocking(fn_body: list[ast.stmt]) -> Iterator[tuple[ast.Call, str]]:
-    """Yield (call, reason) for blocking calls lexically in this scope,
-    plus reads/writes on file handles and hasher updates bound here."""
-    handles: set[str] = set()
-    hashers: set[str] = set()
-    for node in _walk_scope(fn_body):
-        if isinstance(node, ast.With):
-            for item in node.items:
-                if (isinstance(item.context_expr, ast.Call)
-                        and _dotted(item.context_expr.func)
-                        in ("open", "io.open")
-                        and isinstance(item.optional_vars, ast.Name)):
-                    handles.add(item.optional_vars.id)
-        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            d = _dotted(node.value.func)
-            for tgt in node.targets:
-                if not isinstance(tgt, ast.Name):
-                    continue
-                if d in ("open", "io.open"):
-                    handles.add(tgt.id)
-                elif d is not None and d.startswith("hashlib."):
-                    hashers.add(tgt.id)
-    for node in _walk_scope(fn_body):
-        if not isinstance(node, ast.Call):
-            continue
-        reason = _blocking_reason(node)
-        if reason is not None:
-            yield node, reason
-            continue
-        f = node.func
-        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)):
-            if f.value.id in handles and f.attr in _FILE_METHODS:
-                yield node, (f"{f.value.id}.{f.attr}() on a blocking file "
-                             f"handle — route file IO through an executor")
-            elif f.value.id in hashers and f.attr == "update":
-                yield node, ("whole-buffer hasher.update on the loop "
-                             "thread — hash off-loop (PR 5 zero-stall rule)")
-
-
 @register
 class BlockingInAsync(Rule):
     """DF001: blocking call reachable from a coroutine.
@@ -251,10 +120,14 @@ class BlockingInAsync(Rule):
     coroutine can reach stalls EVERY task in the process. Fix: hop
     through ``loop.run_in_executor`` (default executor for cold/control
     paths; the 4-thread storage pool is reserved for span landing).
-    The rule follows module-local call edges, so a sync helper called
-    from a coroutine (e.g. ``announcer.host_with_stats``) is analyzed
-    too; code inside nested sync ``def``s/lambdas is exempt because
-    those are the executor thunks themselves.
+
+    The rule follows call edges transitively — module-local ones as in
+    v1, and (v2) edges that the package index resolves across module
+    boundaries: a sync helper in ``common/`` whose summary says it
+    blocks is reported at its call site in the coroutine's own module,
+    because that call site is where the executor hop goes. Code inside
+    nested sync ``def``s/lambdas is exempt because those are the
+    executor thunks themselves.
     """
 
     code = "DF001"
@@ -286,6 +159,8 @@ class BlockingInAsync(Rule):
                 yield Finding(self.code, ctx.rel, call.lineno,
                               call.col_offset,
                               f"{reason} (in async def {where})")
+            yield from self._cross_module(ctx, fn.body, owner or "",
+                                          f"async def {where}")
         for key, origin in sorted(reached.items()):
             node = sync[key]
             where = f"{key[0]}.{key[1]}" if key[0] else key[1]
@@ -294,6 +169,37 @@ class BlockingInAsync(Rule):
                               call.col_offset,
                               f"{reason} (in {where}(), called from "
                               f"coroutine {origin})")
+            yield from self._cross_module(
+                ctx, node.body, key[0],
+                f"{where}(), called from coroutine {origin}")
+
+    def _cross_module(self, ctx: ModuleCtx, body: list[ast.stmt],
+                      owner: str, where: str) -> Iterator[Finding]:
+        """v2: calls in this (coroutine-reachable) scope that resolve to
+        a *sync* function in another module whose summary blocks."""
+        index, mi = ctx.index, ctx.mod
+        if index is None or mi is None:
+            return
+        for node in _walk_scope(body):
+            if not isinstance(node, ast.Call):
+                continue
+            if _blocking_reason(node) is not None:
+                continue        # already flagged by the direct scan
+            key = index.resolve_call(mi, owner, node)
+            if key is None or key[0] == mi.modname:
+                continue        # local edges are the v1 pass's job
+            info = index.funcs.get(key)
+            summ = index.summaries.get(key)
+            if info is None or summ is None or info.is_async \
+                    or summ.blocking is None:
+                continue
+            reason, via = summ.blocking
+            callee = display(key, index.top)
+            hop = f" (via {via})" if via else ""
+            yield Finding(
+                self.code, ctx.rel, node.lineno, node.col_offset,
+                f"call into {callee}(){hop} runs blocking IO on the "
+                f"loop thread: {reason} (in {where})")
 
 
 # ---------------------------------------------------------------------------
@@ -351,9 +257,6 @@ class OrphanedCreateTask(Rule):
 # ---------------------------------------------------------------------------
 # DF003 — wait_for around Condition.wait
 # ---------------------------------------------------------------------------
-
-_CONDISH_RE = re.compile(r"cond", re.IGNORECASE)
-
 
 @register
 class WaitForOnConditionWait(Rule):
@@ -471,15 +374,6 @@ class BroadExceptInCoroutine(Rule):
 # DF005 — slow await while holding an async lock
 # ---------------------------------------------------------------------------
 
-_LOCKISH_RE = re.compile(r"lock|cond|sem|mutex", re.IGNORECASE)
-_SLOW_AWAITS = frozenset({
-    "sleep", "gather", "wait", "wait_for", "open_connection",
-    "getaddrinfo", "connect", "request", "get", "post", "put", "patch",
-    "delete", "fetch", "recv", "read", "readexactly", "readline",
-    "readuntil", "drain", "send", "send_json", "json", "text",
-})
-
-
 @register
 class SlowAwaitUnderLock(Rule):
     """DF005: awaiting network/sleep/queue primitives while holding an
@@ -493,6 +387,12 @@ class SlowAwaitUnderLock(Rule):
     <lock>:`` the only await that belongs is the lock's own
     ``wait``/``wait_for``; compute the decision under the lock, do the
     IO outside it.
+
+    v2: besides the direct name heuristic, awaits whose call the package
+    index resolves to a coroutine (any module) are checked against that
+    coroutine's fixpoint summary — ``await self._flush()`` under a lock
+    flags when ``_flush`` transitively awaits a network write three
+    modules away.
     """
 
     code = "DF005"
@@ -500,6 +400,7 @@ class SlowAwaitUnderLock(Rule):
 
     def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
         ctors = _lock_ctor_map(ctx.tree)
+        index, mi = ctx.index, ctx.mod
 
         def lockish(expr: ast.expr) -> str | None:
             name = _terminal(expr)
@@ -517,6 +418,12 @@ class SlowAwaitUnderLock(Rule):
         for fn in ast.walk(ctx.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):
                 continue
+            owner = ""
+            if index is not None and mi is not None:
+                for (cls, name), node in mi.defs.items():
+                    if node is fn:
+                        owner = cls
+                        break
             for node in _walk_scope(fn.body):
                 if not isinstance(node, ast.AsyncWith):
                     continue
@@ -530,16 +437,36 @@ class SlowAwaitUnderLock(Rule):
                         continue
                     call = sub.value
                     fname = _terminal(call.func)
-                    if fname not in _SLOW_AWAITS:
-                        continue
                     recv = (call.func.value
                             if isinstance(call.func, ast.Attribute) else None)
                     if recv is not None and _terminal(recv) in held:
                         continue    # cond.wait()/.wait_for(): the pattern
+                    if fname in _SLOW_AWAITS:
+                        yield Finding(
+                            self.code, ctx.rel, sub.lineno, sub.col_offset,
+                            f"await {fname}(…) while holding "
+                            f"{'/'.join(sorted(held))} — a slow peer or "
+                            f"timer convoys every other task on this "
+                            f"lock; move the IO outside the lock scope "
+                            f"(in async def {fn.name})")
+                        continue
+                    if index is None or mi is None:
+                        continue
+                    key = index.resolve_call(mi, owner, call)
+                    if key is None:
+                        continue
+                    info = index.funcs.get(key)
+                    summ = index.summaries.get(key)
+                    if info is None or summ is None or not info.is_async \
+                            or summ.slow is None:
+                        continue
+                    reason, via = summ.slow
+                    callee = display(key, index.top)
+                    hop = f" via {via}" if via else ""
                     yield Finding(
                         self.code, ctx.rel, sub.lineno, sub.col_offset,
-                        f"await {fname}(…) while holding "
-                        f"{'/'.join(sorted(held))} — a slow peer or timer "
-                        f"convoys every other task on this lock; move the "
-                        f"IO outside the lock scope (in async def "
-                        f"{fn.name})")
+                        f"await {callee}(…) while holding "
+                        f"{'/'.join(sorted(held))} — it transitively "
+                        f"{reason}{hop}, convoying every task on this "
+                        f"lock; move the call outside the lock scope "
+                        f"(in async def {fn.name})")

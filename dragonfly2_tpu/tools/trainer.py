@@ -48,7 +48,9 @@ async def serve(cfg: TrainerConfig, debug_port: int = 0) -> None:
     await trainer.stop()
     health.PLANE.release()
     from ..common import tracing
-    tracing.shutdown()   # don't drop the final span batch of a short run
+    # the OTLP drain sleeps in bounded 50 ms hops — off-loop, so a
+    # still-draining RPC server isn't parked behind the span flush
+    await asyncio.to_thread(tracing.shutdown)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -137,7 +137,9 @@ class TrainerService:
                     gnn = await asyncio.to_thread(training.train_gnn,
                                                   topo_rows)
             else:
+                # dflint: disable=DF001 — train_in_thread=False is the deterministic unit-test knob; production fits ride to_thread above
                 mlp = training.train_mlp(rows) if rows is not None else None
+                # dflint: disable=DF001 — see above: test-only direct-fit knob
                 gnn = (training.train_gnn(topo_rows)
                        if topo_rows is not None else None)
             for name, fitted in ((training.MLP_MODEL_NAME, mlp),
@@ -175,8 +177,14 @@ class TrainerService:
         blob, metrics = fitted
         infer = self._infer_cache.get(name)
         if infer is None:
-            infer = serving.make_mlp_infer(blob)
-            self._infer_cache[name] = infer
+            # deserialize + hash the blob off-loop (cold cache only)
+            infer = await asyncio.to_thread(serving.make_mlp_infer, blob)
+            # a training round may have published a new model while the
+            # build was suspended — caching then would pin the OLD model
+            # past train()'s invalidating pop; serve this request from
+            # the blob it read, but only cache a still-current build
+            if self.latest.get(name, (None,))[0] is blob:
+                self._infer_cache[name] = infer
         outputs = await asyncio.to_thread(infer, req.features or [])
         return ModelInferResponse(outputs=outputs,
                                   model_version=metrics["version"])

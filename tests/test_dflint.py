@@ -2,9 +2,13 @@
 
 Every rule gets a flagged-positive, a clean-negative, and a suppressed
 case; DF003 additionally gets the PR 2 ``wait_for(cond.wait(), t)``
-deadlock pattern verbatim. The gate test at the bottom walks the whole
-package and fails on ANY unsuppressed finding — concurrency discipline
-enforced mechanically, not by reviewer memory.
+deadlock pattern verbatim, and DF009 the PR 11 admission-under-lock
+inversion. TestCrossModule pins the v2 engine upgrade: a two-module
+blocking-helper fixture the v1 module-local pass provably missed,
+plus interface-keyed cache invalidation. The gate test at the bottom
+walks the whole package (interprocedural pass on) and fails on ANY
+unsuppressed finding — concurrency discipline enforced mechanically,
+not by reviewer memory — and pins the cold run under a 15 s budget.
 """
 
 import json
@@ -12,6 +16,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -343,6 +348,605 @@ class TestDF005:
 
 
 # ---------------------------------------------------------------------------
+# DF007 — pooled-buffer lifecycle
+# ---------------------------------------------------------------------------
+
+class TestDF007:
+    def test_flags_leak_retention_and_use_after_release(self):
+        fs = run_lint("""
+            from pkg.bufpool import POOL
+
+            async def leaks(size):
+                buf = POOL.acquire(size)
+                await fill(buf)                # unwinds holding buf
+                POOL.release(buf)              # …skipping this
+
+            class Engine:
+                async def retains(self, size):
+                    buf = POOL.acquire(size)
+                    self._stash = buf          # second owner after release
+                    POOL.release(buf)
+
+            async def use_after(size):
+                buf = POOL.acquire(size)
+                POOL.release(buf)
+                return bytes(buf)              # another download's bytes
+        """)
+        got = codes(fs)
+        assert got.count("DF007") >= 3
+        msgs = " ".join(f.message for f in active(fs))
+        assert "leak on the exception path" in msgs
+        assert "retained on self" in msgs
+        assert "used after POOL.release" in msgs
+
+    def test_flags_closure_capture_and_plain_leak(self):
+        fs = run_lint("""
+            from pkg.bufpool import POOL
+
+            async def captured(loop, size):
+                buf = POOL.acquire(size)
+                def thunk():
+                    return buf[0]              # closure outlives release
+                await loop.run_in_executor(None, thunk)
+                POOL.release(buf)
+
+            async def never_released(size):
+                buf = POOL.acquire(size)
+                await fill(buf)
+        """)
+        msgs = " ".join(f.message for f in active(fs))
+        assert "captured by a nested function" in msgs
+        assert "never reaches" in msgs
+
+    def test_blessed_shapes_are_clean(self):
+        # the two shipped idioms: try/finally (piece_engine) and
+        # except+release+raise with return-transfer (_read_body)
+        fs = run_lint("""
+            from pkg.bufpool import POOL
+
+            async def finally_shape(size):
+                buf = POOL.acquire(size)
+                try:
+                    await land(buf)
+                finally:
+                    POOL.release(buf)
+
+            async def read_body(size):
+                buf = POOL.acquire(size)
+                try:
+                    await fill(buf)
+                except BaseException:
+                    POOL.release(buf)
+                    raise
+                return buf                     # ownership -> caller
+        """)
+        assert codes(fs) == []
+
+    def test_suppressed(self):
+        fs = run_lint("""
+            from pkg.bufpool import POOL
+
+            async def chaos_fixture(size):
+                # dflint: disable=DF007 — chaos test leaks on purpose to prove the discard metric
+                buf = POOL.acquire(size)
+                await fill(buf)
+        """)
+        assert codes(fs) == []
+        assert [f.code for f in fs if f.suppressed] == ["DF007"]
+
+
+# ---------------------------------------------------------------------------
+# DF008 — acquire/refund pairing
+# ---------------------------------------------------------------------------
+
+class TestDF008:
+    def test_flags_uncovered_optimistic_acquire(self):
+        # the function refunds the limiter in one place, so its acquires
+        # are optimistic — the bare one leaks tokens on a failed write
+        fs = run_lint("""
+            async def serve(limiter, resp, chunks):
+                for chunk in chunks:
+                    await limiter.acquire(len(chunk))
+                    await resp.write(chunk)        # raises -> tokens lost
+                await limiter.acquire(1)
+                try:
+                    await resp.write(b"x")
+                except ConnectionError:
+                    limiter.refund(1)
+                    raise
+        """)
+        assert codes(fs) == ["DF008"]
+        assert "optimistic await limiter.acquire" in active(fs)[0].message
+
+    def test_intervening_unwindable_try_breaks_coverage(self):
+        # an unrelated try (with awaits the handlers may not catch)
+        # between the acquire and the refunding try can unwind first —
+        # the later refund is unreachable on that path
+        fs = run_lint("""
+            async def serve(limiter, resp, other, n):
+                await limiter.acquire(n)
+                try:
+                    await other()            # ConnectionError escapes
+                except ValueError:
+                    pass
+                try:
+                    await resp.write(b"x")
+                except ConnectionError:
+                    limiter.refund(n)
+                    raise
+        """)
+        assert codes(fs) == ["DF008"]
+
+    def test_flags_leaky_lease(self):
+        fs = run_lint("""
+            async def leaky(gate):
+                slot = await gate.acquire()
+                await work()                       # unwinds holding slot
+                slot.release()
+
+            async def never(gate):
+                slot = await gate.acquire()
+                await work()
+        """)
+        got = codes(fs)
+        assert got == ["DF008", "DF008"]
+        msgs = " ".join(f.message for f in active(fs))
+        assert "leak on the exception path" in msgs
+        assert "never released" in msgs
+
+    def test_paired_and_nonoptimistic_shapes_are_clean(self):
+        fs = run_lint("""
+            async def upload(limiter, resp, chunks):
+                for chunk in chunks:
+                    await limiter.acquire(len(chunk))
+                    try:
+                        await resp.write(chunk)
+                    except ConnectionError:
+                        limiter.refund(len(chunk))  # PR 5 contract
+                        raise
+
+            async def accounting_only(limiter, chunks):
+                # no refund anywhere: tokens pay for bytes already moved
+                for chunk in chunks:
+                    await limiter.acquire(len(chunk))
+
+            async def finally_lease(gate):
+                slot = await gate.acquire()
+                try:
+                    await work()
+                finally:
+                    slot.release()
+
+            async def handed_off(gate, registry):
+                slot = await gate.acquire()
+                registry.adopt(slot)               # ownership transfer
+        """)
+        assert codes(fs) == []
+
+    def test_suppressed(self):
+        fs = run_lint("""
+            async def serve(limiter, resp, chunk):
+                limiter.refund(0)
+                # dflint: disable=DF008 — fixture: the refund path is exercised by the chaos test directly
+                await limiter.acquire(len(chunk))
+                await resp.write(chunk)
+        """)
+        assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# DF009 — async lock-ordering (global rule)
+# ---------------------------------------------------------------------------
+
+class TestDF009:
+    def test_flags_lock_order_cycle(self):
+        fs = run_lint("""
+            import asyncio
+
+            lock_a = asyncio.Lock()
+            lock_b = asyncio.Lock()
+
+            async def one():
+                async with lock_a:
+                    async with lock_b:
+                        pass
+
+            async def two():
+                async with lock_b:
+                    async with lock_a:
+                        pass
+        """)
+        hits = [f for f in active(fs) if f.code == "DF009"]
+        assert len(hits) == 2
+        assert "lock-order cycle" in hits[0].message
+
+    def test_flags_transitive_reentry(self):
+        # the deadlock DF005 can't see: f holds the lock and awaits a
+        # helper that re-acquires it — non-reentrant, silent wedge
+        fs = run_lint("""
+            import asyncio
+
+            _lock = asyncio.Lock()
+
+            async def helper():
+                async with _lock:
+                    return 1
+
+            async def f():
+                async with _lock:
+                    return await helper()
+        """)
+        hits = [f for f in active(fs) if f.code == "DF009"]
+        assert len(hits) == 1
+        assert "re-acquired" in hits[0].message
+
+    def test_flags_admission_inversion_pr11_shape(self):
+        # the PR 11 incident verbatim: awaiting a QoS admission (which
+        # parks on a capacity future) while holding the manager lock
+        fs = run_lint("""
+            import asyncio
+
+            class Governor:
+                async def admit(self, cls):
+                    fut = asyncio.get_running_loop().create_future()
+                    self._waiters.append(fut)
+                    await fut
+
+            GOV = Governor()
+
+            class Manager:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+
+                async def get_or_create(self, cls):
+                    async with self._lock:
+                        await GOV.admit(cls)
+        """)
+        hits = [f for f in active(fs) if f.code == "DF009"]
+        assert len(hits) == 1
+        assert "priority inversion" in hits[0].message
+        assert "OUTSIDE the lock" in hits[0].message
+
+    def test_flags_direct_sem_acquire_under_lock(self):
+        # the helper-free form: `await sem.acquire()` under a held lock
+        # parks on capacity with nothing to resolve through — DF005's
+        # name table doesn't know `acquire`, so DF009's direct arm must
+        fs = run_lint("""
+            import asyncio
+
+            class Pool:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+                    self._sem = asyncio.Semaphore(4)
+
+                async def take(self):
+                    async with self._lock:
+                        await self._sem.acquire()
+        """)
+        hits = [f for f in active(fs) if f.code == "DF009"]
+        assert len(hits) == 1
+        assert "priority inversion" in hits[0].message
+
+    def test_heuristic_admit_arm_flags_untyped_governor(self):
+        # the governor arrives through an untyped ctor param (the real
+        # peertask_manager shape) — name arm still catches admit-under-lock
+        fs = run_lint("""
+            import asyncio
+
+            class Manager:
+                def __init__(self, qos):
+                    self.qos = qos
+                    self._lock = asyncio.Lock()
+
+                async def create(self, cls):
+                    async with self._lock:
+                        await self.qos.admit(cls)
+        """)
+        assert any(f.code == "DF009" for f in active(fs))
+
+    def test_one_direction_nesting_and_own_cond_wait_are_clean(self):
+        fs = run_lint("""
+            import asyncio
+
+            outer = asyncio.Lock()
+            inner = asyncio.Lock()
+
+            class D:
+                def __init__(self):
+                    self._cond = asyncio.Condition()
+
+                async def consistent(self):
+                    async with outer:
+                        async with inner:
+                            pass
+
+                async def wait_notified(self):
+                    async with self._cond:
+                        await self._cond.wait()
+        """)
+        assert codes(fs) == []
+
+    def test_suppressed(self):
+        fs = run_lint("""
+            import asyncio
+
+            _lock = asyncio.Lock()
+
+            async def helper():
+                async with _lock:
+                    return 1
+
+            async def f():
+                async with _lock:
+                    # dflint: disable=DF009 — fixture reproducing the re-entry wedge for the chaos suite
+                    return await helper()
+        """)
+        assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# the interprocedural engine: cross-module resolution + caching
+# ---------------------------------------------------------------------------
+
+def _write_pkg(tmp_path, files: dict[str, str]):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        if not (p.parent / "__init__.py").exists():
+            (p.parent / "__init__.py").write_text("")
+        p.write_text(textwrap.dedent(src))
+    return pkg
+
+
+class TestCrossModule:
+    """The engine-upgrade regression pin: hazards v1's module-local pass
+    provably missed, caught by the package-wide index."""
+
+    FEEDER = """
+        from .io_helpers import read_all
+
+        async def pump(path):
+            return read_all(path)
+    """
+    IO_HELPERS = """
+        def read_all(path):
+            with open(path) as f:
+                return f.read()
+    """
+
+    def test_v1_module_local_pass_misses_the_blocking_helper(self):
+        # lint the caller module ALONE (v1 semantics): the import edge
+        # is invisible, so no DF001 — this is the blindness the two-pass
+        # engine exists to remove, pinned so it can't silently return
+        fs = run_lint(self.FEEDER)
+        assert "DF001" not in codes(fs)
+
+    def test_package_pass_catches_cross_module_blocking_call(self, tmp_path):
+        _write_pkg(tmp_path, {"feeder.py": self.FEEDER,
+                              "io_helpers.py": self.IO_HELPERS})
+        fs = lint_paths([str(tmp_path / "pkg")], repo_root=str(tmp_path))
+        hits = [f for f in active(fs) if f.code == "DF001"
+                and f.path.endswith("feeder.py")]
+        assert len(hits) == 1
+        assert "io_helpers.read_all" in hits[0].message
+        assert "pump" in hits[0].message
+
+    def test_cross_module_slow_await_under_lock(self, tmp_path):
+        _write_pkg(tmp_path, {
+            "net.py": """
+                async def flush(session, url):
+                    await session.post(url)
+            """,
+            "shaper.py": """
+                import asyncio
+                from .net import flush
+
+                _lock = asyncio.Lock()
+
+                async def tick(session, url):
+                    async with _lock:
+                        await flush(session, url)
+            """})
+        fs = lint_paths([str(tmp_path / "pkg")], repo_root=str(tmp_path))
+        hits = [f for f in active(fs) if f.code == "DF005"]
+        assert len(hits) == 1
+        assert "net.flush" in hits[0].message
+
+    def test_cross_module_lock_cycle(self, tmp_path):
+        _write_pkg(tmp_path, {
+            "a.py": """
+                import asyncio
+                lock_a = asyncio.Lock()
+
+                async def use_b():
+                    from .b import locked_b
+                    async with lock_a:
+                        await locked_b()
+            """,
+            "b.py": """
+                import asyncio
+                from .a import lock_a
+                lock_b = asyncio.Lock()
+
+                async def locked_b():
+                    async with lock_b:
+                        pass
+
+                async def use_a():
+                    async with lock_b:
+                        async with lock_a:
+                            pass
+            """})
+        fs = lint_paths([str(tmp_path / "pkg")], repo_root=str(tmp_path))
+        hits = [f for f in active(fs) if f.code == "DF009"]
+        assert hits, [f.render() for f in active(fs)]
+        assert any("lock-order cycle" in f.message for f in hits)
+
+    def test_definition_site_suppression_retires_hazard_package_wide(
+            self, tmp_path):
+        # one reasoned suppression at the helper's hazard line keeps
+        # every cross-module caller quiet — and the DF000 unused audit
+        # treats it as used even with no module-local finding
+        _write_pkg(tmp_path, {
+            "feeder.py": self.FEEDER,
+            "io_helpers.py": """
+                def read_all(path):
+                    # dflint: disable=DF001 — tiny /proc read at the call sites, not worth a hop
+                    with open(path) as f:
+                        # dflint: disable=DF001 — see above: tiny read
+                        return f.read()
+            """})
+        fs = lint_paths([str(tmp_path / "pkg")], repo_root=str(tmp_path))
+        assert codes(fs) == [], [f.render() for f in active(fs)]
+
+
+class TestResultCache:
+    def test_cache_hits_after_unchanged_rerun(self, tmp_path):
+        _write_pkg(tmp_path, {"feeder.py": TestCrossModule.FEEDER,
+                              "io_helpers.py": TestCrossModule.IO_HELPERS})
+        stats1: dict = {}
+        fs1 = lint_paths([str(tmp_path / "pkg")],
+                         repo_root=str(tmp_path), stats=stats1)
+        assert stats1["cache_hits"] == 0 and stats1["cache_misses"] > 0
+        stats2: dict = {}
+        fs2 = lint_paths([str(tmp_path / "pkg")],
+                         repo_root=str(tmp_path), stats=stats2)
+        assert stats2["cache_misses"] == 0
+        assert stats2["cache_hits"] == stats1["cache_misses"]
+        assert [f.render() for f in fs1] == [f.render() for f in fs2]
+
+    def test_dependency_interface_change_invalidates_dependents(
+            self, tmp_path):
+        # the helper is clean; the caller's results are cached. Making
+        # the helper BLOCK changes its interface digest, so the cached
+        # caller result must be discarded and the new finding surface.
+        clean = {"feeder.py": TestCrossModule.FEEDER,
+                 "io_helpers.py": "def read_all(path):\n    return ''\n"}
+        _write_pkg(tmp_path, clean)
+        fs = lint_paths([str(tmp_path / "pkg")], repo_root=str(tmp_path))
+        assert [f for f in active(fs) if f.code == "DF001"] == []
+        (tmp_path / "pkg" / "io_helpers.py").write_text(
+            textwrap.dedent(TestCrossModule.IO_HELPERS))
+        stats: dict = {}
+        fs = lint_paths([str(tmp_path / "pkg")],
+                        repo_root=str(tmp_path), stats=stats)
+        hits = [f for f in active(fs) if f.code == "DF001"
+                and f.path.endswith("feeder.py")]
+        assert len(hits) == 1   # served fresh, not from the stale cache
+
+    def test_scoped_run_does_not_evict_full_package_cache(self, tmp_path):
+        # a --changed-style run over ONE file must merge into the cache,
+        # not replace it — else every pre-commit run resets the gate to
+        # a cold start
+        pkg = _write_pkg(tmp_path,
+                         {"feeder.py": TestCrossModule.FEEDER,
+                          "io_helpers.py": TestCrossModule.IO_HELPERS})
+        lint_paths([str(pkg)], repo_root=str(tmp_path))      # warm all
+        lint_paths([str(pkg / "feeder.py")],
+                   repo_root=str(tmp_path))                  # scoped run
+        stats: dict = {}
+        lint_paths([str(pkg)], repo_root=str(tmp_path), stats=stats)
+        assert stats["cache_misses"] == 0, stats
+
+    def test_singleton_reexport_dependency_invalidates_through_hop(
+            self, tmp_path):
+        # a.py resolves GOV.admit through b's re-exported singleton into
+        # impl.py, which a.py never imports — impl gaining a parking
+        # await must still invalidate a.py's cached (clean) result
+        files = {
+            "impl.py": """
+                class Governor:
+                    async def admit(self):
+                        return 1
+            """,
+            "b.py": """
+                from .impl import Governor
+                GOV = Governor()
+            """,
+            "a.py": """
+                import asyncio
+                from .b import GOV
+                _lock = asyncio.Lock()
+
+                async def create():
+                    async with _lock:
+                        await GOV.admit()
+            """}
+        pkg = _write_pkg(tmp_path, files)
+        fs = lint_paths([str(pkg)], repo_root=str(tmp_path))
+        assert [f for f in active(fs) if f.code == "DF009"] == []
+        (pkg / "impl.py").write_text(textwrap.dedent("""
+            class Governor:
+                async def admit(self):
+                    fut = make_future()
+                    await fut
+        """))
+        fs = lint_paths([str(pkg)], repo_root=str(tmp_path))
+        hits = [f for f in active(fs) if f.code == "DF009"
+                and f.path.endswith("a.py")]
+        assert len(hits) == 1, [f.render() for f in active(fs)]
+
+    def test_standalone_file_gets_global_rules_too(self, tmp_path):
+        # the CLI path (lint_paths on a loose file) must agree with
+        # lint_source on DF009 — solo files get the global pass as well
+        loose = tmp_path / "loops.py"
+        loose.write_text(textwrap.dedent("""
+            import asyncio
+
+            lock_a = asyncio.Lock()
+            lock_b = asyncio.Lock()
+
+            async def one():
+                async with lock_a:
+                    async with lock_b:
+                        pass
+
+            async def two():
+                async with lock_b:
+                    async with lock_a:
+                        pass
+        """))
+        fs = lint_paths([str(loose)], repo_root=str(tmp_path))
+        assert [f.code for f in active(fs)] == ["DF009", "DF009"]
+
+    def test_suppression_grammar_in_docstring_does_not_retire_hazard(
+            self, tmp_path):
+        # the index pass reads comments via tokenize: grammar QUOTED in
+        # a docstring (e.g. documentation showing the disable syntax)
+        # must not silently retire a real hazard from the summary
+        _write_pkg(tmp_path, {
+            "feeder.py": TestCrossModule.FEEDER,
+            "io_helpers.py": """
+                def read_all(path):
+                    doc = '# dflint: disable=DF001 — sample reason'
+                    with open(path) as f:
+                        doc2 = '# dflint: disable=DF001 — sample reason'
+                        return f.read()
+            """})
+        fs = lint_paths([str(tmp_path / "pkg")], repo_root=str(tmp_path))
+        hits = [f for f in active(fs) if f.code == "DF001"
+                and f.path.endswith("feeder.py")]
+        assert len(hits) == 1, [f.render() for f in active(fs)]
+
+    def test_comment_only_dependency_edit_keeps_dependents_cached(
+            self, tmp_path):
+        _write_pkg(tmp_path, {"feeder.py": TestCrossModule.FEEDER,
+                              "io_helpers.py": TestCrossModule.IO_HELPERS})
+        lint_paths([str(tmp_path / "pkg")], repo_root=str(tmp_path))
+        helper = tmp_path / "pkg" / "io_helpers.py"
+        helper.write_text("# a comment\n" + helper.read_text())
+        stats: dict = {}
+        lint_paths([str(tmp_path / "pkg")], repo_root=str(tmp_path),
+                   stats=stats)
+        # the helper itself re-analyzes (content hash moved) but its
+        # interface digest didn't — the caller stays cached
+        assert stats["cache_misses"] == 1
+
+
+# ---------------------------------------------------------------------------
 # DF000 — the suppression grammar polices itself
 # ---------------------------------------------------------------------------
 
@@ -632,6 +1236,75 @@ class TestCLI:
         assert p.returncode in (0, 1), p.stderr
         json.loads(p.stdout)
 
+    def test_stats_emits_counts_and_pass_times(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\n\nasync def go():\n    time.sleep(1)\n")
+        p = _cli("--stats", str(bad))
+        assert p.returncode == 1, p.stderr
+        doc = json.loads(p.stdout)
+        assert doc["counts"]["by_code"] == {"DF001": 1}
+        assert doc["passes"]["index_s"] >= 0.0
+        assert doc["passes"]["analysis_s"] >= 0.0
+        assert doc["cache"]["hits"] + doc["cache"]["misses"] == 1
+
+
+class TestChangedResolution:
+    """--changed scopes against the merge-base, never the index."""
+
+    def _fake_git(self, outputs):
+        calls = []
+
+        def git(args):
+            calls.append(args)
+            for prefix, out in outputs.items():
+                if tuple(args[:len(prefix)]) == prefix:
+                    return out
+            return None
+        return git, calls
+
+    def test_merge_base_diff_with_untracked_union(self):
+        from dragonfly2_tpu.tools.dflint import changed_files
+        tracked = "dragonfly2_tpu/common/ids.py"
+        fresh = "dragonfly2_tpu/common/rate.py"
+        git, calls = self._fake_git({
+            ("merge-base",): "abc123",
+            ("diff",): f"{tracked}\n{fresh}",
+            ("ls-files",): fresh,            # union + dedupe with diff
+        })
+        out = changed_files(git)
+        assert [os.path.basename(p) for p in out] == ["ids.py", "rate.py"]
+        diff_calls = [c for c in calls if c[0] == "diff"]
+        # the one diff runs against the merge-base sha — branch commits
+        # and working-tree edits in one listing
+        assert diff_calls == [["diff", "--name-only", "abc123", "--",
+                               "*.py"]]
+        # the index is never consulted: staging state is laptop-local
+        assert not any("--cached" in c for c in calls)
+
+    def test_no_upstream_falls_back_to_head_not_index(self):
+        from dragonfly2_tpu.tools.dflint import changed_files
+        git, calls = self._fake_git({
+            ("diff",): "dragonfly2_tpu/common/ids.py",
+            ("ls-files",): "",
+        })
+        out = changed_files(git)
+        assert [os.path.basename(p) for p in out] == ["ids.py"]
+        assert ["diff", "--name-only", "HEAD", "--", "*.py"] in calls
+        assert not any("--cached" in c for c in calls)
+
+    def test_untracked_only_change_is_linted(self):
+        # the untracked-file union: a brand-new module never appears in
+        # `git diff`, and it is exactly the file most likely to carry a
+        # fresh hazard
+        from dragonfly2_tpu.tools.dflint import changed_files
+        git, _ = self._fake_git({
+            ("merge-base",): "abc123",
+            ("diff",): "",
+            ("ls-files",): "dragonfly2_tpu/common/rate.py",
+        })
+        out = changed_files(git)
+        assert [os.path.basename(p) for p in out] == ["rate.py"]
+
 
 # ---------------------------------------------------------------------------
 # THE GATE: zero unsuppressed findings over the whole package
@@ -655,6 +1328,23 @@ class TestTier1Gate:
         the original incidents — re-lint the PR 2 fixture here so a
         future rule refactor can't silently hollow the gate out."""
         assert "DF003" in codes(run_lint(PR2_DEADLOCK))
+
+    def test_cold_package_run_stays_under_budget(self):
+        """The per-module cache is what keeps the tier-1 gate cheap;
+        this pins the COLD path (cache deleted) under 15 s so an engine
+        change that silently quadratics the index or analysis pass fails
+        here instead of slowly rotting the gate."""
+        cache = os.path.join(REPO, ".dflint_cache.json")
+        if os.path.exists(cache):
+            os.remove(cache)
+        stats: dict = {}
+        t0 = time.perf_counter()
+        lint_paths([PKG], repo_root=REPO, stats=stats)
+        elapsed = time.perf_counter() - t0
+        assert stats["cache_hits"] == 0 and stats["cache_misses"] > 0
+        assert elapsed < 15.0, (
+            f"cold package-wide dflint run took {elapsed:.1f}s "
+            f"(budget 15s) — stats: {stats}")
 
 
 if __name__ == "__main__":

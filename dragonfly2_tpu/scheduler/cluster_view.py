@@ -33,6 +33,7 @@ _flights = REGISTRY.counter(
 
 STRAGGLER_FACTOR = 3.0      # mean cost beyond this x median -> straggler
 MIN_STRAGGLER_PIECES = 4    # don't judge a parent on one slow piece
+SNAPSHOT_TTL_S = 1.0        # /debug/cluster rebuild cadence (see snapshot)
 
 
 class _HostAgg:
@@ -57,9 +58,17 @@ class _HostAgg:
 
 
 class ClusterView:
-    def __init__(self, ledger=None, quarantine=None) -> None:
+    def __init__(self, ledger=None, quarantine=None,
+                 snapshot_ttl_s: float = SNAPSHOT_TTL_S) -> None:
         self._hosts: dict[str, _HostAgg] = {}
         self.started_at = time.time()
+        # /debug/cluster rebuilds walk every host; on a 10k-host fleet a
+        # tight poller would turn that O(hosts) sweep into scheduler load.
+        # Snapshots are cached for snapshot_ttl_s and the payload reports
+        # its own staleness so pollers know what vintage they read.
+        self.snapshot_ttl_s = snapshot_ttl_s
+        self._snap: dict | None = None
+        self._snap_at = 0.0
         # decision ledger (scheduler/decision_ledger.py): its compact
         # counters ride the cluster snapshot so /debug/cluster answers
         # "is the pod herding onto no-slots/bad-node exclusions" next to
@@ -133,6 +142,21 @@ class ClusterView:
                 if m > STRAGGLER_FACTOR * median]
 
     def snapshot(self) -> dict:
+        """TTL-cached view; ``staleness_s`` in the payload says how old."""
+        now = time.monotonic()
+        if (self._snap is not None
+                and now - self._snap_at <= self.snapshot_ttl_s):
+            snap = dict(self._snap)
+            snap["staleness_s"] = round(now - self._snap_at, 3)
+            return snap
+        snap = self._build_snapshot()
+        snap["snapshot_ttl_s"] = self.snapshot_ttl_s
+        snap["staleness_s"] = 0.0
+        self._snap = snap
+        self._snap_at = now
+        return snap
+
+    def _build_snapshot(self) -> dict:
         p2p = sum(a.bytes_down_p2p for a in self._hosts.values())
         src = sum(a.bytes_down_source for a in self._hosts.values())
         hosts = {}

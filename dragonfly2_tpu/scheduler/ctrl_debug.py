@@ -1,0 +1,108 @@
+"""Control-plane observatory surface: ``GET /debug/ctrl``.
+
+Role parity: none in the reference — the live half of the PR-16
+control-plane observatory. Joins the ruling profiler's aggregates
+(common/phasetimer.py: rulings/sec, per-phase p50/p99, queue-wait vs
+compute) with bytes-of-state accounting across every control-plane
+component (Resource, DecisionLedger, PodFederation, QuarantineRegistry,
+ShardAffinity — each exposing ``state_bytes()``), served on the
+scheduler launcher's ``--debug-port`` next to /debug/cluster and
+rendered by ``dfdiag --ctrl``.
+
+The state-bytes walk is O(every object the scheduler holds) — at 10k
+peers that is seconds, which must never ride the ruling loop. It is
+computed lazily behind a short TTL cache, and the payload reports its
+own ``state_staleness_s`` so a poller knows what vintage it is reading
+(the same honesty contract as the /debug/cluster snapshot cache).
+
+``GET /debug/ctrl?arm=1`` / ``?arm=0`` arms/disarms the profiler live —
+the operator's "profile this incident now" switch; the scheduler does
+not need a restart (and the disarmed tax on rulings stays near zero, so
+shipping with it armed is also fine).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..common import phasetimer
+from ..common.metrics import REGISTRY
+
+_state_bytes_gauge = REGISTRY.gauge(
+    "df_ctrl_state_bytes",
+    "bytes of control-plane state held per component (deep-sizeof walk, "
+    "refreshed at the /debug/ctrl TTL cadence)", ("component",))
+
+STATE_TTL_S = 5.0       # state-bytes walk cache; staleness is reported
+
+
+class CtrlObservatory:
+    """Holds the component refs and the TTL-cached state-bytes walk."""
+
+    def __init__(self, *, resource=None, ledger=None, federation=None,
+                 quarantine=None, sharded=None,
+                 ttl_s: float = STATE_TTL_S,
+                 clock=time.monotonic) -> None:
+        self.components = {
+            "resource": resource,
+            "ledger": ledger,
+            "federation": federation,
+            "quarantine": quarantine,
+            "shard_affinity": sharded,
+        }
+        self.ttl_s = ttl_s
+        self.clock = clock
+        self._state_cache: dict | None = None
+        self._state_at = 0.0
+
+    def peer_count(self) -> int:
+        res = self.components.get("resource")
+        if res is None:
+            return 0
+        return sum(len(t.peers) for t in res.tasks.values())
+
+    def state_bytes(self) -> dict:
+        """Per-component bytes + per-peer quotient, behind the TTL."""
+        now = self.clock()
+        if (self._state_cache is not None
+                and now - self._state_at <= self.ttl_s):
+            return self._state_cache
+        per = {name: comp.state_bytes()
+               for name, comp in self.components.items()
+               if comp is not None}
+        for name, b in per.items():
+            _state_bytes_gauge.labels(name).set(b)
+        total = sum(per.values())
+        peers = self.peer_count()
+        self._state_cache = {
+            "components": per,
+            "total": total,
+            "peers": peers,
+            "per_peer": round(total / peers, 1) if peers else 0.0,
+        }
+        self._state_at = now
+        return self._state_cache
+
+    def snapshot(self) -> dict:
+        snap = phasetimer.snapshot()
+        snap["state_bytes"] = self.state_bytes()
+        snap["state_staleness_s"] = round(
+            max(self.clock() - self._state_at, 0.0), 3)
+        snap["state_ttl_s"] = self.ttl_s
+        return snap
+
+
+def add_ctrl_routes(router, obs: CtrlObservatory) -> None:
+    """``GET /debug/ctrl`` — mounted on the scheduler launcher's
+    --debug-port server next to /debug/cluster and /debug/decisions."""
+    from aiohttp import web
+
+    async def ctrl(req: web.Request) -> web.Response:
+        arm = req.query.get("arm", "")
+        if arm in ("1", "true"):
+            phasetimer.arm()
+        elif arm in ("0", "false"):
+            phasetimer.disarm()
+        return web.json_response(obs.snapshot())
+
+    router.add_get("/debug/ctrl", ctrl)

@@ -80,6 +80,15 @@ class DecisionLedger:
             "ring": len(self._ring),
         }
 
+    def state_bytes(self) -> int:
+        """Bytes of ledger state (the bounded ring + counters) for the
+        /debug/ctrl bytes-per-peer accounting. Deep sizeof walk —
+        snapshot cadence only, never on a ruling path."""
+        from ..common.sizeof import deep_sizeof
+        seen: set = set()
+        return sum(deep_sizeof(o, seen) for o in (
+            self._ring, self.by_kind, self.excluded_by_reason))
+
     def snapshot(self, task_id: str = "", peer_id: str = "",
                  limit: int = 64) -> dict:
         """Newest-last slice of the ring for ``GET /debug/decisions``

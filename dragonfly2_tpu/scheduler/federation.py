@@ -256,6 +256,16 @@ class PodFederation:
 
     # -- debug ---------------------------------------------------------
 
+    def state_bytes(self) -> int:
+        """Bytes of federation state (pod membership, rings, election
+        memos) for the /debug/ctrl bytes-per-peer accounting. Deep
+        sizeof walk — snapshot cadence only, never on a ruling path."""
+        from ..common.sizeof import deep_sizeof
+        seen: set = set()
+        return sum(deep_sizeof(o, seen) for o in (
+            self._pod_of, self._members, self._rings,
+            self._elected, self._result))
+
     def describe(self) -> dict:
         return {
             "seeds_per_pod": self.seeds_per_pod,

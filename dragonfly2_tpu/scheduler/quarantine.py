@@ -340,6 +340,13 @@ class QuarantineRegistry:
 
     # -- debug surface ---------------------------------------------------
 
+    def state_bytes(self) -> int:
+        """Bytes of quarantine state (per-host ladders, evidence sets)
+        for the /debug/ctrl bytes-per-peer accounting. Deep sizeof walk
+        — snapshot cadence only, never on a ruling path."""
+        from ..common.sizeof import deep_sizeof
+        return deep_sizeof(self._hosts)
+
     def snapshot(self) -> dict:
         now = self.clock()
         hosts = {}

@@ -450,6 +450,18 @@ class Resource:
 
     # -- GC ------------------------------------------------------------
 
+    def state_bytes(self) -> int:
+        """Bytes of cluster state of record (tasks, peers, hosts, DAGs)
+        for the /debug/ctrl bytes-per-peer accounting — the number that
+        decides whether a 10k-daemon fleet fits one asyncio brain. Deep
+        sizeof walk over the full object graph (O(peers); the visited
+        set keeps the Peer<->Task<->Host cross-references from double
+        counting) — snapshot cadence only, never on a ruling path."""
+        from ..common.sizeof import deep_sizeof
+        seen: set = set()
+        return sum(deep_sizeof(o, seen)
+                   for o in (self.tasks, self.hosts))
+
     def gc(self) -> int:
         """Evict idle peers, empty/expired tasks, and silent hosts."""
         now = time.time()

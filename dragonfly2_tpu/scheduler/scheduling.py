@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import logging
 import random
+import time
 
+from ..common import phasetimer
 from ..common.metrics import REGISTRY
 from ..idl.messages import PeerAddr, PeerPacket
 from ..tpu.topology import link_type
@@ -77,10 +79,11 @@ class Scheduling:
         — the daemon then treats every requested shard as tree-class."""
         if self.sharded is None or not requested:
             return None
-        return self.sharded.assign(
-            task_id=child.task.id, peer_id=child.id,
-            host_id=child.host.id,
-            topology=child.host.msg.topology, requested=requested)
+        with phasetimer.ruling("shard"):
+            return self.sharded.assign(
+                task_id=child.task.id, peer_id=child.id,
+                host_id=child.host.id,
+                topology=child.host.msg.topology, requested=requested)
 
     # ------------------------------------------------------------------
 
@@ -101,7 +104,15 @@ class Scheduling:
         # child's descendant set once and testing membership replaces
         # O(candidates x DAG) repeated can_reach walks — the filter's
         # former hot spot at 1k+-peer pools (dfbench --pr13 fakepods)
-        cycle_blocked = task.dag.descendants(child.id)
+        with phasetimer.phase("dag-walk"):
+            cycle_blocked = task.dag.descendants(child.id)
+        # hoisted ARMED (the phasetimer overhead contract): the
+        # per-candidate quarantine/federation lookups below accumulate a
+        # local perf_counter delta and record ONE `exclusion` sample per
+        # ruling — a context manager per candidate would put profiler
+        # cost inside the pool loop even disarmed
+        armed = phasetimer.ARMED
+        excl_s = 0.0
         out: list[Peer] = []
         for parent in pool:
             full = len(out) >= self.cfg.filter_parent_limit
@@ -146,31 +157,44 @@ class Scheduling:
             if self.evaluator.is_bad_node(parent):
                 self._trace(child, parent, "bad-node", excluded)
                 continue
-            if (self.quarantine is not None
-                    and not self.quarantine.offerable(parent.host.id,
-                                                      child.id)):
-                # pod-wide quarantine (hard corrupt evidence / self-flag):
-                # excluded from offers — and therefore from relay-tree
-                # shaping and every downstream choice — until the ladder
-                # walks the host back through probation. Probation hosts
-                # pass here only within the bounded probe budget.
-                self._trace(child, parent, "quarantined", excluded)
-                continue
-            if (self.federation is not None
-                    and not self.federation.allows(child, parent)):
-                # cross-pod federation: a parent in ANOTHER pod is legal
-                # only for this pod's elected seeds — everyone else gets
-                # the bytes off the pod seed's ICI tree instead of
-                # opening one more DCN stream per child (the two-level
-                # origin -> pod-seed -> ICI relay chain, ROADMAP item 2)
-                self._trace(child, parent, "cross-pod", excluded)
-                continue
+            if self.quarantine is not None:
+                t0 = time.perf_counter() if armed else 0.0
+                offerable = self.quarantine.offerable(parent.host.id,
+                                                      child.id)
+                if armed:
+                    excl_s += time.perf_counter() - t0
+                if not offerable:
+                    # pod-wide quarantine (hard corrupt evidence /
+                    # self-flag): excluded from offers — and therefore
+                    # from relay-tree shaping and every downstream choice
+                    # — until the ladder walks the host back through
+                    # probation. Probation hosts pass here only within
+                    # the bounded probe budget.
+                    self._trace(child, parent, "quarantined", excluded)
+                    continue
+            if self.federation is not None:
+                t0 = time.perf_counter() if armed else 0.0
+                allowed = self.federation.allows(child, parent)
+                if armed:
+                    excl_s += time.perf_counter() - t0
+                if not allowed:
+                    # cross-pod federation: a parent in ANOTHER pod is
+                    # legal only for this pod's elected seeds — everyone
+                    # else gets the bytes off the pod seed's ICI tree
+                    # instead of opening one more DCN stream per child
+                    # (the two-level origin -> pod-seed -> ICI relay
+                    # chain, ROADMAP item 2)
+                    self._trace(child, parent, "cross-pod", excluded)
+                    continue
             if parent.id in cycle_blocked:
                 # would_cycle(parent, child): the parent is downstream of
                 # the child, so the edge would close a loop
                 self._trace(child, parent, "cycle", excluded)
                 continue
             out.append(parent)
+        if armed and (self.quarantine is not None
+                      or self.federation is not None):
+            phasetimer.record("exclusion", excl_s)
         return out
 
     @staticmethod
@@ -255,6 +279,10 @@ class Scheduling:
         if not self.cfg.qos_preemption \
                 or getattr(child, "qos_class", "standard") != "critical":
             return None
+        with phasetimer.ruling("preempt"):
+            return self._preempt_scan(child)
+
+    def _preempt_scan(self, child: Peer) -> Peer | None:
         task = child.task
         dag = task.dag
         # holders whose slots are exhausted (the no-slots exclusion the
@@ -327,43 +355,54 @@ class Scheduling:
         ``sorted(..., reverse=True)`` is stable either way — the offer, and
         therefore the schedule digest, cannot move (gated by
         tests/test_dfbench.py on the PR-3 baseline)."""
-        sink = self.decision_sink
-        excluded: list | None = [] if sink is not None else None
-        candidates = self.filter_candidates(child, excluded)
-        total = child.task.total_piece_count
-        explained: list[tuple[Peer, dict]] = []
-        relay_note: dict | None = None
-        prev_offer = set(child.last_offer_ids)
-        if not candidates:
-            offer: list[Peer] = []
-        else:
-            if sink is None:
-                scored = sorted(
-                    candidates,
-                    key=lambda p: self.evaluator.evaluate(
-                        child, p, total_piece_count=total),
-                    reverse=True)
+        # the ruling profiler (common/phasetimer.py) wraps the whole
+        # ruling and decomposes it into the pinned PHASES — same purity
+        # contract as the ledger: timing never touches the rng or the
+        # ordering, so the armed digest gate holds too
+        with phasetimer.ruling(decision_kind):
+            sink = self.decision_sink
+            excluded: list | None = [] if sink is not None else None
+            with phasetimer.phase("filter"):
+                candidates = self.filter_candidates(child, excluded)
+            total = child.task.total_piece_count
+            explained: list[tuple[Peer, dict]] = []
+            relay_note: dict | None = None
+            prev_offer = set(child.last_offer_ids)
+            if not candidates:
+                offer: list[Peer] = []
             else:
-                explained = [(p, self.evaluator.explain(
-                    child, p, total_piece_count=total))
-                    for p in candidates]
-                explained.sort(key=lambda pe: pe[1]["total"], reverse=True)
-                scored = [p for p, _ in explained]
-            if self.cfg.relay_fanout > 0:
-                scored, relay_note = self._relay_shape(child, scored)
-            if decision_kind == "refresh":
-                kept = [p for p in scored if p.id in prev_offer]
-                fresh = [p for p in scored if p.id not in prev_offer]
-                offer = self._ensure_holder(
-                    scored, (kept + fresh)[:self.cfg.candidate_parent_limit])
-            else:
-                offer = self._ensure_holder(
-                    scored, scored[:self.cfg.candidate_parent_limit])
-        if sink is not None:
-            self._emit_decision(child, decision_kind, explained,
-                                excluded or [], offer, prev_offer, total,
-                                relay_note=relay_note)
-        return offer
+                with phasetimer.phase("score"):
+                    if sink is None:
+                        scored = sorted(
+                            candidates,
+                            key=lambda p: self.evaluator.evaluate(
+                                child, p, total_piece_count=total),
+                            reverse=True)
+                    else:
+                        explained = [(p, self.evaluator.explain(
+                            child, p, total_piece_count=total))
+                            for p in candidates]
+                        explained.sort(key=lambda pe: pe[1]["total"],
+                                       reverse=True)
+                        scored = [p for p, _ in explained]
+                if self.cfg.relay_fanout > 0:
+                    with phasetimer.phase("relay"):
+                        scored, relay_note = self._relay_shape(child, scored)
+                if decision_kind == "refresh":
+                    kept = [p for p in scored if p.id in prev_offer]
+                    fresh = [p for p in scored if p.id not in prev_offer]
+                    offer = self._ensure_holder(
+                        scored,
+                        (kept + fresh)[:self.cfg.candidate_parent_limit])
+                else:
+                    offer = self._ensure_holder(
+                        scored, scored[:self.cfg.candidate_parent_limit])
+            if sink is not None:
+                with phasetimer.phase("emit"):
+                    self._emit_decision(child, decision_kind, explained,
+                                        excluded or [], offer, prev_offer,
+                                        total, relay_note=relay_note)
+            return offer
 
     def _emit_decision(self, child: Peer, decision_kind: str,
                        explained: list, excluded: list, offer: list[Peer],

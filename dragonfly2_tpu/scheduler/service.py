@@ -17,6 +17,7 @@ import asyncio
 import logging
 from typing import AsyncIterator
 
+from ..common import phasetimer
 from ..common.errors import Code, DFError
 from ..common.metrics import REGISTRY
 from ..idl.messages import (AnnounceHostRequest, Empty, HostType,
@@ -406,7 +407,8 @@ class SchedulerService:
             if packet is not None:
                 sink.put_nowait(packet)
             return
-        deadline = (asyncio.get_running_loop().time() + SCHEDULE_PATIENCE_S)
+        t0 = asyncio.get_running_loop().time()
+        deadline = t0 + SCHEDULE_PATIENCE_S
         while True:
             if peer.is_done() or peer.state == PeerState.BACK_SOURCE:
                 return
@@ -422,6 +424,12 @@ class SchedulerService:
                     await self._push_victim_packet(victim)
                     continue
             if parents:
+                if phasetimer.ARMED:
+                    # queue-wait: register arrival -> offer landing, minus
+                    # nothing — the ruling compute inside is µs against the
+                    # 250ms retry ticks that dominate a queued child
+                    phasetimer.note_queue_wait(
+                        asyncio.get_running_loop().time() - t0)
                 peer.schedule_count += 1
                 peer.last_offer_ids = {p.id for p in parents}
                 peer.task.set_parents(peer.id, [p.id for p in parents])

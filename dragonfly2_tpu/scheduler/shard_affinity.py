@@ -159,6 +159,15 @@ class ShardAffinity:
         self._last = {k: v for k, v in self._last.items()
                       if k[2] != host_id}
 
+    def state_bytes(self) -> int:
+        """Bytes of shard-affinity state (request tables, assignment
+        memos) for the /debug/ctrl bytes-per-peer accounting. Deep
+        sizeof walk — snapshot cadence only, never on a ruling path."""
+        from ..common.sizeof import deep_sizeof
+        seen: set = set()
+        return sum(deep_sizeof(o, seen)
+                   for o in (self._requests, self._last))
+
     def describe(self) -> dict:
         return {
             "tasks": {f"{tid[:12]}/{group or '<flat>'}":

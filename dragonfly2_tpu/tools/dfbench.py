@@ -2645,6 +2645,299 @@ def _run_pr14(args) -> dict:
     }
 
 
+# --- PR 16: control-plane observatory (ROADMAP item: make the control
+# plane a benchmarked hot path) ---------------------------------------
+
+CTRL_FLEETS = (1000, 5000, 10000)   # virtual daemons per full-mode point
+CTRL_SMOKE_FLEET = 64               # tier-1 digest-gate size (always run)
+CTRL_PEERS_PER_POD = 256            # one task per pod-sized group
+CTRL_PIECES = 32                    # pinned: the smoke digest gate must
+                                    # re-derive with the committed params
+CTRL_SHARDS = 16                    # shard names per shard ruling
+CTRL_SHARD_RULINGS = 512            # shard rulings per fleet (rendezvous
+                                    # hashing is O(shards x group) per
+                                    # ruling — capped so 10k stays minutes)
+CTRL_QUARANTINED = 3                # pod-0 hosts poisoned pre-refresh
+CTRL_CRITICAL_EVERY = 97            # every Nth register is critical class
+CTRL_BULK_EVERY = 3                 # every Nth register is bulk class
+
+
+def run_ctrl_bench(*, seed: int = 7, daemons: int = 1000,
+                   pieces: int = 32, piece_size: int = 4 << 20,
+                   armed: bool = True) -> dict:
+    """Cold-herd register storm + steady-state refresh storm through the
+    REAL control-plane stack: ``Scheduling`` over the real ``Resource``
+    model with the real ``DecisionLedger``, ``PodFederation``,
+    ``QuarantineRegistry``, and ``ShardAffinity`` all armed — every
+    ``find``/``refresh``/``preempt``/``shard`` ruling the fleet takes,
+    profiled by common/phasetimer.py when ``armed``.
+
+    The storm: ``daemons`` hosts across pod-sized tasks (one task +
+    SUPER_SEED seed peer per CTRL_PEERS_PER_POD group) register back to
+    back (the cold herd — ``find`` rulings; queue-wait is each
+    registrant's real wall delay behind the single brain), a few pod-0
+    hosts earn quarantine, then every peer reports progress and
+    re-rules (``refresh``), critical children probe ``preempt``, and a
+    capped slice takes ``shard`` rulings.
+
+    Determinism: virtual quarantine clock, seeded rng, sha256 shard
+    hashing — ``ruling_digest`` (ordered [kind, peer, chosen] rows,
+    never latencies) is a pure function of (seed, daemons, pieces), and
+    identical armed or disarmed (the profiler-purity gate)."""
+    from ..common import phasetimer
+    from ..idl.messages import Host as HostMsg
+    from ..idl.messages import HostType
+    from ..scheduler.config import SchedulerConfig
+    from ..scheduler.ctrl_debug import CtrlObservatory
+    from ..scheduler.decision_ledger import DecisionLedger
+    from ..scheduler.evaluator import make_evaluator
+    from ..scheduler.federation import PodFederation
+    from ..scheduler.quarantine import QuarantineRegistry
+    from ..scheduler.resource import PeerState, Resource, Task
+    from ..scheduler.scheduling import Scheduling
+    from ..scheduler.shard_affinity import ShardAffinity
+    import time as _time
+
+    random.seed(seed)          # filter_candidates' pool shuffle (see run_bench)
+    now_ref = [0.0]            # virtual ms, read by the registry clock
+
+    res = Resource()
+    registry = QuarantineRegistry(
+        corrupt_threshold=3.0, halflife_s=1e9, probation_delay_s=1e9,
+        clock=lambda: now_ref[0] / 1000.0)
+    fed = PodFederation(seeds_per_pod=1)
+    ledger = DecisionLedger()
+    affinity = ShardAffinity(sink=ledger.on_decision)
+    sched = Scheduling(SchedulerConfig(relay_fanout=RELAY_FANOUT),
+                       make_evaluator("default"), quarantine=registry,
+                       federation=fed, sharded=affinity)
+    sched.decision_sink = ledger.on_decision
+
+    phasetimer.reset()
+    if armed:
+        phasetimer.arm()
+
+    pods = max(1, -(-daemons // CTRL_PEERS_PER_POD))
+
+    def topo(pod: int, i: int) -> TopologyInfo:
+        return TopologyInfo(slice_name=f"pod-{pod}",
+                            ici_coords=(i % 16, (i // 16) % 16),
+                            zone="bench-zone")
+
+    tasks: list[Task] = []
+    for p in range(pods):
+        # registered with the Resource (unlike the pure-sim benches): the
+        # state-bytes walk and peer-count quotient read res.tasks
+        task = res.get_or_create_task(f"ctrl{p:03d}".ljust(64, "0"),
+                                      f"bench://ctrl/{p}")
+        task.set_content_info(pieces * piece_size, piece_size, pieces)
+        t = topo(p, 255)
+        host = res.store_host(HostMsg(
+            id=f"c{p}seed-host", ip="10.0.0.1", port=1, download_port=2,
+            type=HostType.SUPER_SEED, topology=t))
+        fed.observe_host(host.id, t)
+        sp = res.get_or_create_peer(f"c{p}seed-peer", task, host)
+        sp.transit(PeerState.RUNNING)
+        sp.finished_pieces = set(range(pieces))
+        sp.transit(PeerState.SUCCEEDED)
+        tasks.append(task)
+
+    hosts = []
+    for i in range(daemons):
+        p = i // CTRL_PEERS_PER_POD
+        t = topo(p, i % CTRL_PEERS_PER_POD)
+        host = res.store_host(HostMsg(
+            id=f"c{p}w{i % CTRL_PEERS_PER_POD}-host", ip="10.0.0.1",
+            port=1, download_port=2, topology=t))
+        fed.observe_host(host.id, t)
+        hosts.append(host)
+
+    rows: list[list] = []      # [kind, peer_id, chosen ids] -> the digest
+    peers = []
+
+    # -- cold-herd register storm: every daemon rules `find` back to
+    # back; registrant i's queue wait is the real wall serialization
+    # behind the i-1 rulings before it
+    t_storm = _time.perf_counter()
+    for i, host in enumerate(hosts):
+        p = i // CTRL_PEERS_PER_POD
+        task = tasks[p]
+        peer = res.get_or_create_peer(
+            f"c{p}w{i % CTRL_PEERS_PER_POD}-peer", task, host)
+        peer.created_at = float(i)     # deterministic preempt-victim order
+        if i % CTRL_CRITICAL_EVERY == 0:
+            peer.qos_class = "critical"
+        elif i % CTRL_BULK_EVERY == 0:
+            peer.qos_class = "bulk"
+        peers.append(peer)
+        if armed:
+            phasetimer.note_queue_wait(_time.perf_counter() - t_storm)
+        parents = sched.find_parents(peer)
+        peer.last_offer_ids = {pr.id for pr in parents}
+        task.set_parents(peer.id, [pr.id for pr in parents])
+        rows.append(["find", peer.id, [pr.id for pr in parents]])
+    register_wall_s = _time.perf_counter() - t_storm
+
+    # -- a few pod-0 hosts earn pod-wide quarantine (virtual clock), so
+    # the refresh storm exercises the `quarantined` exclusion path
+    now_ref[0] = 1000.0
+    for host in hosts[:CTRL_QUARANTINED]:
+        for rep in ("rep-a", "rep-b"):
+            for _ in range(2):
+                registry.record_corrupt(host.id, task_id=tasks[0].id,
+                                        reporter=rep)
+
+    # -- steady state: the fleet reports progress, then re-rules
+    for i, peer in enumerate(peers):
+        peer.finished_pieces = set(range((i * 7) % pieces))
+    t1 = _time.perf_counter()
+    for peer in peers:
+        parents = sched.refresh_parents(peer)
+        peer.last_offer_ids = {pr.id for pr in parents}
+        peer.task.set_parents(peer.id, [pr.id for pr in parents])
+        rows.append(["refresh", peer.id, [pr.id for pr in parents]])
+    refresh_wall_s = _time.perf_counter() - t1
+
+    t2 = _time.perf_counter()
+    for peer in peers:
+        if peer.qos_class != "critical":
+            continue
+        victim = sched.preempt_for(peer)
+        rows.append(["preempt", peer.id,
+                     [victim.id] if victim is not None else []])
+    requested = [f"layer-{j:02d}" for j in range(CTRL_SHARDS)]
+    for peer in peers[:CTRL_SHARD_RULINGS]:
+        assigned = sched.shard_assignment(peer, requested)
+        rows.append(["shard", peer.id, list(assigned or [])])
+    tail_wall_s = _time.perf_counter() - t2
+
+    wall_s = register_wall_s + refresh_wall_s + tail_wall_s
+    snap = phasetimer.snapshot() if armed else None
+    obs = CtrlObservatory(resource=res, ledger=ledger, federation=fed,
+                          quarantine=registry, sharded=affinity, ttl_s=0.0)
+    state = obs.state_bytes()
+    phasetimer.reset()
+    digest = hashlib.sha256(
+        json.dumps(rows, sort_keys=True).encode()).hexdigest()
+    n_rulings = len(rows)
+    out = {
+        "daemons": daemons,
+        "pods": pods,
+        "pieces": pieces,
+        "armed": armed,
+        "rulings": n_rulings,
+        "rulings_per_sec": round(n_rulings / max(wall_s, 1e-9), 1),
+        "wall_ms": {
+            "register_storm": round(register_wall_s * 1000, 3),
+            "refresh_storm": round(refresh_wall_s * 1000, 3),
+            "preempt_and_shard": round(tail_wall_s * 1000, 3),
+            "total": round(wall_s * 1000, 3),
+        },
+        "state_bytes": state,
+        "ruling_digest": digest,
+    }
+    if snap is not None:
+        out["profile"] = {
+            "rulings": snap["rulings"],
+            "phases": snap["phases"],
+            "compute_ms": snap["compute_ms"],
+            "unattributed_ms": snap["unattributed_ms"],
+            "queue_wait_ms": snap["queue_wait_ms"],
+        }
+    return out
+
+
+def _ctrl_overhead_ns() -> dict:
+    """ns per phase() call, disarmed vs armed — the disarmed number is
+    the tax every ruling pays for carrying the profiler (documented in
+    docs/OBSERVABILITY.md; gated as near-zero in tests/test_phasetimer)."""
+    import time as _time
+    from ..common import phasetimer
+
+    phasetimer.reset()
+    n = 200_000
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        with phasetimer.phase("filter"):
+            pass
+    disarmed = (_time.perf_counter() - t0) / n * 1e9
+    phasetimer.arm()
+    n2 = 20_000
+    t0 = _time.perf_counter()
+    for _ in range(n2):
+        with phasetimer.phase("filter"):
+            pass
+    armed = (_time.perf_counter() - t0) / n2 * 1e9
+    phasetimer.reset()
+    return {"disarmed_ns_per_call": round(disarmed, 1),
+            "armed_ns_per_call": round(armed, 1)}
+
+
+def _run_pr16(args) -> dict:
+    """The PR-16 trajectory point: control-plane observatory. Gates:
+    the baseline data-plane sim re-run with the profiler ARMED keeps a
+    ``schedule_digest`` byte-identical to BENCH_pr3 (the profiler never
+    perturbs a ruling), the fleet-64 ctrl storm's ``ruling_digest`` is
+    armed==disarmed AND stable across runs (the tier-1 smoke gate), and
+    the disarmed overhead is measured. Full mode adds the 1k/5k/10k
+    fleet sweep: rulings/sec, per-phase p50/p99, queue-wait growth, and
+    bytes-of-state per peer at each size."""
+    from ..common import phasetimer
+
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    phasetimer.reset()
+    phasetimer.arm()
+    prof = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    phasetimer.reset()
+    profiler_pure = base["schedule_digest"] == prof["schedule_digest"]
+
+    # fleet-64 always runs, twice: the disarmed twin proves the armed
+    # profiler never changed a ruling, and its digest is the committed
+    # value tier-1 `--ctrl --smoke` re-derives and compares. Pieces are
+    # PINNED (not --pieces/--smoke-scaled): the smoke digest must be
+    # derived from the exact parameters the committed artifact used.
+    ctrl_pieces = CTRL_PIECES
+    disarmed64 = run_ctrl_bench(seed=args.seed, daemons=CTRL_SMOKE_FLEET,
+                                pieces=ctrl_pieces, armed=False)
+    scenarios = {str(CTRL_SMOKE_FLEET): run_ctrl_bench(
+        seed=args.seed, daemons=CTRL_SMOKE_FLEET, pieces=ctrl_pieces,
+        armed=True)}
+    if not args.smoke:
+        for n in CTRL_FLEETS:
+            scenarios[str(n)] = run_ctrl_bench(
+                seed=args.seed, daemons=n, pieces=ctrl_pieces, armed=True)
+    ctrl_pure = (disarmed64["ruling_digest"]
+                 == scenarios[str(CTRL_SMOKE_FLEET)]["ruling_digest"])
+    keys = sorted(scenarios, key=int)
+    return {
+        "bench": "dfbench-ctrl",
+        "seed": args.seed,
+        "fleets": [int(k) for k in keys],
+        "pieces": ctrl_pieces,
+        # armed baseline == the committed BENCH_pr3 digest (tier-1 gate)
+        "schedule_digest": base["schedule_digest"],
+        "profiler_pure": profiler_pure,
+        "ctrl_profiler_pure": ctrl_pure,
+        "ruling_digests": {k: scenarios[k]["ruling_digest"] for k in keys},
+        "scenarios": scenarios,
+        "rulings_per_sec": {k: scenarios[k]["rulings_per_sec"]
+                            for k in keys},
+        "phase_p50_ms": {k: {ph: r["p50_ms"] for ph, r in
+                             scenarios[k]["profile"]["phases"].items()}
+                         for k in keys},
+        "phase_p99_ms": {k: {ph: r["p99_ms"] for ph, r in
+                             scenarios[k]["profile"]["phases"].items()}
+                         for k in keys},
+        "state_bytes_per_peer": {k: scenarios[k]["state_bytes"]["per_peer"]
+                                 for k in keys},
+        "overhead": _ctrl_overhead_ns(),
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -2724,6 +3017,18 @@ def build_parser() -> argparse.ArgumentParser:
                    "time-to-ready-arrays makespan vs fleet size, "
                    "per-shard p99, tree/ICI bytes, and the "
                    "sharded-disabled digest gate against BENCH_pr3")
+    p.add_argument("--ctrl", action="store_true",
+                   help="drive the REAL control-plane stack (Scheduling "
+                   "+ Resource + DecisionLedger + PodFederation + "
+                   "QuarantineRegistry + ShardAffinity) through a "
+                   "cold-herd register storm and a steady-state refresh "
+                   "storm at 1k/5k/10k virtual daemons with the ruling "
+                   "profiler armed, and write the PR-16 trajectory "
+                   "point (BENCH_pr16.json): rulings/sec, per-phase "
+                   "p50/p99 ruling latency, queue-wait growth, bytes of "
+                   "scheduler state per peer, the profiler-purity "
+                   "digest gate against BENCH_pr3, and the disarmed-"
+                   "overhead microbenchmark")
     p.add_argument("--pr8", action="store_true",
                    help="replay the baseline run's decision-ledger rows "
                    "through every offline evaluator (default/nt/ml) and "
@@ -2768,7 +3073,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr14:
+        if args.ctrl:
+            args.out = "BENCH_pr16.json"
+        elif args.pr14:
             args.out = "BENCH_pr14.json"
         elif args.pr13:
             args.out = "BENCH_pr13.json"
@@ -2794,7 +3101,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr14:
+    if args.ctrl:
+        result = _run_pr16(args)
+    elif args.pr14:
         result = _run_pr14(args)
     elif args.pr13:
         result = _run_pr13(args)
@@ -2823,7 +3132,20 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr14:
+        if args.ctrl:
+            rps = result["rulings_per_sec"]
+            big = str(result["fleets"][-1])
+            p99 = result["phase_p99_ms"][big]
+            worst = max(p99, key=p99.get) if p99 else ""
+            print(f"dfbench: wrote {args.out} (ctrl: "
+                  f"{rps[big]}/s rulings @ {big} daemons, worst phase "
+                  f"{worst} p99={p99.get(worst, 0.0)}ms, state "
+                  f"{result['state_bytes_per_peer'][big]:.0f} B/peer, "
+                  f"profiler pure={result['profiler_pure']}"
+                  f"/{result['ctrl_profiler_pure']}, disarmed "
+                  f"{result['overhead']['disarmed_ns_per_call']}ns/call, "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.pr14:
             mk = result["makespan_ms"]
             big = result["sizes"][-1]
             print(f"dfbench: wrote {args.out} (rollout makespan@{big} "

@@ -62,6 +62,12 @@ def fetch_cluster(scheduler: str, timeout_s: float = 10.0) -> dict:
     return _get(f"http://{scheduler}/debug/cluster", timeout_s)
 
 
+def fetch_ctrl(scheduler: str, timeout_s: float = 10.0,
+               arm: str = "") -> dict:
+    q = f"?arm={arm}" if arm else ""
+    return _get(f"http://{scheduler}/debug/ctrl{q}", timeout_s)
+
+
 def render_waterfall(summary: dict, *, width: int = 64) -> str:
     """ASCII waterfall: one row per piece, bars proportional to wall time,
     segmented by stage. Pure function over the /debug/flight summary (or a
@@ -232,6 +238,59 @@ def render_cluster(snapshot: dict) -> str:
     return "\n".join(out)
 
 
+def render_ctrl(snap: dict) -> str:
+    """Tabular view of the scheduler's control-plane observatory
+    (/debug/ctrl): rulings/sec, per-kind and per-phase latency, the
+    queue-wait vs compute split, and bytes-of-state per component. Pure
+    function over the snapshot so it is testable offline."""
+    rul = snap.get("rulings") or {}
+    out = [f"ctrl: armed={snap.get('armed')}  "
+           f"rulings={rul.get('total', 0)}  "
+           f"{rul.get('per_sec_busy', 0.0)}/s busy  "
+           f"{rul.get('per_sec_60s', 0.0)}/s last-60s  "
+           f"compute={snap.get('compute_ms', 0.0)}ms  "
+           f"unattributed={snap.get('unattributed_ms', 0.0)}ms"]
+    qw = snap.get("queue_wait_ms")
+    if qw:
+        out.append(f"queue-wait: n={qw['count']} mean={qw['mean_ms']}ms "
+                   f"p50={qw['p50_ms']}ms p99={qw['p99_ms']}ms "
+                   f"max={qw['max_ms']}ms")
+    def _hdr(col: str) -> str:
+        return (f"{col:<12} {'count':>8} {'self-ms':>10} {'mean-ms':>9} "
+                f"{'p50-ms':>9} {'p99-ms':>9} {'max-ms':>9}")
+
+    kinds = rul.get("by_kind") or {}
+    if kinds:
+        out.append(_hdr("ruling"))
+        for kind, r in sorted(kinds.items()):
+            out.append(f"{kind:<12} {r['count']:>8} {r['self_ms']:>10} "
+                       f"{r['mean_ms']:>9} {r['p50_ms']:>9} "
+                       f"{r['p99_ms']:>9} {r['max_ms']:>9}")
+    phases = snap.get("phases") or {}
+    if phases:
+        out.append(_hdr("phase"))
+        for name, r in sorted(phases.items()):
+            out.append(f"{name:<12} {r['count']:>8} {r['self_ms']:>10} "
+                       f"{r['mean_ms']:>9} {r['p50_ms']:>9} "
+                       f"{r['p99_ms']:>9} {r['max_ms']:>9}")
+    if not kinds and not phases:
+        out.append("(no rulings profiled — arm with "
+                   "GET /debug/ctrl?arm=1 or dfdiag --ctrl --arm on)")
+    state = snap.get("state_bytes") or {}
+    if state:
+        out.append(
+            f"state: {_fmt_bytes(state.get('total', 0))} across "
+            f"{state.get('peers', 0)} peers "
+            f"({_fmt_bytes(state.get('per_peer', 0))}/peer; "
+            f"staleness {snap.get('state_staleness_s', 0.0)}s of "
+            f"{snap.get('state_ttl_s', 0.0)}s ttl)")
+        comps = state.get("components") or {}
+        out.append("  " + "  ".join(
+            f"{name}={_fmt_bytes(b)}"
+            for name, b in sorted(comps.items())))
+    return "\n".join(out)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfdiag", description="flight-recorder waterfall + verdict")
@@ -247,6 +306,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="list recorded flights on the daemon")
     p.add_argument("--cluster", action="store_true",
                    help="show the scheduler's cluster health view")
+    p.add_argument("--ctrl", action="store_true",
+                   help="show the scheduler's control-plane observatory "
+                   "(/debug/ctrl on --scheduler): rulings/sec, per-phase "
+                   "ruling latency (p50/p99), queue-wait vs compute "
+                   "split, and bytes of scheduler state per component")
+    p.add_argument("--arm", default="", choices=["", "on", "off"],
+                   help="with --ctrl: arm/disarm the ruling profiler "
+                   "live before reading the snapshot")
     p.add_argument("--decisions", action="store_true",
                    help="show the scheduler's live decision ledger "
                    "(/debug/decisions on --scheduler): recent rulings "
@@ -322,6 +389,16 @@ def main(argv: list[str] | None = None) -> int:
                 print(render_decision(d))
                 print()
             print(f"ledger: {json.dumps(snap.get('stats') or {})}")
+            return EXIT_OK
+        if args.ctrl:
+            if not args.scheduler:
+                print("dfdiag: --ctrl needs --scheduler host:port "
+                      "(the scheduler's --debug-port)", file=sys.stderr)
+                return EXIT_USAGE
+            arm = {"on": "1", "off": "0"}.get(args.arm, "")
+            snap = fetch_ctrl(args.scheduler, args.timeout, arm=arm)
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_ctrl(snap))
             return EXIT_OK
         if args.cluster:
             if not args.scheduler:

@@ -242,6 +242,111 @@ class DecisionVocabulary(Rule):
                     f"(line {declared_line})")
 
 
+_PHASE_FIRE_RE = re.compile(
+    r"phasetimer\.(?:phase|record)\(\s*[\"']([a-z-]+)[\"']")
+_RULING_FIRE_RE = re.compile(
+    r"phasetimer\.ruling\(\s*[\"']([a-z-]+)[\"']")
+
+
+@register
+class PhaseVocabulary(Rule):
+    """DF006 (ruling profiler): the control-plane phase vocabulary must
+    stay closed and documented — the ``PHASES``/``RULING_KINDS``
+    registries in ``common/phasetimer.py``, the literals at every
+    ``phasetimer.phase(…)``/``record(…)``/``ruling(…)`` call site across
+    the package (which become ``df_sched_ruling_seconds``/
+    ``df_ctrl_ruling_seconds`` labels and /debug/ctrl rows), and the
+    backticked vocabulary in docs/OBSERVABILITY.md must agree. An
+    unregistered literal raises ValueError the first armed ruling (the
+    registry validates), a registered-but-never-fired phase is dead
+    vocabulary, and an undocumented one is a /debug/ctrl surface
+    operators cannot read. Ruling kinds are swept one-sided (literal ->
+    registered + documented): the main ``_decide`` path passes its kind
+    as a variable, so absence of a kind literal proves nothing.
+    """
+
+    code = "DF006"
+    name = "phase-vocabulary"
+
+    def _declared(self, ctx: ModuleCtx,
+                  registry: str) -> tuple[dict[str, int], int]:
+        out: dict[str, int] = {}
+        reg_line = 1
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == registry
+                            for t in node.targets)):
+                continue
+            reg_line = node.lineno
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) \
+                        and isinstance(const.value, str):
+                    out[const.value] = const.lineno
+        return out, reg_line
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if not ctx.rel.replace(os.sep, "/").endswith(
+                "common/phasetimer.py"):
+            return
+        phases, phases_line = self._declared(ctx, "PHASES")
+        kinds, kinds_line = self._declared(ctx, "RULING_KINDS")
+        if not phases and not kinds:
+            return
+        # package-wide call-site sweep, rooted at the package holding
+        # this file (…/common/phasetimer.py -> …/); dflint_rules holds
+        # these regexes themselves, not call sites
+        pkg_root = os.path.dirname(os.path.dirname(ctx.path))
+        fired_phases: set[str] = set()
+        fired_kinds: set[str] = set()
+        for dirpath, dirs, files in os.walk(pkg_root):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", "dflint_rules")]
+            for name in files:
+                if not name.endswith(".py") or name == "phasetimer.py":
+                    continue
+                try:
+                    with open(os.path.join(dirpath, name),
+                              encoding="utf-8") as f:
+                        src = f.read()
+                except OSError:
+                    continue
+                fired_phases.update(_PHASE_FIRE_RE.findall(src))
+                fired_kinds.update(_RULING_FIRE_RE.findall(src))
+        obs = _ticked(ctx, "OBSERVABILITY.md")
+        for ph, line in sorted(phases.items()):
+            if ph not in fired_phases:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"phase {ph!r} is registered in PHASES but no "
+                    f"phasetimer.phase/record call fires it — dead "
+                    f"vocabulary")
+            if ph not in obs:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"phase {ph!r} is not documented in "
+                    f"docs/OBSERVABILITY.md — the "
+                    f"df_sched_ruling_seconds label and /debug/ctrl "
+                    f"rows are unreadable to operators")
+        for kind, line in sorted(kinds.items()):
+            if kind not in obs:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"ruling kind {kind!r} is not documented in "
+                    f"docs/OBSERVABILITY.md")
+        for ph in sorted(fired_phases - set(phases)):
+            yield Finding(
+                self.code, ctx.rel, phases_line, 0,
+                f"phasetimer.phase({ph!r}) appears in the package but "
+                f"{ph!r} is not in the PHASES registry — the first "
+                f"armed ruling raises ValueError")
+        for kind in sorted(fired_kinds - set(kinds)):
+            yield Finding(
+                self.code, ctx.rel, kinds_line, 0,
+                f"phasetimer.ruling({kind!r}) appears in the package "
+                f"but {kind!r} is not in the RULING_KINDS registry — "
+                f"the first armed ruling raises ValueError")
+
+
 _CLASS_USE_RES = (
     # qos_class == / != / = "x"  (comparisons, assignments, kwargs)
     re.compile(r"qos_class\s*(?:==|!=|=)\s*[\"']([a-z_]+)[\"']"),

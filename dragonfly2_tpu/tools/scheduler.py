@@ -46,11 +46,18 @@ async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
     await sched.start()
     from ..common.debug_http import maybe_start_debug
     from ..scheduler.cluster_view import add_cluster_routes
+    from ..scheduler.ctrl_debug import CtrlObservatory, add_ctrl_routes
     from ..scheduler.decision_ledger import add_decision_routes
 
     def _extra_routes(router) -> None:
         add_cluster_routes(router, sched.service.cluster)
         add_decision_routes(router, sched.ledger)
+        add_ctrl_routes(router, CtrlObservatory(
+            resource=sched.service.resource,
+            ledger=sched.ledger,
+            federation=sched.service.federation,
+            quarantine=sched.service.quarantine,
+            sharded=sched.service.scheduling.sharded))
 
     debug_runner = await maybe_start_debug(debug_port,
                                            extra_routes=_extra_routes)

@@ -15,7 +15,9 @@ Usage:
 docs/RESILIENCE.md) for the duration of the run and disarms it after;
 ``--pod-report host1:port,host2:port`` attaches the podscope pod summary
 (docs/OBSERVABILITY.md) so the report says what the POD did under load,
-not just what this client saw.
+not just what this client saw; ``--ctrl-report sched_host:debug_port``
+likewise attaches the scheduler's /debug/ctrl observatory snapshot
+(rulings/sec, worst ruling phase, bytes of scheduler state).
 With ``--chaos-target`` the script is POSTed to that daemon's
 ``/debug/faults`` surface (requires ``upload.debug_endpoints: true``), so
 a LIVE daemon takes the faults while this tool measures what its clients
@@ -347,6 +349,13 @@ def main(argv: list[str] | None = None) -> int:
                         "after the run, attach its /debug/pex snapshot "
                         "(gossip membership + swarm index) to the report — "
                         "pairs with --chaos 'pex.gossip=...' runs")
+    p.add_argument("--ctrl-report", default="",
+                   help="scheduler debug host:port (the --debug-port); "
+                        "after the run, attach its /debug/ctrl snapshot "
+                        "(rulings/sec, worst ruling phase, bytes of "
+                        "scheduler state) so the report says what the "
+                        "control plane spent, not just what this "
+                        "client saw")
     p.add_argument("--pod-report", default="",
                    help="comma-separated daemon upload host:port set; "
                         "after the run, attach the podscope pod summary "
@@ -363,6 +372,8 @@ def main(argv: list[str] | None = None) -> int:
             manifest_path=args.shard_manifest))
         if args.pod_report:
             result["podscope"] = _pod_report(args.pod_report)
+        if args.ctrl_report:
+            result["ctrl"] = _ctrl_report(args.ctrl_report)
         print(json.dumps(result))
         return 1 if result["shards_ready"] == 0 else 0
     result = asyncio.run(_run_with_chaos(args))
@@ -372,6 +383,8 @@ def main(argv: list[str] | None = None) -> int:
         result["pex"] = asyncio.run(_fetch_pex(args.pex_dump.rstrip("/")))
     if args.pod_report:
         result["podscope"] = _pod_report(args.pod_report)
+    if args.ctrl_report:
+        result["ctrl"] = _ctrl_report(args.ctrl_report)
     if args.byzantine:
         result["byzantine"] = {
             "pct": int(args.byzantine),
@@ -416,6 +429,33 @@ def _verdict_report(pod: str) -> dict:
                         if row.get("shunned")],
         }
     return out
+
+
+def _ctrl_report(scheduler: str) -> dict:
+    """Control-plane snapshot for the stress report (dfdiag --ctrl's
+    /debug/ctrl, compacted): rulings/sec, the worst phase by total self
+    time, and state bytes — so a stress/chaos report says what the
+    SCHEDULER spent on its rulings, not just what this client saw.
+    Diagnostics must not fail a run."""
+    try:
+        from .dfdiag import fetch_ctrl
+        snap = fetch_ctrl(scheduler, timeout_s=5.0)
+        phases = snap.get("phases") or {}
+        worst = (max(phases, key=lambda n: phases[n]["self_ms"])
+                 if phases else "")
+        rul = snap.get("rulings") or {}
+        return {
+            "armed": snap.get("armed"),
+            "rulings": rul.get("total", 0),
+            "rulings_per_sec_busy": rul.get("per_sec_busy", 0.0),
+            "rulings_per_sec_60s": rul.get("per_sec_60s", 0.0),
+            "worst_phase": worst,
+            "worst_phase_ms": (phases[worst]["self_ms"] if worst else 0.0),
+            "queue_wait_ms": snap.get("queue_wait_ms"),
+            "state_bytes": snap.get("state_bytes"),
+        }
+    except Exception as exc:  # noqa: BLE001 - diagnostics must not fail a run
+        return {"error": str(exc)}
 
 
 def _pod_report(pod: str) -> dict:

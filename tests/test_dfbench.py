@@ -884,5 +884,83 @@ class TestCLI:
         assert ratios["scheds_down_pex"] > 0.9
 
 
+class TestPr16Ctrl:
+    """PR-16 point: the control-plane observatory. The ctrl storm must
+    be deterministic (one ruling digest per seed, byte-identical across
+    processes), the profiler must be pure observation (armed digest ==
+    disarmed digest, for both the plain sim and the ctrl storms), and
+    the committed BENCH_pr16.json must carry the BENCH_pr3 schedule
+    digest with every acceptance flag stamped true."""
+
+    SHAPE = dict(seed=7, daemons=64, pieces=32)
+
+    def test_ctrl_bench_deterministic(self):
+        from dragonfly2_tpu.tools.dfbench import run_ctrl_bench
+        a = run_ctrl_bench(**self.SHAPE, armed=True)
+        b = run_ctrl_bench(**self.SHAPE, armed=True)
+        assert a["ruling_digest"] == b["ruling_digest"]
+        c = run_ctrl_bench(seed=11, daemons=64, pieces=32, armed=True)
+        assert c["ruling_digest"] != a["ruling_digest"]
+
+    def test_profiler_is_pure_observation(self):
+        from dragonfly2_tpu.tools.dfbench import run_ctrl_bench
+        armed = run_ctrl_bench(**self.SHAPE, armed=True)
+        disarmed = run_ctrl_bench(**self.SHAPE, armed=False)
+        assert armed["ruling_digest"] == disarmed["ruling_digest"]
+        # armed run actually profiled: every kind and every phase fired
+        prof = armed["profile"]
+        assert set(prof["rulings"]["by_kind"]) == {
+            "find", "refresh", "preempt", "shard"}
+        assert set(prof["phases"]) == {
+            "filter", "dag-walk", "exclusion", "score", "relay", "emit"}
+        assert prof["queue_wait_ms"]["count"] == 64
+        # disarmed run carried no profile at all
+        assert "profile" not in disarmed
+        # state accounting saw the fleet (64 registrants + 1 seed/pod)
+        assert armed["state_bytes"]["peers"] == 65
+        assert armed["state_bytes"]["per_peer"] > 0
+
+    def test_ctrl_smoke_stdout_only_and_committed_digest(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--ctrl", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-ctrl"
+        assert r["profiler_pure"] is True
+        assert r["ctrl_profiler_pure"] is True
+        assert r["fleets"] == [64]
+        assert not list(tmp_path.iterdir())      # stdout only
+        # the cross-process gate: the smoke re-derivation of the fleet-64
+        # storm matches the committed artifact byte-for-byte
+        committed = json.loads(
+            open(os.path.join(REPO, "BENCH_pr16.json")).read())
+        assert r["ruling_digests"]["64"] == committed["ruling_digests"]["64"]
+
+    def test_pr16_committed_matches_baselines(self):
+        """The committed trajectory gate: BENCH_pr16's armed plain-sim
+        digest is byte-identical to BENCH_pr3 (the profiler perturbed
+        nothing), the fleet sweep reached 10k daemons, and the disarmed
+        overhead stayed in the leave-it-in-the-hot-path regime."""
+        r = json.loads(open(os.path.join(REPO, "BENCH_pr16.json")).read())
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["profiler_pure"] is True
+        assert r["ctrl_profiler_pure"] is True
+        assert r["fleets"] == [64, 1000, 5000, 10000]
+        for k in ("64", "1000", "5000", "10000"):
+            assert len(r["ruling_digests"][k]) == 64
+            assert r["rulings_per_sec"][k] > 0
+            assert r["state_bytes_per_peer"][k] > 0
+        # every phase made it into the biggest fleet's latency columns
+        assert set(r["phase_p99_ms"]["10000"]) == {
+            "filter", "dag-walk", "exclusion", "score", "relay", "emit"}
+        # disarmed call sites cost well under a microsecond
+        assert r["overhead"]["disarmed_ns_per_call"] < 2000
+        assert r["overhead"]["armed_ns_per_call"] > 0
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-v"])

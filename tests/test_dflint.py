@@ -1187,6 +1187,70 @@ class TestDF006Faultgate:
         assert len(fs) == 3
 
 
+class TestDF006PhaseVocabulary:
+    def _tree(self, tmp_path, *, phases, kinds, fired_phases,
+              fired_kinds, doc):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text(doc)
+        pkg = tmp_path / "pkg"
+        (pkg / "common").mkdir(parents=True, exist_ok=True)
+        timer = pkg / "common" / "phasetimer.py"
+        timer.write_text(
+            "PHASES = (%s)\nRULING_KINDS = (%s)\n" % (
+                ", ".join(f'"{p}"' for p in phases) + ",",
+                ", ".join(f'"{k}"' for k in kinds) + ","))
+        lines = [f'    with phasetimer.phase("{p}"):\n        pass'
+                 for p in fired_phases]
+        lines += [f'    with phasetimer.ruling("{k}"):\n        pass'
+                  for k in fired_kinds]
+        (pkg / "caller.py").write_text(
+            "from .common import phasetimer\n\n\ndef go():\n"
+            + ("\n".join(lines) or "    pass") + "\n")
+        return timer
+
+    def test_in_sync_is_clean(self, tmp_path):
+        timer = self._tree(tmp_path, phases=["filter"], kinds=["find"],
+                           fired_phases=["filter"], fired_kinds=["find"],
+                           doc="`filter` and `find`")
+        assert codes(lint_file(str(timer), repo_root=str(tmp_path))) == []
+
+    def test_dead_undocumented_and_unregistered_flag(self, tmp_path):
+        timer = self._tree(
+            tmp_path, phases=["filter", "dead-phase"], kinds=["find"],
+            fired_phases=["filter", "ghost-phase"],
+            fired_kinds=["find", "decree"],
+            doc="`filter` and `find`")
+        fs = active(lint_file(str(timer), repo_root=str(tmp_path)))
+        msgs = " ".join(f.message for f in fs)
+        assert "dead vocabulary" in msgs            # dead-phase never fired
+        assert "not documented" in msgs             # dead-phase undocumented
+        assert "not in the PHASES registry" in msgs      # ghost-phase
+        assert "not in the RULING_KINDS registry" in msgs  # decree
+        assert len(fs) == 4
+
+    def test_undocumented_kind_flags(self, tmp_path):
+        timer = self._tree(tmp_path, phases=["filter"],
+                           kinds=["find", "preempt"],
+                           fired_phases=["filter"],
+                           fired_kinds=["find", "preempt"],
+                           doc="`filter` and `find`")
+        fs = active(lint_file(str(timer), repo_root=str(tmp_path)))
+        assert len(fs) == 1
+        assert "ruling kind 'preempt' is not documented" in fs[0].message
+
+    def test_record_literal_is_swept(self, tmp_path):
+        timer = self._tree(tmp_path, phases=["filter"], kinds=["find"],
+                           fired_phases=["filter"], fired_kinds=["find"],
+                           doc="`filter` `find`")
+        caller = tmp_path / "pkg" / "caller.py"
+        caller.write_text(caller.read_text()
+                          + '\n\ndef hot():\n'
+                            '    phasetimer.record("sneaky", 0.1)\n')
+        fs = active(lint_file(str(timer), repo_root=str(tmp_path)))
+        assert len(fs) == 1
+        assert "'sneaky' is not in the PHASES registry" in fs[0].message
+
+
 # ---------------------------------------------------------------------------
 # CLI: --json, --changed, exit codes
 # ---------------------------------------------------------------------------

@@ -191,6 +191,33 @@ class TestClusterView:
                                               cost_ms=900))
         assert view.stragglers() == []
 
+    def test_snapshot_ttl_cache_and_staleness(self, monkeypatch):
+        import time as _time
+
+        from dragonfly2_tpu.scheduler.cluster_view import ClusterView
+        from dragonfly2_tpu.scheduler.resource import Resource, Task
+        clk = [100.0]
+        monkeypatch.setattr(_time, "monotonic", lambda: clk[0])
+        res = Resource()
+        task = Task("t" * 64, "u")
+        child = self._peer(res, task, "c", "hc")
+        view = ClusterView(snapshot_ttl_s=1.0)
+        view.on_piece(child, self._result(task.id, "c", ""))
+        s1 = view.snapshot()
+        assert s1["staleness_s"] == 0.0
+        assert s1["snapshot_ttl_s"] == 1.0
+        # a report landing inside the TTL is invisible until expiry, and
+        # the payload admits how old the view is
+        view.on_piece(child, self._result(task.id, "c", ""))
+        clk[0] = 100.5
+        s2 = view.snapshot()
+        assert s2["hosts"]["hc"]["pieces_down"] == 1   # cached vintage
+        assert s2["staleness_s"] == 0.5
+        clk[0] = 101.6
+        s3 = view.snapshot()
+        assert s3["hosts"]["hc"]["pieces_down"] == 2   # rebuilt
+        assert s3["staleness_s"] == 0.0
+
 
 class TestExpositionStrictness:
     """Registry.expose() exposition-format guarantees."""

@@ -24,10 +24,14 @@ import re
 import ssl
 import threading
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+from . import cryptoshim
+
+cryptoshim.install()   # no-op when the real wheel is importable
+
+from cryptography import x509  # noqa: E402 - shim must land first
+from cryptography.hazmat.primitives import hashes, serialization  # noqa: E402
+from cryptography.hazmat.primitives.asymmetric import ec  # noqa: E402
+from cryptography.x509.oid import NameOID  # noqa: E402
 
 log = logging.getLogger("df.proxy.certs")
 

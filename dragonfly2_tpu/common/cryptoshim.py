@@ -1,0 +1,542 @@
+"""OpenSSL-CLI-backed stand-in for the ``cryptography`` wheel.
+
+Role parity: none in the reference (Go links its crypto statically).
+The container images this repo targets carry the ``openssl`` binary but
+not the ``cryptography`` Python wheel, and installing wheels is off the
+table — so every TLS surface (proxy MITM minting, fleet cert issuance,
+the OCI mirror e2e) used to skip its tests and ship unexercised.
+
+``install()`` registers a minimal, subprocess-backed implementation of
+the exact ``cryptography`` subset this package uses (EC P-256 keys,
+X.509 build/sign/parse, PEM serialization) under the real module names
+in ``sys.modules`` — a NO-OP whenever the real wheel is importable, so
+environments that have it see zero behavior change. The certs produced
+are real certs (OpenSSL makes them); ``ssl.SSLContext`` handshakes
+against them exactly as with wheel-minted ones.
+
+Deliberate non-goals: anything the package does not call. This is not a
+general reimplementation — unknown API surface raises instead of
+guessing, so a future consumer of a missing feature fails loudly at the
+call site rather than subtly at the handshake.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import os
+import secrets
+import subprocess
+import sys
+import tempfile
+import types
+
+OPENSSL = "openssl"
+
+
+def _run(args: list[str], data: bytes | None = None) -> bytes:
+    proc = subprocess.run([OPENSSL] + args, input=data,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"openssl {' '.join(args[:3])}... failed: "
+            f"{proc.stderr.decode(errors='replace').strip()}")
+    return proc.stdout
+
+
+# -- names ---------------------------------------------------------------
+
+class _OID:
+    def __init__(self, name: str):
+        self._name = name
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<OID {self._name}>"
+
+
+class NameOID:
+    COMMON_NAME = _OID("commonName")
+
+
+class NameAttribute:
+    def __init__(self, oid, value: str):
+        self.oid = oid
+        self.value = value
+
+
+class Name:
+    """Held as an RFC2253 string (what ``openssl -nameopt RFC2253``
+    prints), which makes equality between a parsed issuer and a parsed
+    subject exact. Optionally carries a backref to the certificate PEM
+    it was read from — the builder needs the CA *certificate* to sign a
+    leaf via the CLI, and ``issuer_name(ca_cert.subject)`` is the only
+    way the package ever names a non-self issuer."""
+
+    def __init__(self, attributes=(), *, rfc2253: str = "",
+                 cert_pem: bytes = b""):
+        self._attrs = list(attributes)
+        if rfc2253:
+            self._rfc2253 = rfc2253
+        else:
+            # only CN is ever used by this package
+            self._rfc2253 = ",".join(
+                f"CN={a.value}" for a in self._attrs)
+        self._cert_pem = cert_pem
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Name) and self._rfc2253 == other._rfc2253
+
+    def __hash__(self) -> int:
+        return hash(self._rfc2253)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Name({self._rfc2253})>"
+
+    def _subj(self) -> str:
+        """openssl -subj form. CN values are the only attributes the
+        package writes; escape the two characters -subj treats
+        specially."""
+        parts = []
+        for a in self._attrs:
+            v = str(a.value).replace("\\", "\\\\").replace("/", "\\/")
+            parts.append(f"CN={v}")
+        if not parts and self._rfc2253.startswith("CN="):
+            parts = [self._rfc2253]
+        return "/" + "/".join(parts)
+
+
+# -- keys ----------------------------------------------------------------
+
+class SECP256R1:
+    name = "secp256r1"
+    _openssl = "prime256v1"
+
+
+class _Encoding:
+    PEM = "PEM"
+
+
+class _PrivateFormat:
+    PKCS8 = "PKCS8"
+
+
+class _PublicFormat:
+    SubjectPublicKeyInfo = "SubjectPublicKeyInfo"
+
+
+class NoEncryption:
+    pass
+
+
+class _ECPublicKey:
+    def __init__(self, pem: bytes):
+        self._pem = pem
+
+    def public_bytes(self, encoding, fmt) -> bytes:
+        return self._pem
+
+
+class _ECPrivateKey:
+    def __init__(self, pkcs8_pem: bytes):
+        self._pem = pkcs8_pem
+
+    def public_key(self) -> _ECPublicKey:
+        with tempfile.TemporaryDirectory(prefix="dfshim-") as d:
+            kp = os.path.join(d, "k.pem")
+            with open(kp, "wb") as f:
+                f.write(self._pem)
+            pub = _run(["pkey", "-in", kp, "-pubout"])
+        return _ECPublicKey(pub)
+
+    def private_bytes(self, encoding, fmt, encryption) -> bytes:
+        return self._pem
+
+
+def generate_private_key(curve) -> _ECPrivateKey:
+    raw = _run(["ecparam", "-name", getattr(curve, "_openssl", "prime256v1"),
+                "-genkey", "-noout"])
+    pkcs8 = _run(["pkcs8", "-topk8", "-nocrypt"], raw)
+    return _ECPrivateKey(pkcs8)
+
+
+def load_pem_private_key(data: bytes, password=None,
+                         backend=None) -> _ECPrivateKey:
+    if password is not None:
+        raise NotImplementedError("cryptoshim: encrypted keys unsupported")
+    pkcs8 = _run(["pkcs8", "-topk8", "-nocrypt"], data)
+    return _ECPrivateKey(pkcs8)
+
+
+def load_pem_public_key(data: bytes, backend=None) -> _ECPublicKey:
+    # normalize through openssl so malformed input fails HERE, not at sign
+    return _ECPublicKey(_run(["pkey", "-pubin", "-pubout"], data))
+
+
+# -- hashes --------------------------------------------------------------
+
+class SHA256:
+    name = "sha256"
+
+
+# -- x509 extensions -----------------------------------------------------
+
+class BasicConstraints:
+    def __init__(self, ca: bool, path_length: int | None):
+        self.ca = ca
+        self.path_length = path_length
+
+    def _conf(self) -> str:
+        v = f"CA:{'TRUE' if self.ca else 'FALSE'}"
+        if self.ca and self.path_length is not None:
+            v += f",pathlen:{self.path_length}"
+        return f"basicConstraints={v}"
+
+
+_KEY_USAGE_FLAGS = (
+    ("digital_signature", "digitalSignature"),
+    ("content_commitment", "nonRepudiation"),
+    ("key_encipherment", "keyEncipherment"),
+    ("data_encipherment", "dataEncipherment"),
+    ("key_agreement", "keyAgreement"),
+    ("key_cert_sign", "keyCertSign"),
+    ("crl_sign", "cRLSign"),
+    ("encipher_only", "encipherOnly"),
+    ("decipher_only", "decipherOnly"),
+)
+
+
+class KeyUsage:
+    def __init__(self, **flags: bool):
+        self._flags = flags
+
+    def _conf(self) -> str:
+        names = [ossl for attr, ossl in _KEY_USAGE_FLAGS
+                 if self._flags.get(attr)]
+        return "keyUsage=" + ",".join(names)
+
+
+class GeneralName:
+    pass
+
+
+class DNSName(GeneralName):
+    def __init__(self, value: str):
+        self.value = value
+
+    def _conf(self) -> str:
+        return f"DNS:{self.value}"
+
+
+class IPAddress(GeneralName):
+    def __init__(self, value):
+        self.value = value
+
+    def _conf(self) -> str:
+        return f"IP:{self.value}"
+
+
+class SubjectAlternativeName:
+    def __init__(self, general_names):
+        self._names = list(general_names)
+
+    def _conf(self) -> str:
+        return "subjectAltName=" + ",".join(n._conf() for n in self._names)
+
+    def get_values_for_type(self, type_) -> list:
+        return [n.value for n in self._names if isinstance(n, type_)]
+
+
+class ExtensionNotFound(Exception):
+    pass
+
+
+class _Extension:
+    def __init__(self, value):
+        self.value = value
+
+
+class _Extensions:
+    def __init__(self, cert: "Certificate"):
+        self._cert = cert
+
+    def get_extension_for_class(self, cls) -> _Extension:
+        if cls is SubjectAlternativeName:
+            return _Extension(self._cert._san())
+        raise ExtensionNotFound(
+            f"cryptoshim: only SubjectAlternativeName is parseable "
+            f"(asked for {cls.__name__})")
+
+
+def random_serial_number() -> int:
+    # the wheel's contract: positive, < 2^159
+    return secrets.randbits(158) | 1
+
+
+# -- certificates --------------------------------------------------------
+
+class Certificate:
+    def __init__(self, pem: bytes):
+        self._pem = pem
+        self._subject: Name | None = None
+        self._issuer: Name | None = None
+
+    def public_bytes(self, encoding) -> bytes:
+        return self._pem
+
+    def _parse_names(self) -> None:
+        out = _run(["x509", "-noout", "-subject", "-issuer",
+                    "-nameopt", "RFC2253"], self._pem).decode()
+        subj = issr = ""
+        for line in out.splitlines():
+            if line.startswith("subject="):
+                subj = line[len("subject="):].strip()
+            elif line.startswith("issuer="):
+                issr = line[len("issuer="):].strip()
+        self._subject = Name(rfc2253=subj, cert_pem=self._pem)
+        self._issuer = Name(rfc2253=issr)
+
+    @property
+    def subject(self) -> Name:
+        if self._subject is None:
+            self._parse_names()
+        return self._subject
+
+    @property
+    def issuer(self) -> Name:
+        if self._issuer is None:
+            self._parse_names()
+        return self._issuer
+
+    @property
+    def extensions(self) -> _Extensions:
+        return _Extensions(self)
+
+    def _san(self) -> SubjectAlternativeName:
+        out = _run(["x509", "-noout", "-ext", "subjectAltName"],
+                   self._pem).decode()
+        names: list[GeneralName] = []
+        for line in out.splitlines():
+            line = line.strip()
+            if ":" not in line or line.endswith(":"):
+                continue
+            for part in line.split(","):
+                part = part.strip()
+                if part.startswith("DNS:"):
+                    names.append(DNSName(part[4:]))
+                elif part.startswith("IP Address:"):
+                    names.append(IPAddress(
+                        ipaddress.ip_address(part[len("IP Address:"):])))
+        if not names:
+            raise ExtensionNotFound("no subjectAltName")
+        return SubjectAlternativeName(names)
+
+
+def load_pem_x509_certificate(data: bytes, backend=None) -> Certificate:
+    # round-trip through openssl: verifies the PEM parses AND normalizes
+    # trailing garbage away (the wheel is equally strict)
+    return Certificate(_run(["x509"], data))
+
+
+class CertificateBuilder:
+    """Collects the same chained state as the wheel's builder; ``sign``
+    drives the OpenSSL CLI. Self-signed when the builder's public key
+    matches the signing key; otherwise the issuer Name must have been
+    read off a Certificate (it carries the CA PEM backref) — which is
+    the only non-self pattern this package uses."""
+
+    def __init__(self):
+        self._subject: Name | None = None
+        self._issuer: Name | None = None
+        self._pub: _ECPublicKey | None = None
+        self._serial: int | None = None
+        self._not_before = None
+        self._not_after = None
+        self._extensions: list = []
+
+    def subject_name(self, name: Name) -> "CertificateBuilder":
+        self._subject = name
+        return self
+
+    def issuer_name(self, name: Name) -> "CertificateBuilder":
+        self._issuer = name
+        return self
+
+    def public_key(self, key) -> "CertificateBuilder":
+        self._pub = key if isinstance(key, _ECPublicKey) \
+            else _ECPublicKey(key.public_bytes(_Encoding.PEM,
+                                               _PublicFormat
+                                               .SubjectPublicKeyInfo))
+        return self
+
+    def serial_number(self, serial: int) -> "CertificateBuilder":
+        self._serial = serial
+        return self
+
+    def not_valid_before(self, dt) -> "CertificateBuilder":
+        self._not_before = dt
+        return self
+
+    def not_valid_after(self, dt) -> "CertificateBuilder":
+        self._not_after = dt
+        return self
+
+    def add_extension(self, ext, critical: bool) -> "CertificateBuilder":
+        self._extensions.append((ext, critical))
+        return self
+
+    def _days(self) -> int:
+        import datetime
+        if self._not_after is None:
+            return 1
+        now = datetime.datetime.now(datetime.timezone.utc)
+        secs = (self._not_after - now).total_seconds()
+        return max(1, int(secs // 86400) + 1)
+
+    def _ext_conf(self) -> str:
+        lines = ["[v3_shim]"]
+        for ext, critical in self._extensions:
+            conf = ext._conf()
+            if critical:
+                key, _, val = conf.partition("=")
+                conf = f"{key}=critical,{val}"
+            lines.append(conf)
+        return "\n".join(lines) + "\n"
+
+    def sign(self, private_key: _ECPrivateKey, algorithm,
+             backend=None) -> Certificate:
+        if self._subject is None or self._pub is None:
+            raise ValueError("cryptoshim: subject and public key required")
+        with tempfile.TemporaryDirectory(prefix="dfshim-") as d:
+            key_p = os.path.join(d, "sign.key")
+            pub_p = os.path.join(d, "pub.pem")
+            ext_p = os.path.join(d, "ext.cnf")
+            with open(key_p, "wb") as f:
+                f.write(private_key._pem)
+            with open(pub_p, "wb") as f:
+                f.write(self._pub._pem)
+            with open(ext_p, "w", encoding="utf-8") as f:
+                # req -x509 wants a full config; x509 -req only the section
+                f.write("[req]\ndistinguished_name=dn\nprompt=no\n[dn]\n"
+                        "CN=placeholder\n" + self._ext_conf())
+            self_signed = (self._issuer is None
+                           or self._issuer == self._subject)
+            if self_signed:
+                signer_pub = private_key.public_key()._pem
+                if signer_pub != self._pub._pem:
+                    raise NotImplementedError(
+                        "cryptoshim: self-named issuer with a foreign "
+                        "public key")
+                pem = _run(["req", "-new", "-x509", "-key", key_p,
+                            "-subj", self._subject._subj(),
+                            "-days", str(self._days()), "-sha256",
+                            "-config", ext_p, "-extensions", "v3_shim",
+                            "-set_serial", str(self._serial
+                                               or random_serial_number())])
+                return Certificate(pem)
+            ca_pem = getattr(self._issuer, "_cert_pem", b"")
+            if not ca_pem:
+                raise NotImplementedError(
+                    "cryptoshim: issuer Name must come from a parsed "
+                    "Certificate (ca_cert.subject) to locate the CA")
+            ca_p = os.path.join(d, "ca.pem")
+            with open(ca_p, "wb") as f:
+                f.write(ca_pem)
+            # CSR exists only to carry the subject; -force_pubkey swaps
+            # in the real leaf key, so the CSR's own key (the CA key,
+            # already on disk) never shows in the result
+            csr = _run(["req", "-new", "-key", key_p,
+                        "-subj", self._subject._subj()])
+            pem = _run(["x509", "-req", "-CA", ca_p, "-CAkey", key_p,
+                        "-set_serial", str(self._serial
+                                           or random_serial_number()),
+                        "-days", str(self._days()), "-sha256",
+                        "-extfile", ext_p, "-extensions", "v3_shim",
+                        "-force_pubkey", pub_p], csr)
+            return Certificate(pem)
+
+
+# -- module assembly -----------------------------------------------------
+
+def _available() -> bool:
+    """Is the CLI there? Cached: one probe per process."""
+    global _PROBE
+    if _PROBE is None:
+        try:
+            _run(["version"])
+            _PROBE = True
+        except (OSError, RuntimeError):
+            _PROBE = False
+    return _PROBE
+
+
+_PROBE: bool | None = None
+
+
+def install() -> bool:
+    """Register the shim under the ``cryptography`` module names.
+
+    No-op (returns True) when the real wheel imports; returns False when
+    neither the wheel nor the ``openssl`` binary is available — callers
+    (the TLS test prologues) turn that into a skip, which then means
+    "this machine genuinely cannot do TLS", not "a wheel is missing".
+    """
+    import importlib.util
+    if "cryptography" in sys.modules:
+        return True        # real wheel already imported, or shim installed
+    if importlib.util.find_spec("cryptography") is not None:
+        return True
+    if not _available():
+        return False
+
+    root = types.ModuleType("cryptography")
+    root.__df_shim__ = True
+
+    x509 = types.ModuleType("cryptography.x509")
+    for name in ("Name", "NameAttribute", "CertificateBuilder",
+                 "Certificate", "BasicConstraints", "KeyUsage",
+                 "GeneralName", "DNSName", "IPAddress",
+                 "SubjectAlternativeName", "ExtensionNotFound",
+                 "load_pem_x509_certificate", "random_serial_number"):
+        setattr(x509, name, globals()[name])
+    oid = types.ModuleType("cryptography.x509.oid")
+    oid.NameOID = NameOID
+    x509.oid = oid
+
+    hazmat = types.ModuleType("cryptography.hazmat")
+    primitives = types.ModuleType("cryptography.hazmat.primitives")
+    hashes_m = types.ModuleType("cryptography.hazmat.primitives.hashes")
+    hashes_m.SHA256 = SHA256
+    serialization = types.ModuleType(
+        "cryptography.hazmat.primitives.serialization")
+    serialization.Encoding = _Encoding
+    serialization.PrivateFormat = _PrivateFormat
+    serialization.PublicFormat = _PublicFormat
+    serialization.NoEncryption = NoEncryption
+    serialization.load_pem_private_key = load_pem_private_key
+    serialization.load_pem_public_key = load_pem_public_key
+    asymmetric = types.ModuleType(
+        "cryptography.hazmat.primitives.asymmetric")
+    ec_m = types.ModuleType("cryptography.hazmat.primitives.asymmetric.ec")
+    ec_m.SECP256R1 = SECP256R1
+    ec_m.generate_private_key = generate_private_key
+    ec_m.EllipticCurvePrivateKey = _ECPrivateKey
+    ec_m.EllipticCurvePublicKey = _ECPublicKey
+
+    primitives.hashes = hashes_m
+    primitives.serialization = serialization
+    primitives.asymmetric = asymmetric
+    asymmetric.ec = ec_m
+    hazmat.primitives = primitives
+    root.x509 = x509
+    root.hazmat = hazmat
+
+    sys.modules["cryptography"] = root
+    sys.modules["cryptography.x509"] = x509
+    sys.modules["cryptography.x509.oid"] = oid
+    sys.modules["cryptography.hazmat"] = hazmat
+    sys.modules["cryptography.hazmat.primitives"] = primitives
+    sys.modules["cryptography.hazmat.primitives.hashes"] = hashes_m
+    sys.modules["cryptography.hazmat.primitives.serialization"] = \
+        serialization
+    sys.modules["cryptography.hazmat.primitives.asymmetric"] = asymmetric
+    sys.modules["cryptography.hazmat.primitives.asymmetric.ec"] = ec_m
+    return True

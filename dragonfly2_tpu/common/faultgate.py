@@ -32,6 +32,14 @@ stress tool can arm with deterministic scripts:
                     immune system's chaos lever; arm with pct= to poison
                     a deterministic fraction of serves,
                     ``stress.py --byzantine``)
+    sched.snapshot.io
+                    scheduler/statestore.py persist path, keyed by the
+                    snapshot reason: torn ('corrupt' flips a byte of the
+                    serialized blob so load refuses it wholesale), ENOSPC
+                    ('error'/'fail' raise mid-persist), or a wedged disk
+                    ('delay'; 'hang' degrades to fail — the writer is
+                    sync). The store swallows every one of them: a failed
+                    snapshot is counted, never raised into a ruling path
 
 Script syntax (one clause per site, ';'-separated)::
 
@@ -86,6 +94,7 @@ SITES = frozenset({
     "pex.gossip",
     "relay.stall",
     "upload.serve",
+    "sched.snapshot.io",
 })
 
 KINDS = frozenset({"fail", "error", "delay", "hang", "corrupt"})

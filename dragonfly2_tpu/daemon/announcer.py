@@ -1,9 +1,18 @@
-"""Daemon announcer: periodic host heartbeat to the scheduler.
+"""Daemon announcer: periodic host heartbeat + recovery content replay.
 
 Role parity: reference ``client/daemon/announcer/announcer.go`` — announce
 host spec (CPU/mem/disk/net via gopsutil there; /proc + shutil here) to the
 scheduler's ``AnnounceHost`` on an interval so the evaluator's free-slot and
 load scores track reality.
+
+Beyond the reference: the announce loop is also the daemon's half of
+control-plane crash recovery (scheduler/statestore.py). Every announce
+response carries the scheduler's boot epoch; when the connector sees it
+CHANGE — or a register fails over around the ring — the loop wakes
+immediately and replays what this daemon holds (``AnnounceContent``, the
+PEX digest entry shape sealed with the PEX envelope codec), so a restarted
+brain relearns who holds what within one announce interval instead of
+ruling the herd back to origin.
 """
 
 from __future__ import annotations
@@ -13,8 +22,8 @@ import logging
 import os
 import shutil
 
-from ..idl.messages import (AnnounceHostRequest, CPUStat, DiskStat, Host,
-                            MemoryStat)
+from ..idl.messages import (AnnounceContentRequest, AnnounceHostRequest,
+                            CPUStat, DiskStat, Host, MemoryStat)
 
 log = logging.getLogger("df.flow.announcer")
 
@@ -71,16 +80,75 @@ class Announcer:
         if self._task is None:
             self._task = asyncio.get_running_loop().create_task(self._loop())
 
+    def _held_content(self) -> list[dict]:
+        """PEX digest entry shape + ``url`` (the scheduler needs it to
+        re-create the task record). A self-quarantined daemon advertises
+        NOTHING — replaying a poisoner's inventory at a freshly recovered
+        brain would be the exact re-offer the quarantine ladder exists to
+        prevent."""
+        verdicts = getattr(self.daemon, "verdicts", None)
+        if verdicts is not None and verdicts.self_quarantined:
+            return []
+        entries = []
+        for ts in self.daemon.storage_mgr.tasks():
+            md = ts.md
+            if not md.pieces and not (md.done and md.success):
+                continue
+            done = bool(md.done and md.success)
+            entry = {"task_id": md.task_id, "url": md.url,
+                     "total": md.total_piece_count,
+                     "content_length": md.content_length,
+                     "piece_size": md.piece_size, "done": done}
+            if not done:
+                entry["pieces"] = sorted(md.pieces)
+            entries.append(entry)
+        return entries
+
+    async def _announce_content(self) -> None:
+        from .pex import DIGEST_VERSION, seal
+        entries = self._held_content()
+        if not entries:
+            return
+        resp = await self.daemon.scheduler.announce_content(
+            AnnounceContentRequest(
+                host=self.host_with_stats(),
+                digest=seal({"v": DIGEST_VERSION, "tasks": entries})))
+        log.info("re-announced %d held tasks (%d adopted)", len(entries),
+                 getattr(resp, "tasks_adopted", 0))
+
     async def _loop(self) -> None:
+        # initial replay: a daemon restarting over persisted storage
+        # tells the brain what it still holds (the reverse direction of
+        # scheduler recovery — same RPC, same codec)
+        reconcile = True
         while True:
             try:
                 await self.daemon.scheduler.announce_host(AnnounceHostRequest(
                     host=self.host_with_stats(), interval_s=self.interval_s))
+                # announce_host fed the epoch watermark; a change (or a
+                # register ring failover) left reconcile_event set
+                event = getattr(self.daemon.scheduler, "reconcile_event",
+                                None)
+                if reconcile or (event is not None and event.is_set()):
+                    if event is not None:
+                        event.clear()
+                    await self._announce_content()
+                reconcile = False
             except asyncio.CancelledError:
                 raise
             except Exception as exc:  # noqa: BLE001 - scheduler may be away
                 log.debug("announce failed: %s", exc)
-            await asyncio.sleep(self.interval_s)
+            event = getattr(self.daemon.scheduler, "reconcile_event", None)
+            if event is None:
+                await asyncio.sleep(self.interval_s)
+                continue
+            # sleep the interval, but wake EARLY when the connector flags
+            # a reconcile (epoch change / ring failover): the recovered
+            # brain's first rulings are exactly when amnesia costs origin
+            try:
+                await asyncio.wait_for(event.wait(), self.interval_s)
+            except asyncio.TimeoutError:
+                pass
 
     async def stop(self) -> None:
         if self._task is not None:

@@ -9,6 +9,7 @@ scheduler client.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import socket
@@ -385,6 +386,10 @@ class Daemon:
         elif self.cfg.manager_addresses:
             await self._attach_manager()
         self.ptm.scheduler = self.scheduler
+        # S2: demotion memory survives the daemon process (next to the
+        # rest of the daemon's on-disk metadata) — covers every boot path
+        # above (configured addresses, factory, manager discovery)
+        await asyncio.to_thread(self._restore_scheduler_demotions)
         # local API over unix socket (dfget/dfcache/dfstore)
         sock = self.cfg.unix_sock or self.paths.daemon_sock()
         # dflint: disable=DF001 — stale-socket cleanup during start(), nothing is served yet
@@ -514,6 +519,8 @@ class Daemon:
                         demote_s=self.cfg.scheduler.demote_s)
                     if self.ptm is not None:
                         self.ptm.scheduler = self.scheduler
+                    await asyncio.to_thread(
+                        self._restore_scheduler_demotions)
                     await self._wire_scheduler_extras()
                     log.info("schedulers appeared: %s", addrs)
                 elif set(addrs) != set(self.scheduler.addresses):
@@ -522,6 +529,49 @@ class Daemon:
                     self.scheduler.update_addresses(addrs)
             except Exception as exc:  # noqa: BLE001 - manager flaky is fine
                 log.debug("scheduler refresh failed: %s", exc)
+
+    def _demotions_path(self) -> str:
+        return os.path.join(self.paths.data_dir, "scheduler_demotions.json")
+
+    def _restore_scheduler_demotions(self) -> None:
+        """S2: re-arm the connector's sticky demotion memory from the
+        previous process — a restarted daemon must not re-probe every
+        known-dead scheduler through the full register-timeout ladder."""
+        if self.scheduler is None or not hasattr(self.scheduler,
+                                                 "restore_demotions"):
+            return
+        try:
+            with open(self._demotions_path(), "rb") as f:
+                state = json.loads(f.read())
+        except FileNotFoundError:
+            return
+        except (OSError, ValueError) as exc:
+            log.debug("demotion state unreadable (%s); starting clean", exc)
+            return
+        self.scheduler.restore_demotions(state)
+
+    def _persist_scheduler_demotions(self) -> None:
+        """Counterpart of ``_restore_scheduler_demotions`` on the stop
+        path (tmp+fsync+rename, the TaskMetadata.save idiom). Best
+        effort: shutdown must not fail on a full disk."""
+        if self.scheduler is None or not hasattr(self.scheduler,
+                                                 "export_demotions"):
+            return
+        path = self._demotions_path()
+        tmp = path + ".tmp"
+        try:
+            payload = json.dumps(self.scheduler.export_demotions(),
+                                 sort_keys=True).encode()
+            f = open(tmp, "wb")
+            try:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            finally:
+                f.close()          # fd released even on a torn write
+            os.replace(tmp, path)
+        except OSError as exc:
+            log.debug("demotion persist failed: %s", exc)
 
     async def stop(self) -> None:
         renewal = getattr(self, "_cert_renewal", None)
@@ -563,6 +613,7 @@ class Daemon:
         if getattr(self, "_peer_channels", None) is not None:
             await self._peer_channels.close()
         if self.scheduler is not None:
+            await asyncio.to_thread(self._persist_scheduler_demotions)
             if hasattr(self.scheduler, "leave_host"):
                 await self.scheduler.leave_host()
             if hasattr(self.scheduler, "close"):

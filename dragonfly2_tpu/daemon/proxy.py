@@ -34,6 +34,24 @@ from .config import ProxyConfig
 
 log = logging.getLogger("df.http.proxy")
 
+
+async def _writer_start_tls(writer: asyncio.StreamWriter,
+                            ctx: ssl.SSLContext) -> None:
+    """``StreamWriter.start_tls`` exists only on Python >= 3.11; on 3.10
+    drive ``loop.start_tls`` directly (the same thing 3.11's method does)
+    and swap the writer's transport for the TLS one. The reader needs no
+    rewiring: the SSL protocol delivers decrypted bytes to the same
+    StreamReaderProtocol underneath."""
+    if hasattr(writer, "start_tls"):
+        await writer.start_tls(ctx)
+        return
+    await writer.drain()
+    loop = asyncio.get_running_loop()
+    transport = writer.transport
+    new_transport = await loop.start_tls(
+        transport, transport.get_protocol(), ctx, server_side=True)
+    writer._transport = new_transport  # noqa: SLF001 - no public hook on 3.10
+
 _proxy_reqs = REGISTRY.counter("df_proxy_requests_total",
                                "proxy requests", ("route",))
 _proxy_bytes = REGISTRY.counter("df_proxy_bytes_total",
@@ -219,7 +237,7 @@ class ProxyServer:
                         self._issuer.server_context, host)
                     # asyncio infers server_side=True for start_server
                     # streams; the TLS transport resumes reading itself
-                    await writer.start_tls(ctx)
+                    await _writer_start_tls(writer, ctx)
                     _proxy_reqs.labels("hijack").inc()
                     scheme, authority = "https", target
                     continue        # decrypted requests re-enter this loop

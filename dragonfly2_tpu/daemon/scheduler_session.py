@@ -218,6 +218,13 @@ class SchedulerConnector:
         self._demoted: dict[str, float] = {}   # addr -> monotonic revive time
         self._close_tasks: set = set()   # strong refs: the loop only
         # weak-refs tasks, and a GC'd close task leaks its channel
+        # scheduler-epoch watermark (recovery reconciliation): register
+        # results and announce responses carry the serving scheduler's
+        # boot epoch; a CHANGE means the brain restarted with at best a
+        # snapshot of what this daemon holds — the announcer drains
+        # reconcile_event and replays held content (AnnounceContent)
+        self._epoch = 0
+        self.reconcile_event = asyncio.Event()
 
     def update_addresses(self, addresses: list[str]) -> None:
         """Adopt a refreshed scheduler set (manager dynconfig): new
@@ -243,6 +250,28 @@ class SchedulerConnector:
                 self._close_tasks.add(t)
                 t.add_done_callback(self._close_tasks.discard)
         self.addresses = list(addresses)
+
+    # -- scheduler epoch (recovery reconciliation) ---------------------
+
+    def note_epoch(self, epoch: int) -> bool:
+        """Record the serving scheduler's boot epoch. Returns True (and
+        wakes the announcer's reconcile wait) when a previously-seen
+        epoch CHANGED — the brain restarted and must relearn who holds
+        what. First contact is not a change: the announcer's initial
+        content announce covers the daemon-restart direction."""
+        if not epoch or epoch == self._epoch:
+            return False
+        first = self._epoch == 0
+        self._epoch = epoch
+        if first:
+            return False
+        self.reconcile_event.set()
+        return True
+
+    def mark_reconcile(self) -> None:
+        """Force a content re-announce (register ring failover: the
+        successor member may have imported only a handoff summary)."""
+        self.reconcile_event.set()
 
     # -- demotion (sticky failover memory) -----------------------------
 
@@ -305,6 +334,41 @@ class SchedulerConnector:
         for addr in revived:
             self.revive(addr)
         return revived
+
+    def export_demotions(self) -> dict:
+        """Persistable demotion memory: remaining seconds per demoted
+        member (monotonic stamps don't survive a process). A restarted
+        dfdaemon that forgot its demotions would re-probe every dead
+        scheduler on its first task and pay the register timeout ladder
+        all over again — the exact sticky-memory this set exists for."""
+        now = time.monotonic()
+        return {"v": 1,
+                "demoted": {a: round(t - now, 3)
+                            for a, t in self._demoted.items() if t > now}}
+
+    def restore_demotions(self, state: dict | None) -> int:
+        """Re-arm demotions from a prior process. Refusal is wholesale
+        (schema guard); each entry's remaining window is clamped to
+        ``demote_s`` — a clock-skewed or hand-edited blob must not demote
+        a member for hours — and members no longer in the address set are
+        dropped."""
+        if not isinstance(state, dict) or state.get("v") != 1:
+            return 0
+        now = time.monotonic()
+        known = set(self.addresses)
+        n = 0
+        for addr, remaining in (state.get("demoted") or {}).items():
+            try:
+                rem = min(float(remaining), self.demote_s)
+            except (TypeError, ValueError):
+                continue
+            if rem <= 0 or addr not in known:
+                continue
+            self._demoted[addr] = now + rem
+            n += 1
+        if n:
+            log.info("restored %d demoted scheduler(s) from prior run", n)
+        return n
 
     def _candidates(self, key: str) -> list[str]:
         """Failover order for ``key``: the next-N distinct ring members
@@ -380,8 +444,13 @@ class SchedulerConnector:
                             "member", addr, exc)
                 continue
             self.revive(addr)
-            if i > 0 and flight is not None:
-                flight.rung(fr.RUNG_RING_FAILOVER)
+            self.note_epoch(int(getattr(result, "scheduler_epoch", 0)))
+            if i > 0:
+                if flight is not None:
+                    flight.rung(fr.RUNG_RING_FAILOVER)
+                # the member clockwise of a dead one starts from at most
+                # a manager-relayed summary: replay held content at it
+                self.mark_reconcile()
             # adopt the scheduler's application-table resolution only when
             # it actually resolved something: an older scheduler echoes the
             # LEVEL0 default, which must not clobber an explicit local value
@@ -397,18 +466,39 @@ class SchedulerConnector:
             f"all {len(cands)} scheduler ring members unreachable "
             f"(last: {last_exc})")
 
-    async def announce_host(self, request) -> None:
+    async def announce_host(self, request):
         if not self.addresses:
-            return
+            return None
         cands = self._candidates(self.host.id)
         if not cands:
             raise DFError(Code.UNAVAILABLE, "no scheduler addresses")
         # single retry layer, same rationale as ReportPeerResult above
         client = self._client_at(cands[0], max_attempts=1)
-        await Retrier(_REPORT_RETRY).run(
+        resp = await Retrier(_REPORT_RETRY).run(
             lambda: client.unary("AnnounceHost", request, timeout=5.0),
             retryable=lambda exc: not isinstance(exc, DFError)
             or exc.code in _FAILOVER_CODES)
+        # older scheduler answers Empty (epoch 0 -> ignored by note_epoch)
+        self.note_epoch(int(getattr(resp, "scheduler_epoch", 0)))
+        return resp
+
+    async def announce_content(self, request):
+        """Replay held content at the hashed scheduler (recovery
+        reconciliation). Same single-retry envelope as announce_host —
+        a brain that stays away gets the replay on the next announce
+        interval instead."""
+        if not self.addresses:
+            return None
+        cands = self._candidates(self.host.id)
+        if not cands:
+            raise DFError(Code.UNAVAILABLE, "no scheduler addresses")
+        client = self._client_at(cands[0], max_attempts=1)
+        resp = await Retrier(_REPORT_RETRY).run(
+            lambda: client.unary("AnnounceContent", request, timeout=10.0),
+            retryable=lambda exc: not isinstance(exc, DFError)
+            or exc.code in _FAILOVER_CODES)
+        self.note_epoch(int(getattr(resp, "scheduler_epoch", 0)))
+        return resp
 
     async def sync_probes(self):
         """Open the probe bidi stream (network-topology module drives it)."""

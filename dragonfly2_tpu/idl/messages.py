@@ -332,6 +332,11 @@ class RegisterResult:
     # None = no affinity ruling (scheduler arm disabled / whole-file
     # task): every needed piece is tree-eligible immediately.
     assigned_shards: list[str] | None = None
+    # the answering scheduler's boot epoch (crash resilience): a daemon
+    # that sees this CHANGE knows the brain restarted and re-announces
+    # its held content so the recovered scheduler relearns who holds
+    # what within one announce interval. 0 = pre-epoch scheduler.
+    scheduler_epoch: int = 0
 
 
 @message
@@ -421,6 +426,53 @@ class PeerResult:
 class AnnounceHostRequest:
     host: Host | None = None
     interval_s: float = 30.0
+
+
+@message
+class AnnounceHostResponse:
+    """Scheduler -> daemon heartbeat answer. Carries the scheduler's
+    boot epoch so the announce plane doubles as restart detection (the
+    register path carries it too — whichever lands first wins). Old
+    schedulers answered Empty; the codec is self-describing, so a
+    daemon treats anything without an epoch as epoch 0 (unknown)."""
+
+    scheduler_epoch: int = 0
+
+
+@message
+class HeldContentEntry:
+    """One task's holdings in a daemon's recovery re-announce — the PEX
+    digest entry shape (daemon/pex.py build_digest), typed for the
+    scheduler RPC plane."""
+
+    task_id: str = ""
+    url: str = ""
+    total_piece_count: int = -1
+    content_length: int = -1
+    piece_size: int = 0
+    done: bool = False
+    pieces: list[int] | None = None     # partial holdings (done=False)
+
+
+@message
+class AnnounceContentRequest:
+    """Daemon -> scheduler after an epoch change / register failover:
+    re-announce held content so a freshly restarted (or newly elected)
+    brain rebuilds its resource view from the swarm instead of sending
+    the herd back to origin. ``digest`` is the daemon's sealed PEX
+    envelope (sha256 + canonical JSON, daemon/pex.py seal) over the
+    same entries — the scheduler verifies the seal and refuses torn or
+    version-skewed blobs wholesale."""
+
+    host: Host | None = None
+    entries: list[HeldContentEntry] | None = None
+    digest: bytes = b""
+
+
+@message
+class AnnounceContentResponse:
+    scheduler_epoch: int = 0
+    tasks_adopted: int = 0
 
 
 @message
@@ -832,6 +884,34 @@ class TenantEntry:
 @message
 class ListTenantsResponse:
     tenants: list[TenantEntry] | None = None
+
+
+@message
+class SetSchedulerStateRequest:
+    """Demoting/stopping scheduler -> manager: park this member's last
+    exported quarantine/affinity summary with the config plane of
+    record, so the failover successor can import it. ``signature`` is
+    an HMAC over ``blob`` with the cluster's issuance token when
+    security is on ("" = unsigned, accepted only by managers that hold
+    no token either)."""
+
+    scheduler_id: str = ""           # exporter identity (host:port)
+    cluster_id: int = 0
+    blob: bytes = b""                # sealed summary (pex.seal envelope)
+    signature: str = ""
+
+
+@message
+class GetSchedulerStateRequest:
+    cluster_id: int = 0
+    exclude: str = ""                # don't hand a member its own blob
+
+
+@message
+class GetSchedulerStateResponse:
+    scheduler_id: str = ""           # "" = nothing parked
+    blob: bytes = b""
+    signature: str = ""
 
 
 @message

@@ -17,10 +17,12 @@ from ..common.errors import Code, DFError
 from ..idl.messages import (CertificateRequest, CertificateResponse,
                             CreateModelRequest, Empty, GetModelRequest,
                             GetModelResponse, GetSchedulersRequest,
-                            GetSchedulersResponse, GetSeedPeersRequest,
+                            GetSchedulersResponse, GetSchedulerStateRequest,
+                            GetSchedulerStateResponse, GetSeedPeersRequest,
                             GetSeedPeersResponse, KeepAliveRequest,
                             ModelEntity, RegisterSchedulerRequest,
-                            RegisterSeedPeerRequest)
+                            RegisterSeedPeerRequest,
+                            SetSchedulerStateRequest)
 from ..rpc.server import ServiceDef
 from .searcher import find_scheduler_cluster
 from .store import Store
@@ -133,6 +135,36 @@ class ManagerService:
                 topology=req.topology))
         return Empty()
 
+    # -- scheduler handoff relay (control-plane failover) --------------
+
+    async def set_scheduler_state(self, req: SetSchedulerStateRequest,
+                                  context) -> Empty:
+        """Park a demoting scheduler's state summary. The manager is a
+        dumb relay: the blob is opaque (sealed by the exporter) and the
+        HMAC signature is verified by the IMPORTER against the shared
+        issuance token — a compromised relay can drop a handoff (safe:
+        successor falls back to its own snapshot + live rebuild) but
+        cannot forge one that verifies."""
+        if not req.scheduler_id or not req.blob:
+            raise DFError(Code.INVALID_ARGUMENT,
+                          "scheduler_id and blob required")
+        await asyncio.to_thread(
+            lambda: self.store.park_scheduler_state(
+                cluster_id=req.cluster_id, scheduler_id=req.scheduler_id,
+                blob=req.blob, signature=req.signature))
+        return Empty()
+
+    async def get_scheduler_state(self, req: GetSchedulerStateRequest,
+                                  context) -> GetSchedulerStateResponse:
+        row = await asyncio.to_thread(
+            lambda: self.store.latest_scheduler_state(
+                cluster_id=req.cluster_id, exclude=req.exclude))
+        if row is None:
+            return GetSchedulerStateResponse()
+        return GetSchedulerStateResponse(
+            scheduler_id=row["scheduler_id"], blob=row["blob"],
+            signature=row["signature"])
+
     # -- model registry (reference manager/models/model.go:36) ---------
 
     async def create_model(self, req: CreateModelRequest, context) -> Empty:
@@ -183,6 +215,10 @@ class ManagerService:
                           "public_key_pem and hosts required")
         import datetime
 
+        from ..common import cryptoshim
+        # no-op when the real wheel is importable; first call may probe
+        # for an openssl binary, so keep it off the loop thread
+        await asyncio.to_thread(cryptoshim.install)
         from cryptography.hazmat.primitives import serialization
 
         def sign() -> bytes:
@@ -222,6 +258,8 @@ def build_service(svc: ManagerService) -> ServiceDef:
     d.unary_unary("RegisterScheduler", svc.register_scheduler)
     d.unary_unary("RegisterSeedPeer", svc.register_seed_peer)
     d.stream_unary("KeepAlive", svc.keep_alive)
+    d.unary_unary("SetSchedulerState", svc.set_scheduler_state)
+    d.unary_unary("GetSchedulerState", svc.get_scheduler_state)
     d.unary_unary("CreateModel", svc.create_model)
     d.unary_unary("GetModel", svc.get_model)
     d.unary_unary("IssueCertificate", svc.issue_certificate)

@@ -73,6 +73,15 @@ CREATE TABLE IF NOT EXISTS tenants (
   shed_retry_after_ms INTEGER NOT NULL DEFAULT 0,
   created_at REAL, updated_at REAL
 );
+CREATE TABLE IF NOT EXISTS scheduler_states (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  cluster_id INTEGER NOT NULL,
+  scheduler_id TEXT NOT NULL,
+  blob BLOB NOT NULL,
+  signature TEXT NOT NULL DEFAULT '',
+  updated_at REAL,
+  UNIQUE(cluster_id, scheduler_id)
+);
 CREATE TABLE IF NOT EXISTS jobs (
   id INTEGER PRIMARY KEY AUTOINCREMENT,
   type TEXT NOT NULL,
@@ -389,6 +398,34 @@ class Store:
     def tenants(self) -> list[dict]:
         return [dict(r) for r in self._rows(
             "SELECT * FROM tenants ORDER BY id")]
+
+    # -- scheduler handoff blobs (control-plane failover) --------------
+
+    def park_scheduler_state(self, *, cluster_id: int, scheduler_id: str,
+                             blob: bytes, signature: str = "") -> None:
+        """Park a demoting scheduler's exported quarantine/affinity
+        summary so its ring successor can import it. One row per
+        (cluster, scheduler); the manager relays blobs opaquely — it
+        never parses them, and the signature travels with the blob so
+        the importer (not the relay) verifies provenance."""
+        self._exec(
+            "INSERT INTO scheduler_states(cluster_id, scheduler_id, blob,"
+            " signature, updated_at) VALUES (?,?,?,?,?)"
+            " ON CONFLICT(cluster_id, scheduler_id) DO UPDATE SET"
+            " blob=excluded.blob, signature=excluded.signature,"
+            " updated_at=excluded.updated_at",
+            (int(cluster_id), scheduler_id, blob, signature, _now()))
+
+    def latest_scheduler_state(self, *, cluster_id: int,
+                               exclude: str = "") -> dict | None:
+        """Freshest parked blob in the cluster, skipping the asker's own
+        export (a successor importing its own stale summary would learn
+        nothing and age its evidence twice)."""
+        rows = self._rows(
+            "SELECT * FROM scheduler_states WHERE cluster_id=? AND"
+            " scheduler_id != ? ORDER BY updated_at DESC LIMIT 1",
+            (int(cluster_id), exclude))
+        return dict(rows[0]) if rows else None
 
     def create_job(self, type_: str, args: dict) -> int:
         cur = self._exec(

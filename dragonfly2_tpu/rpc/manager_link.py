@@ -79,6 +79,13 @@ class ManagerLink:
         from ..idl.messages import Empty
         return await self._unary("ListTenants", Empty())
 
+    async def set_scheduler_state(self, req) -> None:
+        """Park a scheduler's handoff blob (control-plane failover)."""
+        await self._unary("SetSchedulerState", req)
+
+    async def get_scheduler_state(self, req):
+        return await self._unary("GetSchedulerState", req)
+
     async def create_model(self, req) -> None:
         await self._unary("CreateModel", req, timeout=60.0)
 

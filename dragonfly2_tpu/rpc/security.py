@@ -10,6 +10,7 @@ the wire.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 
@@ -22,6 +23,10 @@ async def obtain_certificate(manager_addresses: list[str], *,
                              tls_ca: str = "") -> tuple[str, str, str]:
     """Enroll with the first reachable manager; returns
     (cert_path, key_path, ca_path) written 0600 under ``out_dir``."""
+    from ..common import cryptoshim
+    # no-op when the real wheel is importable; first call may probe for
+    # an openssl binary, so keep it off the loop thread
+    await asyncio.to_thread(cryptoshim.install)
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import ec
 

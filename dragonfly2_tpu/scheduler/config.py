@@ -145,3 +145,18 @@ class SchedulerConfig:
     train_upload_interval_s: float = 60.0  # records -> trainer cadence
     model_refresh_interval_s: float = 60.0  # manager -> ml evaluator cadence
     workdir: str = ""
+    # crash-survivable control-plane state (scheduler/statestore.py):
+    # the quarantine ladder, shard-affinity memos, federation seed
+    # elections, and tenant quotas journal to
+    # <statestore_dir>/scheduler_state.json (tmp+fsync+rename) on this
+    # cadence PLUS every covered transition (event-driven dirty mark);
+    # on boot the snapshot restores before the first ruling and daemons
+    # seeing the epoch change re-announce held content. "" = durability
+    # off: the pre-PR amnesiac brain (and the exact pre-PR boot path).
+    statestore_dir: str = ""
+    statestore_interval_s: float = 30.0
+    # failover handoff: on graceful stop/demotion, park the exported
+    # quarantine/affinity summary with the manager (the config plane of
+    # record) so the ring successor can import it — warmed to at most
+    # `suspect` (the PR 12 anti-slander ceiling). Needs manager_addresses.
+    statestore_handoff: bool = True

@@ -40,7 +40,7 @@ class CtrlObservatory:
     """Holds the component refs and the TTL-cached state-bytes walk."""
 
     def __init__(self, *, resource=None, ledger=None, federation=None,
-                 quarantine=None, sharded=None,
+                 quarantine=None, sharded=None, statestore=None,
                  ttl_s: float = STATE_TTL_S,
                  clock=time.monotonic) -> None:
         self.components = {
@@ -50,6 +50,7 @@ class CtrlObservatory:
             "quarantine": quarantine,
             "shard_affinity": sharded,
         }
+        self.statestore = statestore
         self.ttl_s = ttl_s
         self.clock = clock
         self._state_cache: dict | None = None
@@ -89,6 +90,13 @@ class CtrlObservatory:
         snap["state_staleness_s"] = round(
             max(self.clock() - self._state_at, 0.0), 3)
         snap["state_ttl_s"] = self.ttl_s
+        # recovered-vs-rebuilt provenance: which slices of this brain's
+        # view came back from the durable snapshot (statestore.restore)
+        # vs were relearned live from announce/register traffic — an
+        # operator reading /debug/ctrl after an incident can tell whether
+        # the scheduler is ruling from memory or from hearsay
+        if self.statestore is not None:
+            snap["recovery"] = self.statestore.provenance
         return snap
 
 

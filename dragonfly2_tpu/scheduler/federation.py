@@ -275,3 +275,47 @@ class PodFederation:
                           for (tid, pod), seeds in
                           sorted(self._elected.items())},
         }
+
+    # -- durable state (scheduler/statestore.py) -------------------------
+
+    def export_state(self) -> dict:
+        """Seed elections + the pod map they stand on. ``pod_of`` IS
+        persisted even though membership is announce-fed: ``seeds_for``
+        destroys an election memo the moment its pod has no ring, so a
+        restore that carried elections without the membership they were
+        ruled over would discard every one of them on first query —
+        exactly the re-election stampede durability exists to prevent.
+        Hosts that died during the outage are evicted the normal way
+        (host GC / leave → ``forget_host``) once the live view catches
+        up."""
+        return {
+            "seq": self._seq,
+            "pod_of": dict(self._pod_of),
+            "elected": [[tid, pod, seeds]
+                        for (tid, pod), seeds in self._elected.items()],
+            "result": [[tid, pod, res]
+                       for (tid, pod), res in self._result.items()],
+        }
+
+    def restore(self, state: dict) -> int:
+        """Rebuild pods, rings, and election memos from
+        :meth:`export_state` output — membership FIRST (rings must exist
+        before any ``seeds_for`` runs), memos second, silently: a
+        restored election that still stands emits no fresh ledger row."""
+        for hid, pod in (state.get("pod_of") or {}).items():
+            if pod and hid not in self._pod_of:
+                self._pod_of[hid] = pod
+                self._members.setdefault(pod, set()).add(hid)
+                ring = self._rings.get(pod)
+                if ring is None:
+                    ring = self._rings[pod] = HashRing()
+                ring.add(hid)
+        restored = 0
+        for tid, pod, seeds in (state.get("elected") or ()):
+            self._elected[(tid, pod)] = list(seeds)
+            restored += 1
+        for tid, pod, res in (state.get("result") or ()):
+            self._result[(tid, pod)] = res
+        self._seq = max(self._seq, int(state.get("seq", 0)))
+        _pods_gauge.set(len(self._members))
+        return restored

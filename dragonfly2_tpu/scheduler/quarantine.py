@@ -372,3 +372,110 @@ class QuarantineRegistry:
             "probe_successes": self.probe_successes,
             "hosts": hosts,
         }
+
+    # -- durable state (scheduler/statestore.py) -------------------------
+
+    def export_state(self) -> dict:
+        """The crash-survivable half of the ladder, decayed to now and
+        anchored in AGES (seconds before the export), never in absolute
+        monotonic stamps — a restarted process has a different monotonic
+        origin, and the statestore adds the wall-clock downtime gap on
+        restore so decay keeps running while the scheduler is down."""
+        now = self.clock()
+        hosts = {}
+        for hid, h in self._hosts.items():
+            h.decay(now, self.halflife_s)
+            if h.state == HEALTHY and h.corrupt <= 0.0 and h.relayed <= 0.0:
+                continue              # fully recovered: nothing to carry
+            hosts[hid] = {
+                "state": h.state,
+                "corrupt": round(h.corrupt, 6),
+                "relayed": round(h.relayed, 6),
+                "reporters": sorted(h.reporters),
+                "tasks": sorted(h.tasks),
+                "last_evidence_age_s": round(max(now - h.last_evidence,
+                                                 0.0), 3),
+                "entered_age_s": round(max(now - h.entered_at, 0.0), 3),
+                "probe_ok": h.probe_ok,
+                "self_flagged": h.self_flagged,
+                "reason": h.reason,
+            }
+        return {"seq": self._seq, "hosts": hosts}
+
+    def restore(self, state: dict, *, gap_s: float = 0.0) -> int:
+        """Rebuild the ladder from :meth:`export_state` output. ``gap_s``
+        is the wall-clock downtime between export and now: evidence ages
+        by ``age + gap`` so the lazy decay arithmetic lands exactly where
+        an uninterrupted registry would (a suspect whose evidence crosses
+        the decay horizon during the outage comes back HEALTHY — its
+        entry is simply dropped, unknown hosts being healthy by default).
+
+        QUARANTINED hosts are the one deliberate exception: their
+        probation timer restarts at recovery (``last_evidence = now``)
+        instead of aging through the gap — no probe could possibly have
+        run while the brain was down, and a poisoner must never walk
+        itself into offerable probation on the strength of the
+        scheduler's own outage. Restores are silent (no ledger rows, no
+        transition counters): nothing here is a fresh ruling."""
+        now = self.clock()
+        gap = max(float(gap_s), 0.0)
+        restored = 0
+        for hid, row in (state.get("hosts") or {}).items():
+            h = _HostLadder(now)
+            h.state = row.get("state", SUSPECT)
+            if h.state not in STATES:
+                continue
+            h.corrupt = float(row.get("corrupt", 0.0))
+            h.relayed = float(row.get("relayed", 0.0))
+            # the export decayed evidence to export time; anchoring the
+            # decay clock `gap` in the past makes the next decay() charge
+            # the downtime too
+            h.at = now - gap
+            h.decay(now, self.halflife_s)
+            h.reporters = set(row.get("reporters") or ())
+            h.tasks = set(row.get("tasks") or ())
+            h.probe_ok = int(row.get("probe_ok", 0))
+            h.self_flagged = bool(row.get("self_flagged", False))
+            h.reason = row.get("reason", "")
+            if h.state == QUARANTINED:
+                h.last_evidence = now
+                h.entered_at = now
+            else:
+                h.last_evidence = now - (
+                    float(row.get("last_evidence_age_s", 0.0)) + gap)
+                h.entered_at = now - (
+                    float(row.get("entered_age_s", 0.0)) + gap)
+                if h.state == SUSPECT and h.corrupt <= 0.0 \
+                        and h.relayed <= 0.0:
+                    continue          # decayed across the outage: healthy
+            self._hosts[hid] = h
+            restored += 1
+        self._seq = max(self._seq, int(state.get("seq", 0)))
+        self._export()
+        return restored
+
+    def import_summary(self, state: dict, *, source: str = "") -> int:
+        """Failover handoff import — the PR 12 anti-slander rule applied
+        to second-hand state: a demoted scheduler's exported summary
+        warms the successor's ladder to at most SUSPECT. Imported mass
+        lands in the RELAYED (circumstantial) counter, which by
+        construction can never cross into QUARANTINED — only fresh
+        first-hand corrupt reports arriving at THIS scheduler can evict.
+        Reporter identities are deliberately not imported (carrying them
+        over would let a forged blob pre-stage ``min_reporters``)."""
+        imported = 0
+        now = self.clock()
+        for hid, row in (state.get("hosts") or {}).items():
+            mass = float(row.get("corrupt", 0.0)) \
+                + float(row.get("relayed", 0.0))
+            if mass <= 0.0 and row.get("state") == HEALTHY:
+                continue
+            h = self._get(hid)
+            h.decay(now, self.halflife_s)
+            h.relayed += min(mass, self.corrupt_threshold) or 1.0
+            if h.state == HEALTHY:
+                self._transit(hid, h, SUSPECT,
+                              f"imported verdict from {source or 'peer'} "
+                              "(anti-slander: suspect ceiling)")
+            imported += 1
+        return imported

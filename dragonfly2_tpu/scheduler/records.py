@@ -344,7 +344,17 @@ class DownloadRecords:
             self._timer_task.cancel()
             self._timer_task = None
         if self._pending:
-            self._flush_sync(self._pending)
+            try:
+                self._flush_sync(self._pending)
+            except (OSError, ValueError):
+                # counted ONCE at the raise site in _flush_sync; the tail
+                # batch is lost from the file copy only. Swallowed here
+                # because close() runs inside the scheduler's shutdown
+                # sequence — a disk that died (or a file something closed
+                # first) must not abort the rest of teardown behind us
+                # (statestore save, handoff export, manager close)
+                log.warning("tail record flush failed at close",
+                            exc_info=True)
             self._pending = []
         if self._file is not None:
             self._file.close()

@@ -98,11 +98,71 @@ class Scheduler:
 
             self.resource.on_host_evict = _evict_host
             self.resource.on_task_evict = _evict_task
+        # crash-survivable control plane (scheduler/statestore.py): the
+        # slow-moving ruling state — quarantine ladder, shard-affinity
+        # memos, seed elections, tenant quotas — journals to one
+        # versioned snapshot. Event-driven cadence rides the components'
+        # existing decision sinks (every covered transition already
+        # emits a ledger row), so durability costs one dirty-flag store
+        # per ruling and zero new wiring inside the components.
+        self.statestore = None
+        if cfg.statestore_dir:
+            from .statestore import SchedulerStateStore
+            self.statestore = SchedulerStateStore(
+                cfg.statestore_dir, interval_s=cfg.statestore_interval_s)
+            if self.quarantine is not None:
+                self.statestore.register("quarantine",
+                                         self.quarantine.export_state,
+                                         self.quarantine.restore)
+                self.quarantine.sink = self.statestore.wrap_sink(
+                    self.quarantine.sink)
+            if self.federation is not None:
+                self.statestore.register("federation",
+                                         self.federation.export_state,
+                                         self.federation.restore)
+                self.federation.sink = self.statestore.wrap_sink(
+                    self.federation.sink)
+            if self.sharded is not None:
+                self.statestore.register("shard_affinity",
+                                         self.sharded.export_state,
+                                         self.sharded.restore)
+                self.sharded.sink = self.statestore.wrap_sink(
+                    self.sharded.sink)
         self.service = SchedulerService(cfg, self.resource, self.scheduling,
                                         self.seed_client, self.topo,
                                         records=records, ledger=self.ledger,
                                         quarantine=self.quarantine,
                                         federation=self.federation)
+        if self.statestore is not None:
+            svc = self.service
+
+            def _export_tenants() -> dict:
+                return {"tenants": svc.tenants,
+                        "applications": svc.applications}
+
+            def _restore_tenants(sub: dict) -> int:
+                # restored quotas hold until the first manager dynconfig
+                # refresh overwrites them — a recovered brain enforces
+                # tenant limits from ruling one instead of running
+                # quota-blind for a refresh interval
+                svc.tenants = dict(sub.get("tenants") or {})
+                svc.applications = {k: int(v) for k, v in
+                                    (sub.get("applications") or {}).items()}
+                return len(svc.tenants)
+
+            def _export_meta() -> dict:
+                return {"epoch": svc.epoch}
+
+            def _restore_meta(sub: dict) -> int:
+                # strictly-increasing epoch across durable restarts: the
+                # daemons' change detection must never see a restart
+                # land on the same epoch value
+                svc.epoch = max(svc.epoch, int(sub.get("epoch", 0)) + 1)
+                return 1
+
+            self.statestore.register("tenants", _export_tenants,
+                                     _restore_tenants)
+            self.statestore.register("meta", _export_meta, _restore_meta)
         self.announcer = None
         self.rpc: RPCServer | None = None
         self.gc = GC()
@@ -119,6 +179,33 @@ class Scheduler:
             tracing.configure(service="dfscheduler",
                               jsonl_path=self.cfg.tracing_jsonl,
                               otlp_endpoint=self.cfg.tracing_otlp)
+        if self.statestore is not None:
+            # restore BEFORE the first RPC can land: a ruling made on an
+            # amnesiac view and then "corrected" by a late restore would
+            # be exactly the half-applied state the store exists to
+            # prevent. A refused/missing snapshot degrades to the cold
+            # path — recovery must never block boot.
+            prov = await asyncio.to_thread(self.statestore.restore)
+            if prov.get("recovered") and self.ledger is not None:
+                self.service._recovery_seq += 1
+                self.ledger.on_decision({
+                    "kind": "decision",
+                    "decision_kind": "recovery",
+                    "decision_id":
+                        f"r{self.service._recovery_seq:08d}.snapshot",
+                    "host_id": "",
+                    "source": "snapshot",
+                    "gap_s": prov.get("gap_s", 0.0),
+                    "components": {
+                        k: v.get("restored", 0)
+                        for k, v in (prov.get("components") or {}).items()},
+                    "scheduler_epoch": self.service.epoch,
+                    "task_id": "",
+                    "peer_id": "",
+                    "candidates": [],
+                    "excluded": [],
+                    "chosen": [],
+                })
         self.rpc = RPCServer(f"{self.cfg.listen_ip}:{self.cfg.port}")
         self.rpc.register(build_service(self.service))
         await self.rpc.start()
@@ -129,6 +216,14 @@ class Scheduler:
             await self._enroll_security()
         self.gc.add(GCTask("resource", self.cfg.gc_interval_s,
                            self.resource.gc))
+        if self.statestore is not None:
+            # snapshot ticker rides the GC runner (periodic + dirty):
+            # maybe_save never raises, so a sick disk shows up as an
+            # error-result counter, not a dead sweeper
+            store = self.statestore
+            self.gc.add(GCTask("statestore",
+                               min(self.cfg.statestore_interval_s, 5.0),
+                               lambda: int(store.maybe_save())))
         self.gc.start()
         # records → trainer upload + model → evaluator refresh (ML loop)
         from .announcer import SchedulerAnnouncer
@@ -210,12 +305,111 @@ class Scheduler:
         except Exception as exc:  # noqa: BLE001 - manager optional at boot
             log.warning("manager attach failed (%s); running standalone", exc)
             return
+        if self.cfg.statestore_handoff:
+            await self._import_handoff()
         # applications are OPTIONAL (an older manager may lack the verb):
         # a failed first fetch must neither mislabel the attach as failed
         # nor disable refresh — the loop keeps retrying and recovers when
         # the manager catches up
         self._app_refresh = asyncio.get_running_loop().create_task(
             self._app_refresh_loop())
+
+    def _handoff_signature(self, blob: bytes) -> str:
+        import hashlib
+        import hmac
+        token = self.cfg.security_issue_token
+        if not token:
+            return ""
+        return hmac.new(token.encode(), blob, hashlib.sha256).hexdigest()
+
+    async def _export_handoff(self) -> None:
+        """Graceful stop/demotion: park the quarantine/affinity summary
+        with the manager (config plane of record) so the ring successor
+        can warm itself — sealed with the PEX envelope codec, HMAC'd
+        with the cluster issuance token when security is on."""
+        if (self.manager is None or self.statestore is None
+                or not self.cfg.statestore_handoff):
+            return
+        from ..daemon.pex import DIGEST_VERSION, seal
+        from ..idl.messages import SetSchedulerStateRequest
+        body: dict = {"v": DIGEST_VERSION}
+        if self.quarantine is not None:
+            body["quarantine"] = self.quarantine.export_state()
+        if self.sharded is not None:
+            body["shard_affinity"] = self.sharded.export_state()
+        if len(body) == 1:
+            return
+        blob = seal(body)
+        try:
+            await self.manager.set_scheduler_state(SetSchedulerStateRequest(
+                scheduler_id=self.address,
+                cluster_id=self.cfg.cluster_id,
+                blob=blob,
+                signature=self._handoff_signature(blob)))
+        except Exception as exc:  # noqa: BLE001 - handoff is best-effort
+            log.debug("handoff export failed: %s", exc)
+
+    async def _import_handoff(self) -> None:
+        """Ring-failover successor: import the demoted member's parked
+        summary. The PR 12 anti-slander rule is structural, not
+        advisory: imported verdicts land as CIRCUMSTANTIAL (relayed)
+        mass via ``QuarantineRegistry.import_summary``, which tops out
+        at `suspect` — only fresh first-hand corrupt reports arriving
+        HERE can quarantine. Affinity memos import whole (the split is a
+        pure observable function, so adopting them only preserves
+        stickiness)."""
+        if self.manager is None:
+            return
+        import hmac as _hmac
+
+        from ..daemon.pex import unseal
+        from ..idl.messages import GetSchedulerStateRequest
+        try:
+            resp = await self.manager.get_scheduler_state(
+                GetSchedulerStateRequest(cluster_id=self.cfg.cluster_id,
+                                         exclude=self.address))
+        except Exception as exc:  # noqa: BLE001 - older manager: no verb
+            log.debug("handoff import unavailable: %s", exc)
+            return
+        if resp is None or not resp.blob or resp.scheduler_id == self.address:
+            return
+        want = self._handoff_signature(resp.blob)
+        if want and not _hmac.compare_digest(want, resp.signature or ""):
+            log.warning("handoff blob from %s refused: bad signature",
+                        resp.scheduler_id)
+            return
+        body = unseal(resp.blob)
+        if body is None:
+            log.warning("handoff blob from %s refused: torn/version-skewed",
+                        resp.scheduler_id)
+            return
+        imported = 0
+        if self.quarantine is not None \
+                and isinstance(body.get("quarantine"), dict):
+            imported += self.quarantine.import_summary(
+                body["quarantine"], source=resp.scheduler_id)
+        if self.sharded is not None \
+                and isinstance(body.get("shard_affinity"), dict):
+            imported += self.sharded.restore(body["shard_affinity"])
+        log.info("handoff import from %s: %d entries warmed",
+                 resp.scheduler_id, imported)
+        if self.ledger is not None and imported:
+            self.service._recovery_seq += 1
+            self.ledger.on_decision({
+                "kind": "decision",
+                "decision_kind": "recovery",
+                "decision_id": f"r{self.service._recovery_seq:08d}.handoff",
+                "host_id": "",
+                "source": "handoff",
+                "from_scheduler": resp.scheduler_id,
+                "entries_imported": imported,
+                "scheduler_epoch": self.service.epoch,
+                "task_id": "",
+                "peer_id": "",
+                "candidates": [],
+                "excluded": [],
+                "chosen": [],
+            })
 
     async def _refresh_applications(self) -> None:
         """Pull the application priority table into the service (reference
@@ -250,6 +444,13 @@ class Scheduler:
             self._app_refresh.cancel()
         if self.announcer is not None:
             await self.announcer.stop()
+        if self.statestore is not None:
+            # final snapshot + manager handoff BEFORE the manager link
+            # closes; both swallow failures — shutdown never wedges on a
+            # sick disk or an absent manager
+            await asyncio.to_thread(self.statestore.save,
+                                    reason="shutdown")
+            await self._export_handoff()
         if self.service.records is not None:
             await self.service.records.aclose()
         if getattr(self, "manager", None) is not None:

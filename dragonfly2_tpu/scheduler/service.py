@@ -15,12 +15,15 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import AsyncIterator
 
 from ..common import phasetimer
 from ..common.errors import Code, DFError
 from ..common.metrics import REGISTRY
-from ..idl.messages import (AnnounceHostRequest, Empty, HostType,
+from ..idl.messages import (AnnounceContentRequest, AnnounceContentResponse,
+                            AnnounceHostRequest, AnnounceHostResponse,
+                            Empty, HostType,
                             LeaveHostRequest,
                             LeavePeerRequest, PeerPacket, PeerResult,
                             PieceResult, Priority, RegisterPeerTaskRequest,
@@ -50,6 +53,11 @@ _quota_sheds = REGISTRY.counter(
     "registers rejected by a tenant's max_running quota "
     "(RESOURCE_EXHAUSTED + retry-after; HTTP surfaces answer 429)",
     ("tenant",))
+_recovery_announces = REGISTRY.counter(
+    "df_sched_recovery_announces_total",
+    "daemon content re-announces after a scheduler epoch change, by "
+    "outcome (adopted = holdings merged into the resource view, "
+    "rejected = torn/unsealed digest refused wholesale)", ("result",))
 
 SCHEDULE_RETRY_INTERVAL_S = 0.25
 SCHEDULE_PATIENCE_S = 10.0
@@ -94,6 +102,13 @@ class SchedulerService:
         # "shed_retry_after_ms"}), fed from the manager's tenants table
         # over the same dynconfig cadence; enforced at register
         self.tenants: dict[str, dict] = {}
+        # boot epoch, echoed on register/announce so daemons detect a
+        # restart and re-announce held content (AnnounceContent). The
+        # wall-clock default changes on every restart even without a
+        # statestore; a restore overrides it with snapshot-epoch + 1 so
+        # it is strictly increasing across durable restarts.
+        self.epoch = int(time.time())
+        self._recovery_seq = 0
 
     # ------------------------------------------------------------------
     # RegisterPeerTask
@@ -175,7 +190,8 @@ class SchedulerService:
         result = RegisterResult(task_id=task.id, size_scope=SizeScope.NORMAL,
                                 content_length=task.content_length,
                                 piece_size=task.piece_size,
-                                resolved_priority=Priority(resolved_priority))
+                                resolved_priority=Priority(resolved_priority),
+                                scheduler_epoch=self.epoch)
         if scope == SizeScope.EMPTY:
             result.size_scope = SizeScope.EMPTY
         elif scope == SizeScope.TINY:
@@ -730,7 +746,8 @@ class SchedulerService:
     # host lifecycle + stat + probes
     # ------------------------------------------------------------------
 
-    async def announce_host(self, req: AnnounceHostRequest, context) -> Empty:
+    async def announce_host(self, req: AnnounceHostRequest,
+                            context) -> AnnounceHostResponse:
         if req.host is not None:
             self.resource.store_host(req.host)
             if self.quarantine is not None:
@@ -744,7 +761,87 @@ class SchedulerService:
                 # so re-announce is a no-op — elections stay sticky
                 self.federation.observe_host(req.host.id,
                                              req.host.topology)
-        return Empty()
+        # the heartbeat answer carries the boot epoch: the announce plane
+        # doubles as restart detection, so a daemon that never registers
+        # still re-announces held content within one announce interval
+        return AnnounceHostResponse(scheduler_epoch=self.epoch)
+
+    async def announce_content(self, req: AnnounceContentRequest,
+                               context) -> AnnounceContentResponse:
+        """Recovery re-announce: a daemon saw the scheduler epoch change
+        (restart) or a register failover, and replays what it holds so
+        the new brain rebuilds its resource view from the swarm instead
+        of ruling the herd back to origin. The sealed digest (the
+        daemon's PEX envelope codec) is the authoritative payload —
+        torn, unparseable, or version-skewed blobs are refused WHOLESALE
+        (the statestore load rule, applied to the announce plane)."""
+        from ..daemon.pex import unseal
+        body = unseal(req.digest) if req.digest else None
+        if req.host is None or body is None:
+            _recovery_announces.labels("rejected").inc()
+            return AnnounceContentResponse(scheduler_epoch=self.epoch)
+        if self.quarantine is not None:
+            self.quarantine.record_self(
+                req.host.id, req.host.quarantined,
+                reason="self-quarantine flag on content re-announce")
+        if self.federation is not None:
+            self.federation.observe_host(req.host.id, req.host.topology)
+        host = self.resource.store_host(req.host)
+        adopted = 0
+        pieces_learned = 0
+        for e in body.get("tasks") or ():
+            task_id = e.get("task_id") or ""
+            if not task_id:
+                continue
+            task = self.resource.get_or_create_task(task_id,
+                                                    e.get("url") or "")
+            task.set_content_info(int(e.get("content_length", -1)),
+                                  int(e.get("piece_size", 0)),
+                                  int(e.get("total", -1)))
+            if task.state == TaskState.PENDING:
+                task.transit(TaskState.RUNNING)
+            # a synthetic holder peer per (host, task): the recovered
+            # brain can offer this daemon as a parent immediately — the
+            # piece metadata itself still travels peer-to-peer over the
+            # sync streams, exactly as it does for any live parent
+            peer_id = f"{host.id}-recov-{task_id[:16]}"
+            peer = self.resource.get_or_create_peer(peer_id, task, host)
+            if peer.state == PeerState.PENDING:
+                peer.transit(PeerState.RUNNING)
+            if e.get("done"):
+                if peer.state == PeerState.RUNNING:
+                    peer.transit(PeerState.SUCCEEDED)
+                if task.state == TaskState.RUNNING:
+                    task.transit(TaskState.SUCCEEDED)
+            else:
+                fresh = set(int(p) for p in (e.get("pieces") or ()))
+                pieces_learned += len(fresh - peer.finished_pieces)
+                peer.finished_pieces |= fresh
+            adopted += 1
+        _recovery_announces.labels("adopted").inc()
+        if self.ledger is not None and adopted:
+            # provenance: this slice of the resource view was REBUILT
+            # from the swarm, not recovered from the snapshot — the
+            # recovery ledger row makes the distinction replayable
+            self._recovery_seq += 1
+            self.ledger.on_decision({
+                "kind": "decision",
+                "decision_kind": "recovery",
+                "decision_id": f"r{self._recovery_seq:08d}."
+                               f"{host.id[-12:]}",
+                "host_id": host.id,
+                "source": "reannounce",
+                "tasks_adopted": adopted,
+                "pieces_learned": pieces_learned,
+                "scheduler_epoch": self.epoch,
+                "task_id": "",
+                "peer_id": "",
+                "candidates": [],
+                "excluded": [],
+                "chosen": [],
+            })
+        return AnnounceContentResponse(scheduler_epoch=self.epoch,
+                                       tasks_adopted=adopted)
 
     async def leave_host(self, req: LeaveHostRequest, context) -> Empty:
         # federation view notified via Resource.on_host_evict inside
@@ -836,6 +933,7 @@ def build_service(svc: SchedulerService) -> ServiceDef:
     d.stream_stream("ReportPieceResult", svc.report_piece_result)
     d.unary_unary("ReportPeerResult", svc.report_peer_result)
     d.unary_unary("AnnounceHost", svc.announce_host)
+    d.unary_unary("AnnounceContent", svc.announce_content)
     d.unary_unary("LeaveHost", svc.leave_host)
     d.unary_unary("LeavePeer", svc.leave_peer)
     d.unary_unary("StatTask", svc.stat_task)

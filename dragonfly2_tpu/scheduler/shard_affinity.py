@@ -175,3 +175,36 @@ class ShardAffinity:
                       for (tid, group), reqs in
                       sorted(self._requests.items())},
         }
+
+    # -- durable state (scheduler/statestore.py) -------------------------
+
+    def export_state(self) -> dict:
+        """Request membership + assignment memos, tuple keys flattened to
+        JSON-safe lists. The split itself is a pure function of the
+        request tables (rendezvous hashing stores no partition), so
+        carrying membership across a crash is exactly what makes the
+        restarted brain re-rule the SAME subsets — the ≥90 % stickiness
+        the recovery bench gates."""
+        return {
+            "seq": self._seq,
+            "requests": [[tid, group, reqs]
+                         for (tid, group), reqs in self._requests.items()],
+            "last": [[tid, group, hid, assigned]
+                     for (tid, group, hid), assigned in self._last.items()],
+        }
+
+    def restore(self, state: dict) -> int:
+        """Rebuild from :meth:`export_state` output. Insertion order is
+        preserved (the MAX_TASKS eviction order), memos silently — a
+        restored memo means the first post-restart register of an
+        unchanged requester set emits NO fresh ledger row, which is the
+        point: recovery observes, it does not re-rule."""
+        restored = 0
+        for tid, group, reqs in (state.get("requests") or ()):
+            self._requests[(tid, group)] = {
+                hid: list(names) for hid, names in reqs.items()}
+            restored += 1
+        for tid, group, hid, assigned in (state.get("last") or ()):
+            self._last[(tid, group, hid)] = list(assigned)
+        self._seq = max(self._seq, int(state.get("seq", 0)))
+        return restored

@@ -2661,6 +2661,13 @@ CTRL_QUARANTINED = 3                # pod-0 hosts poisoned pre-refresh
 CTRL_CRITICAL_EVERY = 97            # every Nth register is critical class
 CTRL_BULK_EVERY = 3                 # every Nth register is bulk class
 
+RECOV_OUTAGE_MS = 5_000.0           # virtual scheduler downtime (crash
+                                    # to restarted-and-serving)
+RECOV_ANNOUNCE_MS = 30_000.0        # one announce interval: how long the
+                                    # amnesia brain waits to re-learn
+                                    # holders from periodic announces
+RECOV_FULL_FLEET = 512              # full-mode second recovery point
+
 
 def run_ctrl_bench(*, seed: int = 7, daemons: int = 1000,
                    pieces: int = 32, piece_size: int = 4 << 20,
@@ -2938,6 +2945,373 @@ def _run_pr16(args) -> dict:
     }
 
 
+def run_recovery_bench(*, seed: int = 7, daemons: int = 64,
+                       pieces: int = 32, piece_size: int = 4 << 20,
+                       durable: bool = True) -> dict:
+    """One leg of the PR-17 crash-resilience storm: a cold herd through
+    the REAL control-plane stack (``Scheduling`` over ``Resource`` with
+    ``QuarantineRegistry``/``PodFederation``/``ShardAffinity`` armed),
+    the scheduler KILLED at 50 % of the refresh storm, then restarted —
+    with the ``scheduler/statestore.py`` snapshot (``durable=True``) or
+    with amnesia (the reference Dragonfly2 behavior the snapshot exists
+    to beat).
+
+    The crash discards every in-memory ruling input. On restart the
+    durable brain restores the snapshot (quarantine ladder, shard
+    request tables + memos, seed elections) and — because daemons see
+    the epoch change — every holder's content is re-announced BEFORE
+    the herd's retry storm lands. The amnesia brain learns holders only
+    from each daemon's periodic announce, one ``RECOV_ANNOUNCE_MS``
+    interval later, so its retry storm back-sources from the origin.
+
+    Measured per leg: time from restart to the first ruling served,
+    origin hits in the retry storm (a ruling whose offer names no
+    content holder = one origin back-source), re-offers of a host
+    quarantined BEFORE the crash, and shard-assignment stickiness
+    across the restart. The durable leg also proves the
+    ``sched.snapshot.io`` contract mid-run: an injected ENOSPC save
+    fails silently while the very next ruling still lands.
+
+    Determinism: virtual quarantine/statestore clocks, seeded rng —
+    ``ruling_digest`` (ordered [kind, peer, chosen] rows, never wall
+    times) is a pure function of (seed, daemons, pieces, durable)."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from ..common import faultgate
+    from ..idl.messages import Host as HostMsg
+    from ..idl.messages import HostType
+    from ..scheduler.config import SchedulerConfig
+    from ..scheduler.decision_ledger import DecisionLedger
+    from ..scheduler.evaluator import make_evaluator
+    from ..scheduler.federation import PodFederation
+    from ..scheduler.quarantine import QuarantineRegistry
+    from ..scheduler.resource import PeerState, Resource
+    from ..scheduler.scheduling import Scheduling
+    from ..scheduler.shard_affinity import ShardAffinity
+    from ..scheduler.statestore import SchedulerStateStore
+
+    random.seed(seed)          # filter_candidates' pool shuffle
+    now_ref = [0.0]            # virtual ms: quarantine AND statestore
+
+    def vclock() -> float:
+        return now_ref[0] / 1000.0
+
+    def build_stack():
+        res = Resource()
+        registry = QuarantineRegistry(
+            corrupt_threshold=3.0, halflife_s=1e9, probation_delay_s=1e9,
+            clock=vclock)
+        fed = PodFederation(seeds_per_pod=1)
+        ledger = DecisionLedger()
+        affinity = ShardAffinity(sink=ledger.on_decision)
+        sched = Scheduling(SchedulerConfig(relay_fanout=RELAY_FANOUT),
+                           make_evaluator("default"), quarantine=registry,
+                           federation=fed, sharded=affinity)
+        sched.decision_sink = ledger.on_decision
+        return res, registry, fed, affinity, sched
+
+    def wire(store, registry, fed, affinity):
+        # the same component set scheduler/server.py registers (minus
+        # tenants/meta, which have no bench-side analog)
+        store.register("quarantine", registry.export_state,
+                       registry.restore)
+        store.register("federation", fed.export_state, fed.restore)
+        store.register("shard_affinity", affinity.export_state,
+                       affinity.restore)
+
+    pods = max(1, -(-daemons // CTRL_PEERS_PER_POD))
+
+    def topo(pod: int, i: int) -> TopologyInfo:
+        return TopologyInfo(slice_name=f"pod-{pod}",
+                            ici_coords=(i % 16, (i // 16) % 16),
+                            zone="bench-zone")
+
+    def make_tasks(res):
+        out = []
+        for p in range(pods):
+            task = res.get_or_create_task(f"recv{p:03d}".ljust(64, "0"),
+                                          f"bench://recovery/{p}")
+            task.set_content_info(pieces * piece_size, piece_size, pieces)
+            out.append(task)
+        return out
+
+    def add_seed(res, fed, tasks, p):
+        t = topo(p, 255)
+        host = res.store_host(HostMsg(
+            id=f"r{p}seed-host", ip="10.0.0.1", port=1, download_port=2,
+            type=HostType.SUPER_SEED, topology=t))
+        fed.observe_host(host.id, t)
+        sp = res.get_or_create_peer(f"r{p}seed-peer", tasks[p], host)
+        sp.transit(PeerState.RUNNING)
+        sp.finished_pieces = set(range(pieces))
+        sp.transit(PeerState.SUCCEEDED)
+
+    res, registry, fed, affinity, sched = build_stack()
+    tasks = make_tasks(res)
+    for p in range(pods):
+        add_seed(res, fed, tasks, p)
+
+    rows: list[list] = []      # [kind, peer_id, chosen ids] -> the digest
+    peers = []
+
+    # -- cold herd: every daemon registers (find rulings)
+    for i in range(daemons):
+        p = i // CTRL_PEERS_PER_POD
+        w = i % CTRL_PEERS_PER_POD
+        t = topo(p, w)
+        host = res.store_host(HostMsg(
+            id=f"r{p}w{w}-host", ip="10.0.0.1", port=1, download_port=2,
+            topology=t))
+        fed.observe_host(host.id, t)
+        peer = res.get_or_create_peer(f"r{p}w{w}-peer", tasks[p], host)
+        peer.created_at = float(i)
+        peers.append(peer)
+        parents = sched.find_parents(peer)
+        peer.last_offer_ids = {pr.id for pr in parents}
+        tasks[p].set_parents(peer.id, [pr.id for pr in parents])
+        rows.append(["find", peer.id, [pr.id for pr in parents]])
+
+    # -- one pod-0 holder goes byzantine: two independent reporters, two
+    # hard verdicts each -> pod-wide quarantine (the PR 12 ladder)
+    now_ref[0] = 1000.0
+    poisoner_peer_id = peers[0].id
+    for rep in ("rep-a", "rep-b"):
+        for _ in range(2):
+            registry.record_corrupt(peers[0].host.id, task_id=tasks[0].id,
+                                    reporter=rep)
+
+    # -- progress: the herd holds partial content; the poisoner holds
+    # EVERYTHING, so it is maximally attractive to any brain that
+    # forgot why it was quarantined
+    for i, peer in enumerate(peers):
+        peer.finished_pieces = set(range((i * 7) % pieces))
+    peers[0].finished_pieces = set(range(pieces))
+
+    requested = [f"layer-{j:02d}" for j in range(CTRL_SHARDS)]
+    shard_n = min(daemons, CTRL_SHARD_RULINGS)
+    for peer in peers[:shard_n]:       # membership warm-up pass
+        assigned = sched.shard_assignment(peer, requested)
+        rows.append(["shard", peer.id, list(assigned or [])])
+    pre_shard = {}
+    for peer in peers[:shard_n]:       # steady state: full membership
+        assigned = sched.shard_assignment(peer, requested)
+        rows.append(["shard-steady", peer.id, list(assigned or [])])
+        pre_shard[peer.host.id] = list(assigned or [])
+
+    half = daemons // 2
+    for peer in peers[:half]:
+        parents = sched.refresh_parents(peer)
+        peer.last_offer_ids = {pr.id for pr in parents}
+        peer.task.set_parents(peer.id, [pr.id for pr in parents])
+        rows.append(["refresh", peer.id, [pr.id for pr in parents]])
+
+    # -- durable leg: the snapshot first survives an injected ENOSPC
+    # (the sched.snapshot.io contract: a failed snapshot must never
+    # block or perturb a ruling — one still lands mid-fault), then
+    # persists for real
+    tmpdir = ""
+    snapshot_fault_survived = None
+    try:
+        if durable:
+            tmpdir = tempfile.mkdtemp(prefix="dfbench-pr17-")
+            store = SchedulerStateStore(tmpdir, clock=vclock, wall=vclock)
+            wire(store, registry, fed, affinity)
+            faultgate.reset()
+            faultgate.arm_script("sched.snapshot.io=error:n=1")
+            failed_save = store.save(reason="bench")
+            probe = sched.refresh_parents(peers[half])
+            peers[half].last_offer_ids = {pr.id for pr in probe}
+            peers[half].task.set_parents(peers[half].id,
+                                         [pr.id for pr in probe])
+            rows.append(["refresh-during-fault", peers[half].id,
+                         [pr.id for pr in probe]])
+            faultgate.reset()
+            snapshot_fault_survived = (failed_save is False
+                                       and store.save(reason="bench"))
+
+        # crash-time holdings: what each daemon can re-announce later
+        holdings = [(i, sorted(peer.finished_pieces))
+                    for i, peer in enumerate(peers)]
+
+        # ==== CRASH: the scheduler dies at 50 % of the refresh storm;
+        # every in-memory ruling input is gone. Restart after a virtual
+        # outage.
+        now_ref[0] += RECOV_OUTAGE_MS
+        res, registry, fed, affinity, sched = build_stack()
+        tasks = make_tasks(res)
+
+        t_restart = _time.perf_counter()
+        provenance = None
+        if durable:
+            store2 = SchedulerStateStore(tmpdir, clock=vclock, wall=vclock)
+            wire(store2, registry, fed, affinity)
+            provenance = store2.restore()
+            # epoch change -> every daemon re-announces held content
+            # (PEX digest codec) BEFORE the retry storm lands: holders
+            # are back immediately — and the restored ladder keeps the
+            # poisoner's full copy out of every offer
+            for p in range(pods):
+                add_seed(res, fed, tasks, p)
+            for i, held in holdings:
+                if not held:
+                    continue
+                p = i // CTRL_PEERS_PER_POD
+                w = i % CTRL_PEERS_PER_POD
+                t = topo(p, w)
+                host = res.store_host(HostMsg(
+                    id=f"r{p}w{w}-host", ip="10.0.0.1", port=1,
+                    download_port=2, topology=t))
+                fed.observe_host(host.id, t)
+                tw = res.get_or_create_peer(f"r{p}w{w}-peer", tasks[p],
+                                            host)
+                tw.created_at = float(i)
+                tw.finished_pieces = set(held)
+
+        # -- retry storm: the mid-pull herd re-registers IMMEDIATELY (no
+        # daemon waits out an announce interval to retry). A ruling
+        # whose offer names no content holder is an origin hit: that
+        # child back-sources its bytes over the WAN.
+        time_to_first_ruling_ms = 0.0
+        origin_hits = 0
+        poisoner_offers = 0
+        post_shard = {}
+        peers2 = []
+        for i in range(daemons):
+            p = i // CTRL_PEERS_PER_POD
+            w = i % CTRL_PEERS_PER_POD
+            t = topo(p, w)
+            host = res.store_host(HostMsg(
+                id=f"r{p}w{w}-host", ip="10.0.0.1", port=1,
+                download_port=2, topology=t))
+            fed.observe_host(host.id, t)
+            peer = res.get_or_create_peer(f"r{p}w{w}-peer", tasks[p], host)
+            peer.created_at = float(i)
+            peers2.append(peer)
+            parents = sched.find_parents(peer)
+            if i == 0:
+                time_to_first_ruling_ms = round(
+                    (_time.perf_counter() - t_restart) * 1000, 3)
+            peer.last_offer_ids = {pr.id for pr in parents}
+            tasks[p].set_parents(peer.id, [pr.id for pr in parents])
+            rows.append(["recover-find", peer.id,
+                         [pr.id for pr in parents]])
+            if not any(pr.has_content() for pr in parents):
+                origin_hits += 1
+            if any(pr.id == poisoner_peer_id for pr in parents):
+                poisoner_offers += 1
+            if i < shard_n:
+                assigned = sched.shard_assignment(peer, requested)
+                rows.append(["recover-shard", peer.id,
+                             list(assigned or [])])
+                post_shard[host.id] = list(assigned or [])
+
+        # -- one announce interval later: the amnesia brain finally
+        # re-learns holders from periodic announces — including the
+        # poisoner, whose quarantine evidence died with the old process
+        now_ref[0] += RECOV_ANNOUNCE_MS
+        if not durable:
+            for p in range(pods):
+                add_seed(res, fed, tasks, p)
+            for i, held in holdings:
+                peers2[i].finished_pieces = set(held)
+
+        # -- steady state resumes: the whole herd re-rules
+        for peer in peers2:
+            parents = sched.refresh_parents(peer)
+            peer.last_offer_ids = {pr.id for pr in parents}
+            peer.task.set_parents(peer.id, [pr.id for pr in parents])
+            rows.append(["recover-refresh", peer.id,
+                         [pr.id for pr in parents]])
+            if any(pr.id == poisoner_peer_id for pr in parents):
+                poisoner_offers += 1
+    finally:
+        faultgate.reset()
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+    sticky = sum(1 for hid, a in pre_shard.items()
+                 if post_shard.get(hid) == a)
+    digest = hashlib.sha256(
+        json.dumps(rows, sort_keys=True).encode()).hexdigest()
+    out = {
+        "leg": "durable" if durable else "amnesia",
+        "daemons": daemons,
+        "pods": pods,
+        "pieces": pieces,
+        "rulings": len(rows),
+        "time_to_first_ruling_ms": time_to_first_ruling_ms,
+        "origin_hits_after_restart": origin_hits,
+        "poisoner_reoffers": poisoner_offers,
+        "shard_stickiness": round(sticky / max(len(pre_shard), 1), 4),
+        "ruling_digest": digest,
+    }
+    if durable:
+        out["snapshot_fault_survived"] = bool(snapshot_fault_survived)
+        out["provenance"] = provenance
+    return out
+
+
+def _run_pr17(args) -> dict:
+    """The PR-17 trajectory point: control-plane crash resilience.
+    Gates: the no-crash baseline sim keeps a ``schedule_digest``
+    byte-identical to BENCH_pr3 (durability never perturbs a ruling),
+    the durable leg serves its first post-restart ruling with ZERO
+    origin stampede while the amnesia twin back-sources the whole herd,
+    a host quarantined before the crash is never re-offered across the
+    restart (the amnesia twin re-offers it), shard assignments stay
+    >=90 % sticky, and a snapshot that fails mid-run (injected ENOSPC)
+    never blocks a ruling. ``recovery_digest`` pins both legs' ruling
+    streams for the tier-1 smoke re-derivation gate."""
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    # legs are PINNED to the fleet-64 x 32-piece shape (not --smoke
+    # scaled): the smoke re-derivation must use the exact parameters
+    # the committed artifact used
+    legs = {
+        "durable": run_recovery_bench(
+            seed=args.seed, daemons=CTRL_SMOKE_FLEET, pieces=CTRL_PIECES,
+            durable=True),
+        "amnesia": run_recovery_bench(
+            seed=args.seed, daemons=CTRL_SMOKE_FLEET, pieces=CTRL_PIECES,
+            durable=False),
+    }
+    if not args.smoke:
+        for name, durable in (("durable", True), ("amnesia", False)):
+            legs[f"{name}_{RECOV_FULL_FLEET}"] = run_recovery_bench(
+                seed=args.seed, daemons=RECOV_FULL_FLEET,
+                pieces=CTRL_PIECES, durable=durable)
+    d, a = legs["durable"], legs["amnesia"]
+    recovery_digest = hashlib.sha256(
+        (d["ruling_digest"] + a["ruling_digest"]).encode()).hexdigest()
+    return {
+        "bench": "dfbench-recovery",
+        "seed": args.seed,
+        "daemons": CTRL_SMOKE_FLEET,
+        "pieces": CTRL_PIECES,
+        "schedule_digest": base["schedule_digest"],
+        "recovery_digest": recovery_digest,
+        "legs": legs,
+        "time_to_first_ruling_ms": {
+            k: v["time_to_first_ruling_ms"] for k, v in legs.items()},
+        "origin_hits_after_restart": {
+            k: v["origin_hits_after_restart"] for k, v in legs.items()},
+        "poisoner_reoffers": {
+            k: v["poisoner_reoffers"] for k, v in legs.items()},
+        "shard_stickiness": {
+            k: v["shard_stickiness"] for k, v in legs.items()},
+        "snapshot_fault_survived": d["snapshot_fault_survived"],
+        "origin_amplification_bounded": (
+            d["origin_hits_after_restart"] * 10
+            <= a["origin_hits_after_restart"]),
+        "poisoner_quarantined_across_restart": (
+            d["poisoner_reoffers"] == 0 < a["poisoner_reoffers"]),
+        "affinity_sticky": d["shard_stickiness"] >= 0.9,
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -3029,6 +3403,18 @@ def build_parser() -> argparse.ArgumentParser:
                    "scheduler state per peer, the profiler-purity "
                    "digest gate against BENCH_pr3, and the disarmed-"
                    "overhead microbenchmark")
+    p.add_argument("--pr17", action="store_true",
+                   help="drive the crash/restart recovery storm (REAL "
+                   "control-plane stack + scheduler/statestore.py "
+                   "snapshot vs a cold-amnesia twin: kill the scheduler "
+                   "at 50%% of the refresh storm, restart, retry storm) "
+                   "and write the PR-17 trajectory point "
+                   "(BENCH_pr17.json): time-to-first-ruling after "
+                   "restart, origin amplification vs amnesia, "
+                   "quarantined-poisoner exclusion across the restart, "
+                   "shard-affinity stickiness, the injected-ENOSPC "
+                   "snapshot-fault contract, and the no-crash digest "
+                   "gate against BENCH_pr3")
     p.add_argument("--pr8", action="store_true",
                    help="replay the baseline run's decision-ledger rows "
                    "through every offline evaluator (default/nt/ml) and "
@@ -3073,7 +3459,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.ctrl:
+        if args.pr17:
+            args.out = "BENCH_pr17.json"
+        elif args.ctrl:
             args.out = "BENCH_pr16.json"
         elif args.pr14:
             args.out = "BENCH_pr14.json"
@@ -3101,7 +3489,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.ctrl:
+    if args.pr17:
+        result = _run_pr17(args)
+    elif args.ctrl:
         result = _run_pr16(args)
     elif args.pr14:
         result = _run_pr14(args)
@@ -3132,7 +3522,20 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.ctrl:
+        if args.pr17:
+            oh = result["origin_hits_after_restart"]
+            ttf = result["time_to_first_ruling_ms"]
+            print(f"dfbench: wrote {args.out} (recovery: first ruling "
+                  f"{ttf['durable']}ms after restart, origin hits "
+                  f"durable={oh['durable']} vs amnesia={oh['amnesia']}, "
+                  f"poisoner reoffers "
+                  f"{result['poisoner_reoffers']['durable']}/"
+                  f"{result['poisoner_reoffers']['amnesia']}, stickiness "
+                  f"{result['shard_stickiness']['durable']}/"
+                  f"{result['shard_stickiness']['amnesia']}, snapshot "
+                  f"fault survived={result['snapshot_fault_survived']}, "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.ctrl:
             rps = result["rulings_per_sec"]
             big = str(result["fleets"][-1])
             p99 = result["phase_p99_ms"][big]

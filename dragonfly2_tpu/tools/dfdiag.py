@@ -288,6 +288,19 @@ def render_ctrl(snap: dict) -> str:
         out.append("  " + "  ".join(
             f"{name}={_fmt_bytes(b)}"
             for name, b in sorted(comps.items())))
+    recov = snap.get("recovery")
+    if recov is not None:
+        if recov.get("recovered"):
+            parts = [f"recovery: warm (gap {recov.get('gap_s', 0.0)}s)"]
+            rcomps = recov.get("components") or {}
+            if rcomps:
+                parts.append("  " + "  ".join(
+                    f"{name}={sub.get('restored', 0)} restored"
+                    + ("" if sub.get("present", True) else " [absent]")
+                    for name, sub in sorted(rcomps.items())))
+            out.extend(parts)
+        else:
+            out.append("recovery: cold boot (no usable snapshot)")
     return "\n".join(out)
 
 

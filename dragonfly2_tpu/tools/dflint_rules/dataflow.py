@@ -473,3 +473,104 @@ class AcquireRefundPairing(Rule):
                     f"await/raise sits between acquire and release but "
                     f"no release runs in a finally/except; an abandoned "
                     f"slot starves the gate (upload-slot discipline)")
+
+
+# ---------------------------------------------------------------------------
+# DF008 — tmp-file fd release on persist paths (statestore idiom)
+# ---------------------------------------------------------------------------
+
+def _is_raw_open(call: ast.Call) -> bool:
+    """``open(...)`` or ``os.fdopen(...)`` — a file object whose close
+    this function owns (a ``with`` block never binds through Assign, so
+    it is exempt by construction)."""
+    if isinstance(call.func, ast.Name) and call.func.id == "open":
+        return True
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "fdopen"
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "os")
+
+
+def _calls_os_replace(fn) -> bool:
+    for node in _walk_scope(fn.body):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "replace"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "os"):
+            return True
+    return False
+
+
+@register
+class TmpFdRelease(Rule):
+    """DF008 family: a persist path using the tmp+rename idiom must
+    release its tmp-file fd on the exception path.
+
+    Incident class (PR 17, made static): ``statestore.save`` runs on the
+    GC ticker and swallows every failure by design — the snapshot that
+    cannot land must never block a ruling, so the NEXT tick retries. On
+    an ENOSPC'd or wedged disk that means the torn ``f.write`` raises
+    every few seconds forever; with the fd closed only on the
+    straight-line path, each retry leaks one descriptor and the process
+    walks into EMFILE — at which point the scheduler cannot accept
+    connections either, and the "best-effort" snapshot has taken the
+    control plane down with it.
+
+    The rule fires on any function that performs the idiom (calls
+    ``os.replace``) and binds a raw ``open()``/``os.fdopen()`` to a
+    name: the ``close()`` must run in a ``finally`` or ``except`` arm
+    (the ``statestore._write`` / ``TaskMetadata.save``-with-``with``
+    shapes). A straight-line-only close sits after writes that raise on
+    a full disk; no close at all leaks even on success.
+    """
+
+    code = "DF008"
+    name = "tmp-fd-release"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _calls_os_replace(fn):
+                continue
+            yield from self._check_fn(ctx, fn)
+
+    def _check_fn(self, ctx: ModuleCtx, fn) -> Iterator[Finding]:
+        for node in _walk_scope(fn.body):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and _is_raw_open(node.value)):
+                continue
+            var = node.targets[0].id
+            closes = [
+                n for n in _walk_scope(fn.body)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "close"
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == var]
+            if not closes:
+                yield Finding(
+                    self.code, ctx.rel, node.lineno, node.col_offset,
+                    f"tmp-file fd {var!r} on a tmp+rename persist path "
+                    f"is never closed — every retry of a failing persist "
+                    f"leaks one fd until EMFILE; close it in a finally "
+                    f"(statestore._write shape) or use `with`")
+                continue
+            protected = _protected_sites(
+                fn, lambda c: (isinstance(c.func, ast.Attribute)
+                               and c.func.attr == "close"
+                               and isinstance(c.func.value, ast.Name)
+                               and c.func.value.id == var))
+            if not protected:
+                yield Finding(
+                    self.code, ctx.rel, node.lineno, node.col_offset,
+                    f"tmp-file fd {var!r} closes only on the straight-"
+                    f"line path — a torn write (ENOSPC, the "
+                    f"sched.snapshot.io fault) raises before close and "
+                    f"the retry loop leaks one fd per tick; move the "
+                    f"close into a finally (statestore._write shape) or "
+                    f"use `with`")

@@ -57,7 +57,8 @@ async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
             ledger=sched.ledger,
             federation=sched.service.federation,
             quarantine=sched.service.quarantine,
-            sharded=sched.service.scheduling.sharded))
+            sharded=sched.service.scheduling.sharded,
+            statestore=sched.statestore))
 
     debug_runner = await maybe_start_debug(debug_port,
                                            extra_routes=_extra_routes)

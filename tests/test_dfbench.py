@@ -962,5 +962,90 @@ class TestPr16Ctrl:
         assert r["overhead"]["armed_ns_per_call"] > 0
 
 
+class TestPr17Recovery:
+    """PR-17 point: control-plane crash resilience. The crash/restart
+    storm must be deterministic (one ruling digest per (seed, leg),
+    byte-identical across processes), the durable leg must beat the
+    amnesia twin on every recovery gate, and the committed
+    BENCH_pr17.json must carry the BENCH_pr3 schedule digest with every
+    acceptance flag stamped true."""
+
+    SHAPE = dict(seed=7, daemons=64, pieces=32)
+
+    def test_recovery_bench_deterministic(self):
+        from dragonfly2_tpu.tools.dfbench import run_recovery_bench
+        a = run_recovery_bench(**self.SHAPE, durable=True)
+        b = run_recovery_bench(**self.SHAPE, durable=True)
+        assert a["ruling_digest"] == b["ruling_digest"]
+        c = run_recovery_bench(seed=11, daemons=64, pieces=32,
+                               durable=True)
+        assert c["ruling_digest"] != a["ruling_digest"]
+
+    def test_durable_leg_beats_amnesia_on_every_gate(self):
+        from dragonfly2_tpu.tools.dfbench import run_recovery_bench
+        d = run_recovery_bench(**self.SHAPE, durable=True)
+        a = run_recovery_bench(**self.SHAPE, durable=False)
+        # origin stampede: the warm brain re-announced every holder
+        # before the retry storm; the amnesia brain back-sourced the
+        # whole herd for one announce interval
+        assert d["origin_hits_after_restart"] == 0
+        assert a["origin_hits_after_restart"] == 64
+        # a host quarantined BEFORE the crash is never re-offered across
+        # the restart; the amnesia twin re-offers its full copy
+        assert d["poisoner_reoffers"] == 0
+        assert a["poisoner_reoffers"] > 0
+        # restored shard request tables re-rule the identical subsets
+        assert d["shard_stickiness"] == 1.0
+        assert a["shard_stickiness"] < 0.9
+        # an injected-ENOSPC snapshot failed silently mid-run while the
+        # very next ruling still landed
+        assert d["snapshot_fault_survived"] is True
+        # the restore actually recovered every registered component
+        prov = d["provenance"]
+        assert prov["recovered"] is True
+        assert prov["gap_s"] == 5.0
+        for comp in ("quarantine", "federation", "shard_affinity"):
+            assert prov["components"][comp]["restored"] >= 1
+
+    def test_pr17_smoke_stdout_only_and_committed_digest(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr17", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-recovery"
+        assert not list(tmp_path.iterdir())      # stdout only
+        # the cross-process gate: the smoke re-derivation of the
+        # fleet-64 crash storm matches the committed artifact
+        committed = json.loads(
+            open(os.path.join(REPO, "BENCH_pr17.json")).read())
+        assert r["recovery_digest"] == committed["recovery_digest"]
+
+    def test_pr17_committed_matches_baselines(self):
+        """The committed trajectory gate: BENCH_pr17's no-crash baseline
+        digest is byte-identical to BENCH_pr3 (durability perturbed
+        nothing) and every acceptance flag landed true, at 64 and at
+        512 daemons."""
+        r = json.loads(open(os.path.join(REPO, "BENCH_pr17.json")).read())
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["origin_amplification_bounded"] is True
+        assert r["poisoner_quarantined_across_restart"] is True
+        assert r["affinity_sticky"] is True
+        assert r["snapshot_fault_survived"] is True
+        assert set(r["legs"]) == {"durable", "amnesia",
+                                  "durable_512", "amnesia_512"}
+        for name, leg in r["legs"].items():
+            if name.startswith("durable"):
+                assert leg["origin_hits_after_restart"] == 0
+                assert leg["poisoner_reoffers"] == 0
+                assert leg["shard_stickiness"] >= 0.9
+            else:
+                assert leg["origin_hits_after_restart"] == leg["daemons"]
+                assert leg["poisoner_reoffers"] > 0
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-v"])

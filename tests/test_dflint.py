@@ -534,6 +534,78 @@ class TestDF008:
         assert codes(fs) == []
 
 
+class TestDF008TmpFd:
+    """tmp-file fd release on tmp+rename persist paths (PR 17)."""
+
+    def test_flags_straight_line_close(self):
+        # the write raises on a full disk BEFORE the close runs — each
+        # retry of the persist tick leaks one descriptor
+        fs = run_lint("""
+            import os
+
+            def save(path, payload):
+                tmp = path + ".tmp"
+                f = open(tmp, "wb")
+                f.write(payload)           # ENOSPC raises here
+                os.fsync(f.fileno())
+                f.close()                  # straight-line only
+                os.replace(tmp, path)
+        """)
+        assert codes(fs) == ["DF008"]
+        assert "straight-line path" in active(fs)[0].message
+
+    def test_flags_missing_close(self):
+        fs = run_lint("""
+            import os
+
+            def save(path, payload):
+                tmp = path + ".tmp"
+                f = open(tmp, "wb")
+                f.write(payload)
+                os.replace(tmp, path)      # fd leaks even on success
+        """)
+        assert codes(fs) == ["DF008"]
+        assert "never closed" in active(fs)[0].message
+
+    def test_protected_and_with_shapes_are_clean(self):
+        fs = run_lint("""
+            import os
+
+            def save(path, payload):
+                tmp = path + ".tmp"
+                f = open(tmp, "wb")
+                try:
+                    f.write(payload)
+                    os.fsync(f.fileno())
+                finally:
+                    f.close()              # statestore._write shape
+                os.replace(tmp, path)
+
+            def save_with(path, payload):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+
+            def save_fd(path, payload, fd):
+                tmp = path + ".tmp"
+                f = os.fdopen(fd, "wb")
+                try:
+                    f.write(payload)
+                finally:
+                    f.close()
+                os.replace(tmp, path)
+
+            def not_a_persist_path(path, payload):
+                # no os.replace -> outside the rule's incident class
+                f = open(path, "wb")
+                f.write(payload)
+                f.close()
+        """)
+        assert codes(fs) == []
+
+
 # ---------------------------------------------------------------------------
 # DF009 — async lock-ordering (global rule)
 # ---------------------------------------------------------------------------

@@ -22,7 +22,12 @@ import ssl
 
 import pytest
 
-pytest.importorskip("cryptography")  # TLS registry + MITM need the wheel
+# TLS registry + MITM ride the cryptography API — wheel or CLI shim
+from dragonfly2_tpu.common import cryptoshim
+
+if not cryptoshim.install():
+    pytest.skip("no cryptography wheel and no openssl binary",
+                allow_module_level=True)
 from aiohttp import web
 
 from dragonfly2_tpu.common.certs import CertIssuer

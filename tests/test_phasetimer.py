@@ -338,3 +338,21 @@ class TestRenderCtrl:
         text = render_ctrl(phasetimer.snapshot())
         assert "no rulings profiled" in text
         assert "arm" in text
+        assert "recovery" not in text        # no statestore → no line
+
+    def test_render_recovery_warm(self):
+        snap = phasetimer.snapshot()
+        snap["recovery"] = {
+            "recovered": True, "gap_s": 4.2,
+            "components": {"quarantine": {"restored": 3, "present": True},
+                           "federation": {"restored": 0, "present": False}}}
+        text = render_ctrl(snap)
+        assert "recovery: warm (gap 4.2s)" in text
+        assert "quarantine=3 restored" in text
+        assert "federation=0 restored [absent]" in text
+
+    def test_render_recovery_cold(self):
+        snap = phasetimer.snapshot()
+        snap["recovery"] = {"recovered": False}
+        text = render_ctrl(snap)
+        assert "recovery: cold boot (no usable snapshot)" in text

@@ -16,7 +16,12 @@ import ssl
 
 import pytest
 
-pytest.importorskip("cryptography")  # MITM cert minting needs the wheel
+# MITM cert minting rides the cryptography API — wheel or CLI shim
+from dragonfly2_tpu.common import cryptoshim
+
+if not cryptoshim.install():
+    pytest.skip("no cryptography wheel and no openssl binary",
+                allow_module_level=True)
 
 from dragonfly2_tpu.common.certs import CertIssuer, generate_ca
 from dragonfly2_tpu.daemon.config import (DaemonConfig, DownloadConfig,

@@ -1,0 +1,231 @@
+"""Recovery chaos e2e: the scheduler is KILLED mid-swarm and restarted
+over its durable statestore (PR 17 acceptance). The restarted brain must
+(a) restore the quarantine ladder / shard memos / epoch from the
+snapshot BEFORE its first ruling, (b) have every daemon re-announce held
+content within one announce interval of seeing the epoch change, and
+(c) serve a fresh leecher entirely from the swarm with the origin gone —
+while a host quarantined before the crash is never offered again. A
+torn snapshot must be refused WHOLESALE and degrade to a clean cold
+boot, never a half-applied view."""
+
+import asyncio
+import os
+
+import pytest
+
+# real daemons + full pulls + a scheduler restart: seconds of wall time
+# by design — tier-1 excludes it (ROADMAP -m 'not slow')
+pytestmark = pytest.mark.slow
+
+from test_daemon_e2e import daemon_config, start_origin
+from test_scheduler import download_via, leecher_config
+
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.scheduler.config import SchedulerConfig, SeedPeerAddr
+from dragonfly2_tpu.scheduler.quarantine import HEALTHY, QUARANTINED
+from dragonfly2_tpu.scheduler.server import Scheduler
+
+
+def _sched_cfg(tmp_path, seed, *, port: int = 0) -> SchedulerConfig:
+    return SchedulerConfig(
+        port=port,
+        seed_peers=[SeedPeerAddr(ip="127.0.0.1", rpc_port=seed.rpc.port,
+                                 download_port=seed.upload_server.port)],
+        statestore_dir=str(tmp_path / "sched-state"),
+        statestore_interval_s=0.1,
+        statestore_handoff=False)          # no manager in this fleet
+
+
+def _fast_leecher(tmp_path, name, sched_addr):
+    """Leecher wired for fast recovery detection: sub-second announce
+    and register-refresh cadence, so the epoch-change reconcile fires
+    within test timescales instead of the production 30 s."""
+    cfg = leecher_config(tmp_path, name, sched_addr)
+    cfg.announce_interval_s = 0.2
+    cfg.scheduler.refresh_interval_s = 0.2
+    return cfg
+
+
+def _recovery_sources(sched) -> list[str]:
+    return [row.get("source") for row in sched.ledger._ring
+            if row.get("decision_kind") == "recovery"]
+
+
+def test_scheduler_crash_recovers_quarantine_and_serves_without_origin(
+        tmp_path):
+    """Kill + restart the scheduler mid-swarm over its statestore: the
+    quarantine verdict survives (the poisoner is never re-offered), the
+    daemons' re-announce rebuilds the holder view within one announce
+    interval, and a fresh leecher then pulls the whole task
+    byte-identical from the swarm with ZERO origin bytes — the origin
+    is gone before the pull starts."""
+
+    async def go():
+        data = os.urandom(10 * 1024 * 1024 + 777)        # 3 pieces
+        origin, base = await start_origin({"m.bin": data})
+        url = f"{base}/m.bin"
+        seed_cfg = daemon_config(tmp_path, "seed")
+        seed_cfg.is_seed = True
+        seed = Daemon(seed_cfg)
+        await seed.start()
+        sched = Scheduler(_sched_cfg(tmp_path, seed))
+        await sched.start()
+        l1 = Daemon(_fast_leecher(tmp_path, "l1", sched.address))
+        lp = Daemon(_fast_leecher(tmp_path, "lp", sched.address))
+        await l1.start()
+        await lp.start()
+        sched2 = None
+        l2 = None
+        try:
+            # phase 1: two leechers complete — both are attractive
+            # parents; lp will be the one that earned quarantine
+            r1 = await download_via(l1, url, str(tmp_path / "l1.out"))
+            rp = await download_via(lp, url, str(tmp_path / "lp.out"))
+            assert r1 is not None and rp is not None
+            assert (tmp_path / "l1.out").read_bytes() == data
+            assert (tmp_path / "lp.out").read_bytes() == data
+            task_id = r1.task_id
+            lp_host = lp.upload_server.host_id
+            assert lp_host == "lp-127.0.0.1"
+
+            # phase 2: the poisoner earns pod-wide quarantine BEFORE
+            # the crash (two independent reporters, two verdicts each —
+            # the PR 12 ladder), and the event-driven statestore
+            # cadence snapshots the transition
+            reg = sched.quarantine
+            for rep in ("l1-127.0.0.1", "seed-127.0.0.1"):
+                for _ in range(2):
+                    reg.record_corrupt(lp_host, task_id=task_id,
+                                       reporter=rep)
+            assert reg.state(lp_host) == QUARANTINED
+
+            # ---- CRASH: the brain stops (the shutdown snapshot
+            # lands); restart on the SAME address over the same store
+            port = sched.port
+            await sched.stop()
+            sched2 = Scheduler(_sched_cfg(tmp_path, seed, port=port))
+            await sched2.start()
+
+            # (a) restored before the first ruling, with provenance
+            prov = sched2.statestore.provenance
+            assert prov["recovered"] is True
+            assert prov["components"]["quarantine"]["restored"] >= 1
+            assert "snapshot" in _recovery_sources(sched2)
+            # the verdict survived: still excluded, with NO fresh
+            # evidence fed to the restarted registry
+            assert sched2.quarantine.state(lp_host) == QUARANTINED
+            assert not sched2.quarantine.offerable(lp_host, "any-child")
+
+            # (b) warm reconciliation: daemons see the epoch change and
+            # re-announce held content within one (fast) announce
+            # interval — the recovered brain re-learns its holders
+            def holders() -> int:
+                t = sched2.resource.tasks.get(task_id)
+                if t is None:
+                    return 0
+                return sum(1 for p in t.peers.values()
+                           if p.finished_pieces or p.is_done())
+
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while asyncio.get_running_loop().time() < deadline:
+                if holders() >= 1:
+                    break
+                await asyncio.sleep(0.1)
+            assert holders() >= 1, "no re-announced holder within 10s"
+            assert "reannounce" in _recovery_sources(sched2)
+
+            # (c) the origin dies; a fresh leecher joins the recovered
+            # swarm and pulls byte-identical with zero origin bytes
+            await origin.cleanup()
+            l2 = Daemon(_fast_leecher(tmp_path, "l2", sched2.address))
+            await l2.start()
+            r2 = await download_via(l2, url, str(tmp_path / "l2.out"))
+            assert r2 is not None
+            assert (tmp_path / "l2.out").read_bytes() == data
+            c = l2.ptm.conductor(task_id)
+            assert c.state == c.SUCCESS
+            assert c.traffic_source == 0       # zero origin amplification
+            assert c.traffic_p2p == len(data)
+
+            # the quarantined poisoner was never offered across the
+            # restart: no post-crash ruling's chosen list names its
+            # host (its re-announced holder twin carries the host id)
+            for row in sched2.ledger._ring:
+                for chosen in (row.get("chosen") or []):
+                    assert lp_host not in str(chosen), row
+        finally:
+            if l2 is not None:
+                await l2.stop()
+            if sched2 is not None:
+                await sched2.stop()
+            await lp.stop()
+            await l1.stop()
+            await seed.stop()
+            await origin.cleanup()
+
+    asyncio.run(go())
+
+
+def test_torn_snapshot_refused_wholesale_and_boot_degrades_to_cold(
+        tmp_path):
+    """Crash-rot on the snapshot itself: the blob is truncated mid-file
+    while the scheduler is down. The restart must refuse it WHOLESALE
+    (no half-applied quarantine view), report unrecovered provenance,
+    and still boot into a fully serving cold brain — a pull through it
+    completes byte-identical."""
+
+    async def go():
+        data = os.urandom(5 * 1024 * 1024 + 99)          # 2 pieces
+        origin, base = await start_origin({"m.bin": data})
+        url = f"{base}/m.bin"
+        seed_cfg = daemon_config(tmp_path, "seed")
+        seed_cfg.is_seed = True
+        seed = Daemon(seed_cfg)
+        await seed.start()
+        sched = Scheduler(_sched_cfg(tmp_path, seed))
+        await sched.start()
+        sched2 = None
+        l1 = None
+        try:
+            # durable state worth refusing: a suspect on the ladder
+            sched.quarantine.record_corrupt("ghost-host",
+                                            task_id="t" * 64,
+                                            reporter="rep-a")
+            port = sched.port
+            await sched.stop()
+
+            # tear the snapshot mid-file while the brain is down
+            path = tmp_path / "sched-state" / "scheduler_state.json"
+            raw = path.read_bytes()
+            assert len(raw) > 2
+            path.write_bytes(raw[: len(raw) // 2])
+
+            sched2 = Scheduler(_sched_cfg(tmp_path, seed, port=port))
+            await sched2.start()
+            # wholesale refusal: nothing recovered, nothing half-applied
+            assert sched2.statestore.provenance == {"recovered": False}
+            assert sched2.quarantine.state("ghost-host") == HEALTHY
+            assert "snapshot" not in _recovery_sources(sched2)
+
+            # amnesia, but never a crash: the cold brain serves
+            l1 = Daemon(_fast_leecher(tmp_path, "l1", sched2.address))
+            await l1.start()
+            r = await download_via(l1, url, str(tmp_path / "l1.out"),
+                                   disable_back_source=False)
+            assert r is not None
+            assert (tmp_path / "l1.out").read_bytes() == data
+            c = l1.ptm.conductor(r.task_id)
+            assert c.state == c.SUCCESS
+        finally:
+            if l1 is not None:
+                await l1.stop()
+            if sched2 is not None:
+                await sched2.stop()
+            await seed.stop()
+            await origin.cleanup()
+
+    asyncio.run(go())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -12,9 +12,13 @@ import os
 import pytest
 
 # the whole security surface (manager issuance, fleet mTLS, PATs) rides
-# the cryptography wheel; without it these are environment gaps, not
-# regressions — skip cleanly instead of failing tier-1
-pytest.importorskip("cryptography")
+# the cryptography API; the openssl-CLI shim covers a missing wheel, so
+# these only skip on a machine with NEITHER — a genuine capability gap
+from dragonfly2_tpu.common import cryptoshim
+
+if not cryptoshim.install():
+    pytest.skip("no cryptography wheel and no openssl binary",
+                allow_module_level=True)
 
 from dragonfly2_tpu.manager.server import Manager, ManagerConfig
 from dragonfly2_tpu.manager.store import Store

@@ -1,0 +1,371 @@
+"""Durable scheduler state (PR 17): snapshot journal + recovery units.
+
+The crash-survivable half of the control plane: the tmp+fsync+rename
+persist idiom, wholesale schema refusal on torn snapshots (for EVERY
+persisted component), quarantine-ladder decay continuity across a
+save/load round trip on a virtual clock, the `sched.snapshot.io`
+faultgate site (a failed snapshot must never raise into a ruling), and
+the records-close shutdown ordering (a closed file counts one flush
+failure, it does not abort teardown).
+"""
+
+import json
+import os
+
+import pytest
+
+from dragonfly2_tpu.common import faultgate
+from dragonfly2_tpu.scheduler.federation import PodFederation
+from dragonfly2_tpu.scheduler.quarantine import (HEALTHY, QUARANTINED,
+                                                 SUSPECT, QuarantineRegistry)
+from dragonfly2_tpu.scheduler.records import DownloadRecords
+from dragonfly2_tpu.scheduler.shard_affinity import ShardAffinity
+from dragonfly2_tpu.scheduler.statestore import (SCHEMA_VERSION,
+                                                 SchedulerStateStore)
+
+
+class VClock:
+    """One virtual time source driving both the statestore's wall clock
+    and the quarantine ladder's monotonic clock, so decay across a
+    simulated outage is deterministic."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def make_store(tmp_path, clock: VClock, **kw) -> SchedulerStateStore:
+    return SchedulerStateStore(str(tmp_path / "state"), clock=clock,
+                               wall=clock, **kw)
+
+
+def decision_row(i: int = 0) -> dict:
+    return {"kind": "decision", "decision_kind": "find",
+            "decision_id": f"d{i:08d}.x", "task_id": "t", "peer_id": "p",
+            "candidates": [], "excluded": [], "chosen": []}
+
+
+class TestPersistIdiom:
+    def test_save_load_round_trip(self, tmp_path):
+        clock = VClock()
+        store = make_store(tmp_path, clock)
+        store.register("unit", lambda: {"n": 7}, lambda sub: sub["n"])
+        assert store.save()
+        reborn = make_store(tmp_path, clock)
+        restored = {}
+        reborn.register("unit", dict,
+                        lambda sub: restored.update(sub) or len(sub))
+        prov = reborn.restore()
+        assert prov["recovered"] is True
+        assert restored == {"n": 7}
+        assert prov["components"]["unit"]["restored"] == 1
+
+    def test_dirty_and_periodic_cadence(self, tmp_path):
+        clock = VClock()
+        store = make_store(tmp_path, clock, interval_s=30.0)
+        store.register("unit", lambda: {}, lambda sub: 0)
+        store.save()                       # anchors _last_save
+        assert not store.maybe_save()      # neither dirty nor elapsed
+        store.mark_dirty()
+        assert store.maybe_save()          # event-driven
+        clock.t += 31.0
+        assert store.maybe_save()          # periodic
+        assert not store.maybe_save()
+
+    def test_wrap_sink_marks_dirty_and_forwards(self, tmp_path):
+        store = make_store(tmp_path, VClock())
+        seen = []
+        wrapped = store.wrap_sink(seen.append)
+        wrapped({"kind": "decision"})
+        assert seen and store.maybe_save()
+        # a None inner sink is tolerated (component had no ledger)
+        store.wrap_sink(None)({"kind": "decision"})
+
+    def test_version_skew_refused_wholesale(self, tmp_path):
+        clock = VClock()
+        store = make_store(tmp_path, clock)
+        store.register("unit", lambda: {"n": 1}, lambda sub: 1)
+        assert store.save()
+        with open(store.path, "r+", encoding="utf-8") as f:
+            body = json.load(f)
+            body["v"] = SCHEMA_VERSION + 1
+            f.seek(0)
+            f.truncate()
+            json.dump(body, f)
+        reborn = make_store(tmp_path, clock)
+        called = []
+        reborn.register("unit", dict, lambda sub: called.append(sub) or 0)
+        prov = reborn.restore()
+        assert prov == {"recovered": False}
+        assert not called                  # never half-applied
+
+    def test_missing_component_and_failing_restore_skip_independently(
+            self, tmp_path):
+        clock = VClock()
+        store = make_store(tmp_path, clock)
+        store.register("good", lambda: {"n": 1}, lambda sub: 1)
+        store.register("bad", lambda: {"n": 1}, lambda sub: 1)
+        assert store.save()
+        reborn = make_store(tmp_path, clock)
+        reborn.register("good", dict, lambda sub: 1)
+
+        def explode(sub):
+            raise RuntimeError("component rot")
+
+        reborn.register("bad", dict, explode)
+        reborn.register("newer", dict, lambda sub: 1)   # not in snapshot
+        prov = reborn.restore()
+        comps = prov["components"]
+        assert prov["recovered"] is True
+        assert comps["good"]["restored"] == 1
+        assert comps["bad"]["error"] and comps["bad"]["restored"] == 0
+        assert comps["newer"] == {"restored": 0, "present": False}
+
+
+def full_snapshot(tmp_path, clock: VClock) -> SchedulerStateStore:
+    """A store journaling every component the real scheduler registers:
+    quarantine, federation, shard_affinity, tenants, meta."""
+    from dragonfly2_tpu.idl.messages import TopologyInfo
+
+    store = make_store(tmp_path, clock)
+    quarantine = QuarantineRegistry(clock=clock, sink=None)
+    quarantine.record_corrupt("badhost", task_id="t1", reporter="r1")
+    federation = PodFederation()
+    federation.observe_host("h1", TopologyInfo(pod="podA"))
+    sharded = ShardAffinity()
+    sharded.assign(task_id="t1", peer_id="p1", host_id="h1",
+                   topology=None, requested=["s0", "s1"])
+    tenants = {"tenants": {"bulk": {"qos_class": "bulk"}},
+               "applications": {"app": 3}}
+    meta = {"epoch": 1700000000}
+    store.register("quarantine", quarantine.export_state, quarantine.restore)
+    store.register("federation", federation.export_state, federation.restore)
+    store.register("shard_affinity", sharded.export_state, sharded.restore)
+    store.register("tenants", lambda: tenants, lambda sub: len(sub))
+    store.register("meta", lambda: meta, lambda sub: 1)
+    assert store.save()
+    return store
+
+
+class TestTornSnapshotEveryComponent:
+    """Truncation at any byte must refuse the WHOLE blob — no component
+    may see a half-parsed sub-dict."""
+
+    COMPONENTS = ("quarantine", "federation", "shard_affinity", "tenants",
+                  "meta")
+
+    @pytest.mark.parametrize("keep", [0.25, 0.5, 0.9])
+    def test_truncated_snapshot_restores_nothing(self, tmp_path, keep):
+        clock = VClock()
+        store = full_snapshot(tmp_path, clock)
+        raw = open(store.path, "rb").read()
+        body = json.loads(raw)
+        for name in self.COMPONENTS:
+            assert name in body["components"]     # the snapshot is real
+        with open(store.path, "wb") as f:
+            f.write(raw[:int(len(raw) * keep)])   # torn mid-write
+        reborn = make_store(tmp_path, clock)
+        applied = []
+        for name in self.COMPONENTS:
+            reborn.register(name, dict,
+                            lambda sub, _n=name: applied.append(_n) or 0)
+        assert reborn.load() is None
+        prov = reborn.restore()
+        assert prov == {"recovered": False}
+        assert applied == []
+
+    def test_intact_snapshot_reaches_every_component(self, tmp_path):
+        clock = VClock()
+        store = full_snapshot(tmp_path, clock)
+        reborn = make_store(tmp_path, clock)
+        quarantine = QuarantineRegistry(clock=clock)
+        federation = PodFederation()
+        sharded = ShardAffinity()
+        tenants_in, meta_in = {}, {}
+        reborn.register("quarantine", quarantine.export_state,
+                        quarantine.restore)
+        reborn.register("federation", federation.export_state,
+                        federation.restore)
+        reborn.register("shard_affinity", sharded.export_state,
+                        sharded.restore)
+        reborn.register("tenants", dict,
+                        lambda sub: tenants_in.update(sub) or len(sub))
+        reborn.register("meta", dict,
+                        lambda sub: meta_in.update(sub) or 1)
+        prov = reborn.restore()
+        assert prov["recovered"] is True
+        assert quarantine.state("badhost") == SUSPECT
+        assert federation.pod_of_host("h1") == "podA"
+        # the restored memo re-rules the identical subset silently
+        assert sharded.restore is not None and prov["components"][
+            "shard_affinity"]["restored"] == 1
+        assert tenants_in["tenants"]["bulk"]["qos_class"] == "bulk"
+        assert meta_in["epoch"] == 1700000000
+
+    def test_store_survives_missing_file(self, tmp_path):
+        reborn = make_store(tmp_path, VClock())
+        reborn.register("unit", dict, lambda sub: 0)
+        assert reborn.load() is None
+        assert reborn.restore() == {"recovered": False}
+
+
+class TestQuarantineDecayRoundTrip:
+    """The ISSUE's named unit: evidence decay keeps running across the
+    outage. Snapshot a host at `suspect`; a reload after the decay
+    horizon comes back `healthy`, a reload within it preserves the
+    ladder position (and the decayed mass)."""
+
+    def setup_ladder(self, tmp_path, clock):
+        store = make_store(tmp_path, clock)
+        reg = QuarantineRegistry(clock=clock, halflife_s=600.0)
+        reg.record_corrupt("badhost", task_id="t1", reporter="r1")
+        assert reg.state("badhost") == SUSPECT
+        store.register("quarantine", reg.export_state, reg.restore)
+        assert store.save()
+        return store
+
+    def reload(self, tmp_path, clock):
+        reborn = make_store(tmp_path, clock)
+        reg = QuarantineRegistry(clock=clock, halflife_s=600.0)
+        reborn.register("quarantine", reg.export_state, reg.restore)
+        prov = reborn.restore()
+        return reg, prov
+
+    def test_reload_after_decay_horizon_is_healthy(self, tmp_path):
+        clock = VClock()
+        self.setup_ladder(tmp_path, clock)
+        clock.t += 6000.0                  # ten halflives of downtime
+        reg, prov = self.reload(tmp_path, clock)
+        assert reg.state("badhost") == HEALTHY
+        # the entry decayed out entirely — dropped, not carried as zero
+        assert prov["components"]["quarantine"]["restored"] == 0
+        assert prov["gap_s"] == pytest.approx(6000.0)
+
+    def test_reload_within_horizon_preserves_position(self, tmp_path):
+        clock = VClock()
+        self.setup_ladder(tmp_path, clock)
+        clock.t += 300.0                   # half a halflife of downtime
+        reg, prov = self.reload(tmp_path, clock)
+        assert reg.state("badhost") == SUSPECT
+        assert prov["components"]["quarantine"]["restored"] == 1
+        h = reg._hosts["badhost"]
+        # exported at 1.0, charged the 300 s gap: 1.0 * 0.5**(300/600)
+        assert h.corrupt == pytest.approx(0.5 ** 0.5, rel=1e-3)
+        assert h.reporters == {"r1"}
+
+    def test_quarantined_probation_timer_restarts_at_recovery(self,
+                                                              tmp_path):
+        clock = VClock()
+        store = make_store(tmp_path, clock)
+        reg = QuarantineRegistry(clock=clock, halflife_s=3600.0,
+                                 corrupt_threshold=2.0, min_reporters=2,
+                                 probation_delay_s=30.0)
+        reg.record_corrupt("poisoner", reporter="r1")
+        reg.record_corrupt("poisoner", reporter="r2")
+        assert reg.state("poisoner") == QUARANTINED
+        store.register("quarantine", reg.export_state, reg.restore)
+        assert store.save()
+        # the outage alone exceeds probation_delay_s — but no probe can
+        # have run while the brain was down, so the poisoner must NOT
+        # come back lazily promoted into offerable probation
+        clock.t += 120.0
+        reborn = make_store(tmp_path, clock)
+        reg2 = QuarantineRegistry(clock=clock, halflife_s=3600.0,
+                                  corrupt_threshold=2.0, min_reporters=2,
+                                  probation_delay_s=30.0)
+        reborn.register("quarantine", reg2.export_state, reg2.restore)
+        reborn.restore()
+        assert reg2.state("poisoner") == QUARANTINED
+        assert not reg2.offerable("poisoner", "child")
+
+
+class TestSnapshotFaultgate:
+    """`sched.snapshot.io`: a failing persist is counted and swallowed —
+    it must never raise into (or block) the ruling path."""
+
+    def teardown_method(self):
+        faultgate.reset()
+
+    def test_enospc_shaped_failure_never_raises_then_recovers(self,
+                                                              tmp_path):
+        clock = VClock()
+        store = make_store(tmp_path, clock)
+        store.register("unit", lambda: {"n": 1}, lambda sub: 1)
+        faultgate.arm_script("sched.snapshot.io=error:n=1")
+        assert store.save() is False       # swallowed, not raised
+        assert not os.path.exists(store.path)
+        assert store.save() is True        # next tick retries clean
+        assert json.load(open(store.path))["components"]["unit"] == {"n": 1}
+
+    def test_failed_save_keeps_dirty_for_retry(self, tmp_path):
+        clock = VClock()
+        store = make_store(tmp_path, clock, interval_s=3600.0)
+        store.register("unit", lambda: {}, lambda sub: 0)
+        store.mark_dirty()
+        faultgate.arm_script("sched.snapshot.io=error:n=1")
+        assert store.maybe_save() is False
+        # still dirty: the NEXT tick persists without waiting interval_s
+        assert store.maybe_save() is True
+
+    def test_torn_write_is_refused_at_next_load(self, tmp_path):
+        clock = VClock()
+        store = make_store(tmp_path, clock)
+        store.register("unit", lambda: {"n": 1}, lambda sub: 1)
+        faultgate.arm_script("sched.snapshot.io=corrupt:n=1")
+        assert store.save() is True        # the write itself lands...
+        reborn = make_store(tmp_path, clock)
+        reborn.register("unit", dict, lambda sub: 1)
+        assert reborn.load() is None       # ...and is refused wholesale
+        assert reborn.restore() == {"recovered": False}
+
+    def test_old_snapshot_survives_failed_overwrite(self, tmp_path):
+        clock = VClock()
+        store = make_store(tmp_path, clock)
+        value = {"n": 1}
+        store.register("unit", lambda: dict(value), lambda sub: 1)
+        assert store.save()
+        value["n"] = 2
+        faultgate.arm_script("sched.snapshot.io=error:n=1")
+        assert store.save() is False
+        # atomic-rename idiom: the reader still sees the old COMPLETE
+        # snapshot, never a torn half of the new one
+        body = make_store(tmp_path, clock).load()
+        assert body["components"]["unit"] == {"n": 1}
+
+
+class TestRecordsCloseOrdering:
+    """S3: a records flush hitting an already-closed file mid-shutdown
+    counts `df_records_flush_failures_total` once and close() returns —
+    teardown behind it (statestore save, handoff export, manager close)
+    must keep running."""
+
+    def _failures(self) -> float:
+        from dragonfly2_tpu.scheduler.records import _flush_failures
+        return _flush_failures.value()
+
+    def test_close_with_dead_file_counts_once_and_returns(self, tmp_path):
+        rec = DownloadRecords(records_dir=str(tmp_path / "records"))
+        rec.on_decision(decision_row())
+        assert rec._pending                # tail batch still buffered
+        before = self._failures()
+        rec._file.close()                  # something closed it first
+        rec.close()                        # must NOT raise into teardown
+        assert self._failures() == before + 1
+        assert rec._pending == []          # tail dropped from file copy
+        assert rec._file is None
+
+    def test_clean_close_flushes_tail(self, tmp_path):
+        rec = DownloadRecords(records_dir=str(tmp_path / "records"))
+        rec.on_decision(decision_row())
+        before = self._failures()
+        rec.close()
+        assert self._failures() == before
+        path = os.path.join(str(tmp_path / "records"), "download.jsonl")
+        rows = [json.loads(line) for line in open(path, encoding="utf-8")]
+        assert rows and rows[-1]["decision_kind"] == "find"
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

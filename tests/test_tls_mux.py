@@ -13,7 +13,12 @@ import os
 
 import pytest
 
-pytest.importorskip("cryptography")  # cert minting needs the wheel
+# cert minting rides the cryptography API — wheel or openssl-CLI shim
+from dragonfly2_tpu.common import cryptoshim
+
+if not cryptoshim.install():
+    pytest.skip("no cryptography wheel and no openssl binary",
+                allow_module_level=True)
 
 from dragonfly2_tpu.common.certs import CertIssuer
 from dragonfly2_tpu.idl.messages import Empty

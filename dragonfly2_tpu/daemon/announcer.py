@@ -24,6 +24,7 @@ import shutil
 
 from ..idl.messages import (AnnounceContentRequest, AnnounceHostRequest,
                             CPUStat, DiskStat, Host, MemoryStat)
+from .pulse import build_pulse
 
 log = logging.getLogger("df.flow.announcer")
 
@@ -68,6 +69,18 @@ class Announcer:
         self.daemon = daemon
         self.interval_s = daemon.cfg.announce_interval_s
         self._task: asyncio.Task | None = None
+        # pulse sequence: lets the scheduler order digests and spot a
+        # restart (seq reset) independently of wall clocks
+        self._pulse_seq = 0
+
+    def _pulse(self):
+        """Build this announce's pulse digest; a pulse failure must never
+        cost the heartbeat it rides on."""
+        self._pulse_seq += 1
+        try:
+            return build_pulse(self.daemon, self._pulse_seq)
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            return None
 
     def host_with_stats(self) -> Host:
         host = self.daemon.host_info()
@@ -111,7 +124,7 @@ class Announcer:
             return
         resp = await self.daemon.scheduler.announce_content(
             AnnounceContentRequest(
-                host=self.host_with_stats(),
+                host=self.host_with_stats(), pulse=self._pulse(),
                 digest=seal({"v": DIGEST_VERSION, "tasks": entries})))
         log.info("re-announced %d held tasks (%d adopted)", len(entries),
                  getattr(resp, "tasks_adopted", 0))
@@ -124,7 +137,8 @@ class Announcer:
         while True:
             try:
                 await self.daemon.scheduler.announce_host(AnnounceHostRequest(
-                    host=self.host_with_stats(), interval_s=self.interval_s))
+                    host=self.host_with_stats(), interval_s=self.interval_s,
+                    pulse=self._pulse()))
                 # announce_host fed the epoch watermark; a change (or a
                 # register ring failover) left reconcile_event set
                 event = getattr(self.daemon.scheduler, "reconcile_event",

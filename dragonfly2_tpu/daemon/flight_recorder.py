@@ -123,7 +123,8 @@ class TaskFlight:
 
     __slots__ = ("task_id", "peer_id", "started_at", "_m0", "events",
                  "serves", "state", "url", "report_drops", "_sum_key",
-                 "_sum_cache", "qos_class", "tenant", "shards_total")
+                 "_sum_cache", "qos_class", "tenant", "shards_total",
+                 "on_rung")
 
     def __init__(self, task_id: str, peer_id: str, *, url: str = "",
                  max_events: int = 4096, max_serves: int = 1024,
@@ -156,6 +157,10 @@ class TaskFlight:
         self.shards_total = 0
         self._sum_key: tuple | None = None   # summarize() memo (see there)
         self._sum_cache: dict = {}
+        # daemon-wide rung tally hook (FlightRecorder._note_rung): the
+        # fleet pulse needs cumulative served-rung counts without a
+        # summarize() replay per announce, so rung() tallies through here
+        self.on_rung = None
 
     # -- recording (hot path) ------------------------------------------
 
@@ -179,6 +184,8 @@ class TaskFlight:
     def rung(self, name: str) -> None:
         """Journal a degradation-ladder transition (RUNG_* constants)."""
         self.event(RUNG, parent=name)
+        if self.on_rung is not None:
+            self.on_rung(name)
 
     def serve(self, *, peer: str, addr: str = "", piece: int = -1,
               nbytes: int = 0, serve_ms: float = 0.0,
@@ -506,7 +513,15 @@ class FlightRecorder:
         # the /debug/flight index so an operator can tell a quiet pod
         # from one whose history is churning out of the ring
         self.evicted = 0
+        # cumulative served-rung tallies since boot (rung name -> count):
+        # flights tally through on_rung at transition time so the fleet
+        # pulse reads a dict, never replays journals; survives flight
+        # eviction (the ring caps history, not the counters)
+        self.rung_tallies: dict[str, int] = {}
         self._tasks: OrderedDict[str, TaskFlight] = OrderedDict()
+
+    def _note_rung(self, name: str) -> None:
+        self.rung_tallies[name] = self.rung_tallies.get(name, 0) + 1
 
     def begin(self, task_id: str, peer_id: str, url: str = "",
               qos_class: str = "", tenant: str = "") -> TaskFlight | None:
@@ -521,6 +536,7 @@ class FlightRecorder:
                             max_events=self.max_events,
                             max_serves=self.max_serves,
                             qos_class=qos_class, tenant=tenant)
+        flight.on_rung = self._note_rung
         self._tasks[task_id] = flight
         self._tasks.move_to_end(task_id)
         while len(self._tasks) > self.max_tasks:
@@ -559,6 +575,7 @@ class FlightRecorder:
         flight = TaskFlight(task_id, peer_id,
                             max_events=self.max_events,
                             max_serves=self.max_serves)
+        flight.on_rung = self._note_rung
         flight.state = "serving"
         self._tasks[task_id] = flight
         _flight_tasks.set(len(self._tasks))

@@ -422,10 +422,52 @@ class PeerResult:
     flight_summary: dict | None = None
 
 
+# Fleet-pulse digest schema version. Bumped when the field semantics
+# change incompatibly; the scheduler's ingest (scheduler/fleetpulse.py)
+# refuses mismatched versions WHOLESALE (the PEX schema-refusal rule) —
+# a half-understood telemetry stream is worse than none, because it
+# looks like knowledge.
+PULSE_VERSION = 1
+
+
+@message
+class PulseDigest:
+    """One daemon's health counters, folded compact and piggybacked on
+    the ``AnnounceHost`` heartbeat it already sends (daemon/pulse.py
+    builds it; scheduler/fleetpulse.py ingests it). Zero new
+    connections; dfbench --pr18 gates the encoded overhead at <= 512 B
+    per announce.
+
+    All ``*_total``-style fields are since-boot monotonic counters (the
+    scheduler differentiates them; a restart's reset clamps to zero) —
+    gauges are instantaneous. Unknown fields from a NEWER daemon are
+    dropped by the codec (idl/base.py forward-compat rule); an unknown
+    ``v`` rejects the whole digest at ingest, never crashes it."""
+
+    v: int = PULSE_VERSION
+    seq: int = 0                    # per-daemon announce counter
+    flight_tasks: int = 0           # flight-ring occupancy (gauge)
+    flight_evicted: int = 0         # flights dropped oldest (counter)
+    served_rungs: dict | None = None    # ladder rung -> entries (counter)
+    loop_lag_max_ms: float = 0.0    # event-loop lag high-water (gauge)
+    loop_stalls: int = 0            # stall-threshold crossings (counter)
+    slo_breaches: int = 0           # per-stage budget breaches (counter)
+    corrupt_verdicts: int = 0       # first-hand corrupt verdicts (counter)
+    shunned_parents: int = 0        # parents currently shunned (gauge)
+    self_quarantined: bool = False  # the daemon pulled itself out
+    qos_state: str = "normal"       # QoS governor state (gauge)
+    qos_shed: int = 0               # admissions shed (counter)
+    storage_tasks: int = 0          # tasks held by the storage manager
+
+
 @message
 class AnnounceHostRequest:
     host: Host | None = None
     interval_s: float = 30.0
+    # fleet-pulse piggyback (daemon/pulse.py): None from a pre-pulse
+    # daemon — the scheduler treats absence as "no telemetry", never
+    # as an anomaly by itself (silent-daemon keys off missed announces)
+    pulse: PulseDigest | None = None
 
 
 @message
@@ -467,6 +509,10 @@ class AnnounceContentRequest:
     host: Host | None = None
     entries: list[HeldContentEntry] | None = None
     digest: bytes = b""
+    # same piggyback as AnnounceHostRequest: the recovery re-announce is
+    # a heartbeat too, and a freshly restarted brain wants telemetry
+    # history started on the FIRST contact, not one interval later
+    pulse: PulseDigest | None = None
 
 
 @message

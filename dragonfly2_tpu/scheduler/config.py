@@ -160,3 +160,8 @@ class SchedulerConfig:
     # record) so the ring successor can import it — warmed to at most
     # `suspect` (the PR 12 anti-slander ceiling). Needs manager_addresses.
     statestore_handoff: bool = True
+    # fleet pulse plane (scheduler/fleetpulse.py): ingest announce-borne
+    # pulse digests, run the EWMA anomaly detector, keep per-daemon ring
+    # time series + incident bundles at GET /debug/fleet. Strictly
+    # observational — disabling it (False) changes no ruling.
+    fleetpulse_enabled: bool = True

@@ -128,11 +128,29 @@ class Scheduler:
                                          self.sharded.restore)
                 self.sharded.sink = self.statestore.wrap_sink(
                     self.sharded.sink)
+        # fleet pulse plane (scheduler/fleetpulse.py): announce-borne
+        # telemetry rings + EWMA anomaly detector + incident capture.
+        # Anomaly firings ride the decision ledger (decision_kind=anomaly)
+        # and the rings register with the statestore so incident history
+        # survives a scheduler crash/failover.
+        self.fleetpulse = None
+        if cfg.fleetpulse_enabled:
+            from .fleetpulse import FleetPulse
+            self.fleetpulse = FleetPulse(
+                sink=self.ledger.on_decision,
+                quarantine=self.quarantine,
+                federation=self.federation,
+                statestore=self.statestore)
+            if self.statestore is not None:
+                self.statestore.register("fleetpulse",
+                                         self.fleetpulse.export_state,
+                                         self.fleetpulse.restore)
         self.service = SchedulerService(cfg, self.resource, self.scheduling,
                                         self.seed_client, self.topo,
                                         records=records, ledger=self.ledger,
                                         quarantine=self.quarantine,
-                                        federation=self.federation)
+                                        federation=self.federation,
+                                        fleetpulse=self.fleetpulse)
         if self.statestore is not None:
             svc = self.service
 
@@ -224,6 +242,12 @@ class Scheduler:
             self.gc.add(GCTask("statestore",
                                min(self.cfg.statestore_interval_s, 5.0),
                                lambda: int(store.maybe_save())))
+        if self.fleetpulse is not None:
+            # silent-daemon detection + series aging ride the GC runner:
+            # a daemon that stops announcing can't push its own absence
+            fp = self.fleetpulse
+            self.gc.add(GCTask("fleetpulse", self.cfg.gc_interval_s,
+                               lambda: fp.tick()))
         self.gc.start()
         # records → trainer upload + model → evaluator refresh (ML loop)
         from .announcer import SchedulerAnnouncer

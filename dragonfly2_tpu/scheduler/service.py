@@ -75,7 +75,7 @@ class SchedulerService:
     def __init__(self, cfg: SchedulerConfig, resource: Resource,
                  scheduling: Scheduling, seed_client: SeedPeerClient,
                  topo: TopologyStore, *, records=None, ledger=None,
-                 quarantine=None, federation=None):
+                 quarantine=None, federation=None, fleetpulse=None):
         self.cfg = cfg
         self.resource = resource
         self.scheduling = scheduling
@@ -91,6 +91,9 @@ class SchedulerService:
         # pods from register/announce, forgets on leave; None = the
         # pre-federation single-pod fabric
         self.federation = federation
+        # fleet pulse plane (scheduler/fleetpulse.py): announce-borne
+        # telemetry digests land here; None = pulse plane disabled
+        self.fleetpulse = fleetpulse
         self.cluster = ClusterView(ledger=ledger,
                                    quarantine=quarantine)  # GET /debug/cluster
         self._seed_tasks: set[asyncio.Task] = set()
@@ -761,6 +764,12 @@ class SchedulerService:
                 # so re-announce is a no-op — elections stay sticky
                 self.federation.observe_host(req.host.id,
                                              req.host.topology)
+            if self.fleetpulse is not None and req.pulse is not None:
+                # piggybacked telemetry: ingest is total (never raises)
+                # and strictly observational — no ruling path reads it
+                self.fleetpulse.ingest(
+                    req.host.id, req.pulse,
+                    interval_s=float(req.interval_s or 0.0) or 30.0)
         # the heartbeat answer carries the boot epoch: the announce plane
         # doubles as restart detection, so a daemon that never registers
         # still re-announces held content within one announce interval
@@ -786,6 +795,8 @@ class SchedulerService:
                 reason="self-quarantine flag on content re-announce")
         if self.federation is not None:
             self.federation.observe_host(req.host.id, req.host.topology)
+        if self.fleetpulse is not None and req.pulse is not None:
+            self.fleetpulse.ingest(req.host.id, req.pulse)
         host = self.resource.store_host(req.host)
         adopted = 0
         pieces_learned = 0

@@ -2668,10 +2668,19 @@ RECOV_ANNOUNCE_MS = 30_000.0        # one announce interval: how long the
                                     # holders from periodic announces
 RECOV_FULL_FLEET = 512              # full-mode second recovery point
 
+PULSE_SMOKE_FLEET = 128             # tier-1 pulse digest-gate size
+PULSE_FLEETS = (1000, 10000)        # full-mode virtual fleet points
+PULSE_INTERVALS = 40                # announce intervals simulated per leg
+PULSE_INJECT_AT = 20                # interval the fault injection starts
+PULSE_FAULTY = 7                    # daemons driven faulty per fault leg
+PULSE_SILENT = 3                    # daemons that go silent (stall leg)
+PULSE_ANNOUNCE_MS = 30_000.0        # one announce interval (virtual)
+PULSE_MAX_BYTES = 512               # per-announce piggyback budget (gate)
+
 
 def run_ctrl_bench(*, seed: int = 7, daemons: int = 1000,
                    pieces: int = 32, piece_size: int = 4 << 20,
-                   armed: bool = True) -> dict:
+                   armed: bool = True, pulse: bool = False) -> dict:
     """Cold-herd register storm + steady-state refresh storm through the
     REAL control-plane stack: ``Scheduling`` over the real ``Resource``
     model with the real ``DecisionLedger``, ``PodFederation``,
@@ -2723,6 +2732,17 @@ def run_ctrl_bench(*, seed: int = 7, daemons: int = 1000,
     phasetimer.reset()
     if armed:
         phasetimer.arm()
+
+    # the PR-18 purity leg: a FleetPulse fed synthetic pulses BETWEEN
+    # rulings mid-storm. Its own Random (never the global stream the
+    # candidate shuffle reads) and its own sink — the gate downstream is
+    # that ruling_digest is byte-identical with pulse on or off.
+    pulse_fp = pulse_rng = None
+    if pulse:
+        from ..scheduler.fleetpulse import FleetPulse
+        pulse_fp = FleetPulse(sink=(lambda row: None), federation=fed,
+                              clock=lambda: now_ref[0] / 1000.0)
+        pulse_rng = random.Random(f"ctrl-pulse:{seed}:{daemons}")
 
     pods = max(1, -(-daemons // CTRL_PEERS_PER_POD))
 
@@ -2799,6 +2819,19 @@ def run_ctrl_bench(*, seed: int = 7, daemons: int = 1000,
         peer.finished_pieces = set(range((i * 7) % pieces))
     t1 = _time.perf_counter()
     for peer in peers:
+        if pulse_fp is not None:
+            # a pulse lands between rulings, exactly as announces do in
+            # production — if ingest touched ANY ruling input the digest
+            # gate below would catch it
+            pulse_fp.ingest(peer.host.id, {
+                "v": 1, "seq": 1, "flight_tasks": 1,
+                "loop_lag_max_ms": 5.0 + pulse_rng.random(),
+                "slo_breaches": pulse_rng.randrange(3),
+                "served_rungs": {"p2p": pulse_rng.randrange(8)},
+                "qos_shed": 0, "corrupt_verdicts": 0,
+                "shunned_parents": 0, "self_quarantined": False,
+                "qos_state": "normal",
+            }, interval_s=PULSE_ANNOUNCE_MS / 1000.0)
         parents = sched.refresh_parents(peer)
         peer.last_offer_ids = {pr.id for pr in parents}
         peer.task.set_parents(peer.id, [pr.id for pr in parents])
@@ -3312,6 +3345,215 @@ def _run_pr17(args) -> dict:
     }
 
 
+# --- PR 18: fleet pulse (push telemetry + anomaly detection) ---------
+
+
+def run_fleetpulse_bench(*, seed: int = 7, daemons: int = 1000,
+                         inject: str = "none") -> dict:
+    """Drive ``daemons`` virtual announce streams through the REAL
+    ``FleetPulse`` plane (scheduler/fleetpulse.py) on a virtual clock:
+    ``PULSE_INTERVALS`` announce intervals of stationary noise, then —
+    on the fault legs — inject at ``PULSE_INJECT_AT``:
+
+    * ``stall``     — PULSE_FAULTY daemons spike loop lag + SLO
+      breaches (the faultgate loop-stall shape) and PULSE_SILENT
+      daemons stop announcing entirely (silent-daemon via tick()).
+    * ``byzantine`` — PULSE_FAULTY daemons burst corrupt verdicts /
+      shunned parents (one self-quarantines), escalate serves off the
+      primary rung, and shed admissions (the byzantine-serve shape).
+
+    Reported per leg: per-kind detection latency in announce intervals
+    (anomaly ``at`` minus injection time), false positives (any firing
+    on a clean daemon, or anything at all on the clean leg), and a
+    sha256 ``pulse_digest`` over the anomaly rows — the tier-1 smoke
+    gate re-derives it from the committed artifact's parameters."""
+    from ..scheduler.fleetpulse import FleetPulse
+
+    interval_s = PULSE_ANNOUNCE_MS / 1000.0
+    rng = random.Random(f"{seed}:{daemons}:{inject}")
+    now_ref = [0.0]
+    rows: list[dict] = []
+    fp = FleetPulse(sink=rows.append, clock=lambda: now_ref[0])
+
+    faulty = [f"vd{i:05d}" for i in range(PULSE_FAULTY)] \
+        if inject in ("stall", "byzantine") else []
+    silent = [f"vd{i:05d}" for i in
+              range(PULSE_FAULTY, PULSE_FAULTY + PULSE_SILENT)] \
+        if inject == "stall" else []
+    injected = set(faulty) | set(silent)
+
+    # per-daemon since-boot counters (the daemon/pulse.py shape)
+    cum = {f"vd{i:05d}": {"slo": 0, "shed": 0, "corrupt": 0, "shun": 0,
+                          "rung": 0, "p2p": 0}
+           for i in range(daemons)}
+
+    import time as _time
+    t0 = _time.perf_counter()
+    for t in range(PULSE_INTERVALS):
+        now_ref[0] += interval_s
+        hot = t >= PULSE_INJECT_AT
+        for i in range(daemons):
+            hid = f"vd{i:05d}"
+            if hot and hid in silent:
+                continue            # the daemon fell over: no announce
+            c = cum[hid]
+            # stationary noise, all under the detector's absolute
+            # floors: the clean leg must produce ZERO firings
+            c["slo"] += rng.randrange(2)
+            c["shed"] += rng.randrange(2)
+            c["p2p"] += 4 + rng.randrange(4)
+            c["rung"] += rng.randrange(2)
+            lag = 4.0 + 8.0 * rng.random()
+            quar = False
+            if hot and hid in faulty:
+                if inject == "stall":
+                    lag = 500.0 + 400.0 * rng.random()
+                    c["slo"] += 10 + rng.randrange(5)
+                else:
+                    c["corrupt"] += 5 + rng.randrange(3)
+                    c["shun"] += 1
+                    c["rung"] += 6 + rng.randrange(3)
+                    c["shed"] += 10 + rng.randrange(5)
+                    quar = (i == 0 and t >= PULSE_INJECT_AT + 2)
+            fp.ingest(hid, {
+                "v": 1, "seq": t, "flight_tasks": 1 + i % 3,
+                "loop_lag_max_ms": round(lag, 3),
+                "slo_breaches": c["slo"],
+                "served_rungs": {"p2p": c["p2p"], "seed": c["rung"]},
+                "qos_shed": c["shed"],
+                "corrupt_verdicts": c["corrupt"],
+                "shunned_parents": c["shun"],
+                "self_quarantined": quar,
+                "qos_state": "shed" if (hot and hid in faulty
+                                        and inject == "byzantine")
+                             else "normal",
+            }, interval_s=interval_s)
+        fp.tick()                   # the scheduler's GC cadence
+    wall_s = _time.perf_counter() - t0
+
+    inject_at_s = PULSE_INJECT_AT * interval_s
+    latency: dict[str, float] = {}
+    false_positives = 0
+    for row in rows:
+        kind = row["anomaly"]
+        on_injected = row["host_id"] in injected
+        if inject == "none" or not on_injected \
+                or row["at"] <= inject_at_s:
+            false_positives += 1
+            continue
+        lat = (row["at"] - inject_at_s) / interval_s
+        if kind not in latency or lat < latency[kind]:
+            latency[kind] = round(lat, 1)
+    digest = hashlib.sha256(json.dumps(
+        [[r["decision_id"], r["anomaly"], r["host_id"], r["signal"]]
+         for r in rows], sort_keys=True).encode()).hexdigest()
+    return {
+        "daemons": daemons,
+        "inject": inject,
+        "intervals": PULSE_INTERVALS,
+        "announces": fp.ingested,
+        "anomalies": len(rows),
+        "anomaly_counts": {k: v for k, v in
+                           sorted(fp.anomaly_counts.items()) if v},
+        "detection_latency_intervals": dict(sorted(latency.items())),
+        "false_positives": false_positives,
+        "incidents": len(fp.incidents),
+        "ingest_per_sec": round(fp.ingested / max(wall_s, 1e-9), 1),
+        "pulse_digest": digest,
+    }
+
+
+def _pulse_overhead_bytes() -> int:
+    """Encoded bytes a busy pulse adds to one announce: the same
+    AnnounceHostRequest with and without a fully-populated digest,
+    through the real msgpack codec. Gated at <= PULSE_MAX_BYTES."""
+    from ..idl.base import dumps
+    from ..idl.messages import Host as HostMsg
+    from ..idl.messages import AnnounceHostRequest, PulseDigest
+
+    host = HostMsg(id="overhead-probe-host", ip="10.0.0.1", port=65001,
+                   download_port=65002,
+                   topology=TopologyInfo(slice_name="pod-00",
+                                         ici_coords=(15, 15),
+                                         zone="bench-zone"))
+    pulse = PulseDigest(
+        seq=999_999, flight_tasks=64, flight_evicted=4096,
+        served_rungs={"p2p": 1_000_000, "seed": 50_000, "cross": 10_000,
+                      "origin": 5_000, "relay": 2_500, "swap": 1_250},
+        loop_lag_max_ms=1234.567, loop_stalls=999, slo_breaches=100_000,
+        corrupt_verdicts=5_000, shunned_parents=64, self_quarantined=True,
+        qos_state="brownout", qos_shed=100_000, storage_tasks=4096)
+    bare = AnnounceHostRequest(host=host, interval_s=30.0)
+    full = AnnounceHostRequest(host=host, interval_s=30.0, pulse=pulse)
+    return len(dumps(full)) - len(dumps(bare))
+
+
+def _run_pr18(args) -> dict:
+    """The PR-18 trajectory point: fleet pulse. Gates: the baseline sim
+    keeps a ``schedule_digest`` byte-identical to BENCH_pr3 and the
+    ctrl storm's ruling digest is byte-identical with the pulse plane
+    ingesting mid-storm or absent (the observer-purity pair), injected
+    stall/byzantine anomalies are detected within 2 announce intervals
+    with zero false positives on every leg, all six vocabulary kinds
+    fire across the legs, and a busy pulse costs <= PULSE_MAX_BYTES
+    per announce. Smoke mode runs the 128-daemon legs only (the
+    committed artifact adds 1k and 10k)."""
+    base = run_bench(seed=args.seed, daemons=args.daemons,
+                     pieces=args.pieces, piece_size=args.piece_size,
+                     parallelism=args.parallelism)
+    disarmed = run_ctrl_bench(seed=args.seed, daemons=CTRL_SMOKE_FLEET,
+                              pieces=CTRL_PIECES, armed=False)
+    pulsed = run_ctrl_bench(seed=args.seed, daemons=CTRL_SMOKE_FLEET,
+                            pieces=CTRL_PIECES, armed=False, pulse=True)
+    legs = {}
+    fleets = [PULSE_SMOKE_FLEET] + ([] if args.smoke
+                                    else list(PULSE_FLEETS))
+    for n in fleets:
+        for inj in ("none", "stall", "byzantine"):
+            legs[f"{inj}_{n}"] = run_fleetpulse_bench(
+                seed=args.seed, daemons=n, inject=inj)
+    smoke_legs = [legs[f"{inj}_{PULSE_SMOKE_FLEET}"]
+                  for inj in ("none", "stall", "byzantine")]
+    pulse_digest = hashlib.sha256("".join(
+        leg["pulse_digest"] for leg in smoke_legs).encode()).hexdigest()
+    detected = sorted({k for leg in legs.values()
+                       for k in leg["anomaly_counts"]})
+    # silent-daemon is gap-triggered (2.5 missed intervals by design),
+    # so it carries its own bound; every push-signal kind must clear
+    # the <= 2-interval acceptance gate
+    push_latency = {}
+    silent_latency = 0.0
+    for leg in legs.values():
+        for kind, lat in leg["detection_latency_intervals"].items():
+            if kind == "silent-daemon":
+                silent_latency = max(silent_latency, lat)
+            else:
+                push_latency[kind] = max(push_latency.get(kind, 0.0), lat)
+    overhead = _pulse_overhead_bytes()
+    return {
+        "bench": "dfbench-fleetpulse",
+        "seed": args.seed,
+        "fleets": fleets,
+        "intervals": PULSE_INTERVALS,
+        "inject_at": PULSE_INJECT_AT,
+        "schedule_digest": base["schedule_digest"],
+        "fleetpulse_pure": (disarmed["ruling_digest"]
+                            == pulsed["ruling_digest"]),
+        "pulse_digest": pulse_digest,
+        "legs": legs,
+        "detected_kinds": detected,
+        "detection_latency_intervals": dict(sorted(push_latency.items())),
+        "silent_detection_intervals": silent_latency,
+        "detection_bounded": all(v <= 2.0 for v in push_latency.values()),
+        "false_positives": {name: leg["false_positives"]
+                            for name, leg in sorted(legs.items())},
+        "zero_false_positives": all(leg["false_positives"] == 0
+                                    for leg in legs.values()),
+        "bytes_per_announce": overhead,
+        "pulse_overhead_ok": overhead <= PULSE_MAX_BYTES,
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfbench", description="deterministic fakepod benchmark")
@@ -3403,6 +3645,17 @@ def build_parser() -> argparse.ArgumentParser:
                    "scheduler state per peer, the profiler-purity "
                    "digest gate against BENCH_pr3, and the disarmed-"
                    "overhead microbenchmark")
+    p.add_argument("--pr18", action="store_true",
+                   help="drive virtual announce streams through the REAL "
+                   "fleet-pulse plane (scheduler/fleetpulse.py) — "
+                   "stationary noise, then injected loop stalls, silent "
+                   "daemons, and byzantine corrupt/shed bursts at 1k and "
+                   "10k virtual daemons — and write the PR-18 trajectory "
+                   "point (BENCH_pr18.json): per-kind detection latency "
+                   "in announce intervals, false-positive counts, "
+                   "per-announce pulse overhead bytes, the observer-"
+                   "purity ruling-digest pair, and the baseline digest "
+                   "gate against BENCH_pr3")
     p.add_argument("--pr17", action="store_true",
                    help="drive the crash/restart recovery storm (REAL "
                    "control-plane stack + scheduler/statestore.py "
@@ -3459,7 +3712,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr17:
+        if args.pr18:
+            args.out = "BENCH_pr18.json"
+        elif args.pr17:
             args.out = "BENCH_pr17.json"
         elif args.ctrl:
             args.out = "BENCH_pr16.json"
@@ -3489,7 +3744,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr17:
+    if args.pr18:
+        result = _run_pr18(args)
+    elif args.pr17:
         result = _run_pr17(args)
     elif args.ctrl:
         result = _run_pr16(args)
@@ -3522,7 +3779,20 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr17:
+        if args.pr18:
+            lat = result["detection_latency_intervals"]
+            worst = max(lat, key=lat.get) if lat else ""
+            fps = sum(result["false_positives"].values())
+            print(f"dfbench: wrote {args.out} (fleet pulse: "
+                  f"{len(result['detected_kinds'])}/6 kinds detected, "
+                  f"worst push latency {worst}="
+                  f"{lat.get(worst, 0.0)} intervals, silent="
+                  f"{result['silent_detection_intervals']} intervals, "
+                  f"false positives={fps}, "
+                  f"{result['bytes_per_announce']} B/announce, "
+                  f"pure={result['fleetpulse_pure']}, "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.pr17:
             oh = result["origin_hits_after_restart"]
             ttf = result["time_to_first_ruling_ms"]
             print(f"dfbench: wrote {args.out} (recovery: first ruling "

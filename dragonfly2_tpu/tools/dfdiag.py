@@ -68,6 +68,10 @@ def fetch_ctrl(scheduler: str, timeout_s: float = 10.0,
     return _get(f"http://{scheduler}/debug/ctrl{q}", timeout_s)
 
 
+def fetch_fleet(scheduler: str, timeout_s: float = 10.0) -> dict:
+    return _get(f"http://{scheduler}/debug/fleet", timeout_s)
+
+
 def render_waterfall(summary: dict, *, width: int = 64) -> str:
     """ASCII waterfall: one row per piece, bars proportional to wall time,
     segmented by stage. Pure function over the /debug/flight summary (or a
@@ -304,6 +308,69 @@ def render_ctrl(snap: dict) -> str:
     return "\n".join(out)
 
 
+def render_fleet(snap: dict) -> str:
+    """Tabular view of the scheduler's fleet-pulse plane (/debug/fleet):
+    rollups over every daemon's latest pulse, active anomaly episodes,
+    recent firings, and the incident ring. Pure function over the
+    snapshot so it is testable offline."""
+    fleet = snap.get("fleet") or {}
+    qos = fleet.get("qos_states") or {}
+    out = [f"fleet: daemons={snap.get('daemons', 0)}  "
+           f"samples={snap.get('samples', 0)}  "
+           f"ingested={snap.get('ingested', 0)}  "
+           f"ignored={snap.get('ignored', 0)}  "
+           f"incidents={snap.get('incidents', 0)}",
+           f"pulse: flights={fleet.get('flight_tasks', 0)}  "
+           f"lag-max={fleet.get('loop_lag_max_ms', 0.0)}ms  "
+           f"slo={fleet.get('slo_breaches', 0)}  "
+           f"escalated={fleet.get('escalated_serves', 0)}  "
+           f"shed={fleet.get('qos_shed', 0)}  "
+           f"corrupt={fleet.get('corrupt_verdicts', 0)}  "
+           f"self-quar={fleet.get('self_quarantined', 0)}  "
+           f"qos={json.dumps(qos, sort_keys=True)}"]
+    counts = snap.get("anomaly_counts") or {}
+    if counts:
+        out.append("anomalies: " + "  ".join(
+            f"{kind}={n}" for kind, n in sorted(counts.items())))
+    active = snap.get("active") or []
+    if active:
+        out.append(f"{'active episode':<18} {'daemon':<24} {'for-s':>8}")
+        for a in active:
+            out.append(f"{a.get('anomaly', ''):<18} "
+                       f"{a.get('host_id', ''):<24} "
+                       f"{a.get('since_s', 0.0):>8}")
+    recent = snap.get("recent_anomalies") or []
+    if recent:
+        out.append(f"{'recent firing':<18} {'daemon':<24} "
+                   f"{'signal':<16} {'value':>10} {'z':>6}")
+        for r in recent[-8:]:
+            out.append(f"{r.get('anomaly', ''):<18} "
+                       f"{r.get('host_id', ''):<24} "
+                       f"{r.get('signal', ''):<16} "
+                       f"{r.get('value', 0.0):>10} {r.get('zscore', 0.0):>6}")
+    if not active and not recent:
+        out.append("(no anomalies — a quiet fleet, or daemons not "
+                   "announcing pulses yet)")
+    bundles = snap.get("incident_bundles")
+    if bundles:
+        out.append("incident ring (latest "
+                   f"{len(bundles)} of {snap.get('incidents', 0)}):")
+        for b in bundles[-5:]:
+            out.append(f"  {b.get('id', '')}  {b.get('anomaly', ''):<16} "
+                       f"{b.get('host_id', '')}  "
+                       f"pod={b.get('pod', '') or '-'}  "
+                       f"quar={b.get('quarantine') or '-'}  "
+                       f"pulses={len(b.get('pulses') or [])}")
+    recov = snap.get("recovery")
+    if recov is not None:
+        sub = (recov.get("components") or {}).get("fleetpulse") or {}
+        out.append(f"recovery: warm (gap {recov.get('gap_s', 0.0)}s, "
+                   f"{sub.get('restored', 0)} restored)"
+                   if recov.get("recovered")
+                   else "recovery: cold boot (no usable snapshot)")
+    return "\n".join(out)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dfdiag", description="flight-recorder waterfall + verdict")
@@ -324,6 +391,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "(/debug/ctrl on --scheduler): rulings/sec, per-phase "
                    "ruling latency (p50/p99), queue-wait vs compute "
                    "split, and bytes of scheduler state per component")
+    p.add_argument("--fleet", action="store_true",
+                   help="show the scheduler's fleet-pulse plane "
+                   "(/debug/fleet on --scheduler): per-daemon pulse "
+                   "rollups, active anomaly episodes, recent firings "
+                   "with z-scores, and the incident-bundle ring; exits "
+                   "3 while any anomaly episode is active so chaos "
+                   "pipelines can gate on a quiet fleet")
     p.add_argument("--arm", default="", choices=["", "on", "off"],
                    help="with --ctrl: arm/disarm the ruling profiler "
                    "live before reading the snapshot")
@@ -403,6 +477,17 @@ def main(argv: list[str] | None = None) -> int:
                 print()
             print(f"ledger: {json.dumps(snap.get('stats') or {})}")
             return EXIT_OK
+        if args.fleet:
+            if not args.scheduler:
+                print("dfdiag: --fleet needs --scheduler host:port "
+                      "(the scheduler's --debug-port)", file=sys.stderr)
+                return EXIT_USAGE
+            snap = fetch_fleet(args.scheduler, args.timeout)
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_fleet(snap))
+            # gate contract: an active anomaly episode exits non-zero so
+            # chaos pipelines can assert the fleet went quiet again
+            return EXIT_BREACH if snap.get("active") else EXIT_OK
         if args.ctrl:
             if not args.scheduler:
                 print("dfdiag: --ctrl needs --scheduler host:port "

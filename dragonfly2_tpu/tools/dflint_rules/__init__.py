@@ -60,7 +60,7 @@ __all__ = [
 ]
 
 #: bump when rule semantics change — invalidates every cache entry
-ENGINE_VERSION = "2.0"
+ENGINE_VERSION = "2.1"
 CACHE_NAME = ".dflint_cache.json"
 
 # the one suppression grammar, shared with the index pass (symbols.py)

@@ -502,3 +502,102 @@ class FaultgateSites(Rule):
                 f"faultgate.fire({site!r}) appears in the package but "
                 f"{site!r} is not in the SITES registry — arming it "
                 f"raises ValueError")
+
+
+_ANOMALY_FIRE_RE = re.compile(r"\._fire\(\s*[\"']([a-z-]+)[\"']")
+
+
+@register
+class AnomalyVocabulary(Rule):
+    """DF006 (fleet pulse): the anomaly-kind vocabulary must stay closed
+    and documented — the ``ANOMALY_KINDS`` registry in
+    ``scheduler/fleetpulse.py``, the kind literal at every
+    ``._fire(…)`` call site across the package (each becomes a
+    ``df_fleet_anomalies_total`` label, a ``decision_kind=anomaly``
+    ledger row, and an incident-bundle id), and the backticked
+    vocabulary in docs/OBSERVABILITY.md must agree. A
+    registered-but-never-fired kind is dead vocabulary the detector can
+    never produce, a fired-but-unregistered kind is an invisible metric
+    label dfbench --pr18's injection matrix never covers, and an
+    undocumented one is a /debug/fleet surface operators cannot read.
+    Unlike the phase sweep, the registry file itself IS swept: the
+    detector's fire sites live beside the registry by design.
+    """
+
+    code = "DF006"
+    name = "anomaly-vocabulary"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if not ctx.rel.replace(os.sep, "/").endswith(
+                "scheduler/fleetpulse.py"):
+            return
+        declared: dict[str, int] = {}
+        declared_line = 1
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "ANOMALY_KINDS"
+                            for t in node.targets)):
+                continue
+            declared_line = node.lineno
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) \
+                        and isinstance(const.value, str):
+                    declared[const.value] = const.lineno
+        if not declared:
+            return
+        # the z-score path fires through the _SIGNALS mapping (signal ->
+        # (kind, floor)): the tuple HEADS are fire sites too, read from
+        # the same AST so the mapping and the literal sweep agree
+        fired: dict[str, str] = {}
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "_SIGNALS"
+                            for t in node.targets)):
+                continue
+            for tup in ast.walk(node.value):
+                if isinstance(tup, ast.Tuple) and tup.elts \
+                        and isinstance(tup.elts[0], ast.Constant) \
+                        and isinstance(tup.elts[0].value, str):
+                    fired.setdefault(tup.elts[0].value, ctx.path)
+        # package-wide fire sweep rooted at the package holding this
+        # file (…/scheduler/fleetpulse.py -> …/), INCLUDING fleetpulse.py
+        # itself — the detector fires beside its registry
+        pkg_root = os.path.dirname(os.path.dirname(ctx.path))
+        for dirpath, dirs, files in os.walk(pkg_root):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", "dflint_rules")]
+            for name in files:
+                if not name.endswith(".py"):
+                    continue
+                fpath = os.path.join(dirpath, name)
+                try:
+                    with open(fpath, encoding="utf-8") as f:
+                        text = f.read()
+                except OSError:
+                    continue
+                for m in _ANOMALY_FIRE_RE.finditer(text):
+                    fired.setdefault(m.group(1), fpath)
+        obs = _ticked(ctx, "OBSERVABILITY.md")
+        for kind, line in sorted(declared.items()):
+            if kind not in fired:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"anomaly kind {kind!r} is registered in "
+                    f"ANOMALY_KINDS but no _fire call emits it — dead "
+                    f"vocabulary the detector can never produce")
+            if kind not in obs:
+                yield Finding(
+                    self.code, ctx.rel, line, 0,
+                    f"anomaly kind {kind!r} is not documented in "
+                    f"docs/OBSERVABILITY.md — a "
+                    f"df_fleet_anomalies_total label and /debug/fleet "
+                    f"row operators cannot read")
+        for kind in sorted(set(fired) - set(declared)):
+            yield Finding(
+                self.code, ctx.rel, declared_line, 0,
+                f"_fire({kind!r}) appears in "
+                f"{os.path.relpath(fired[kind], pkg_root)} but {kind!r} "
+                f"is not in the ANOMALY_KINDS registry — an invisible "
+                f"anomaly label the --pr18 injection matrix never "
+                f"covers")

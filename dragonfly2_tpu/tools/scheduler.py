@@ -48,10 +48,13 @@ async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
     from ..scheduler.cluster_view import add_cluster_routes
     from ..scheduler.ctrl_debug import CtrlObservatory, add_ctrl_routes
     from ..scheduler.decision_ledger import add_decision_routes
+    from ..scheduler.fleetpulse import add_fleet_routes
 
     def _extra_routes(router) -> None:
         add_cluster_routes(router, sched.service.cluster)
         add_decision_routes(router, sched.ledger)
+        if sched.fleetpulse is not None:
+            add_fleet_routes(router, sched.fleetpulse)
         add_ctrl_routes(router, CtrlObservatory(
             resource=sched.service.resource,
             ledger=sched.ledger,

@@ -356,6 +356,13 @@ def main(argv: list[str] | None = None) -> int:
                         "scheduler state) so the report says what the "
                         "control plane spent, not just what this "
                         "client saw")
+    p.add_argument("--fleet-report", default="",
+                   help="scheduler debug host:port (the --debug-port); "
+                        "after the run, attach its compact /debug/fleet "
+                        "snapshot (pulse rollups, anomaly counts, active "
+                        "episodes, incident ids) so a stress/chaos report "
+                        "says what the FLEET's telemetry plane saw, not "
+                        "just what this client measured")
     p.add_argument("--pod-report", default="",
                    help="comma-separated daemon upload host:port set; "
                         "after the run, attach the podscope pod summary "
@@ -374,6 +381,8 @@ def main(argv: list[str] | None = None) -> int:
             result["podscope"] = _pod_report(args.pod_report)
         if args.ctrl_report:
             result["ctrl"] = _ctrl_report(args.ctrl_report)
+        if args.fleet_report:
+            result["fleet"] = _fleet_report(args.fleet_report)
         print(json.dumps(result))
         return 1 if result["shards_ready"] == 0 else 0
     result = asyncio.run(_run_with_chaos(args))
@@ -385,6 +394,8 @@ def main(argv: list[str] | None = None) -> int:
         result["podscope"] = _pod_report(args.pod_report)
     if args.ctrl_report:
         result["ctrl"] = _ctrl_report(args.ctrl_report)
+    if args.fleet_report:
+        result["fleet"] = _fleet_report(args.fleet_report)
     if args.byzantine:
         result["byzantine"] = {
             "pct": int(args.byzantine),
@@ -429,6 +440,30 @@ def _verdict_report(pod: str) -> dict:
                         if row.get("shunned")],
         }
     return out
+
+
+def _fleet_report(scheduler: str) -> dict:
+    """Compact fleet-pulse snapshot for the stress report (dfdiag
+    --fleet's /debug/fleet?compact=1, further compacted): pulse rollups,
+    anomaly counts, any active episodes, and incident ids — a chaos run
+    that tripped the detector should say so in its own report.
+    Diagnostics must not fail a run."""
+    try:
+        from .dfdiag import _get
+        snap = _get(f"http://{scheduler}/debug/fleet?compact=1",
+                    timeout_s=5.0)
+        return {
+            "daemons": snap.get("daemons", 0),
+            "ingested": snap.get("ingested", 0),
+            "ignored": snap.get("ignored", 0),
+            "fleet": snap.get("fleet"),
+            "anomaly_counts": snap.get("anomaly_counts"),
+            "active": snap.get("active"),
+            "incidents": snap.get("incidents", 0),
+            "incident_ids": snap.get("incident_ids"),
+        }
+    except Exception as exc:  # noqa: BLE001 - diagnostics must not fail a run
+        return {"error": str(exc)}
 
 
 def _ctrl_report(scheduler: str) -> dict:

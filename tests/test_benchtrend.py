@@ -1,0 +1,104 @@
+"""benchtrend: the perf-trajectory table over every committed
+BENCH_pr*.json. The tier-1 teeth: every committed artifact must still
+parse, every artifact that carries a baseline ``schedule_digest`` must
+still reference BENCH_pr3's (digest drift in a committed artifact is a
+broken purity gate), and the renderer/CLI must degrade — never crash —
+on schema drift or torn files."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dragonfly2_tpu.tools.benchtrend import collect, main, render
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+
+
+class TestCommittedTrajectory:
+    def test_every_committed_artifact_parses(self):
+        rows = collect(REPO)
+        assert len(rows) >= 13
+        assert [r["pr"] for r in rows] == sorted(r["pr"] for r in rows)
+        assert rows[0]["pr"] == 3           # the digest spine exists
+        assert rows[0]["schedule_digest"]
+
+    def test_all_digest_gates_reference_pr3(self):
+        rows = collect(REPO)
+        drifted = [r["file"] for r in rows if r["digest_vs_pr3"] is False]
+        assert drifted == []
+        # the gate has teeth: most artifacts DO carry the spine digest
+        gated = [r for r in rows if r["digest_vs_pr3"] is True]
+        assert len(gated) >= 10
+
+    def test_headlines_resolved_not_question_marks(self):
+        # '?' means an extractor no longer matches its artifact's schema
+        rows = collect(REPO)
+        assert all(r["headline"] != "?" for r in rows), \
+            [r["file"] for r in rows if r["headline"] == "?"]
+
+
+class TestMechanics:
+    def _write(self, tmp_path, pr, doc):
+        (tmp_path / f"BENCH_pr{pr}.json").write_text(json.dumps(doc))
+
+    def test_drift_detected_and_rendered(self, tmp_path):
+        self._write(tmp_path, 3, {"bench": "dfbench",
+                                  "schedule_digest": "aaa"})
+        self._write(tmp_path, 9, {"bench": "dfbench-coldstart",
+                                  "schedule_digest": "bbb"})
+        rows = collect(str(tmp_path))
+        assert rows[0]["digest_vs_pr3"] is True
+        assert rows[1]["digest_vs_pr3"] is False
+        out = render(rows)
+        assert "DIGEST DRIFT: BENCH_pr9.json" in out
+
+    def test_digestless_artifact_is_ungated_not_drifted(self, tmp_path):
+        self._write(tmp_path, 3, {"bench": "dfbench",
+                                  "schedule_digest": "aaa"})
+        self._write(tmp_path, 4, {"bench": "dfbench-pex"})
+        rows = collect(str(tmp_path))
+        assert rows[1]["digest_vs_pr3"] is None
+        assert "all digest gates reference pr3" in render(rows)
+
+    def test_unknown_pr_degrades_to_question_mark(self, tmp_path):
+        # a future PR with no extractor yet renders, never crashes
+        self._write(tmp_path, 99, {"bench": "dfbench-future",
+                                   "some_future_key": 1})
+        rows = collect(str(tmp_path))
+        assert rows[0]["headline"] == "?"
+        render(rows)                        # never raises
+
+    def test_torn_artifact_raises(self, tmp_path):
+        (tmp_path / "BENCH_pr3.json").write_text("{nope")
+        with pytest.raises(ValueError):
+            collect(str(tmp_path))
+
+
+class TestCLI:
+    def test_table_over_repo_exits_zero(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.benchtrend",
+             "--dir", REPO],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        assert "all digest gates reference pr3" in out.stdout
+        assert not list(tmp_path.iterdir())  # read-only tool
+
+    def test_json_mode_and_drift_exit_code(self, tmp_path):
+        (tmp_path / "BENCH_pr3.json").write_text(
+            '{"bench": "dfbench", "schedule_digest": "aaa"}')
+        (tmp_path / "BENCH_pr9.json").write_text(
+            '{"bench": "x", "schedule_digest": "bbb"}')
+        assert main(["--dir", str(tmp_path), "--json"]) == 2
+
+    def test_empty_dir_is_io_error(self, tmp_path):
+        assert main(["--dir", str(tmp_path)]) == 1
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

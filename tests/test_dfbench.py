@@ -1047,5 +1047,101 @@ class TestPr17Recovery:
                 assert leg["poisoner_reoffers"] > 0
 
 
+class TestPr18FleetPulse:
+    """PR-18 point: fleet pulse. The injection legs must be
+    deterministic (one pulse digest per (seed, fleet, leg),
+    byte-identical across processes), the detector must be pure
+    observation (ctrl ruling digest identical with pulse ingestion
+    interleaved or absent), and the committed BENCH_pr18.json must
+    carry the BENCH_pr3 schedule digest with detection bounded and
+    zero false positives at every fleet size."""
+
+    def test_fleetpulse_bench_deterministic(self):
+        from dragonfly2_tpu.tools.dfbench import run_fleetpulse_bench
+        a = run_fleetpulse_bench(seed=7, daemons=128, inject="stall")
+        b = run_fleetpulse_bench(seed=7, daemons=128, inject="stall")
+        assert a["pulse_digest"] == b["pulse_digest"]
+        # the digest pins WHAT fired (id/kind/host/signal), never the
+        # noise — a different noise seed detects the identical fault
+        # set, so the row digest is seed-ROBUST by design
+        c = run_fleetpulse_bench(seed=11, daemons=128, inject="stall")
+        assert c["pulse_digest"] == a["pulse_digest"]
+        d = run_fleetpulse_bench(seed=7, daemons=128, inject="byzantine")
+        assert d["pulse_digest"] != a["pulse_digest"]
+
+    def test_clean_leg_fires_nothing(self):
+        from dragonfly2_tpu.tools.dfbench import run_fleetpulse_bench
+        r = run_fleetpulse_bench(seed=7, daemons=128, inject="none")
+        assert r["anomalies"] == 0
+        assert r["false_positives"] == 0
+        assert r["anomaly_counts"] == {}
+
+    def test_injection_legs_detect_every_kind_bounded(self):
+        from dragonfly2_tpu.tools.dfbench import run_fleetpulse_bench
+        stall = run_fleetpulse_bench(seed=7, daemons=128, inject="stall")
+        byz = run_fleetpulse_bench(seed=7, daemons=128,
+                                   inject="byzantine")
+        kinds = set(stall["anomaly_counts"]) | set(byz["anomaly_counts"])
+        assert kinds == {"loop-stall", "slo-storm", "silent-daemon",
+                         "corrupt-burst", "rung-escalation", "shed-wave"}
+        assert stall["false_positives"] == 0
+        assert byz["false_positives"] == 0
+        for leg in (stall, byz):
+            for kind, lat in leg["detection_latency_intervals"].items():
+                bound = 3.0 if kind == "silent-daemon" else 2.0
+                assert lat <= bound, (kind, lat)
+
+    def test_pulse_plane_is_pure_observation(self):
+        from dragonfly2_tpu.tools.dfbench import run_ctrl_bench
+        plain = run_ctrl_bench(seed=7, daemons=64, pieces=32,
+                               armed=False)
+        pulsed = run_ctrl_bench(seed=7, daemons=64, pieces=32,
+                                armed=False, pulse=True)
+        assert plain["ruling_digest"] == pulsed["ruling_digest"]
+
+    def test_pr18_smoke_stdout_only_and_committed_digest(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr18", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-fleetpulse"
+        assert r["fleets"] == [128]
+        assert not list(tmp_path.iterdir())      # stdout only
+        # the cross-process gate: the smoke re-derivation of the
+        # fleet-128 legs matches the committed artifact byte-for-byte
+        committed = json.loads(
+            open(os.path.join(REPO, "BENCH_pr18.json")).read())
+        assert r["pulse_digest"] == committed["pulse_digest"]
+
+    def test_pr18_committed_matches_baselines(self):
+        """The committed trajectory gate: BENCH_pr18's baseline digest
+        is byte-identical to BENCH_pr3 (pulse ingestion perturbed
+        nothing), all six kinds fired, push detection landed within 2
+        announce intervals, zero false positives at 128, 1k and 10k
+        daemons, and a busy pulse stays under the announce byte
+        budget."""
+        r = json.loads(open(os.path.join(REPO, "BENCH_pr18.json")).read())
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["fleetpulse_pure"] is True
+        assert r["fleets"] == [128, 1000, 10000]
+        assert r["detected_kinds"] == sorted(
+            ["loop-stall", "slo-storm", "silent-daemon", "corrupt-burst",
+             "rung-escalation", "shed-wave"])
+        assert r["detection_bounded"] is True
+        assert all(v <= 2.0
+                   for v in r["detection_latency_intervals"].values())
+        assert r["silent_detection_intervals"] <= 3.0
+        assert r["zero_false_positives"] is True
+        for name in ("none_128", "none_1000", "none_10000",
+                     "stall_10000", "byzantine_10000"):
+            assert r["false_positives"][name] == 0, name
+        assert r["bytes_per_announce"] <= 512
+        assert r["pulse_overhead_ok"] is True
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-v"])

@@ -1323,6 +1323,63 @@ class TestDF006PhaseVocabulary:
         assert "'sneaky' is not in the PHASES registry" in fs[0].message
 
 
+class TestDF006AnomalyVocabulary:
+    def _tree(self, tmp_path, *, kinds, signal_kinds, fired, doc):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text(doc)
+        pkg = tmp_path / "pkg"
+        (pkg / "scheduler").mkdir(parents=True, exist_ok=True)
+        fp = pkg / "scheduler" / "fleetpulse.py"
+        signals = ",\n    ".join(
+            f'"sig_{i}": ("{k}", 1.0)' for i, k in enumerate(signal_kinds))
+        fires = "\n".join(
+            f'        self._fire("{k}", host_id, "sig", 0.0, 0.0)'
+            for k in fired)
+        fp.write_text(
+            "ANOMALY_KINDS = (%s)\n_SIGNALS = {\n    %s\n}\n\n\n"
+            "class FleetPulse:\n    def tick(self, host_id):\n%s\n" % (
+                ", ".join(f'"{k}"' for k in kinds) + ",",
+                signals, fires or "        pass"))
+        return fp
+
+    def test_registered_fired_documented_is_clean(self, tmp_path):
+        fp = self._tree(tmp_path, kinds=["loop-stall", "silent-daemon"],
+                        signal_kinds=["loop-stall"],
+                        fired=["silent-daemon"],
+                        doc="kinds: `loop-stall` `silent-daemon`")
+        assert codes(lint_file(str(fp), repo_root=str(tmp_path))) == []
+
+    def test_dead_undocumented_and_unregistered_flag(self, tmp_path):
+        fp = self._tree(
+            tmp_path,
+            kinds=["loop-stall", "dead-kind"],
+            signal_kinds=["loop-stall"],
+            fired=["ghost-kind"],
+            doc="kinds: `loop-stall`")
+        fs = active(lint_file(str(fp), repo_root=str(tmp_path)))
+        msgs = " ".join(f.message for f in fs)
+        assert "'dead-kind' is registered" in msgs          # never fired
+        assert "'dead-kind' is not documented" in msgs      # undoc'd
+        assert "not in the ANOMALY_KINDS registry" in msgs  # ghost-kind
+        assert len(fs) == 3
+
+    def test_signal_map_heads_count_as_fire_sites(self, tmp_path):
+        # the z-score path fires through _SIGNALS, not a literal _fire —
+        # the tuple heads must register as fired or every z-kind reads
+        # as dead vocabulary (the bug this fixture pins)
+        fp = self._tree(tmp_path, kinds=["slo-storm"],
+                        signal_kinds=["slo-storm"], fired=[],
+                        doc="`slo-storm`")
+        assert codes(lint_file(str(fp), repo_root=str(tmp_path))) == []
+
+    def test_other_modules_are_not_anomaly_vocabulary(self, tmp_path):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text("")
+        mod = tmp_path / "other.py"
+        mod.write_text('ANOMALY_KINDS = ("whatever",)\n')
+        assert codes(lint_file(str(mod), repo_root=str(tmp_path))) == []
+
+
 # ---------------------------------------------------------------------------
 # CLI: --json, --changed, exit codes
 # ---------------------------------------------------------------------------

@@ -1,0 +1,360 @@
+"""Fleet pulse: the push-telemetry plane (daemon/pulse.py digest build,
+idl codec round-trip, scheduler/fleetpulse.py rings + EWMA detector).
+
+Everything detector-side runs on an injected virtual clock — warm-up
+suppression, exactly-once episode latching, silent-daemon firing from
+the GC tick, and series eviction are all tick-clock tests, never
+sleeps. The ingest path's hard contract: junk, version skew, or a
+crash anywhere inside must COUNT and RETURN, never raise — a daemon's
+telemetry can't be allowed to take the announce plane down.
+"""
+
+import json
+
+import pytest
+
+from dragonfly2_tpu.idl.base import dumps, loads
+from dragonfly2_tpu.idl.messages import (
+    AnnounceHostRequest,
+    Host,
+    PulseDigest,
+    PULSE_VERSION,
+    TopologyInfo,
+)
+from dragonfly2_tpu.scheduler.fleetpulse import (
+    ANOMALY_KINDS,
+    EVICT_AFTER_INTERVALS,
+    FleetPulse,
+    SILENT_AFTER_INTERVALS,
+    WARMUP_SAMPLES,
+)
+
+INTERVAL = 30.0
+
+
+def make_pulse(seq=0, **over):
+    d = {
+        "v": PULSE_VERSION, "seq": seq, "flight_tasks": 2,
+        "flight_evicted": 0, "served_rungs": {"p2p": 10 * (seq + 1)},
+        "loop_lag_max_ms": 5.0, "loop_stalls": 0, "slo_breaches": 0,
+        "corrupt_verdicts": 0, "shunned_parents": 0,
+        "self_quarantined": False, "qos_state": "normal", "qos_shed": 0,
+        "storage_tasks": 1,
+    }
+    d.update(over)
+    return d
+
+
+class Plane:
+    """One FleetPulse on a hand-cranked clock + a captured ledger."""
+
+    def __init__(self, **kw):
+        self.now = [0.0]
+        self.rows = []
+        self.fp = FleetPulse(sink=self.rows.append,
+                             clock=lambda: self.now[0], **kw)
+
+    def announce(self, host, seq, **over):
+        return self.fp.ingest(host, make_pulse(seq, **over),
+                              interval_s=INTERVAL)
+
+    def interval(self, host, seq, **over):
+        """One announce cadence: advance the clock, announce, GC-tick."""
+        self.now[0] += INTERVAL
+        ok = self.announce(host, seq, **over)
+        self.fp.tick()
+        return ok
+
+    def warm(self, host, n=None, **over):
+        n = WARMUP_SAMPLES + 4 if n is None else n
+        for t in range(n):
+            assert self.interval(host, t, **over)
+        return n
+
+    def kinds(self):
+        return [r["anomaly"] for r in self.rows]
+
+
+# ---------------------------------------------------------------------------
+# codec: the digest must survive the real announce wire
+# ---------------------------------------------------------------------------
+
+class TestCodec:
+    def _host(self):
+        return Host(id="d0", ip="10.0.0.1", port=65001,
+                    download_port=65002,
+                    topology=TopologyInfo(slice_name="pod-00",
+                                          ici_coords=(0, 0), zone="z"))
+
+    def test_pulse_round_trips_on_announce(self):
+        pulse = PulseDigest(seq=41, flight_tasks=3, flight_evicted=1,
+                            served_rungs={"p2p": 100, "seed": 7},
+                            loop_lag_max_ms=12.5, loop_stalls=2,
+                            slo_breaches=9, corrupt_verdicts=1,
+                            shunned_parents=2, self_quarantined=False,
+                            qos_state="brownout", qos_shed=4,
+                            storage_tasks=6)
+        req = AnnounceHostRequest(host=self._host(), interval_s=30.0,
+                                  pulse=pulse)
+        back = loads(dumps(req))
+        assert isinstance(back, AnnounceHostRequest)
+        assert back.pulse.v == PULSE_VERSION
+        assert back.pulse.seq == 41
+        assert back.pulse.served_rungs == {"p2p": 100, "seed": 7}
+        assert back.pulse.loop_lag_max_ms == pytest.approx(12.5)
+        assert back.pulse.qos_state == "brownout"
+        assert back.pulse.self_quarantined is False
+
+    def test_absent_pulse_round_trips_as_none(self):
+        req = AnnounceHostRequest(host=self._host(), interval_s=30.0)
+        assert loads(dumps(req)).pulse is None
+
+
+# ---------------------------------------------------------------------------
+# ingest: refusal is total, crashes are swallowed
+# ---------------------------------------------------------------------------
+
+class TestIngest:
+    def test_unknown_version_refused_wholesale(self):
+        p = Plane()
+        assert p.announce("d0", 0, v=PULSE_VERSION + 98) is False
+        assert p.fp.ignored == 1
+        assert p.fp.ingested == 0
+        assert "d0" not in p.fp._series
+
+    def test_junk_never_raises(self):
+        p = Plane()
+        for junk in (None, "garbage", 42, [1, 2], object()):
+            assert p.fp.ingest("d0", junk) is False
+        # malformed fields inside a KNOWN version: counted, swallowed
+        assert p.fp.ingest("d0", {"v": PULSE_VERSION,
+                                  "loop_lag_max_ms": "NaNsense",
+                                  "served_rungs": "not-a-dict"}) is False
+        assert p.fp.ingest("", make_pulse()) is False
+        assert p.fp.ignored == 7
+        assert p.rows == []
+
+    def test_message_object_and_dict_both_ingest(self):
+        p = Plane()
+        assert p.fp.ingest("d0", PulseDigest(seq=1, flight_tasks=1),
+                           interval_s=INTERVAL)
+        assert p.fp.ingest("d1", make_pulse(1), interval_s=INTERVAL)
+        assert p.fp.ingested == 2
+
+    def test_counter_reset_reads_as_zero_delta(self):
+        # a restarted daemon's since-boot counters drop — the clamp must
+        # re-baseline, not read the negative delta as a spike
+        p = Plane()
+        p.warm("d0", slo_breaches=500)
+        p.interval("d0", 99, slo_breaches=0)       # restart: cum fell
+        p.interval("d0", 100, slo_breaches=1)
+        assert p.rows == []
+
+
+# ---------------------------------------------------------------------------
+# rings: bounded under churn
+# ---------------------------------------------------------------------------
+
+class TestRingBounds:
+    def test_pulse_ring_bounded(self):
+        p = Plane(ring=8)
+        p.warm("d0", n=50)
+        s = p.fp._series["d0"]
+        assert len(s.ring) == 8
+        assert s.samples == 50
+        assert [smp["seq"] for smp in s.ring] == list(range(42, 50))
+
+    def test_incident_ring_bounded(self):
+        p = Plane(incident_ring=4)
+        p.now[0] = INTERVAL
+        # every self-quarantine flip fires corrupt-burst with no warm-up
+        for i in range(12):
+            p.announce(f"d{i}", 0, self_quarantined=True)
+        assert len(p.rows) == 12
+        assert len(p.fp.incidents) == 4
+
+    def test_series_evicted_after_long_silence(self):
+        p = Plane()
+        p.warm("d0", n=2)
+        p.warm("d1", n=2)
+        assert len(p.fp._series) == 2
+        # d1 keeps announcing; d0 goes dark past the eviction horizon
+        gone = 0.0
+        seq = 2
+        while gone <= EVICT_AFTER_INTERVALS * INTERVAL:
+            p.interval("d1", seq)
+            gone += INTERVAL
+            seq += 1
+        assert "d0" not in p.fp._series
+        assert "d1" in p.fp._series
+
+
+# ---------------------------------------------------------------------------
+# detector: warm-up, exactly-once, silent-daemon — all on the tick clock
+# ---------------------------------------------------------------------------
+
+class TestDetector:
+    def test_warmup_suppresses_early_spikes(self):
+        p = Plane()
+        for t in range(WARMUP_SAMPLES - 1):
+            p.interval("d0", t, loop_lag_max_ms=900.0)
+        assert p.rows == []
+
+    def test_loop_stall_fires_exactly_once_per_episode(self):
+        p = Plane()
+        p.warm("d0")
+        for t in range(100, 106):
+            p.interval("d0", t, loop_lag_max_ms=900.0)
+        assert p.kinds() == ["loop-stall"]
+        row = p.rows[0]
+        assert row["decision_kind"] == "anomaly"
+        assert row["host_id"] == "d0"
+        assert row["signal"] == "lag_ms"
+        assert row["zscore"] >= 4.0
+        assert row["anomaly"] in ANOMALY_KINDS
+        # recovery clears the episode; a later stall fires a NEW one
+        for t in range(106, 110):
+            p.interval("d0", t)
+        for t in range(110, 113):
+            p.interval("d0", t, loop_lag_max_ms=900.0)
+        assert p.kinds() == ["loop-stall", "loop-stall"]
+        assert p.rows[0]["decision_id"] != p.rows[1]["decision_id"]
+
+    def test_slo_storm_fires_on_rate_not_level(self):
+        # a big but STEADY cumulative count is normal; the detector
+        # fires on the per-interval delta spiking
+        p = Plane()
+        cum = 0
+        for t in range(WARMUP_SAMPLES + 4):
+            cum += 1
+            p.interval("d0", t, slo_breaches=cum)
+        assert p.rows == []
+        cum += 40
+        p.interval("d0", 99, slo_breaches=cum)
+        assert p.kinds() == ["slo-storm"]
+
+    def test_self_quarantine_fires_immediately_no_warmup(self):
+        p = Plane()
+        p.interval("d0", 0)
+        p.interval("d0", 1, self_quarantined=True)
+        assert p.kinds() == ["corrupt-burst"]
+        assert p.rows[0]["signal"] == "self_quarantined"
+        # held latched while the flag stays up: no re-fire
+        p.interval("d0", 2, self_quarantined=True)
+        assert len(p.rows) == 1
+
+    def test_silent_daemon_fires_from_tick_then_clears_on_return(self):
+        p = Plane()
+        n = p.warm("d0")
+        # announces stop; the GC tick crosses the silent threshold
+        p.now[0] += SILENT_AFTER_INTERVALS * INTERVAL + 1.0
+        assert p.fp.tick() == 1
+        assert p.kinds()[-1] == "silent-daemon"
+        assert p.fp.tick() == 0                    # exactly once
+        active = p.fp.snapshot()["active"]
+        assert [(a["host_id"], a["anomaly"]) for a in active] \
+            == [("d0", "silent-daemon")]
+        # the daemon comes back: the episode ends, no new firings
+        p.interval("d0", n + 1)
+        assert p.fp.snapshot()["active"] == []
+        assert p.kinds().count("silent-daemon") == 1
+
+    def test_eviction_past_the_silent_window_still_fires_once(self):
+        # a GC tick coarser than the silent window (found driving a 1 s
+        # announce cadence against the 60 s scheduler ticker) jumps a
+        # dead daemon straight past the eviction horizon — the death
+        # must fire silent-daemon ONCE on the way out, never vanish
+        p = Plane()
+        p.warm("d0", n=2)
+        p.now[0] += (EVICT_AFTER_INTERVALS + 1.0) * INTERVAL
+        assert p.fp.tick() >= 1
+        assert p.kinds() == ["silent-daemon"]
+        assert "d0" not in p.fp._series
+        assert p.fp.tick() == 0
+
+
+# ---------------------------------------------------------------------------
+# statestore + snapshot surfaces
+# ---------------------------------------------------------------------------
+
+class TestStateAndSnapshot:
+    def _fired_plane(self):
+        p = Plane()
+        p.warm("d0")
+        p.interval("d0", 99, loop_lag_max_ms=900.0)
+        assert p.kinds() == ["loop-stall"]
+        return p
+
+    def test_export_restore_round_trip(self):
+        p = self._fired_plane()
+        state = json.loads(json.dumps(p.fp.export_state()))  # wire-real
+        q = Plane()
+        assert q.fp.restore(state) > 0
+        assert q.fp.anomaly_counts["loop-stall"] == 1
+        assert len(q.fp.incidents) == 1
+        assert q.fp.incidents[0]["anomaly"] == "loop-stall"
+        assert q.fp.seq == p.fp.seq               # ids never reused
+        assert list(q.fp._series["d0"].ring)      # ring tail continuity
+        # restored baselines re-warm live: no instant firing on the
+        # first post-restore announce
+        q.interval("d0", 200, loop_lag_max_ms=900.0)
+        assert q.rows == []
+
+    def test_restore_ignores_junk(self):
+        q = Plane()
+        assert q.fp.restore({"incidents": "nope", "rings": {"d0": 7},
+                             "anomaly_counts": {"bogus-kind": 9}}) == 0
+        assert "bogus-kind" not in q.fp.anomaly_counts
+
+    def test_snapshot_shapes(self):
+        p = self._fired_plane()
+        full = p.fp.snapshot()
+        for key in ("daemons", "samples", "ingested", "ignored", "ring",
+                    "fleet", "active", "anomaly_counts",
+                    "recent_anomalies", "incidents"):
+            assert key in full, key
+        assert full["daemons"] == 1
+        assert full["anomaly_counts"] == {"loop-stall": 1}
+        assert full["fleet"]["loop_lag_max_ms"] == pytest.approx(900.0)
+        assert full["incident_bundles"][0]["pulses"]
+        compact = p.fp.snapshot(compact=True)
+        assert "incident_bundles" not in compact
+        assert compact["incident_ids"] == [p.rows[0]["decision_id"]]
+        json.dumps(full), json.dumps(compact)      # both wire-clean
+
+
+# ---------------------------------------------------------------------------
+# daemon side: build_pulse over a stub daemon
+# ---------------------------------------------------------------------------
+
+class TestBuildPulse:
+    def test_bare_daemon_builds_a_valid_empty_pulse(self):
+        from dragonfly2_tpu.daemon.pulse import build_pulse
+        pulse = build_pulse(object(), seq=3)
+        assert pulse.v == PULSE_VERSION
+        assert pulse.seq == 3
+        assert pulse.flight_tasks == 0
+        # and the scheduler side ingests it
+        p = Plane()
+        assert p.fp.ingest("d0", pulse, interval_s=INTERVAL)
+
+    def test_rung_tallies_flow_into_served_rungs(self):
+        from dragonfly2_tpu.daemon.flight_recorder import FlightRecorder
+        from dragonfly2_tpu.daemon.pulse import build_pulse
+
+        class Stub:
+            pass
+
+        daemon = Stub()
+        daemon.flight_recorder = FlightRecorder()
+        flight = daemon.flight_recorder.begin("task-1", "peer-1")
+        flight.rung("p2p")
+        flight.rung("p2p")
+        flight.rung("seed")
+        pulse = build_pulse(daemon, seq=1)
+        assert pulse.served_rungs == {"p2p": 2, "seed": 1}
+        assert pulse.flight_tasks == 1
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

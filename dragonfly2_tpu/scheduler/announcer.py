@@ -15,7 +15,9 @@ import gzip
 import json
 import logging
 import socket
+import time
 
+from ..common.metrics import REGISTRY
 from ..idl.messages import GetModelRequest, TrainRequest
 from ..rpc.client import Channel, ServiceClient
 from ..trainer.features import MLP_MODEL_NAME
@@ -24,6 +26,16 @@ log = logging.getLogger("df.sched.announcer")
 
 TRAINER_SERVICE = "df.trainer.Trainer"
 UPLOAD_CHUNK_BYTES = 1 << 20
+MAX_REFUSALS_REMEMBERED = 8         # rollout-provenance journal bound
+
+_rollouts_total = REGISTRY.counter(
+    "df_ml_model_rollouts_total",
+    "model versions successfully bound into the live serving path",
+    ("model",))
+_refused_total = REGISTRY.counter(
+    "df_ml_model_refused_total",
+    "model blobs refused wholesale at bind time (garbage bytes, stale "
+    "feature schema, non-finite weights)", ("model",))
 
 
 class SchedulerAnnouncer:
@@ -38,8 +50,11 @@ class SchedulerAnnouncer:
         self.refresh_interval_s = refresh_interval_s
         self._tasks: list[asyncio.Task] = []
         self._trainer_channel: Channel | None = None
-        self.model_version = ""        # currently served MLP version
-        self.gnn_version = ""          # currently bound topology imputer
+        self.model_version = ""        # newest MLP version seen (served OR
+        self.gnn_version = ""          # refused) — the if_none_match cursor
+        self.model_bound_at = 0.0      # wall clock of the last MLP bind
+        self.model_metrics: dict = {}  # registry metrics of the served MLP
+        self.refused: dict[str, str] = {}   # version -> bind refusal reason
         self._last_topo_key = 0        # hash of last uploaded topo snapshot
 
     def start(self) -> None:
@@ -171,15 +186,57 @@ class SchedulerAnnouncer:
                 or not model.data:
             return False
         from ..trainer.serving import make_mlp_infer
-        # deserialize + hash the model blob off-loop: this is the
-        # scheduler's serving loop, and a rollout must not stall rulings
-        infer = await asyncio.to_thread(make_mlp_infer, model.data)
+        try:
+            # deserialize + hash the model blob off-loop: this is the
+            # scheduler's serving loop, and a rollout must not stall rulings
+            infer = await asyncio.to_thread(make_mlp_infer, model.data)
+        except ValueError as exc:
+            # bind-time refusal (garbage bytes / stale feature schema /
+            # non-finite weights): the evaluator keeps whatever it is
+            # serving — worst case the heuristic floor. Remember the
+            # refused version so if_none_match skips the full-blob refetch
+            # every cycle, and journal the reason for /debug/ctrl
+            self.model_version = model.version
+            self._remember_refusal(model.version, str(exc))
+            _refused_total.labels(MLP_MODEL_NAME).inc()
+            log.warning("bandwidth mlp %s refused: %s", model.version, exc)
+            return False
         evaluator.infer = infer
         self.model_version = model.version
+        self.model_bound_at = time.time()
+        self.model_metrics = dict(model.metrics or {})
+        _rollouts_total.labels(MLP_MODEL_NAME).inc()
         log.info("ml evaluator now serving %s@%s (final_loss=%s)",
                  model.name, model.version,
                  (model.metrics or {}).get("final_loss"))
         return True
+
+    def _remember_refusal(self, version: str, reason: str) -> None:
+        self.refused[version] = reason
+        while len(self.refused) > MAX_REFUSALS_REMEMBERED:
+            self.refused.pop(next(iter(self.refused)))
+
+    def model_provenance(self) -> dict:
+        """Rollout provenance for ``/debug/ctrl``: which brain version is
+        ruling (from the evaluator itself, not the fetch cursor — a
+        refused blob advances the cursor without being served), when it
+        was bound, the registry metrics it shipped with, and every blob
+        refused at bind time since startup (bounded journal)."""
+        out = {
+            "model": MLP_MODEL_NAME,
+            "checked_version": self.model_version,
+            "bound_at": self.model_bound_at,
+            "metrics": {k: self.model_metrics[k]
+                        for k in ("version", "rows", "final_loss",
+                                  "schema_version")
+                        if k in self.model_metrics},
+            "refused": dict(self.refused),
+            "gnn_version": self.gnn_version,
+        }
+        ev = self._evaluator()
+        if ev is not None:
+            out["evaluator"] = ev.health()
+        return out
 
     async def _refresh_gnn_once(self) -> bool:
         topo = getattr(self.scheduler, "topo", None)
@@ -205,9 +262,12 @@ class SchedulerAnnouncer:
             # refetch every cycle — the trainer's next refit changes the
             # version and gets fetched normally
             self.gnn_version = model.version
+            self._remember_refusal(model.version, str(exc))
+            _refused_total.labels(GNN_MODEL_NAME).inc()
             log.warning("topology gnn %s refused: %s", model.version, exc)
             return False
         self.gnn_version = model.version
+        _rollouts_total.labels(GNN_MODEL_NAME).inc()
         log.info("topology store now imputing with %s@%s",
                  model.name, model.version)
         return True

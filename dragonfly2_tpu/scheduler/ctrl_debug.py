@@ -41,7 +41,7 @@ class CtrlObservatory:
 
     def __init__(self, *, resource=None, ledger=None, federation=None,
                  quarantine=None, sharded=None, statestore=None,
-                 ttl_s: float = STATE_TTL_S,
+                 model_provenance=None, ttl_s: float = STATE_TTL_S,
                  clock=time.monotonic) -> None:
         self.components = {
             "resource": resource,
@@ -51,6 +51,9 @@ class CtrlObservatory:
             "shard_affinity": sharded,
         }
         self.statestore = statestore
+        # zero-arg callable → rollout-provenance dict (the announcer's
+        # model_provenance); None on schedulers without a learning loop
+        self.model_provenance = model_provenance
         self.ttl_s = ttl_s
         self.clock = clock
         self._state_cache: dict | None = None
@@ -97,6 +100,12 @@ class CtrlObservatory:
         # the scheduler is ruling from memory or from hearsay
         if self.statestore is not None:
             snap["recovery"] = self.statestore.provenance
+        # model-rollout provenance: which trained brain (if any) the ml
+        # evaluator is serving, every blob refused at bind time, and the
+        # serve-time fallback tally — dfdiag --ctrl names a degraded
+        # evaluator from this block
+        if self.model_provenance is not None:
+            snap["model"] = self.model_provenance()
         return snap
 
 

@@ -336,6 +336,78 @@ def replay_decisions(rows: list[dict],
     }
 
 
+def replay_regret(rows: list[dict],
+                  evaluators: tuple = ("default", "ml"),
+                  infer=None) -> dict:
+    """Observed-bandwidth regret of each evaluator's counterfactual top
+    pick, judged by what the logged outcomes actually measured.
+
+    For every decision whose ``kind=piece`` outcome rows cover at least
+    two candidates, each evaluator's ranking (``rescore_decision`` — the
+    same pure replay math as ``replay_decisions``) nominates its best
+    candidate *among those with measured outcomes*; the regret of that
+    pick is its shortfall against the best observed bandwidth for the
+    ruling, relative: ``(best_bps - picked_bps) / best_bps``. Restricting
+    the pick to measured candidates keeps the judgment honest — an
+    unmeasured candidate has no observed bandwidth to be judged by.
+
+    Returns per-evaluator mean regret, mean chosen bandwidth, and the
+    fraction of rulings where the evaluator picked the observed-best
+    parent outright. ``decisions_judged`` counts rulings with a usable
+    counterfactual (≥2 measured candidates); single-outcome rulings
+    carry no signal and are skipped, not silently averaged in.
+    """
+    decisions = {r.get("decision_id", ""): r for r in rows
+                 if r.get("kind") == "decision" and r.get("candidates")}
+    # (decision_id, parent) -> [bytes, seconds] accumulated over pieces
+    flow: dict[tuple, list] = {}
+    for r in rows:
+        if r.get("kind") != "piece" or not r.get("decision_id"):
+            continue
+        if r["decision_id"] not in decisions:
+            continue
+        key = (r["decision_id"], r.get("parent_peer_id", ""))
+        agg = flow.setdefault(key, [0, 0.0])
+        agg[0] += int(r.get("piece_length", 0) or 0)
+        agg[1] += float(r.get("cost_ms", 0) or 0) / 1e3
+    per = {name: {"regret": [], "bps": [], "best_picks": 0}
+           for name in evaluators}
+    judged = 0
+    for did, d in decisions.items():
+        observed = {}
+        for c in d.get("candidates") or []:
+            pid = c.get("peer_id", "")
+            agg = flow.get((did, pid))
+            if agg and agg[1] > 0:
+                observed[pid] = agg[0] / agg[1]
+        if len(observed) < 2:
+            continue
+        judged += 1
+        best = max(observed.values())
+        for name in evaluators:
+            ranked = rescore_decision(d, name, infer)
+            pick = next((pid for pid in ranked if pid in observed), None)
+            if pick is None:    # unreachable: observed ⊆ candidates
+                continue
+            bps = observed[pick]
+            per[name]["bps"].append(bps)
+            per[name]["regret"].append((best - bps) / best if best else 0.0)
+            if bps == best:
+                per[name]["best_picks"] += 1
+    out = {"decisions_judged": judged, "evaluators": {}}
+    for name in evaluators:
+        r = per[name]["regret"]
+        b = per[name]["bps"]
+        out["evaluators"][name] = {
+            "mean_regret": round(sum(r) / len(r), 4) if r else 0.0,
+            "mean_chosen_bandwidth_bps": round(sum(b) / len(b), 1)
+            if b else 0.0,
+            "best_pick_rate": round(per[name]["best_picks"] / judged, 4)
+            if judged else 0.0,
+        }
+    return out
+
+
 # drift guard: the replay rebuilds totals from SCORE_TERMS — a new term in
 # the evaluator that never lands here would silently mis-replay
 if tuple(n for n, _ in SCORE_TERMS) != (

@@ -98,6 +98,14 @@ def _headline(pr: int, d: dict) -> str:
                     f"{max(lat.values(), default='?')} intervals, "
                     f"fp={sum((d.get('false_positives') or {}).values())}, "
                     f"{d.get('bytes_per_announce', '?')} B/announce")
+        if pr == 19:
+            reg = d.get("regret") or {}
+            return (f"regret learned={reg.get('learned', '?')} vs "
+                    f"heuristic={reg.get('heuristic', '?')}, "
+                    f"flip={d.get('flip_rate', '?')}, "
+                    f"beats={d.get('learned_beats_heuristic', '?')}, "
+                    f"deterministic={d.get('trained_deterministic', '?')}"
+                    f"/{d.get('learned_deterministic', '?')}")
     except Exception:  # noqa: BLE001 - schema drift degrades, never crashes
         pass
     return "?"

@@ -133,6 +133,8 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
               collect_timeline: bool = False,
               collect_podscope: bool = False,
               collect_decisions: bool = False,
+              collect_outcomes: bool = False,
+              evaluator=None,
               quarantine=None,
               origin_link: LinkType = LinkType.WAN) -> dict:
     """Run one simulated fan-out; returns the result dict (pure function
@@ -148,7 +150,16 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     rows — explain() totals are bit-identical to evaluate() and the sink
     never touches the rng, so the digest cannot move (gated in
     tests/test_dfbench.py); these rows feed the --pr8 counterfactual
-    replay. ``origin_link`` is the link tier origin/back-source fetches
+    replay. ``collect_outcomes`` attaches ``kind=piece`` outcome rows in
+    the ``scheduler/records.py`` schema, one per p2p transfer, stamped
+    with the child's newest ``decision_id`` and the scoring-time feature
+    row — the training dataset ``dfbench --pr19`` fits on; a pure readout
+    of dispatch-time quantities, never in the rng path, so the digest
+    cannot move. ``evaluator`` swaps the scoring policy (default: the
+    exact ``make_evaluator("default")`` every committed digest was ruled
+    by); an ``MLEvaluator(infer=None)`` here proves the ML-disarmed
+    schedule is byte-identical, a trained one runs the learned leg.
+    ``origin_link`` is the link tier origin/back-source fetches
     ride (default WAN — the pre-federation hardcode, so every committed
     digest is untouched); federation scenarios pass DCN to model a
     GCS-attached origin without forking the sim."""
@@ -186,10 +197,12 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
     # filter lookup answers healthy, no rng touched)
     sched = Scheduling(
         SchedulerConfig(relay_fanout=RELAY_FANOUT if relay_mode else 0),
-        make_evaluator("default"), quarantine=quarantine)
+        make_evaluator("default") if evaluator is None else evaluator,
+        quarantine=quarantine)
     decision_rows: list[dict] = []
     if collect_decisions:
         sched.decision_sink = decision_rows.append
+    outcome_rows: list[dict] = []
 
     def topo(slice_name: str, x: int, y: int) -> TopologyInfo:
         return TopologyInfo(slice_name=slice_name, ici_coords=(x, y),
@@ -474,6 +487,36 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
                 t_wire = max(t_first + wire_ms, up[1] + hop)
                 lc.relay_pulls += 1
         t_hbm = t_wire + hbm_ms
+        if collect_outcomes:
+            # one kind=piece outcome row per p2p transfer, in the
+            # scheduler/records.py on_piece schema: the child's newest
+            # decision_id (stamped by _emit_decision at offer time), the
+            # scoring-time feature vector, and the observed-bandwidth
+            # label over the modeled download cost. PURE OBSERVATION of
+            # quantities already computed above — no rng draw, no peer
+            # mutation — so arming it cannot move the schedule digest
+            # (gated in tests/test_dfbench.py)
+            from ..scheduler.evaluator_ml import parent_feature_row
+            from ..trainer.features import label_from_cost
+            cost_ms = ttfb_ms + wire_ms
+            outcome_rows.append({
+                "kind": "piece",
+                "task_id": task.id,
+                "peer_id": lc.peer.id,
+                "host_id": lc.peer.host.id,
+                "decision_id": lc.peer.last_decision_id,
+                "parent_peer_id": parent.id,
+                "parent_host_id": parent.host.id,
+                "piece_num": piece,
+                "piece_length": piece_size,
+                "cost_ms": cost_ms,
+                "success": True,
+                "fail_code": "",
+                "features": parent_feature_row(
+                    lc.peer, parent, total_piece_count=pieces),
+                "label": label_from_cost(piece_size, cost_ms),
+                "created_at": now,
+            })
         lc.arrive[piece] = (t_first, t_wire)
         ev = lc.flight.events.append
         ev((now, fr.SCHEDULED, piece, parent.id, 0, 0.0))
@@ -498,6 +541,8 @@ def run_bench(*, seed: int = 7, daemons: int = 8, pieces: int = 64,
                               for lc in leechers}
     if collect_decisions:
         result["decisions"] = decision_rows
+    if collect_outcomes:
+        result["outcomes"] = outcome_rows
     if collect_podscope:
         # per-daemon snapshots in the podscope shape, on one shared
         # virtual epoch (started_at=0: the sim's event t_ms values are
@@ -823,6 +868,102 @@ def _run_pr8(args) -> dict:
         "cross_evaluator": replay["pairs"],
         "logged_choice_agreement": replay["logged_choice_agreement"],
         "decision_digest": replay["decision_digest"],
+    }
+
+
+def _run_pr19(args) -> dict:
+    """The PR-19 trajectory point: the closed learning loop, proved three
+    ways on one seed. (1) ML-disarmed purity: a cold ``MLEvaluator`` (no
+    model bound) rules the exact baseline schedule — digest byte-identical
+    to BENCH_pr3 (the gate in tests/test_dfbench.py), so arming the
+    learned evaluator without a model changes NOTHING. (2) Offline: one
+    datagen run logs decisions + per-transfer outcome rows; the trainer
+    pipeline fits the parent-quality MLP on the decision-outcome folds
+    (seeded — a second fit must produce the byte-identical version), and
+    the trained model replays counterfactually against the heuristic over
+    the logged rows: choice-flip rate and observed-bandwidth regret, with
+    the heuristic replay's ``logged_choice_agreement`` pinned at 1.0
+    (exact replay math unmoved). (3) Live: the trained model serves a
+    learned leg of the same seed, twice, from independently trained blobs
+    — same schedule AND decision digests both times (seeded training →
+    same blob → same rulings), and the learned leg's regret over its own
+    logged outcomes stays below the heuristic's."""
+    from ..scheduler.decision_ledger import replay_decisions, replay_regret
+    from ..scheduler.evaluator_ml import MLEvaluator
+    from ..trainer.pipeline import train_decision_model
+    from ..trainer.serving import make_mlp_infer
+
+    kw = dict(seed=args.seed, daemons=args.daemons, pieces=args.pieces,
+              piece_size=args.piece_size, parallelism=args.parallelism)
+    base = run_bench(**kw)
+    disarmed = run_bench(evaluator=MLEvaluator(infer=None), **kw)
+    gen = run_bench(collect_decisions=True, collect_outcomes=True, **kw)
+    rows = gen["decisions"] + gen["outcomes"]
+    # two independent seeded fits: the determinism contract the rollout
+    # path rests on (same rows + same seed -> same blob -> same version)
+    fit = train_decision_model(rows, seed=args.seed, use_mesh=False)
+    refit = train_decision_model(rows, seed=args.seed, use_mesh=False)
+    if fit is None or refit is None:
+        raise RuntimeError("pr19: datagen run produced too few trainable "
+                           "rows — grow --daemons/--pieces")
+    blob, metrics = fit
+    infer = make_mlp_infer(blob)
+    replay = replay_decisions(gen["decisions"],
+                              evaluators=("default", "ml"), infer=infer)
+    regret = replay_regret(rows, evaluators=("default", "ml"), infer=infer)
+    learned = run_bench(evaluator=MLEvaluator(infer=infer),
+                        collect_decisions=True, **kw)
+    learned2 = run_bench(evaluator=MLEvaluator(infer=make_mlp_infer(
+        refit[0])), collect_decisions=True, **kw)
+    l_digest = replay_decisions(learned["decisions"])["decision_digest"]
+    l2_digest = replay_decisions(learned2["decisions"])["decision_digest"]
+    reg = regret["evaluators"]
+    return {
+        "bench": "dfbench-learned",
+        "seed": args.seed,
+        "daemons": args.daemons,
+        "pieces": args.pieces,
+        "piece_size": args.piece_size,
+        "parallelism": args.parallelism,
+        # byte-identical to BENCH_pr3 — AND to the ML-disarmed and
+        # outcome-collecting runs: a bound-but-empty learned evaluator
+        # and the training-data tap both observe without perturbing
+        "schedule_digest": base["schedule_digest"],
+        "ml_disarmed_pure": (base["schedule_digest"]
+                             == disarmed["schedule_digest"]),
+        "outcomes_pure": (base["schedule_digest"]
+                          == gen["schedule_digest"]),
+        "decision_rows": len(gen["decisions"]),
+        "outcome_rows": len(gen["outcomes"]),
+        "model": {k: metrics.get(k)
+                  for k in ("version", "rows", "supervision",
+                            "first_epoch_loss", "final_loss",
+                            "schema_version", "feature_dim")},
+        "trained_deterministic": (refit[1]["version"]
+                                  == metrics["version"]),
+        "flip_rate": replay["pairs"]["default_vs_ml"]["choice_flip_rate"],
+        "rank_agreement": replay["pairs"]["default_vs_ml"]
+        ["rank_agreement"],
+        "logged_choice_agreement": replay["logged_choice_agreement"],
+        "decisions_judged": regret["decisions_judged"],
+        "regret": {"heuristic": reg["default"]["mean_regret"],
+                   "learned": reg["ml"]["mean_regret"]},
+        "best_pick_rate": {"heuristic": reg["default"]["best_pick_rate"],
+                           "learned": reg["ml"]["best_pick_rate"]},
+        "mean_chosen_bandwidth_bps": {
+            "heuristic": reg["default"]["mean_chosen_bandwidth_bps"],
+            "learned": reg["ml"]["mean_chosen_bandwidth_bps"]},
+        "learned_beats_heuristic": (reg["ml"]["mean_regret"]
+                                    < reg["default"]["mean_regret"]),
+        "learned_schedule_digest": learned["schedule_digest"],
+        "learned_decision_digest": l_digest,
+        "learned_deterministic": (
+            learned["schedule_digest"] == learned2["schedule_digest"]
+            and l_digest == l2_digest),
+        "wall_ms": {"heuristic": base["wall_ms"],
+                    "learned": learned["wall_ms"]},
+        "seed_served_ratio": {"heuristic": base["seed_served_ratio"],
+                              "learned": learned["seed_served_ratio"]},
     }
 
 
@@ -3675,6 +3816,15 @@ def build_parser() -> argparse.ArgumentParser:
                    "rank-agreement + choice-flip rates, a deterministic "
                    "decision_digest, and a ledger-purity check against "
                    "the BENCH_pr3 schedule digest")
+    p.add_argument("--pr19", action="store_true",
+                   help="close the learning loop: log decisions + "
+                   "per-transfer outcome rows, fit the parent-quality "
+                   "MLP through the trainer pipeline (seeded, twice — "
+                   "determinism gated), replay learned-vs-heuristic for "
+                   "flip rate + observed-bandwidth regret, serve the "
+                   "trained model in a live learned leg, and write the "
+                   "PR-19 trajectory point (BENCH_pr19.json) with the "
+                   "ML-disarmed digest gate against BENCH_pr3")
     p.add_argument("--out", default="",
                    help="result path ('-' = stdout only; default "
                    "BENCH_pr3.json, or BENCH_pr<N>.json with --pr<N>)")
@@ -3712,7 +3862,9 @@ def main(argv: list[str] | None = None) -> int:
         # non-baseline one-off scenarios default to stdout: a bare
         # '--scenario scheds_down_*' run must never clobber the committed
         # BENCH_pr3.json baseline with outage numbers
-        if args.pr18:
+        if args.pr19:
+            args.out = "BENCH_pr19.json"
+        elif args.pr18:
             args.out = "BENCH_pr18.json"
         elif args.pr17:
             args.out = "BENCH_pr17.json"
@@ -3744,7 +3896,9 @@ def main(argv: list[str] | None = None) -> int:
             args.out = "-"
     if args.smoke:
         args.daemons, args.pieces, args.out = 4, 8, "-"
-    if args.pr18:
+    if args.pr19:
+        result = _run_pr19(args)
+    elif args.pr18:
         result = _run_pr18(args)
     elif args.pr17:
         result = _run_pr17(args)
@@ -3779,7 +3933,21 @@ def main(argv: list[str] | None = None) -> int:
     if args.out and args.out != "-":
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        if args.pr18:
+        if args.pr19:
+            reg = result["regret"]
+            print(f"dfbench: wrote {args.out} (learned loop: "
+                  f"model {result['model']['version']} on "
+                  f"{result['model']['rows']} rows, regret "
+                  f"learned={reg['learned']} vs "
+                  f"heuristic={reg['heuristic']}, "
+                  f"flip={result['flip_rate']}, "
+                  f"beats={result['learned_beats_heuristic']}, "
+                  f"deterministic={result['trained_deterministic']}"
+                  f"/{result['learned_deterministic']}, "
+                  f"pure={result['ml_disarmed_pure']}"
+                  f"/{result['outcomes_pure']}, "
+                  f"schedule {result['schedule_digest'][:12]})")
+        elif args.pr18:
             lat = result["detection_latency_intervals"]
             worst = max(lat, key=lat.get) if lat else ""
             fps = sum(result["false_positives"].values())

@@ -305,6 +305,31 @@ def render_ctrl(snap: dict) -> str:
             out.extend(parts)
         else:
             out.append("recovery: cold boot (no usable snapshot)")
+    model = snap.get("model")
+    if model is not None:
+        ev = model.get("evaluator") or {}
+        served = ev.get("version") or ""
+        if served:
+            line = (f"model: serving {model.get('model', '?')}@{served}"
+                    f"  scored={ev.get('scored', 0)}"
+                    f"  fallbacks={ev.get('fallbacks', 0)}")
+        elif ev.get("bound"):
+            line = (f"model: {model.get('model', '?')} bound (unversioned)"
+                    f"  scored={ev.get('scored', 0)}"
+                    f"  fallbacks={ev.get('fallbacks', 0)}")
+        else:
+            line = (f"model: none served — {model.get('model', '?')} "
+                    f"ruling on the heuristic floor")
+        out.append(line)
+        if ev.get("degraded"):
+            # the operator-facing name for a bad model in production: the
+            # floor is doing the ruling, and here is why
+            out.append(f"  DEGRADED evaluator: "
+                       f"{ev.get('fallbacks', 0)} fallback(s), last: "
+                       f"{ev.get('last_fallback_reason', '?')}")
+        refused = model.get("refused") or {}
+        for version, reason in sorted(refused.items()):
+            out.append(f"  refused {version}: {reason}")
     return "\n".join(out)
 
 

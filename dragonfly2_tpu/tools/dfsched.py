@@ -18,11 +18,23 @@ Sources:
                    --debug-port (no outcome join: the ring holds rulings,
                    the records file holds what happened next).
 
+``--replay learned`` re-scores every logged ruling under the learned
+parent-quality model next to the heuristic (the same pure replay math as
+``dfbench --pr8``/``--pr19``: ``scheduler/decision_ledger.py``) and
+renders the choice FLIPS — rulings where the learned model promotes a
+different parent — with the per-term score decomposition of both picks
+side by side, so "what did the model see that the heuristic didn't"
+reads straight off the terminal. The model comes from ``--model
+blob.npz`` (a ``trainer/params_io.py`` artifact) or, when omitted, a
+seeded fit over the records themselves (``trainer/pipeline.py``).
+
 Usage:
     python -m dragonfly2_tpu.tools.dfsched --records records/ <task_id>
     python -m dragonfly2_tpu.tools.dfsched --records download.jsonl --stats
     python -m dragonfly2_tpu.tools.dfsched --scheduler 127.0.0.1:65100
     python -m dragonfly2_tpu.tools.dfsched --records records/ --child f3a9
+    python -m dragonfly2_tpu.tools.dfsched --records records/ \
+        --replay learned [--model bandwidth_mlp.npz]
 
 Exit codes (CI contract, same shape as dfdiag): 0 ok, 1 fetch/IO
 failure, 2 usage.
@@ -169,6 +181,104 @@ def render_decision(d: dict, *, max_candidates: int = 10) -> str:
     return "\n".join(out)
 
 
+def replay_learned(rows: list[dict], infer) -> dict:
+    """Heuristic-vs-learned counterfactual over raw record rows, reusing
+    the ledger's replay machinery wholesale. Returns the summary plus one
+    entry per choice FLIP carrying both picks' per-term decompositions
+    and their scores under each evaluator — the data ``render_flip``
+    draws and ``--json`` emits verbatim."""
+    from ..scheduler.decision_ledger import (replay_decisions, replay_regret,
+                                             rescore_candidate,
+                                             rescore_decision)
+    decisions = [r for r in rows
+                 if r.get("kind") == "decision" and r.get("candidates")]
+    summary = replay_decisions(rows, evaluators=("default", "ml"),
+                               infer=infer)
+    regret = replay_regret(rows, evaluators=("default", "ml"), infer=infer)
+    flips = []
+    for d in decisions:
+        ranked_h = rescore_decision(d, "default")
+        ranked_m = rescore_decision(d, "ml", infer)
+        if not ranked_h or not ranked_m or ranked_h[0] == ranked_m[0]:
+            continue
+        cands = {c.get("peer_id", ""): c for c in d["candidates"]}
+        picks = {}
+        for who, pid in (("heuristic", ranked_h[0]), ("learned",
+                                                      ranked_m[0])):
+            c = cands[pid]
+            terms = c.get("terms") or {}
+            picks[who] = {
+                "peer_id": pid,
+                "terms": {t: round(float(terms.get(t, 0.0)), 4)
+                          for t in _TERM_COLS},
+                "score_heuristic": round(rescore_candidate(
+                    c, "default", d.get("host_id", "")), 4),
+                "score_learned": round(rescore_candidate(
+                    c, "ml", d.get("host_id", ""), infer), 4),
+            }
+        flips.append({"decision_id": d.get("decision_id", ""),
+                      "task_id": d.get("task_id", ""),
+                      "peer_id": d.get("peer_id", ""), **picks})
+    return {"decisions_scored": len(decisions), "summary": summary,
+            "regret": regret, "flips": flips}
+
+
+def render_flip(flip: dict) -> str:
+    """One choice flip: both picks' logged per-term decomposition side by
+    side with the deltas, then each pick's score under each evaluator."""
+    h, m = flip["heuristic"], flip["learned"]
+    out = [f"flip {flip['decision_id']}  task {flip['task_id'][:16]}  "
+           f"child {flip['peer_id'][-16:]}: heuristic keeps "
+           f"{h['peer_id'][-16:]}, learned promotes {m['peer_id'][-16:]}",
+           f"  {'':>10} {'peer':>18} "
+           + " ".join(f"{_TERM_HDR[t]:>6}" for t in _TERM_COLS)
+           + f" {'score_h':>8} {'score_ml':>8}"]
+    for who, pick in (("heuristic", h), ("learned", m)):
+        out.append(
+            f"  {who:>10} {pick['peer_id'][-18:]:>18} "
+            + " ".join(f"{pick['terms'][t]:>6.3f}" for t in _TERM_COLS)
+            + f" {pick['score_heuristic']:>8.4f}"
+            f" {pick['score_learned']:>8.4f}")
+    out.append(
+        f"  {'delta':>10} {'':>18} "
+        + " ".join(f"{m['terms'][t] - h['terms'][t]:>+6.3f}"
+                   for t in _TERM_COLS)
+        + f" {m['score_heuristic'] - h['score_heuristic']:>+8.4f}"
+        f" {m['score_learned'] - h['score_learned']:>+8.4f}")
+    return "\n".join(out)
+
+
+def render_replay(rep: dict, model_desc: str, limit: int = 8) -> str:
+    pair = rep["summary"]["pairs"]["default_vs_ml"]
+    logged = rep["summary"]["logged_choice_agreement"]
+    out = [f"replay: heuristic vs learned ({model_desc}) over "
+           f"{rep['decisions_scored']} ruling(s)",
+           f"  choice flips: {len(rep['flips'])} "
+           f"({pair['choice_flip_rate']:.1%})   rank agreement: "
+           f"{pair['rank_agreement']:.3f}   logged-choice agreement: "
+           f"heuristic {logged['default']:.3f} / learned "
+           f"{logged['ml']:.3f}"]
+    reg = rep["regret"]
+    if reg["decisions_judged"]:
+        ev = reg["evaluators"]
+        out.append(
+            f"  observed-bandwidth regret over {reg['decisions_judged']} "
+            f"judged ruling(s): heuristic "
+            f"{ev['default']['mean_regret']:.4f} vs learned "
+            f"{ev['ml']['mean_regret']:.4f}   best-pick rate: "
+            f"{ev['default']['best_pick_rate']:.1%} vs "
+            f"{ev['ml']['best_pick_rate']:.1%}")
+    else:
+        out.append("  (no outcome rows joined — regret needs "
+                   "kind=piece rows beside the decisions)")
+    for flip in rep["flips"][-limit:]:
+        out.append("")
+        out.append(render_flip(flip))
+    if len(rep["flips"]) > limit:
+        out.append(f"\n  … +{len(rep['flips']) - limit} more flip(s)")
+    return "\n".join(out)
+
+
 def render_stats(stitched: dict) -> str:
     cov = stitched["coverage"]
     decisions = stitched["decisions"]
@@ -209,6 +319,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="newest-N decisions to render (default 8)")
     p.add_argument("--stats", action="store_true",
                    help="coverage + exclusion summary instead of rulings")
+    p.add_argument("--replay", default="", choices=("", "learned"),
+                   help="'learned': re-score every ruling under the "
+                   "learned parent-quality model vs the heuristic and "
+                   "render the choice flips with per-term deltas "
+                   "(needs --records)")
+    p.add_argument("--model", default="",
+                   help="serialized model blob for --replay learned "
+                   "(trainer/params_io.py artifact); omit to fit one "
+                   "from the records themselves")
+    p.add_argument("--seed", type=int, default=0,
+                   help="fit seed when --replay learned fits from the "
+                   "records (ignored with --model)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable JSON instead of rendered text")
     p.add_argument("--timeout", type=float, default=10.0,
@@ -229,6 +351,39 @@ def _pick_task(decisions: list[dict], prefix: str) -> str:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     try:
+        if args.replay:
+            if not args.records:
+                # the live ring would work too, but its rows lack the
+                # joined outcomes the regret judgment needs — keep the
+                # mode honest and file-fed
+                print("dfsched: --replay needs --records PATH",
+                      file=sys.stderr)
+                return EXIT_USAGE
+            rows = load_rows(args.records)
+            from ..trainer.serving import make_mlp_infer
+            if args.model:
+                with open(args.model, "rb") as f:
+                    infer = make_mlp_infer(f.read())
+                desc = (f"model {getattr(infer, 'version', '?')} from "
+                        f"{os.path.basename(args.model)}")
+            else:
+                from ..trainer.pipeline import train_decision_model
+                fitted = train_decision_model(rows, seed=args.seed,
+                                              use_mesh=False)
+                if fitted is None:
+                    print("dfsched: too few usable rows to fit a replay "
+                          "model — pass --model blob.npz or more records",
+                          file=sys.stderr)
+                    return EXIT_IO
+                infer = make_mlp_infer(fitted[0])
+                desc = (f"model {fitted[1]['version']} fit from these "
+                        f"records, seed {args.seed}")
+            rep = replay_learned(rows, infer)
+            if args.json:
+                print(json.dumps({"model": desc, **rep}, indent=2))
+            else:
+                print(render_replay(rep, desc, limit=args.limit))
+            return EXIT_OK
         if args.scheduler:
             # fetch the whole ring (bounded server-side at DEFAULT_RING_ROWS)
             # and slice locally: asking for only --limit rows would truncate

@@ -61,7 +61,9 @@ async def serve(cfg: SchedulerConfig, debug_port: int = 0) -> None:
             federation=sched.service.federation,
             quarantine=sched.service.quarantine,
             sharded=sched.service.scheduling.sharded,
-            statestore=sched.statestore))
+            statestore=sched.statestore,
+            model_provenance=(sched.announcer.model_provenance
+                              if sched.announcer is not None else None)))
 
     debug_runner = await maybe_start_debug(debug_port,
                                            extra_routes=_extra_routes)

@@ -19,15 +19,27 @@ import asyncio
 import logging
 
 from ..common.errors import Code, DFError
+from ..common.metrics import REGISTRY
 from ..idl.messages import (CreateModelRequest, ModelInferRequest,
                             ModelInferResponse, TrainResponse)
 from ..rpc.server import ServiceDef
-from . import serving, training
+from . import pipeline, serving, training
 from .storage import TrainerStorage
 
 log = logging.getLogger("df.trainer.service")
 
 TRAINER_SERVICE = "df.trainer.Trainer"
+
+_fits_total = REGISTRY.counter(
+    "df_trainer_fits_total",
+    "training runs per model by outcome (fitted = a new version produced, "
+    "skipped = snapshot below the usable-row floor)", ("model", "result"))
+_fit_rows = REGISTRY.gauge(
+    "df_trainer_fit_rows",
+    "rows consumed by the most recent fit, per model", ("model",))
+_fit_seconds = REGISTRY.gauge(
+    "df_trainer_fit_seconds",
+    "wall time of the most recent fit, per model", ("model",))
 
 
 class TrainerService:
@@ -129,24 +141,36 @@ class TrainerService:
         falls back to the GNN's when only the GNN fit."""
         rows, topo_rows, cluster_id = snap
         async with self._fit_lock:
+            # the MLP fits through the pipeline's supervision policy:
+            # decision-outcome folds when the uploaded records carry
+            # joined rulings, raw piece rows otherwise
             mlp = gnn = None
             if self.train_in_thread:
                 if rows is not None:
-                    mlp = await asyncio.to_thread(training.train_mlp, rows)
+                    mlp = await asyncio.to_thread(
+                        pipeline.train_decision_model, rows)
                 if topo_rows is not None:
                     gnn = await asyncio.to_thread(training.train_gnn,
                                                   topo_rows)
             else:
                 # dflint: disable=DF001 — train_in_thread=False is the deterministic unit-test knob; production fits ride to_thread above
-                mlp = training.train_mlp(rows) if rows is not None else None
+                mlp = (pipeline.train_decision_model(rows)
+                       if rows is not None else None)
                 # dflint: disable=DF001 — see above: test-only direct-fit knob
                 gnn = (training.train_gnn(topo_rows)
                        if topo_rows is not None else None)
-            for name, fitted in ((training.MLP_MODEL_NAME, mlp),
-                                 (training.GNN_MODEL_NAME, gnn)):
+            for name, fitted, attempted in (
+                    (training.MLP_MODEL_NAME, mlp, rows is not None),
+                    (training.GNN_MODEL_NAME, gnn, topo_rows is not None)):
                 if fitted is None:
+                    if attempted:
+                        _fits_total.labels(name, "skipped").inc()
                     continue
                 blob, metrics = fitted
+                _fits_total.labels(name, "fitted").inc()
+                _fit_rows.labels(name).set(metrics.get("rows", 0))
+                _fit_seconds.labels(name).set(
+                    metrics.get("train_seconds", 0.0))
                 self.latest[name] = (blob, metrics)
                 self._infer_cache.pop(name, None)
                 await self._publish(name, blob, metrics, cluster_id)

@@ -42,15 +42,34 @@ def mlp_forward_np(params: dict, x: np.ndarray) -> np.ndarray:
 def make_mlp_infer(model_bytes: bytes) -> Infer:
     """Deserialize a ``bandwidth_mlp`` blob into ``infer(rows) -> scores``.
 
-    Raises ValueError on feature-schema mismatch — the scheduler must not
-    score with a model trained on a different layout.
+    Raises ValueError when the blob must be refused at bind time — the
+    scheduler must not score with it: undecodable bytes (garbage rollout),
+    a feature-schema mismatch (model trained on a different layout), or
+    non-finite weights (a diverged fit would NaN every ranking). The
+    refresh loop catches the refusal, keeps the current evaluator on its
+    heuristic floor, and remembers the refused version (same discipline as
+    ``make_gnn_impute``'s stale-schema gate).
     """
-    params, meta = params_io.deserialize_params(model_bytes)
+    try:
+        params, meta = params_io.deserialize_params(model_bytes)
+    except Exception as exc:  # noqa: BLE001 - np.load raises zoo-of-errors
+        raise ValueError(f"model blob undecodable: {exc}") from exc
     dim = int(meta.get("feature_dim", features.FEATURE_DIM))
     if dim != features.FEATURE_DIM:
         raise ValueError(
             f"model feature_dim {dim} != scheduler {features.FEATURE_DIM}")
     version = meta.get("version", params_io.version_of(model_bytes))
+    # bind-time probe: one forward pass over a zero row. A model whose
+    # weights went non-finite (NaN/Inf anywhere on the path) fails HERE,
+    # once, instead of on every scheduling tick
+    try:
+        probe = mlp_forward_np(params, np.zeros((1, dim), np.float32))
+    except Exception as exc:  # noqa: BLE001 - malformed layer shapes
+        raise ValueError(f"model forward pass broken: {exc}") from exc
+    if not np.all(np.isfinite(probe)):
+        raise ValueError(
+            f"model {version} emits non-finite scores — diverged fit "
+            "refused at bind time; the heuristic floor keeps ruling")
 
     def infer(rows: list[list[float]]) -> list[float]:
         x = np.asarray(rows, np.float32)

@@ -85,9 +85,9 @@ def train_mlp(rows: list[dict], *, epochs: int = 40, batch_size: int = 512,
         "model": MLP_MODEL_NAME,
         "rows": int(n),
         "epochs": epochs,
+        "seed": int(seed),
         "first_epoch_loss": first_loss,
         "final_loss": last_loss,
-        "train_seconds": time.monotonic() - t0,
         "feature_dim": features.FEATURE_DIM,
         "feature_names": list(features.PARENT_FEATURES),
         "schema_version": features.FEATURE_SCHEMA_VERSION,
@@ -95,7 +95,12 @@ def train_mlp(rows: list[dict], *, epochs: int = 40, batch_size: int = 512,
     }
     host_params = jax.tree_util.tree_map(np.asarray, params)
     data_bytes = serialize_params(host_params, metrics)
+    # version + wall clock ride in the RETURNED metrics only: the
+    # serialized meta must be a function of (rows, seed) alone so the
+    # same fit yields the same blob bytes — the rollout path dedupes on
+    # version and dfbench --pr19 gates refit-to-refit determinism on it
     metrics["version"] = version_of(data_bytes)
+    metrics["train_seconds"] = time.monotonic() - t0
     log.info("mlp fit: rows=%d loss %.4f -> %.4f (%.1fs, %d devices)",
              n, first_loss, last_loss, metrics["train_seconds"],
              metrics["devices"])
@@ -141,14 +146,17 @@ def train_gnn(topo_rows: list[dict], *, epochs: int = 60, lr: float = 1e-3,
         "node_features": list(features.NODE_FEATURES),
         "schema_version": features.FEATURE_SCHEMA_VERSION,
         "epochs": epochs,
+        "seed": int(seed),
         "first_epoch_loss": first_loss,
         "final_loss": last_loss,
-        "train_seconds": time.monotonic() - t0,
         "devices": len(jax.devices()),
     }
     host_params = jax.tree_util.tree_map(np.asarray, params)
     data_bytes = serialize_params(host_params, metrics)
+    # same determinism contract as train_mlp: wall clock stays out of
+    # the serialized meta so identical (rows, seed) → identical bytes
     metrics["version"] = version_of(data_bytes)
+    metrics["train_seconds"] = time.monotonic() - t0
     log.info("gnn fit: edges=%d loss %.4f -> %.4f (%.1fs)",
              metrics["edges"], first_loss, last_loss,
              metrics["train_seconds"])

@@ -34,6 +34,17 @@ class TestCommittedTrajectory:
         gated = [r for r in rows if r["digest_vs_pr3"] is True]
         assert len(gated) >= 10
 
+    def test_pr19_headline_carries_learned_gate(self):
+        # the learned-loop artifact rides the trajectory table with its
+        # acceptance number (regret vs heuristic) as the headline and
+        # the zero-digest-drift contract intact
+        rows = collect(REPO)
+        r19 = next(r for r in rows if r["pr"] == 19)
+        assert r19["bench"] == "dfbench-learned"
+        assert r19["digest_vs_pr3"] is True
+        assert "beats=True" in r19["headline"]
+        assert "regret" in r19["headline"]
+
     def test_headlines_resolved_not_question_marks(self):
         # '?' means an extractor no longer matches its artifact's schema
         rows = collect(REPO)

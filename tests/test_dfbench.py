@@ -1143,5 +1143,87 @@ class TestPr18FleetPulse:
         assert r["pulse_overhead_ok"] is True
 
 
+class TestPr19LearnedLoop:
+    """PR-19 point: the closed learning loop. A cold MLEvaluator and the
+    training-data tap must both be pure observers (baseline digest
+    unmoved), seeded training must be byte-deterministic blob-to-blob,
+    and the committed BENCH_pr19.json must carry the BENCH_pr3 digest
+    with the learned evaluator beating the heuristic on
+    observed-bandwidth regret."""
+
+    def test_disarmed_evaluator_and_outcome_tap_are_pure(self):
+        from dragonfly2_tpu.scheduler.evaluator_ml import MLEvaluator
+        base = run_bench(seed=7, daemons=6, pieces=24)
+        disarmed = run_bench(seed=7, daemons=6, pieces=24,
+                             evaluator=MLEvaluator(infer=None))
+        tapped = run_bench(seed=7, daemons=6, pieces=24,
+                           collect_outcomes=True)
+        assert disarmed["schedule_digest"] == base["schedule_digest"]
+        assert tapped["schedule_digest"] == base["schedule_digest"]
+        # the tap actually yields records.py-schema training rows
+        rows = tapped["outcomes"]
+        assert rows and all(r["kind"] == "piece" and len(r["features"]) == 7
+                            and 0.0 < r["label"] <= 1.0 for r in rows)
+
+    def test_datagen_rows_train_deterministically(self):
+        from dragonfly2_tpu.trainer.pipeline import train_decision_model
+        from dragonfly2_tpu.trainer.serving import make_mlp_infer
+        gen = run_bench(seed=7, daemons=6, pieces=24,
+                        collect_decisions=True, collect_outcomes=True)
+        rows = gen["decisions"] + gen["outcomes"]
+        a = train_decision_model(rows, seed=7, epochs=20, use_mesh=False)
+        b = train_decision_model(rows, seed=7, epochs=20, use_mesh=False)
+        assert a is not None and b is not None
+        assert a[0] == b[0]                       # byte-identical blobs
+        assert a[1]["version"] == b[1]["version"]
+        assert a[1]["supervision"] == "decision_outcomes"
+        # the blob is servable and the loop closes in-process
+        infer = make_mlp_infer(a[0])
+        assert infer.version == a[1]["version"]
+
+    def test_pr19_smoke_stdout_only_and_internally_gated(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "dragonfly2_tpu.tools.dfbench",
+             "--pr19", "--smoke", "--seed", "7"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=300,
+            env=ENV)
+        assert out.returncode == 0, out.stderr[-1500:]
+        r = json.loads(out.stdout)
+        assert r["bench"] == "dfbench-learned"
+        assert not list(tmp_path.iterdir())      # stdout only
+        # the gates that must hold at ANY scale, smoke included
+        assert r["ml_disarmed_pure"] is True
+        assert r["outcomes_pure"] is True
+        assert r["trained_deterministic"] is True
+        assert r["learned_deterministic"] is True
+        assert r["logged_choice_agreement"]["default"] == 1.0
+
+    def test_pr19_committed_matches_baselines(self):
+        """The committed trajectory gate: BENCH_pr19's baseline AND
+        learned-leg schedule digests are byte-identical to BENCH_pr3
+        (arming the learned evaluator perturbed nothing the offer-path
+        sim measures), training is deterministic blob-to-blob, the
+        heuristic replay reproduces every logged choice exactly, and the
+        learned evaluator beats the heuristic on observed-bandwidth
+        regret."""
+        r = json.loads(open(os.path.join(REPO, "BENCH_pr19.json")).read())
+        pr3 = json.loads(open(os.path.join(REPO, "BENCH_pr3.json")).read())
+        assert r["schedule_digest"] == pr3["schedule_digest"]
+        assert r["learned_schedule_digest"] == pr3["schedule_digest"]
+        assert r["ml_disarmed_pure"] is True
+        assert r["outcomes_pure"] is True
+        assert r["trained_deterministic"] is True
+        assert r["learned_deterministic"] is True
+        assert r["logged_choice_agreement"]["default"] == 1.0
+        assert r["learned_beats_heuristic"] is True
+        assert r["regret"]["learned"] < r["regret"]["heuristic"]
+        assert r["best_pick_rate"]["learned"] > \
+            r["best_pick_rate"]["heuristic"]
+        assert r["model"]["supervision"] == "decision_outcomes"
+        assert r["model"]["schema_version"] == 2
+        assert r["decisions_judged"] >= 16
+        assert r["learned_decision_digest"]
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-v"])

@@ -905,5 +905,228 @@ class TestQosChaos:
         asyncio.run(go())
 
 
+# ----------------------------------------------------------------------
+# model-rollout chaos: garbage / NaN / stale-schema blobs mid-swarm must
+# never take the pod below the heuristic floor
+# ----------------------------------------------------------------------
+
+class _ModelRegistry:
+    """Manager-registry stand-in: serves whatever ModelEntity the test
+    plants, honours ``if_none_match`` the way the real registry does (a
+    matching version returns no blob)."""
+
+    def __init__(self):
+        self.models: dict = {}
+        self.fetches: list = []
+
+    async def get_model(self, req):
+        from dragonfly2_tpu.idl.messages import GetModelResponse
+        self.fetches.append((req.name, req.if_none_match))
+        m = self.models.get(req.name)
+        if m is None or m.version == req.if_none_match:
+            return GetModelResponse(model=None)
+        return GetModelResponse(model=m)
+
+    async def close(self):
+        pass          # Scheduler.stop() closes its manager link
+
+
+def _mk_host(hid, slice_name="slice-0", coords=(0, 0)):
+    from dragonfly2_tpu.idl.messages import Host, HostType, TopologyInfo
+    return Host(id=hid, ip="127.0.0.1", port=1, download_port=2,
+                type=HostType.NORMAL,
+                topology=TopologyInfo(slice_name=slice_name, worker_index=0,
+                                      ici_coords=coords, num_chips=4,
+                                      zone="z-a"))
+
+
+class TestModelRolloutChaos:
+    """Satellite: a poisoned model rollout mid-swarm. Every bad blob —
+    garbage bytes, NaN weights, stale feature schema — is refused at
+    bind time (journaled, counted, never refetched), a model that goes
+    non-finite at SERVE time degrades per-ruling to the heuristic floor
+    (``df_ml_fallback_total`` counts it), and dfdiag names the degraded
+    evaluator. The pod never rules below the heuristic floor."""
+
+    def test_bad_blob_ladder_refused_then_good_model_recovers(self):
+        import numpy as np
+
+        from dragonfly2_tpu.common.metrics import REGISTRY
+        from dragonfly2_tpu.idl.messages import ModelEntity
+        from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+        from dragonfly2_tpu.scheduler.announcer import SchedulerAnnouncer
+        from dragonfly2_tpu.scheduler.evaluator_ml import MLEvaluator
+        from dragonfly2_tpu.trainer import features, params_io, training
+
+        refused = REGISTRY.counter("df_ml_model_refused_total", "",
+                                   ("model",))
+        rollouts = REGISTRY.counter("df_ml_model_rollouts_total", "",
+                                    ("model",))
+        name = features.MLP_MODEL_NAME
+
+        def nan_blob():
+            import jax
+            from dragonfly2_tpu.trainer import models
+            host = jax.tree_util.tree_map(
+                np.asarray, models.init_mlp(jax.random.PRNGKey(0)))
+            host["layers"][0]["w"] = np.full_like(
+                host["layers"][0]["w"], np.nan)
+            return params_io.serialize_params(
+                host, {"feature_dim": features.FEATURE_DIM,
+                       "version": "nanfit01"})
+
+        def stale_blob():
+            import jax
+            from dragonfly2_tpu.trainer import models
+            host = jax.tree_util.tree_map(
+                np.asarray, models.init_mlp(jax.random.PRNGKey(0)))
+            return params_io.serialize_params(
+                host, {"feature_dim": 5, "version": "stale001"})
+
+        async def go():
+            sched = Scheduler(SchedulerConfig(listen_ip="127.0.0.1",
+                                              algorithm="ml"))
+            await sched.start()
+            try:
+                reg = _ModelRegistry()
+                sched.manager = reg
+                ann = SchedulerAnnouncer(sched)
+                ev = sched.scheduling.evaluator
+                assert isinstance(ev, MLEvaluator) and ev.infer is None
+                base_refused = refused.value(name)
+                base_rollouts = rollouts.value(name)
+
+                ladder = [
+                    ("garbage01", b"\x00this is not an npz archive",
+                     "undecodable"),
+                    ("nanfit01", nan_blob(), "non-finite"),
+                    ("stale001", stale_blob(), "feature_dim"),
+                ]
+                for version, data, why in ladder:
+                    reg.models[name] = ModelEntity(
+                        name=name, version=version, data=data)
+                    assert await ann.refresh_model_once() is False
+                    # the floor holds: nothing bound, heuristic rules
+                    assert ev.infer is None
+                    assert why in ann.refused[version], (version,
+                                                         ann.refused)
+                    # the refusal is COUNTED, once — the cursor advanced,
+                    # so the next cycle must not refetch + recount
+                    assert await ann.refresh_model_once() is False
+                    assert refused.value(name) == base_refused + 1
+                    base_refused += 1
+
+                # rollout provenance journals the whole ladder for
+                # /debug/ctrl, and dfdiag names every refused version
+                # while the pod is still ruling on the heuristic floor
+                from dragonfly2_tpu.common import phasetimer
+                from dragonfly2_tpu.tools.dfdiag import render_ctrl
+                snap = phasetimer.snapshot()
+                snap["model"] = ann.model_provenance()
+                text = render_ctrl(snap)
+                assert "heuristic floor" in text
+                for version, _, _ in ladder:
+                    assert f"refused {version}" in text
+
+                # the loop recovers: the trainer's next GOOD fit binds
+                rows = [{"features": [0.1 * i] + [0.5]
+                         * (features.FEATURE_DIM - 1),
+                         "label": 0.1 + 0.08 * i} for i in range(10)]
+                blob, metrics = training.train_mlp(rows, epochs=5,
+                                                   use_mesh=False)
+                reg.models[name] = ModelEntity(
+                    name=name, version=metrics["version"], data=blob,
+                    metrics=metrics)
+                assert await ann.refresh_model_once() is True
+                assert ev.infer is not None
+                assert ev.infer.version == metrics["version"]
+                assert rollouts.value(name) == base_rollouts + 1
+                prov = ann.model_provenance()
+                assert prov["evaluator"]["version"] == metrics["version"]
+                assert set(prov["refused"]) == {"garbage01", "nanfit01",
+                                                "stale001"}
+                text = render_ctrl({**phasetimer.snapshot(),
+                                    "model": prov})
+                assert f"serving bandwidth_mlp@{metrics['version']}" \
+                    in text
+            finally:
+                await sched.stop()
+
+        run(go())
+
+    def test_serve_time_nan_degrades_to_heuristic_floor(self):
+        from dragonfly2_tpu.common.metrics import REGISTRY
+        from dragonfly2_tpu.scheduler import Scheduler, SchedulerConfig
+        from dragonfly2_tpu.scheduler.announcer import SchedulerAnnouncer
+        from dragonfly2_tpu.scheduler.evaluator import Evaluator
+        from dragonfly2_tpu.scheduler.evaluator_ml import MLEvaluator
+        from dragonfly2_tpu.scheduler.resource import PeerState
+
+        fallback = REGISTRY.counter("df_ml_fallback_total", "",
+                                    ("reason",))
+
+        class _DivergedInfer:
+            """A model that binds fine but goes NaN on live rows — the
+            bind-time probe can't catch a fit that only diverges off the
+            zero row."""
+
+            version = "diverged1"
+
+            def __call__(self, rows):
+                return [float("nan")] * len(rows)
+
+        async def go():
+            sched = Scheduler(SchedulerConfig(listen_ip="127.0.0.1",
+                                              algorithm="ml"))
+            await sched.start()
+            try:
+                res = sched.resource
+                task = res.get_or_create_task("t" * 64, "http://o/b")
+                task.set_content_info(8 * (4 << 20), 4 << 20, 8)
+                child = res.get_or_create_peer(
+                    "p-child" * 8, task, res.store_host(_mk_host("h-c")))
+                parent = res.get_or_create_peer(
+                    "p-ici" * 8, task,
+                    res.store_host(_mk_host("h-p", coords=(0, 1))))
+                for p in (child, parent):
+                    p.transit(PeerState.RUNNING)
+                parent.finished_pieces.update(range(8))
+
+                ev = sched.scheduling.evaluator
+                assert isinstance(ev, MLEvaluator)
+                ev.infer = _DivergedInfer()
+                total = task.total_piece_count
+                before = fallback.value("non_finite")
+                floor = Evaluator().evaluate(child, parent,
+                                             total_piece_count=total)
+                # the ruling lands EXACTLY on the heuristic floor
+                assert ev.evaluate(child, parent,
+                                   total_piece_count=total) == \
+                    pytest.approx(floor)
+                assert fallback.value("non_finite") == before + 1
+                health = ev.health()
+                assert health["degraded"] is True
+                assert health["last_fallback_reason"].startswith(
+                    "non_finite")
+                # explain() reports the un-substituted heuristic total:
+                # no "total<-ml" mark, because ml did NOT rule
+                exp = ev.explain(child, parent, total_piece_count=total)
+                assert "total" not in (exp.get("substituted") or {})
+                assert exp["total"] == pytest.approx(floor)
+
+                # dfdiag names the degraded evaluator
+                from dragonfly2_tpu.common import phasetimer
+                from dragonfly2_tpu.tools.dfdiag import render_ctrl
+                ann = SchedulerAnnouncer(sched)
+                text = render_ctrl({**phasetimer.snapshot(),
+                                    "model": ann.model_provenance()})
+                assert "DEGRADED evaluator" in text
+                assert "non_finite" in text
+            finally:
+                await sched.stop()
+
+        run(go())
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-v"])

@@ -9,6 +9,7 @@ parent that historically delivered fast pieces.
 """
 
 import asyncio
+import json
 import time
 
 import numpy as np
@@ -246,6 +247,154 @@ def test_records_requeue_on_trainer_outage(tmp_path):
             await sched.stop()
 
     run(main())
+
+
+# ------------------------------------------------- decision-outcome folds
+
+def _decision_row(did, *, v1=False, cands=("pa", "pb"), locality=(0.9, 0.4)):
+    """A ledger decision row. ``v1=True`` drops the federation metadata
+    (no ``link_tier`` on candidates, no ``federation`` block) — the exact
+    shape pre-federation schedulers logged and BENCH_pr8 committed."""
+    row = {"kind": "decision", "decision_id": did, "decision_kind": "find",
+           "task_id": "t1", "peer_id": "c1", "host_id": "h-c1",
+           "candidates": [], "chosen": [cands[0]]}
+    for i, p in enumerate(cands):
+        cand = {"peer_id": p, "host_id": f"h-{p}", "rank": i + 1,
+                "total": 0.9 - 0.1 * i,
+                "features": [1.0, 1.0, 1.0, 0.5, locality[i], 4.0, 0.0]}
+        if not v1:
+            cand["link_tier"] = "ici" if i == 0 else "dcn"
+        row["candidates"].append(cand)
+    if not v1:
+        row["federation"] = {"pod": "pod-a"}
+    return row
+
+
+def _piece_row(did, parent, label):
+    return {"kind": "piece", "task_id": "t1", "peer_id": "c1",
+            "decision_id": did, "parent_peer_id": parent,
+            "piece_length": 4 << 20, "cost_ms": 10.0, "label": label}
+
+
+class TestDecisionOutcomeRows:
+    """Satellite: v1 and v2 record rows MIX in one training snapshot — a
+    fleet mid-upgrade uploads both, and the fold must parse either
+    without crashing the trainer."""
+
+    def test_v2_rows_fold_with_federation_metadata(self):
+        rows = [_decision_row("d1"),
+                _piece_row("d1", "pa", 0.8), _piece_row("d1", "pa", 0.6)]
+        folds = features.decision_outcome_rows(rows)
+        assert len(folds) == 1
+        f = folds[0]
+        assert f["parent_peer_id"] == "pa"
+        assert f["label"] == pytest.approx(0.7)     # mean over pieces
+        assert f["pieces"] == 2 and f["rank"] == 1
+        assert f["link_tier"] == "ici" and f["pod"] == "pod-a"
+
+    def test_v1_rows_parse_with_defaults(self):
+        rows = [_decision_row("d1", v1=True), _piece_row("d1", "pb", 0.5)]
+        folds = features.decision_outcome_rows(rows)
+        assert len(folds) == 1
+        assert folds[0]["link_tier"] == "" and folds[0]["pod"] == ""
+
+    def test_mixed_fleet_upgrade_trains(self):
+        """The teeth: a v1+v2 mixed snapshot folds cleanly AND fits —
+        mid-upgrade the trainer must keep producing models, not crash on
+        the first old-schema row."""
+        from dragonfly2_tpu.trainer import pipeline
+        rows = []
+        for i in range(6):
+            v1 = i % 2 == 1
+            did = f"d{i}"
+            rows.append(_decision_row(did, v1=v1))
+            rows.append(_piece_row(did, "pa", 0.9 - 0.02 * i))
+            rows.append(_piece_row(did, "pb", 0.3 + 0.02 * i))
+        folds = features.decision_outcome_rows(rows)
+        assert len(folds) == 12               # 6 decisions x 2 parents
+        assert {f["pod"] for f in folds} == {"", "pod-a"}
+        fitted = pipeline.train_decision_model(rows, seed=1, epochs=10,
+                                               use_mesh=False)
+        assert fitted is not None
+        assert fitted[1]["supervision"] == "decision_outcomes"
+        assert fitted[1]["rows"] == 12
+
+    def test_wrong_feature_dim_fold_skipped(self):
+        d = _decision_row("d1")
+        d["candidates"][0]["features"] = [1.0, 2.0]       # stale layout
+        rows = [d, _piece_row("d1", "pa", 0.8),
+                _piece_row("d1", "pb", 0.4)]
+        folds = features.decision_outcome_rows(rows)
+        assert [f["parent_peer_id"] for f in folds] == ["pb"]
+
+
+class TestPipeline:
+    """Satellite: the offline pipeline — scheduler records JSONL in,
+    versioned deterministic blob out."""
+
+    def _rows(self, n=8):
+        rows = []
+        for i in range(n):
+            did = f"d{i}"
+            rows.append(_decision_row(did, v1=i % 2 == 1))
+            rows.append(_piece_row(did, "pa", 0.85 - 0.01 * i))
+            rows.append(_piece_row(did, "pb", 0.35 + 0.01 * i))
+        return rows
+
+    def test_records_dir_rotated_half_first_and_torn_tail(self, tmp_path):
+        from dragonfly2_tpu.trainer import pipeline
+        d = tmp_path / "records"
+        d.mkdir()
+        (d / "download.jsonl.1").write_text(
+            json.dumps(_decision_row("d1")) + "\n")
+        (d / "download.jsonl").write_text(
+            json.dumps(_piece_row("d1", "pa", 0.7)) + "\n"
+            + '{"kind": "piece", "torn')          # live-file torn tail
+        rows = pipeline.load_records_jsonl(str(d))
+        assert [r["kind"] for r in rows] == ["decision", "piece"]
+
+    def test_seeded_fit_is_byte_deterministic(self):
+        from dragonfly2_tpu.trainer import pipeline
+        rows = self._rows()
+        a = pipeline.train_decision_model(rows, seed=3, epochs=12,
+                                          use_mesh=False)
+        b = pipeline.train_decision_model(rows, seed=3, epochs=12,
+                                          use_mesh=False)
+        assert a is not None and b is not None
+        # the rollout-dedupe contract: same rows + same seed -> same
+        # BYTES -> same version hash; wall clock must not leak into blob
+        assert a[0] == b[0]
+        assert a[1]["version"] == b[1]["version"]
+        c = pipeline.train_decision_model(rows, seed=4, epochs=12,
+                                          use_mesh=False)
+        assert c is not None and c[1]["version"] != a[1]["version"]
+
+    def test_supervision_falls_back_to_piece_rows(self):
+        from dragonfly2_tpu.trainer import pipeline
+        rows = [{"features": [0.1 * i] + [0.5] * (features.FEATURE_DIM - 1),
+                 "label": 0.1 + 0.08 * i} for i in range(10)]
+        fitted = pipeline.train_decision_model(rows, seed=0, epochs=5,
+                                               use_mesh=False)
+        assert fitted is not None
+        assert fitted[1]["supervision"] == "piece_rows"
+
+    def test_cli_fit_writes_servable_blob(self, tmp_path, capsys):
+        from dragonfly2_tpu.trainer import pipeline
+        rec = tmp_path / "download.jsonl"
+        rec.write_text("\n".join(json.dumps(r) for r in self._rows()))
+        out = tmp_path / "mlp.npz"
+        rc = pipeline.main(["--records", str(rec), "--out", str(out),
+                            "--epochs", "10", "--json"])
+        assert rc == 0
+        metrics = json.loads(capsys.readouterr().out)
+        assert metrics["supervision"] == "decision_outcomes"
+        infer = serving.make_mlp_infer(out.read_bytes())
+        assert infer.version == metrics["version"]
+
+    def test_cli_missing_records_is_exit_1(self, capsys):
+        from dragonfly2_tpu.trainer import pipeline
+        assert pipeline.main(["--records", "/nonexistent/x.jsonl"]) == 1
+        assert "pipeline:" in capsys.readouterr().err
 
 
 class TestGNNImputation:
